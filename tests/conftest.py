@@ -1,0 +1,21 @@
+"""Test environment: force an 8-device virtual CPU mesh before jax imports.
+
+Benchmarks run on the real TPU separately (bench.py); tests exercise the
+multi-device sharded paths on virtual CPU devices.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+prev = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (prev + " --xla_force_host_platform_device_count=8").strip()
+
+import pathlib
+import sys
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+DATA_DIR = pathlib.Path(__file__).resolve().parent / "data"
