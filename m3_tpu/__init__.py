@@ -1,0 +1,20 @@
+"""m3-tpu: a TPU-native time-series metrics platform.
+
+A from-scratch redesign of the capabilities of M3 (distributed TSDB,
+streaming aggregator, PromQL-compatible query engine) around JAX/XLA:
+ingest hot paths (M3TSZ block compression, rollup/quantile pipelines,
+temporal query functions) run as batched array programs over
+(series x time) tensors on TPU, with a thin host control plane for
+sharding, durability and cluster coordination.
+
+This framework requires 64-bit JAX types throughout: timestamps are
+int64 UnixNanos and the M3TSZ wire format is defined over float64 bit
+patterns.  Enabling x64 here — at the framework root, as a documented
+contract — is deliberate; every m3_tpu entry point depends on it.
+"""
+
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

@@ -1,0 +1,183 @@
+"""Exact IEEE-754 float64 semantics as pure integer (uint64) array ops.
+
+TPUs have no float64 ALU, but M3TSZ bit-exactness requires the precise
+rounding behavior of the reference's float arithmetic in
+``convertToIntFloat`` (``src/dbnode/encoding/m3tsz/m3tsz.go:78-118``):
+a single-rounded multiply by 10^k, a chain of single-rounded multiplies
+by 10, Modf integer/fraction splits, and Nextafter ulp steps.  This module
+implements those operations directly on the float64 *bit patterns* as
+jax uint64 ops — deterministic and bit-exact on any backend (CPU test
+mesh or TPU, where XLA lowers 64-bit integer ops to 32-bit pairs).
+
+All functions operate elementwise on arrays of uint64 bit patterns.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+MASK52 = (1 << 52) - 1
+MASK63 = (1 << 63) - 1
+IMPLICIT = 1 << 52
+U64 = jnp.uint64
+I64 = jnp.int64
+
+POW10_U64 = tuple(10**k for k in range(7))
+
+
+def _u(x) -> jax.Array:
+    return jnp.asarray(x, dtype=U64)
+
+
+def split(bits):
+    """(sign, biased_exponent, fraction) fields."""
+    bits = _u(bits)
+    sign = bits >> _u(63)
+    exp = (bits >> _u(52)) & _u(0x7FF)
+    frac = bits & _u(MASK52)
+    return sign, exp, frac
+
+
+def is_nan(bits):
+    _, exp, frac = split(bits)
+    return (exp == _u(0x7FF)) & (frac != _u(0))
+
+
+def abs_bits(bits):
+    return _u(bits) & _u(MASK63)
+
+
+def neg_bits(bits):
+    return _u(bits) ^ _u(1 << 63)
+
+
+def msb_index(v):
+    """Index of the most significant set bit of a uint64 (v must be > 0)."""
+    v = _u(v)
+    # lax.clz on uint64
+    return _u(63) - jnp.asarray(jax.lax.clz(v.astype(I64)).astype(U64))
+
+
+def _mantissa_and_exp2(bits):
+    """value = mantissa * 2^exp2 exactly, for positive finite bits.
+
+    Normals: mantissa has the implicit bit set (53 bits); subnormals use the
+    raw fraction.  Zero yields mantissa 0.
+    """
+    _, exp, frac = split(bits)
+    is_sub = exp == _u(0)
+    mant = jnp.where(is_sub, frac, frac | _u(IMPLICIT))
+    exp2 = jnp.where(is_sub, jnp.int64(-1074), exp.astype(I64) - jnp.int64(1075))
+    return mant, exp2
+
+
+def _round_shift_right_even(m, k):
+    """Round-to-nearest-even right shift of uint64 m by k (0 <= k <= 63)."""
+    m = _u(m)
+    k = _u(k)
+    q = m >> k
+    rem = m & ((_u(1) << k) - _u(1))
+    half = jnp.where(k > _u(0), _u(1) << (k - _u(1)), _u(0))
+    round_up = (rem > half) | ((rem == half) & ((q & _u(1)) == _u(1)))
+    return jnp.where(k > _u(0), q + round_up.astype(U64), m)
+
+
+def _pack(mant, exp2):
+    """Pack (mantissa m, exp2) with value = m * 2^exp2 (m < 2^64, m > 0)
+    into positive float64 bits with round-to-nearest-even."""
+    mant = _u(mant)
+    L = msb_index(jnp.maximum(mant, _u(1))).astype(I64)
+    # Normalized target: 53-bit mantissa, biased exponent.
+    shift = L - jnp.int64(52)
+    eb = exp2 + shift + jnp.int64(1075)
+    # Subnormal: clamp biased exponent at 0 and shift further right.
+    extra = jnp.where(eb < jnp.int64(1), jnp.int64(1) - eb, jnp.int64(0))
+    # Avoid shifting everything out (total > 63 -> result 0).
+    total_r = jnp.clip(shift + extra, None, jnp.int64(63))
+    eb = jnp.where(eb < jnp.int64(1), jnp.int64(0), eb)
+
+    left = jnp.clip(-total_r, jnp.int64(0), jnp.int64(63)).astype(U64)
+    right = jnp.clip(total_r, jnp.int64(0), jnp.int64(63)).astype(U64)
+    m = jnp.where(total_r >= jnp.int64(0),
+                  _round_shift_right_even(mant, right),
+                  mant << left)
+    # Rounding may carry to 2^53 (normal) -> shift one more.
+    carried = m >= _u(1 << 53)
+    m = jnp.where(carried, m >> _u(1), m)
+    eb = jnp.where(carried, eb + jnp.int64(1), eb)
+    # Subnormal carry to 2^52 encodes exp=1 automatically (m == IMPLICIT).
+    is_norm = m >= _u(IMPLICIT)
+    bits = jnp.where(
+        is_norm & (eb >= jnp.int64(1)),
+        (eb.astype(U64) << _u(52)) | (m & _u(MASK52)),
+        m,  # subnormal (eb forced 0) or the carry-to-normal m == 2^52 case
+    )
+    return jnp.where(mant == _u(0), _u(0), bits)
+
+
+def mul10(bits):
+    """Exact IEEE float64 multiply by 10.0 of positive finite bits."""
+    mant, exp2 = _mantissa_and_exp2(bits)
+    return _pack(mant * _u(10), exp2)
+
+
+def mul_pow10(bits, k):
+    """Exact IEEE float64 multiply of positive finite ``bits`` by 10^k, k in [0, 6].
+
+    The 53-bit x 20-bit product can reach 73 bits, so compute it in two
+    uint64 halves before rounding.
+    """
+    mant, exp2 = _mantissa_and_exp2(bits)
+    p10 = jnp.asarray(jnp.array(POW10_U64, dtype=U64))[k]
+    lo32 = mant & _u(0xFFFFFFFF)
+    hi32 = mant >> _u(32)
+    p_lo = lo32 * p10
+    p_hi = hi32 * p10  # < 2^41; full product = (p_hi << 32) + p_lo
+    lo = (p_lo + ((p_hi & _u(0xFFFFFFFF)) << _u(32)))
+    carry = jnp.where(lo < p_lo, _u(1), _u(0))
+    hi = (p_hi >> _u(32)) + carry  # < 2^9
+    # Reduce the 128-bit (hi, lo) product to <= 64 bits with sticky rounding:
+    # shift right by s so msb < 64, tracking dropped bits for round-to-even.
+    nz_hi = hi != _u(0)
+    s = jnp.where(nz_hi, msb_index(jnp.maximum(hi, _u(1))) + _u(1), _u(0))
+    # merged = (hi:lo) >> s, plus sticky bit if any dropped bit set
+    dropped = jnp.where(s > _u(0), lo & ((_u(1) << s) - _u(1)), _u(0))
+    lshift = jnp.where(s > _u(0), _u(64) - s, _u(0))  # avoid shift-by-64
+    merged = jnp.where(nz_hi, (lo >> s) | (hi << lshift), lo)
+    # Fold sticky dropped bits into the low bit region by ORing a sticky flag:
+    # we must preserve "rem vs half" comparisons; since s <= 9 and the final
+    # rounding shift in _pack is >= s bits more, it suffices to OR sticky into
+    # the lowest bit of merged.
+    sticky = (dropped != _u(0)).astype(U64)
+    merged = merged | sticky
+    return _pack(merged, exp2 + s.astype(I64))
+
+
+def floor_parts(bits):
+    """For positive finite bits: (floor as uint64, frac_is_zero bool).
+
+    Only valid when floor(value) < 2^63.
+    """
+    _, exp, _ = split(bits)
+    mant, _ = _mantissa_and_exp2(bits)
+    e = exp.astype(I64) - jnp.int64(1023)  # unbiased exponent
+    lt_one = e < jnp.int64(0)
+    big = e >= jnp.int64(52)
+    shift_r = jnp.clip(jnp.int64(52) - e, jnp.int64(0), jnp.int64(63)).astype(U64)
+    shift_l = jnp.clip(e - jnp.int64(52), jnp.int64(0), jnp.int64(63)).astype(U64)
+    ipart = jnp.where(lt_one, _u(0), jnp.where(big, mant << shift_l, mant >> shift_r))
+    frac_bits = jnp.where(lt_one | big, _u(0), mant & ((_u(1) << shift_r) - _u(1)))
+    frac_zero = jnp.where(lt_one, bits == _u(0), frac_bits == _u(0))
+    return ipart, frac_zero
+
+
+def uint_to_f64_bits(i):
+    """Positive integer (< 2^53) to float64 bits, exact."""
+    i = _u(i)
+    L = msb_index(jnp.maximum(i, _u(1)))
+    shift = _u(52) - L
+    mant = i << shift
+    eb = _u(1075 - 52) + L  # = 1023 + L
+    bits = (eb << _u(52)) | (mant & _u(MASK52))
+    return jnp.where(i == _u(0), _u(0), bits)

@@ -1,0 +1,893 @@
+"""Batched M3TSZ encode/decode as JAX array programs.
+
+The reference codec is an inherently sequential per-series bit-stream
+state machine (``src/dbnode/encoding/m3tsz/encoder.go``,
+``iterator.go``).  The TPU-native formulation:
+
+* **Encode** — ``lax.scan`` over timesteps carrying the codec state
+  (timestamp delta, XOR window, sig-bit tracker), ``vmap``'d across the
+  series axis.  Each step emits a fixed-width staging buffer (4 x uint64
+  words + bit length); a cumulative-sum over lengths then assigns every
+  datapoint its bit offset and a scatter-add packs the payload words into
+  the output stream (disjoint bit ranges make add equivalent to or).
+* **Decode** — ``lax.scan`` over datapoint slots, ``vmap``'d across
+  series, with a dynamic bit-cursor per series; bit reads are two-word
+  gathers plus shifts.  100K series decode in parallel — the batched
+  ReaderIterator configuration from BASELINE.json.
+* All float64 arithmetic demanded by the format (int-optimization
+  classification, ``m3tsz.go:78-118``) runs as exact integer emulation
+  (``f64_emul.py``), so results are bit-identical on TPU, which has no
+  float64 ALU.
+
+Series that would exercise the reference's float64 *rounding* behavior on
+values above 2^53, or that carry annotations, are flagged in the returned
+``fallback`` mask; callers re-run those through the scalar host codec
+(``m3tsz.py``).  This mirrors the host/device split the framework uses
+throughout: the device owns the dense numeric 99.99%, the host owns the
+long tail.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import m3_tpu  # noqa: F401  (enables x64 at the framework root)
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from m3_tpu.core.xtime import Unit
+from m3_tpu.encoding import f64_emul as fe
+from m3_tpu.encoding.scheme import tail_bytes
+
+U64 = jnp.uint64
+I64 = jnp.int64
+I32 = jnp.int32
+MASK64 = (1 << 64) - 1
+
+STAGE_WORDS = 4  # 256 bits of staging per datapoint (worst case ~227)
+
+# time-unit byte -> nanos (0 = invalid/None)
+_UNIT_NANOS = np.zeros(16, dtype=np.int64)
+for _u_ in Unit:
+    _UNIT_NANOS[int(_u_)] = _u_.nanos()
+
+_BITS_1E13 = np.frombuffer(np.float64(10.0**13).tobytes(), dtype=np.uint64)[0]
+_BITS_2_63 = np.frombuffer(np.float64(2.0**63).tobytes(), dtype=np.uint64)[0]
+_I64_MIN = -(2**63)
+_PRECISION_LIMIT = 1 << 53  # beyond this the reference's f64 math rounds
+
+
+def _c(x, dtype=U64):
+    return jnp.asarray(x, dtype=dtype)
+
+
+def _shl(v, s):
+    """uint64 << s with s possibly >= 64 (yields 0)."""
+    s = _c(s)
+    return jnp.where(s >= _c(64), _c(0), v << jnp.minimum(s, _c(63)))
+
+
+def _shr(v, s):
+    s = _c(s)
+    return jnp.where(s >= _c(64), _c(0), v >> jnp.minimum(s, _c(63)))
+
+
+def _num_sig(v):
+    """Number of significant bits of uint64 (0 for 0)."""
+    return jnp.where(
+        v == _c(0), _c(0, I32),
+        (_c(64, I32) - lax.clz(v.astype(I64)).astype(I32)))
+
+
+def _sign_extend(v, nbits):
+    """Sign-extend the low ``nbits`` of uint64 v to int64 (nbits >= 1)."""
+    shift = _c(64) - _c(nbits)
+    return (_shl(v, shift)).astype(I64) >> jnp.minimum(shift, _c(63)).astype(I64)
+
+
+# ---------------------------------------------------------------------------
+# Value classification: exact convertToIntFloat (m3tsz.go:78-118)
+# ---------------------------------------------------------------------------
+
+
+def classify_value(v_bits, cur_mult):
+    """Returns (val int64 scaled, mult int32, is_float bool, precision_flag bool).
+
+    ``precision_flag`` marks values whose downstream encoding would hit
+    float64 rounding in the reference (|val| > 2^53): callers must fall
+    back to the scalar codec for those series.
+    """
+    v_bits = _c(v_bits)
+    sign = (v_bits >> _c(63)) != _c(0)
+    abs_b = v_bits & _c(fe.MASK63)
+    _, exp, _ = fe.split(abs_b)
+    special = exp == _c(0x7FF)  # NaN / Inf never take the int paths
+
+    # Quick path: already integral and v < 2^63 (float compare).
+    ipart0, frac_zero0 = fe.floor_parts(abs_b)
+    v_lt_maxint = sign | (abs_b < _c(_BITS_2_63))
+    quick_ok = (cur_mult == _c(0, I32)) & v_lt_maxint & frac_zero0 & ~special
+    # Go's uint64(int64(v)) saturation for out-of-range magnitudes.
+    sat = abs_b >= _c(_BITS_2_63)
+    quick_mag = jnp.where(sat, _c(_I64_MIN, I64), ipart0.astype(I64))
+    quick_val = jnp.where(sign & ~sat, -quick_mag, quick_mag)
+
+    # Multiplier loop: val = v * 10^cur, then *10 per iteration, looking for
+    # a value within 1 ulp of an integer (see scalar codec for the ulp
+    # reduction of the Modf/Nextafter conditions).
+    val_bits = fe.mul_pow10(abs_b, cur_mult)
+    found = jnp.zeros_like(sign)
+    res_i = jnp.zeros_like(abs_b)
+    res_mult = jnp.zeros_like(cur_mult)
+    for k in range(7):
+        active = (~quick_ok) & (~found) & (_c(k, I32) >= cur_mult) & (
+            val_bits < _c(_BITS_1E13)) & ~special
+        ip, fz = fe.floor_parts(val_bits)
+        bi = fe.uint_to_f64_bits(ip)
+        bi1 = fe.uint_to_f64_bits(ip + _c(1))
+        take_i = fz | (val_bits <= bi + _c(1))
+        take_i1 = (~take_i) & (val_bits + _c(1) >= bi1)
+        hit = active & (take_i | take_i1)
+        chosen = jnp.where(take_i, ip, ip + _c(1))
+        res_i = jnp.where(hit, chosen, res_i)
+        res_mult = jnp.where(hit, _c(k, I32), res_mult)
+        found = found | hit
+        advance = active & ~hit
+        val_bits = jnp.where(advance, fe.mul10(val_bits), val_bits)
+
+    loop_val = jnp.where(sign, -(res_i.astype(I64)), res_i.astype(I64))
+
+    is_float = ~quick_ok & ~found
+    val = jnp.where(quick_ok, quick_val, jnp.where(found, loop_val, _c(0, I64)))
+    mult = jnp.where(found & ~quick_ok, res_mult, _c(0, I32))
+    # Signed compares (not jnp.abs) so INT64_MIN saturations are caught too.
+    precision_flag = ~is_float & ((val > _c(_PRECISION_LIMIT, I64)) |
+                                  (val < _c(-_PRECISION_LIMIT, I64)))
+    return val, mult, is_float, precision_flag
+
+
+# ---------------------------------------------------------------------------
+# Bit builder: append fields into 4x uint64 staging words
+# ---------------------------------------------------------------------------
+
+
+def _bb_new():
+    return (jnp.zeros((), U64), jnp.zeros((), U64), jnp.zeros((), U64),
+            jnp.zeros((), U64), jnp.zeros((), I32))
+
+
+def _bb_append(bb, value, nbits, enable=None):
+    """Append the low ``nbits`` of value. nbits may be a traced int32; when
+    ``enable`` is False (or nbits == 0) this is a no-op."""
+    w0, w1, w2, w3, ln = bb
+    nbits = _c(nbits, I32)
+    if enable is not None:
+        nbits = jnp.where(enable, nbits, _c(0, I32))
+    value = _c(value) & jnp.where(nbits >= _c(64, I32), _c(MASK64),
+                                  (_shl(_c(1), nbits.astype(U64)) - _c(1)))
+    pos = ln.astype(U64)
+    n = nbits.astype(U64)
+    off = pos & _c(63)
+    widx = (pos >> _c(6)).astype(I32)
+    in_first = jnp.minimum(n, _c(64) - off)
+    rest = n - in_first
+    first_chunk = _shl(_shr(value, rest), _c(64) - off - in_first)
+    second_chunk = _shl(value & (_shl(_c(1), rest) - _c(1)), _c(64) - rest)
+    nonzero = nbits > _c(0, I32)
+    first_chunk = jnp.where(nonzero, first_chunk, _c(0))
+    second_chunk = jnp.where(nonzero & (rest > _c(0)), second_chunk, _c(0))
+    ws = [w0, w1, w2, w3]
+    out = []
+    for j in range(STAGE_WORDS):
+        wj = ws[j]
+        wj = wj | jnp.where(widx == j, first_chunk, _c(0))
+        wj = wj | jnp.where(widx == j - 1, second_chunk, _c(0))
+        out.append(wj)
+    return (out[0], out[1], out[2], out[3], ln + nbits)
+
+
+# ---------------------------------------------------------------------------
+# Encoder scan
+# ---------------------------------------------------------------------------
+
+
+# Non-default delta-of-delta buckets: (opcode, num_opcode_bits, num_value_bits).
+_DOD_BUCKETS = ((0b10, 2, 7), (0b110, 3, 9), (0b1110, 4, 12))
+
+
+def _append_dod(bb, dod, unit_is_32bit):
+    """Append a bucketed delta-of-delta (already unit-normalized).
+
+    Returns (bb, overflow) where overflow marks a dod that does not fit the
+    32-bit default bucket of second/millisecond units (the reference raises
+    OverflowError there: timestamp_encoder.go:213-221)."""
+    is_zero = dod == _c(0, I64)
+    bb = _bb_append(bb, _c(0), _c(1, I32), enable=is_zero)
+    done = is_zero
+    for opcode, nob, nvb in _DOD_BUCKETS:
+        lo, hi = -(1 << (nvb - 1)), (1 << (nvb - 1)) - 1
+        fits = (~done) & (dod >= _c(lo, I64)) & (dod <= _c(hi, I64))
+        bb = _bb_append(bb, _c(opcode), _c(nob, I32), enable=fits)
+        bb = _bb_append(bb, dod.astype(U64), _c(nvb, I32), enable=fits)
+        done = done | fits
+    # default bucket: 32-bit (s/ms) or 64-bit (us/ns) value
+    take_def = ~done
+    bb = _bb_append(bb, _c(0b1111), _c(4, I32), enable=take_def)
+    nvb = jnp.where(unit_is_32bit, _c(32, I32), _c(64, I32))
+    bb = _bb_append(bb, dod.astype(U64), nvb, enable=take_def)
+    overflow = take_def & unit_is_32bit & (
+        (dod < _c(-(2**31), I64)) | (dod > _c(2**31 - 1, I64)))
+    return bb, overflow
+
+
+def _append_xor(bb, state, cur_xor):
+    """Gorilla XOR emit (float_encoder_iterator.go:82-103). Returns (bb, new prev_xor)."""
+    prev_xor = state
+    is_zero = cur_xor == _c(0)
+    bb = _bb_append(bb, _c(0), _c(1, I32), enable=is_zero)
+
+    pl = jnp.where(prev_xor == _c(0), _c(64, I32),
+                   lax.clz(prev_xor.astype(I64)).astype(I32))
+    # trailing zeros = index of lowest set bit
+    pt = jnp.where(prev_xor == _c(0), _c(0, I32),
+                   (_num_sig(prev_xor & (~prev_xor + _c(1))) - _c(1, I32)))
+    cl = lax.clz(jnp.maximum(cur_xor, _c(1)).astype(I64)).astype(I32)
+    ct = _num_sig(cur_xor & (~cur_xor + _c(1))) - _c(1, I32)
+
+    contained = (~is_zero) & (cl >= pl) & (ct >= pt)
+    bb = _bb_append(bb, _c(0b10), _c(2, I32), enable=contained)
+    bb = _bb_append(bb, _shr(cur_xor, pt.astype(U64)),
+                    _c(64, I32) - pl - pt, enable=contained)
+
+    uncont = (~is_zero) & (~contained)
+    meaningful = _c(64, I32) - cl - ct
+    bb = _bb_append(bb, _c(0b11), _c(2, I32), enable=uncont)
+    bb = _bb_append(bb, cl.astype(U64), _c(6, I32), enable=uncont)
+    bb = _bb_append(bb, (meaningful - _c(1, I32)).astype(U64), _c(6, I32), enable=uncont)
+    bb = _bb_append(bb, _shr(cur_xor, ct.astype(U64)), meaningful, enable=uncont)
+    new_prev_xor = jnp.where(is_zero, _c(0), cur_xor)
+    return bb, new_prev_xor
+
+
+def _track_new_sig(num_sig_st, cur_hl, num_lower, sig):
+    """IntSigBitsTracker.TrackNewSig (int_sig_bits_tracker.go:68-91)."""
+    new_sig = num_sig_st
+    grow = sig > num_sig_st
+    new_sig = jnp.where(grow, sig, new_sig)
+    shrink = (~grow) & ((num_sig_st - sig) >= _c(3, I32))
+    chl = jnp.where(shrink & (num_lower == _c(0, I32)), sig,
+                    jnp.where(shrink & (sig > cur_hl), sig, cur_hl))
+    nl = jnp.where(shrink, num_lower + _c(1, I32), _c(0, I32))
+    fire = shrink & (nl >= _c(5, I32))
+    new_sig = jnp.where(fire, chl, new_sig)
+    nl = jnp.where(fire, _c(0, I32), nl)
+    return new_sig, chl, nl
+
+
+def _append_int_sig_mult(bb, num_sig_st, max_mult, sig, mult, float_changed):
+    """writeIntSigMult (encoder.go:235-250). Returns (bb, new num_sig, new max_mult)."""
+    # WriteIntSig
+    sig_changed = num_sig_st != sig
+    bb = _bb_append(bb, _c(1), _c(1, I32), enable=sig_changed)
+    zero_sig = sig == _c(0, I32)
+    bb = _bb_append(bb, _c(0), _c(1, I32), enable=sig_changed & zero_sig)
+    bb = _bb_append(bb, _c(1), _c(1, I32), enable=sig_changed & ~zero_sig)
+    bb = _bb_append(bb, (sig - _c(1, I32)).astype(U64), _c(6, I32),
+                    enable=sig_changed & ~zero_sig)
+    bb = _bb_append(bb, _c(0), _c(1, I32), enable=~sig_changed)
+    new_num_sig = sig
+    # mult update
+    mult_up = mult > max_mult
+    # after WriteIntSig num_sig == sig, so condition reduces to:
+    float_only = (~mult_up) & (max_mult == mult) & float_changed
+    bb = _bb_append(bb, _c(1), _c(1, I32), enable=mult_up | float_only)
+    bb = _bb_append(bb, mult.astype(U64), _c(3, I32), enable=mult_up | float_only)
+    bb = _bb_append(bb, _c(0), _c(1, I32), enable=~(mult_up | float_only))
+    new_max_mult = jnp.where(mult_up, mult, max_mult)
+    return bb, new_num_sig, new_max_mult
+
+
+def _append_int_val_diff(bb, num_sig_st, diff_bits, neg):
+    bb = _bb_append(bb, jnp.where(neg, _c(1), _c(0)), _c(1, I32))
+    bb = _bb_append(bb, diff_bits, num_sig_st)
+    return bb
+
+
+def _encode_step(carry, xs, unit: int, default_unit_is_32bit: bool):
+    """One datapoint for one series. carry is the full codec state."""
+    (prev_time, prev_delta, tu_none, int_val, max_mult, is_float,
+     prev_fbits, prev_xor, num_sig_st, cur_hl, num_lower, is_first,
+     fallback) = carry
+    t, v_bits, valid = xs
+
+    bb = _bb_new()
+
+    # ---- timestamp (timestamp_encoder.go:72-129) ----
+    # first datapoint of the stream: 64-bit start already emitted by caller
+    # via the start word (prev_time holds start). Time-unit change marker if
+    # the initial unit was None (unaligned start).
+    emit_tu = is_first & tu_none
+    bb = _bb_append(bb, _c(0x100), _c(9, I32), enable=emit_tu)
+    bb = _bb_append(bb, _c(2), _c(2, I32), enable=emit_tu)  # time-unit marker
+    bb = _bb_append(bb, _c(unit), _c(8, I32), enable=emit_tu)
+
+    time_delta = t - prev_time
+    dod_ns = time_delta - prev_delta
+    # after a TU write: full 64-bit nanosecond dod, delta resets to 0
+    bb = _bb_append(bb, dod_ns.astype(U64), _c(64, I32), enable=emit_tu)
+    unit_nanos = int(Unit(unit).nanos())
+    dod_units = dod_ns // _c(unit_nanos, I64)  # deltas divisible (checked by caller)
+    div_ok = (dod_ns % _c(unit_nanos, I64)) == _c(0, I64)
+    bb_dod, dod_overflow = _append_dod(bb, dod_units,
+                                       _c(default_unit_is_32bit, jnp.bool_))
+    # Only one of the two paths appended bits (enable flags), so select:
+    bb = tuple(jnp.where(emit_tu, a, b) for a, b in zip(bb, bb_dod))
+    new_prev_delta = jnp.where(emit_tu, _c(0, I64), time_delta)
+    new_prev_time = t
+    new_tu_none = tu_none & ~emit_tu
+
+    # ---- value ----
+    val, mult, v_is_float, prec = classify_value(v_bits, max_mult)
+
+    # ---------- first value (encoder.go:112-146) ----------
+    bb_f = bb
+    bb_f = _bb_append(bb_f, jnp.where(v_is_float, _c(1), _c(0)), _c(1, I32))
+    # float mode
+    bb_ff = _bb_append(bb_f, v_bits, _c(64, I32))
+    # int mode
+    neg_diff = val >= _c(0, I64)  # inverted: diff = 0 - val
+    mag = jnp.abs(val).astype(U64)
+    sig_f = _num_sig(mag)
+    bb_fi, ns_fi, mm_fi = _append_int_sig_mult(
+        bb_f, num_sig_st, max_mult, sig_f, mult, _c(False, jnp.bool_))
+    bb_fi = _append_int_val_diff(bb_fi, ns_fi, mag, neg_diff)
+    bb_first = tuple(jnp.where(v_is_float, a, b) for a, b in zip(bb_ff, bb_fi))
+    st_first = dict(
+        int_val=jnp.where(v_is_float, int_val, val),
+        is_float=v_is_float,
+        prev_fbits=jnp.where(v_is_float, v_bits, prev_fbits),
+        prev_xor=jnp.where(v_is_float, v_bits, prev_xor),
+        num_sig=jnp.where(v_is_float, num_sig_st, ns_fi),
+        max_mult_i=jnp.where(v_is_float, mult, mm_fi),
+        cur_hl=cur_hl, num_lower=num_lower,
+    )
+
+    # ---------- next value (encoder.go:148-231) ----------
+    val_diff = int_val - val
+    # float path trigger (diff overflow impossible: flagged by prec limit)
+    go_float = v_is_float
+    # writeFloatVal
+    was_int = ~is_float
+    bb_n = bb
+    #   int->float: '0''0''1' + full float
+    bb_nf1 = _bb_append(bb_n, _c(0b001), _c(3, I32))
+    bb_nf1 = _bb_append(bb_nf1, v_bits, _c(64, I32))
+    #   float repeat: '0''1'
+    repeat_f = is_float & (v_bits == prev_fbits)
+    bb_nf2 = _bb_append(bb_n, _c(0b01), _c(2, I32))
+    #   float next: '1' + xor
+    bb_nf3 = _bb_append(bb_n, _c(1), _c(1, I32))
+    bb_nf3, nxor = _append_xor(bb_nf3, prev_xor, prev_fbits ^ v_bits)
+    bb_float = tuple(
+        jnp.where(was_int, a, jnp.where(repeat_f, b, c))
+        for a, b, c in zip(bb_nf1, bb_nf2, bb_nf3))
+    st_float = dict(
+        int_val=int_val,
+        is_float=_c(True, jnp.bool_),
+        max_mult_i=jnp.where(was_int, mult, max_mult),
+        prev_fbits=v_bits,
+        prev_xor=jnp.where(was_int, v_bits, jnp.where(repeat_f, prev_xor, nxor)),
+        num_sig=num_sig_st, cur_hl=cur_hl, num_lower=num_lower,
+    )
+
+    # writeIntVal
+    repeat_i = (val_diff == _c(0, I64)) & (~is_float) & (mult == max_mult)
+    bb_ir = _bb_append(bb_n, _c(0b01), _c(2, I32))
+    neg = val_diff < _c(0, I64)
+    diff_mag = jnp.abs(val_diff).astype(U64)
+    sig_n = _num_sig(diff_mag)
+    new_sig, t_chl, t_nl = _track_new_sig(num_sig_st, cur_hl, num_lower, sig_n)
+    float_changed = is_float  # is_float state true means mode changes to int
+    need_update = (mult > max_mult) | (num_sig_st != new_sig) | float_changed
+    #   update: '1'? no: opcodeUpdate=0 -> bits '0''0''0'
+    bb_iu = _bb_append(bb_n, _c(0b000), _c(3, I32))
+    bb_iu, ns_iu, mm_iu = _append_int_sig_mult(
+        bb_iu, num_sig_st, max_mult, new_sig, mult, float_changed)
+    bb_iu = _append_int_val_diff(bb_iu, ns_iu, diff_mag, neg)
+    #   no-update: '1' + diff
+    bb_in = _bb_append(bb_n, _c(1), _c(1, I32))
+    bb_in = _append_int_val_diff(bb_in, num_sig_st, diff_mag, neg)
+    bb_int = tuple(
+        jnp.where(repeat_i, a, jnp.where(need_update, b, c))
+        for a, b, c in zip(bb_ir, bb_iu, bb_in))
+    st_int = dict(
+        int_val=jnp.where(repeat_i, int_val, val),
+        is_float=jnp.where(repeat_i, is_float, _c(False, jnp.bool_)),
+        max_mult_i=jnp.where(repeat_i, max_mult,
+                             jnp.where(need_update, mm_iu, max_mult)),
+        prev_fbits=prev_fbits, prev_xor=prev_xor,
+        num_sig=jnp.where(repeat_i, num_sig_st,
+                          jnp.where(need_update, ns_iu, num_sig_st)),
+        cur_hl=jnp.where(repeat_i, cur_hl, t_chl),
+        num_lower=jnp.where(repeat_i, num_lower, t_nl),
+    )
+
+    bb_next = tuple(
+        jnp.where(go_float, a, b) for a, b in zip(bb_float, bb_int))
+    st_next = {
+        k: jnp.where(go_float, st_float[k], st_int[k])
+        for k in st_float
+    }
+
+    bb_out = tuple(jnp.where(is_first, a, b) for a, b in zip(bb_first, bb_next))
+    st = {
+        k: jnp.where(is_first, st_first[k], st_next[k])
+        for k in st_first
+    }
+
+    # inactive (padding) steps emit nothing and keep state
+    w0, w1, w2, w3, ln = bb_out
+    ln = jnp.where(valid, ln, _c(0, I32))
+    zeros = _c(0)
+    w0 = jnp.where(valid, w0, zeros)
+    w1 = jnp.where(valid, w1, zeros)
+    w2 = jnp.where(valid, w2, zeros)
+    w3 = jnp.where(valid, w3, zeros)
+
+    def keep(new, old):
+        return jnp.where(valid, new, old)
+
+    fallback = (fallback | (valid & prec) | (valid & ~div_ok & ~emit_tu)
+                | (valid & dod_overflow & ~emit_tu))
+    new_carry = (
+        keep(new_prev_time, prev_time),
+        keep(new_prev_delta, prev_delta),
+        keep(new_tu_none, tu_none),
+        keep(st["int_val"], int_val),
+        keep(st["max_mult_i"], max_mult),
+        keep(st["is_float"], is_float),
+        keep(st["prev_fbits"], prev_fbits),
+        keep(st["prev_xor"], prev_xor),
+        keep(st["num_sig"], num_sig_st),
+        keep(st["cur_hl"], cur_hl),
+        keep(st["num_lower"], num_lower),
+        is_first & ~valid,
+        fallback,
+    )
+    return new_carry, (w0, w1, w2, w3, ln)
+
+
+@functools.partial(jax.jit, static_argnames=("unit", "out_words"))
+def encode_batch_device(timestamps, value_bits, start, valid, unit: int = 1,
+                        out_words: int = 0):
+    """Encode (S, T) series on device.
+
+    Args:
+      timestamps: (S, T) int64 UnixNanos, padded entries arbitrary.
+      value_bits: (S, T) uint64 float64 bit patterns.
+      start: (S,) int64 encoder start times.
+      valid: (S, T) bool mask of real datapoints (prefix True).
+      unit: static time unit (wire byte value).
+      out_words: static output width in 64-bit words per series
+        (0 -> T * 16 bits / 64 + 4).
+
+    Returns dict with packed words (S, W) uint64 (starting with the 64-bit
+    start time), total_bits (S,), fallback (S,) bool.
+    """
+    S, T = timestamps.shape
+    if out_words == 0:
+        out_words = (T * 16) // 64 + 4
+    u = Unit(unit)
+    default_32 = u in (Unit.SECOND, Unit.MILLISECOND)
+
+    tu_none = (start % jnp.asarray(u.nanos(), I64)) != 0
+
+    carry0 = (
+        start.astype(I64),                      # prev_time
+        jnp.zeros(S, I64),                      # prev_delta
+        tu_none,                                # initial unit None?
+        jnp.zeros(S, I64),                      # int_val
+        jnp.zeros(S, I32),                      # max_mult
+        jnp.zeros(S, jnp.bool_),                # is_float
+        jnp.zeros(S, U64),                      # prev_fbits
+        jnp.zeros(S, U64),                      # prev_xor
+        jnp.zeros(S, I32),                      # num_sig
+        jnp.zeros(S, I32),                      # cur_highest_lower_sig
+        jnp.zeros(S, I32),                      # num_lower_sig
+        jnp.ones(S, jnp.bool_),                 # is_first
+        jnp.zeros(S, jnp.bool_),                # fallback
+    )
+
+    step = functools.partial(_encode_step, unit=unit,
+                             default_unit_is_32bit=default_32)
+    vstep = jax.vmap(step)
+
+    def scan_fn(carry, xs):
+        return vstep(carry, xs)
+
+    xs = (timestamps.T, value_bits.T, valid.T)  # scan over T
+    carry, (w0, w1, w2, w3, lens) = lax.scan(scan_fn, carry0, xs)
+    # outputs are (T, S); transpose to (S, T)
+    w0, w1, w2, w3 = (w.T for w in (w0, w1, w2, w3))
+    lens = lens.T.astype(jnp.int64)
+
+    # bit offsets: 64 bits for the start word, then cumulative lengths
+    offsets = jnp.cumsum(lens, axis=1) - lens + 64
+    total_bits = offsets[:, -1] + lens[:, -1]
+
+    out = jnp.zeros((S, out_words), U64)
+    # start word first
+    out = out.at[:, 0].set(start.astype(U64))
+
+    series_idx = jnp.broadcast_to(jnp.arange(S)[:, None], (S, T))
+    for j, wj in enumerate((w0, w1, w2, w3)):
+        pos = offsets + j * 64
+        gw = (pos >> 6).astype(I32)
+        sh = (pos & 63).astype(U64)
+        in_range = (j * 64) < lens  # word j carries bits only if len > 64j
+        hi = jnp.where(in_range, _shr(wj, sh), _c(0))
+        lo_shift = _c(64) - sh
+        lo = jnp.where(in_range & (sh > _c(0)), _shl(wj, lo_shift), _c(0))
+        out = out.at[series_idx, jnp.clip(gw, 0, out_words - 1)].add(
+            jnp.where(gw < out_words, hi, _c(0)))
+        out = out.at[series_idx, jnp.clip(gw + 1, 0, out_words - 1)].add(
+            jnp.where(gw + 1 < out_words, lo, _c(0)))
+
+    fallback = carry[12] | (total_bits > (out_words * 64))
+    return {"words": out, "total_bits": total_bits, "fallback": fallback}
+
+
+def finalize_streams(words: np.ndarray, total_bits: np.ndarray,
+                     counts=None) -> list[bytes]:
+    """Host finalization: trim to byte length and append the EOS tail."""
+    out = []
+    words = np.asarray(words)
+    total_bits = np.asarray(total_bits)
+    for i in range(words.shape[0]):
+        nbits = int(total_bits[i])
+        raw = words[i].astype(">u8").tobytes()
+        nbytes = (nbits + 7) // 8
+        head = raw[:nbytes]
+        pos = nbits - (nbytes - 1) * 8  # bits used in last byte, 1..8
+        out.append(head[:-1] + tail_bytes(head[-1], pos))
+    return out
+
+
+def encode_batch(timestamps, values, start, counts=None, unit: Unit = Unit.SECOND,
+                 out_words: int = 0):
+    """Host-facing batched encode.
+
+    Returns (streams: list[bytes], fallback: np.ndarray[bool]); fallback
+    series contain b"" and must be encoded with the scalar codec.
+    """
+    timestamps = np.asarray(timestamps, dtype=np.int64)
+    values = np.asarray(values, dtype=np.float64)
+    S, T = timestamps.shape
+    if counts is None:
+        counts = np.full(S, T, dtype=np.int64)
+    valid = np.arange(T)[None, :] < np.asarray(counts)[:, None]
+    vb = values.view(np.uint64)
+    res = encode_batch_device(
+        jnp.asarray(timestamps), jnp.asarray(vb), jnp.asarray(start, dtype=jnp.int64),
+        jnp.asarray(valid), unit=int(unit), out_words=out_words)
+    fallback = np.asarray(res["fallback"])
+    streams = finalize_streams(np.asarray(res["words"]), np.asarray(res["total_bits"]))
+    counts_arr = np.asarray(counts)
+    # An empty series encodes to b"" (the reference encoder's Stream() returns
+    # no segment when nothing was written), not a bare start-word stream.
+    streams = [b"" if (fallback[i] or counts_arr[i] == 0) else streams[i]
+               for i in range(S)]
+    return streams, fallback
+
+
+# ---------------------------------------------------------------------------
+# Batched decode
+# ---------------------------------------------------------------------------
+
+
+def _peek(words, cursor, n):
+    """Read ``n`` (<=64, may be 0 or traced) bits at bit position cursor from a
+    (W+1,) uint64 word array (extra zero pad word)."""
+    w = (cursor >> _c(6, I32))
+    off = (cursor & _c(63, I32)).astype(U64)
+    W = words.shape[0] - 1
+    w = jnp.clip(w, 0, W - 1)
+    w0 = words[w]
+    w1 = words[w + 1]
+    window = _shl(w0, off) | jnp.where(off > _c(0), _shr(w1, _c(64) - off), _c(0))
+    return _shr(window, _c(64) - _c(n, I32).astype(U64))
+
+
+def _decode_step(carry, _, default_unit: int):
+    (words, nbits, cursor, done, err, prec, first, prev_time, prev_delta,
+     unit_idx, prev_fbits, prev_xor, int_val, sig, mult, is_float) = carry
+    active = (~done) & (~err)
+
+    unit_tbl = jnp.asarray(_UNIT_NANOS, I64)
+
+    # ---- first: 64-bit start timestamp ----
+    rd_first = jnp.where(active & first, _c(64, I32), _c(0, I32))
+    nt = _sign_extend(_peek(words, cursor, rd_first), _c(64, I32))
+    cur = cursor + rd_first
+    d_ns = jnp.asarray(int(Unit(default_unit).nanos()), I64)
+    aligned = (lax.rem(nt, d_ns)) == _c(0, I64)
+    unit0 = jnp.where(aligned, _c(default_unit, I32), _c(0, I32))
+    unit_eff = jnp.where(first, unit0, unit_idx)
+    base_time = jnp.where(first, nt, prev_time)
+
+    # ---- marker peek (11 bits) ----
+    can_peek = (cur + _c(11, I32)) <= nbits
+    peek11 = jnp.where(active & can_peek, _peek(words, cur, _c(11, I32)), _c(0))
+    is_marker = (peek11 >> _c(2)) == _c(0x100)
+    mval = (peek11 & _c(3)).astype(I32)
+    eos = active & is_marker & (mval == _c(0, I32))
+    ann = active & is_marker & (mval == _c(1, I32))
+    is_tu = active & is_marker & (mval == _c(2, I32))
+    err = err | ann  # annotations take the host path
+    done = done | eos
+    proceed = active & ~eos & ~ann
+
+    cur = cur + jnp.where(is_tu, _c(11, I32), _c(0, I32))
+    rd_tu = jnp.where(is_tu, _c(8, I32), _c(0, I32))
+    ub = _peek(words, cur, rd_tu).astype(I32)
+    cur = cur + rd_tu
+    ub_valid = (ub >= _c(1, I32)) & (ub <= _c(8, I32))
+    tu_changed = is_tu & ub_valid & (ub != unit_eff)
+    new_unit = jnp.where(is_tu, ub, unit_eff)
+    unit_nanos = unit_tbl[jnp.clip(new_unit, 0, 15)]
+    err = err | (proceed & (unit_nanos == _c(0, I64)) & ~tu_changed)
+
+    # ---- delta of delta ----
+    full64 = tu_changed
+    rd_dod64 = jnp.where(proceed & full64, _c(64, I32), _c(0, I32))
+    dod_full = _sign_extend(_peek(words, cur, rd_dod64), _c(64, I32))
+    cur = cur + rd_dod64
+
+    # bucketed path: peek 4 opcode bits, classify
+    bucket_active = proceed & ~full64
+    op4 = jnp.where(bucket_active, _peek(words, cur, _c(4, I32)), _c(0))
+    b3 = (op4 >> _c(3)) & _c(1)
+    b2 = (op4 >> _c(2)) & _c(1)
+    b1 = (op4 >> _c(1)) & _c(1)
+    b0 = op4 & _c(1)
+    default_is32 = (new_unit == _c(1, I32)) | (new_unit == _c(2, I32))
+    nop = jnp.where(b3 == _c(0), _c(1, I32),
+          jnp.where(b2 == _c(0), _c(2, I32),
+          jnp.where(b1 == _c(0), _c(3, I32), _c(4, I32))))
+    nv = jnp.where(b3 == _c(0), _c(0, I32),
+         jnp.where(b2 == _c(0), _c(7, I32),
+         jnp.where(b1 == _c(0), _c(9, I32),
+         jnp.where(b0 == _c(0), _c(12, I32),
+                   jnp.where(default_is32, _c(32, I32), _c(64, I32))))))
+    nop = jnp.where(bucket_active, nop, _c(0, I32))
+    nv = jnp.where(bucket_active, nv, _c(0, I32))
+    cur = cur + nop
+    dod_bits = _peek(words, cur, nv)
+    cur = cur + nv
+    dod_units = jnp.where(nv > _c(0, I32),
+                          _sign_extend(dod_bits, jnp.maximum(nv, _c(1, I32))),
+                          _c(0, I64))
+    dod_ns = jnp.where(full64, dod_full, dod_units * unit_nanos)
+
+    pd = prev_delta + jnp.where(proceed, dod_ns, _c(0, I64))
+    new_time = base_time + pd
+    pd = jnp.where(full64, _c(0, I64), pd)
+
+    # ---- value ----
+    # first value
+    f_active = proceed & first
+    rd = jnp.where(f_active, _c(1, I32), _c(0, I32))
+    mode_bit = _peek(words, cur, rd)
+    cur = cur + rd
+    f_is_float = f_active & (mode_bit == _c(1))
+    rd = jnp.where(f_is_float, _c(64, I32), _c(0, I32))
+    f_fbits = _peek(words, cur, rd)
+    cur = cur + rd
+
+    # next-value branch bits
+    n_active = proceed & ~first
+    rd = jnp.where(n_active, _c(1, I32), _c(0, I32))
+    nb1 = _peek(words, cur, rd)
+    cur = cur + rd
+    upd = n_active & (nb1 == _c(0))  # opcodeUpdate = 0
+    rd = jnp.where(upd, _c(1, I32), _c(0, I32))
+    nb2 = _peek(words, cur, rd)
+    cur = cur + rd
+    repeat = upd & (nb2 == _c(1))
+    upd2 = upd & (nb2 == _c(0))
+    rd = jnp.where(upd2, _c(1, I32), _c(0, I32))
+    nb3 = _peek(words, cur, rd)
+    cur = cur + rd
+    to_float = upd2 & (nb3 == _c(1))
+    rd = jnp.where(to_float, _c(64, I32), _c(0, I32))
+    n_fbits = _peek(words, cur, rd)
+    cur = cur + rd
+    to_int_upd = upd2 & (nb3 == _c(0))
+
+    # readIntSigMult for first-int or next-int-update
+    sig_rd_active = (f_active & ~f_is_float) | to_int_upd
+    rd = jnp.where(sig_rd_active, _c(1, I32), _c(0, I32))
+    sb1 = _peek(words, cur, rd)
+    cur = cur + rd
+    sig_upd = sig_rd_active & (sb1 == _c(1))
+    rd = jnp.where(sig_upd, _c(1, I32), _c(0, I32))
+    sb2 = _peek(words, cur, rd)
+    cur = cur + rd
+    sig_nonzero = sig_upd & (sb2 == _c(1))
+    rd = jnp.where(sig_nonzero, _c(6, I32), _c(0, I32))
+    sigbits = _peek(words, cur, rd)
+    cur = cur + rd
+    new_sig = jnp.where(sig_upd & ~sig_nonzero, _c(0, I32),
+               jnp.where(sig_nonzero, sigbits.astype(I32) + _c(1, I32), sig))
+    rd = jnp.where(sig_rd_active, _c(1, I32), _c(0, I32))
+    mb1 = _peek(words, cur, rd)
+    cur = cur + rd
+    mult_upd = sig_rd_active & (mb1 == _c(1))
+    rd = jnp.where(mult_upd, _c(3, I32), _c(0, I32))
+    multbits = _peek(words, cur, rd)
+    cur = cur + rd
+    new_mult = jnp.where(mult_upd, multbits.astype(I32), mult)
+    err = err | (mult_upd & (new_mult > _c(6, I32)))
+
+    # int val diff read (first-int, next-int-update, next-int-noupdate)
+    int_noupd = n_active & (nb1 == _c(1)) & ~is_float
+    diff_active = sig_rd_active | int_noupd
+    eff_sig = jnp.where(int_noupd, sig, new_sig)
+    rd = jnp.where(diff_active, _c(1, I32), _c(0, I32))
+    sign_bit = _peek(words, cur, rd)
+    cur = cur + rd
+    rd = jnp.where(diff_active, eff_sig, _c(0, I32))
+    diff_bits = _peek(words, cur, rd)
+    cur = cur + rd
+    # sign convention: opcodeNegative(1) -> +, opcodePositive(0) -> -
+    signed_diff = jnp.where(sign_bit == _c(1), diff_bits.astype(I64),
+                            -(diff_bits.astype(I64)))
+    prec = prec | (diff_active & (diff_bits > _c(_PRECISION_LIMIT)))
+
+    # XOR float next (n_active & ~upd & is_float)
+    xor_active = n_active & (nb1 == _c(1)) & is_float
+    rd = jnp.where(xor_active, _c(1, I32), _c(0, I32))
+    xb1 = _peek(words, cur, rd)
+    cur = cur + rd
+    xor_zero = xor_active & (xb1 == _c(0))
+    xor_nz = xor_active & (xb1 == _c(1))
+    rd = jnp.where(xor_nz, _c(1, I32), _c(0, I32))
+    xb2 = _peek(words, cur, rd)
+    cur = cur + rd
+    contained = xor_nz & (xb2 == _c(0))
+    uncont = xor_nz & (xb2 == _c(1))
+    pl = jnp.where(prev_xor == _c(0), _c(64, I32),
+                   lax.clz(prev_xor.astype(I64)).astype(I32))
+    pt = jnp.where(prev_xor == _c(0), _c(0, I32),
+                   (_num_sig(prev_xor & (~prev_xor + _c(1))) - _c(1, I32)))
+    meaningful_c = _c(64, I32) - pl - pt
+    rd = jnp.where(contained, meaningful_c, _c(0, I32))
+    cbits = _peek(words, cur, rd)
+    cur = cur + rd
+    rd = jnp.where(uncont, _c(12, I32), _c(0, I32))
+    packed = _peek(words, cur, rd)
+    cur = cur + rd
+    u_lead = ((packed >> _c(6)) & _c(0x3F)).astype(I32)
+    u_meaningful = (packed & _c(0x3F)).astype(I32) + _c(1, I32)
+    rd = jnp.where(uncont, u_meaningful, _c(0, I32))
+    ubits = _peek(words, cur, rd)
+    cur = cur + rd
+    u_trail = _c(64, I32) - u_lead - u_meaningful
+    new_xor = jnp.where(xor_zero, _c(0),
+              jnp.where(contained, _shl(cbits, pt.astype(U64)),
+              jnp.where(uncont, _shl(ubits, jnp.clip(u_trail, 0, 63).astype(U64)),
+                        prev_xor)))
+
+    # ---- state update ----
+    got_float_full = f_is_float | to_float
+    n_prev_fbits = jnp.where(got_float_full, jnp.where(f_is_float, f_fbits, n_fbits),
+                    jnp.where(xor_active, prev_fbits ^ new_xor, prev_fbits))
+    n_prev_xor = jnp.where(got_float_full, jnp.where(f_is_float, f_fbits, n_fbits),
+                  jnp.where(xor_active, new_xor, prev_xor))
+    n_int_val = jnp.where(diff_active, int_val + signed_diff, int_val)
+    prec = prec | (diff_active & (jnp.abs(n_int_val) > _c(_PRECISION_LIMIT, I64)))
+    n_is_float = jnp.where(got_float_full, _c(True, jnp.bool_),
+                  jnp.where(to_int_upd | (f_active & ~f_is_float),
+                            _c(False, jnp.bool_), is_float))
+    n_sig = jnp.where(sig_rd_active, new_sig, sig)
+    n_mult = jnp.where(sig_rd_active, new_mult, mult)
+
+    err = err | (proceed & (cur > nbits))
+    emit = proceed & ~err
+
+    out_ts = jnp.where(emit, new_time, _c(0, I64))
+    out_isf = n_is_float
+    out_payload = jnp.where(out_isf, n_prev_fbits, n_int_val.astype(U64))
+    out_meta = (jnp.where(emit, _c(1, I32), _c(0, I32)) << 4 |
+                jnp.where(out_isf, _c(1, I32), _c(0, I32)) << 3 |
+                jnp.clip(n_mult, 0, 7)).astype(jnp.uint8)
+
+    new_carry = (
+        words, nbits,
+        jnp.where(proceed, cur, cursor),
+        done, err, prec,
+        first & ~proceed,
+        jnp.where(proceed, new_time, prev_time),
+        jnp.where(proceed, pd, prev_delta),
+        jnp.where(proceed, new_unit, unit_idx),
+        jnp.where(proceed, n_prev_fbits, prev_fbits),
+        jnp.where(proceed, n_prev_xor, prev_xor),
+        jnp.where(proceed, n_int_val, int_val),
+        jnp.where(proceed, n_sig, sig),
+        jnp.where(proceed, n_mult, mult),
+        jnp.where(proceed, n_is_float, is_float),
+    )
+    return new_carry, (out_ts, out_payload, out_meta)
+
+
+@functools.partial(jax.jit, static_argnames=("max_points", "default_unit"))
+def decode_batch_device(words, nbits, max_points: int, default_unit: int = 1):
+    """Decode (S, W+1) padded word arrays in parallel.
+
+    Returns (ts (S, max_points) int64, payload (S, max_points) uint64,
+    meta (S, max_points) uint8, err (S,), prec (S,)).
+    meta: bit4 = valid, bit3 = is_float, bits0-2 = multiplier.
+    """
+    S = words.shape[0]
+    carry0 = (
+        words, nbits.astype(I32),
+        jnp.zeros(S, I32), jnp.zeros(S, jnp.bool_), jnp.zeros(S, jnp.bool_),
+        jnp.zeros(S, jnp.bool_), jnp.ones(S, jnp.bool_),
+        jnp.zeros(S, I64), jnp.zeros(S, I64), jnp.zeros(S, I32),
+        jnp.zeros(S, U64), jnp.zeros(S, U64), jnp.zeros(S, I64),
+        jnp.zeros(S, I32), jnp.zeros(S, I32), jnp.zeros(S, jnp.bool_),
+    )
+    step = functools.partial(_decode_step, default_unit=default_unit)
+    vstep = jax.vmap(step, in_axes=(0, None))
+
+    def scan_fn(carry, _):
+        return vstep(carry, None)
+
+    carry, (ts, payload, meta) = lax.scan(scan_fn, carry0, None, length=max_points)
+    # A stream whose EOS marker sits exactly after max_points datapoints never
+    # sets done inside the scan; peek once more for it.
+    w_arr, nb_arr, cursor, done = carry[0], carry[1], carry[2], carry[3]
+    can = (cursor + 11) <= nb_arr
+    peek11 = jax.vmap(lambda w, c: _peek(w, c, _c(11, I32)))(w_arr, cursor)
+    eos_tail = can & ((peek11 >> _c(2)) == _c(0x100)) & ((peek11 & _c(3)) == _c(0))
+    done = done | eos_tail
+    err = carry[4] | (~done)  # not done after max_points -> error
+    prec = carry[5]
+    return ts.T, payload.T, meta.T, err, prec
+
+
+def decode_batch(streams: list[bytes], max_points: int,
+                 default_unit: Unit = Unit.SECOND):
+    """Host-facing batched decode.
+
+    Returns (timestamps (S, P) int64, values (S, P) float64,
+    counts (S,), fallback (S,) bool).  Fallback series (annotations,
+    >2^53 magnitudes, errors) must use the scalar ReaderIterator.
+    """
+    S = len(streams)
+    maxlen = max((len(s) for s in streams), default=0)
+    W = (maxlen + 7) // 8 + 1
+    words = np.zeros((S, W + 1), dtype=np.uint64)
+    nbits = np.zeros(S, dtype=np.int32)
+    for i, s in enumerate(streams):
+        padded = s + b"\x00" * (W * 8 - len(s))
+        words[i, :W] = np.frombuffer(padded, dtype=">u8").astype(np.uint64)
+        nbits[i] = len(s) * 8
+    ts, payload, meta, err, prec = decode_batch_device(
+        jnp.asarray(words), jnp.asarray(nbits), max_points=max_points,
+        default_unit=int(default_unit))
+    ts = np.asarray(ts)
+    payload = np.asarray(payload)
+    meta = np.asarray(meta)
+    valid = (meta & 16) != 0
+    isf = (meta & 8) != 0
+    mult = (meta & 7).astype(np.int64)
+    fvals = payload.view(np.float64)
+    ivals = payload.astype(np.int64).astype(np.float64) / np.power(10.0, mult)
+    values = np.where(isf, fvals, ivals)
+    counts = valid.sum(axis=1)
+    fallback = np.asarray(err) | np.asarray(prec)
+    return ts, values, counts, fallback

@@ -1,0 +1,84 @@
+"""Fuzz the integer-emulated float64 ops against the host's IEEE hardware."""
+
+import math
+import random
+import struct
+
+import numpy as np
+import pytest
+
+from tests import conftest  # noqa: F401  (sets JAX_PLATFORMS before jax import)
+import jax
+
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp  # noqa: E402
+
+from m3_tpu.encoding import f64_emul as fe  # noqa: E402
+
+
+def f2b(v: float) -> int:
+    return struct.unpack("<Q", struct.pack("<d", v))[0]
+
+
+def b2f(b: int) -> float:
+    return struct.unpack("<d", struct.pack("<Q", b))[0]
+
+
+def _sample_floats(n=4000, seed=7):
+    rnd = random.Random(seed)
+    out = []
+    for _ in range(n):
+        kind = rnd.random()
+        if kind < 0.3:
+            out.append(float(rnd.randint(0, 10**13)))
+        elif kind < 0.6:
+            out.append(rnd.uniform(0, 10**13))
+        elif kind < 0.75:
+            out.append(rnd.uniform(0, 1))
+        elif kind < 0.9:
+            out.append(rnd.uniform(0, 1e-3) * 10 ** -rnd.randint(0, 300))
+        else:
+            # subnormals and tiny
+            out.append(b2f(rnd.randint(1, 2**52 - 1)))
+    out += [0.0, 1.0, 0.1, 0.9, 1e13 - 1, 5e-324, 2**52 + 0.5, 1e12 + 0.1]
+    return out
+
+
+def test_mul10_matches_hardware():
+    vals = _sample_floats()
+    bits = jnp.asarray([f2b(v) for v in vals], dtype=jnp.uint64)
+    got = np.asarray(jax.jit(fe.mul10)(bits))
+    for v, g in zip(vals, got):
+        expect = f2b(v * 10.0)
+        assert int(g) == expect, f"mul10({v!r}): got {b2f(int(g))!r} want {v * 10.0!r}"
+
+
+@pytest.mark.parametrize("k", range(7))
+def test_mul_pow10_matches_hardware(k):
+    vals = _sample_floats(seed=100 + k)
+    bits = jnp.asarray([f2b(v) for v in vals], dtype=jnp.uint64)
+    ks = jnp.full(len(vals), k, dtype=jnp.int32)
+    got = np.asarray(jax.jit(fe.mul_pow10)(bits, ks))
+    mult = float(10**k)
+    for v, g in zip(vals, got):
+        expect = f2b(v * mult)
+        assert int(g) == expect, f"mul_pow10({v!r},{k}): got {b2f(int(g))!r} want {v * mult!r}"
+
+
+def test_floor_parts():
+    vals = [v for v in _sample_floats(seed=3) if v < 2**62]
+    bits = jnp.asarray([f2b(v) for v in vals], dtype=jnp.uint64)
+    ip, fz = jax.jit(fe.floor_parts)(bits)
+    for v, i, z in zip(vals, np.asarray(ip), np.asarray(fz)):
+        frac, integ = math.modf(v)
+        assert int(i) == int(integ), f"floor({v!r})"
+        assert bool(z) == (frac == 0.0), f"frac_zero({v!r})"
+
+
+def test_uint_to_f64_bits():
+    rnd = random.Random(11)
+    ints = [rnd.randint(0, 2**53 - 1) for _ in range(2000)] + [0, 1, 2**52, 2**53 - 1]
+    arr = jnp.asarray(ints, dtype=jnp.uint64)
+    got = np.asarray(jax.jit(fe.uint_to_f64_bits)(arr))
+    for i, g in zip(ints, got):
+        assert int(g) == f2b(float(i)), f"uint_to_f64({i})"

@@ -1,0 +1,169 @@
+"""Batched JAX codec vs the scalar oracle (which is golden-validated)."""
+
+import base64
+import json
+import math
+
+import numpy as np
+import pytest
+
+from tests.conftest import DATA_DIR
+from m3_tpu.encoding.m3tsz import decode_series, encode_series
+from m3_tpu.encoding.m3tsz_jax import decode_batch, encode_batch
+
+START = 1_600_000_000 * 10**9
+
+
+def _mk_batch(T=200, seed=0):
+    rng = np.random.default_rng(seed)
+    S = 8
+    ts = np.tile(START + (np.arange(1, T + 1) * 10 * 10**9), (S, 1)).astype(np.int64)
+    vals = np.zeros((S, T))
+    starts = np.full(S, START, np.int64)
+    vals[0] = np.arange(T) % 50
+    vals[1] = 42.0
+    vals[2] = np.round(rng.normal(100, 10, T), 2)
+    vals[3] = rng.normal(0, 1, T)
+    vals[4] = np.where(np.arange(T) % 3 == 0, 1.5, 7.0)
+    vals[5] = np.cumsum(rng.integers(0, 100, T)).astype(float)
+    vals[6] = 0.0
+    vals[7] = rng.choice([1e9, 2.25, -5.0, 0.001], T)
+    return ts, vals, starts
+
+
+def test_encode_batch_bit_exact_vs_oracle():
+    ts, vals, starts = _mk_batch()
+    streams, fb = encode_batch(ts, vals, starts, out_words=400)
+    assert not fb.any()
+    for s in range(len(streams)):
+        want = encode_series(list(zip(ts[s].tolist(), vals[s].tolist())), start=START)
+        assert streams[s] == want, f"series {s} not bit-exact"
+
+
+def test_encode_batch_hard_cases():
+    T = 120
+    rng = np.random.default_rng(3)
+    S = 6
+    ts = np.tile(START + (np.arange(1, T + 1) * 10**9), (S, 1)).astype(np.int64)
+    starts = np.full(S, START, np.int64)
+    vals = np.zeros((S, T))
+    vals[0] = np.where(np.arange(T) % 7 == 0, np.nan, 3.0)
+    vals[1] = rng.choice([0.1, 0.25, 1 / 3, 123456.789], T)
+    ts[2] = START + np.cumsum(rng.choice([10**9, 2 * 10**9, 60 * 10**9], T))
+    vals[2] = 5.0
+    starts[3] = START + 123  # unaligned start -> TU marker on first datapoint
+    ts[3] = starts[3] + np.cumsum(np.full(T, 10**9))
+    vals[3] = np.arange(T).astype(float)
+    ts[4, 50:] -= 5 * 10**9  # negative delta-of-delta
+    vals[4] = 17.0
+    vals[5] = np.repeat(rng.normal(50, 5, T // 4).round(4), 4)[:T]
+    streams, fb = encode_batch(ts, vals, starts, out_words=400)
+    assert not fb.any()
+    for s in range(S):
+        want = encode_series(list(zip(ts[s].tolist(), vals[s].tolist())),
+                             start=int(starts[s]))
+        assert streams[s] == want, f"hard series {s} not bit-exact"
+
+
+def test_encode_variable_counts():
+    ts, vals, starts = _mk_batch(T=100)
+    counts = np.array([100, 50, 10, 99, 1, 100, 3, 77])
+    streams, fb = encode_batch(ts, vals, starts, counts=counts, out_words=400)
+    assert not fb.any()
+    for s in range(len(streams)):
+        n = counts[s]
+        want = encode_series(list(zip(ts[s, :n].tolist(), vals[s, :n].tolist())),
+                             start=START)
+        assert streams[s] == want
+
+
+def test_encode_overflow_flags_fallback():
+    # random floats at ~70 bits/pt cannot fit a 16-bit/pt budget
+    rng = np.random.default_rng(1)
+    T = 500
+    ts = np.tile(START + np.arange(1, T + 1) * 10**9, (2, 1)).astype(np.int64)
+    vals = rng.normal(0, 1, (2, T))
+    streams, fb = encode_batch(ts, vals, np.full(2, START, np.int64))
+    assert fb.all()
+    assert streams[0] == b""
+
+
+def test_encode_precision_limit_flags_fallback():
+    T = 4
+    ts = np.tile(START + np.arange(1, T + 1) * 10**9, (1, 1)).astype(np.int64)
+    vals = np.full((1, T), float(2**60))
+    _, fb = encode_batch(ts, vals, np.full(1, START, np.int64), out_words=50)
+    assert fb.all()
+
+
+def test_decode_batch_golden_corpus():
+    with open(DATA_DIR / "m3tsz_sample_series.json") as f:
+        streams = [base64.b64decode(s) for s in json.load(f)]
+    ts, vals, counts, fb = decode_batch(streams, max_points=1500)
+    assert not fb.any()
+    for i, s in enumerate(streams):
+        want = decode_series(s)
+        n = int(counts[i])
+        assert n == len(want)
+        assert ts[i][:n].tolist() == [d.timestamp for d in want]
+        for a, b in zip(vals[i][:n].tolist(), (d.value for d in want)):
+            assert a == b or (math.isnan(a) and math.isnan(b))
+
+
+def test_roundtrip_batched():
+    ts, vals, starts = _mk_batch(T=150, seed=5)
+    streams, fb = encode_batch(ts, vals, starts, out_words=400)
+    assert not fb.any()
+    ts2, vals2, counts, fb2 = decode_batch(streams, max_points=200)
+    assert not fb2.any()
+    assert (counts == 150).all()
+    assert (ts2[:, :150] == ts).all()
+    assert np.allclose(vals2[:, :150], vals, rtol=0, atol=0, equal_nan=True)
+
+
+def test_decode_annotation_stream_flags_fallback():
+    from m3_tpu.core.xtime import Unit
+    from m3_tpu.encoding.m3tsz import Datapoint, Encoder
+
+    enc = Encoder(START)
+    enc.encode(Datapoint(START + 10**9, 1.0, Unit.SECOND, b"schema"))
+    enc.encode(Datapoint(START + 2 * 10**9, 2.0, Unit.SECOND))
+    _, _, _, fb = decode_batch([enc.stream()], max_points=10)
+    assert fb.all()
+
+
+def test_saturated_int64_values_flag_fallback():
+    # Integral |v| >= 2^63 saturates to INT64_MIN and aliases distinct values;
+    # must be routed to the scalar codec (regression).
+    T = 3
+    ts = np.tile(START + np.arange(1, T + 1) * 10**9, (1, 1)).astype(np.int64)
+    vals = np.array([[-1e300, -2e300, -1e300]])
+    _, fb = encode_batch(ts, vals, np.full(1, START, np.int64), out_words=50)
+    assert fb.all()
+
+
+def test_dod_32bit_overflow_flags_fallback():
+    # > 2^31 seconds between points overflows the 32-bit default bucket; the
+    # reference raises OverflowError, the device path must flag fallback.
+    ts = np.array([[START + 10**9, START + 10**9 + (2**32) * 10**9]])
+    vals = np.ones((1, 2))
+    _, fb = encode_batch(ts, vals, np.full(1, START, np.int64), out_words=80)
+    assert fb.all()
+
+
+def test_decode_exactly_max_points_not_flagged():
+    dps = [(START + (i + 1) * 10**9, float(i)) for i in range(5)]
+    stream = encode_series(dps, start=START)
+    ts, vals, counts, fb = decode_batch([stream], max_points=5)
+    assert not fb.any()
+    assert counts[0] == 5
+    assert ts[0].tolist() == [t for t, _ in dps]
+
+
+def test_encode_zero_count_series_empty():
+    ts, vals, starts = _mk_batch(T=10)
+    counts = np.array([10, 0, 5, 0, 10, 10, 10, 10])
+    streams, fb = encode_batch(ts, vals, starts, counts=counts, out_words=50)
+    assert not fb.any()
+    assert streams[1] == b"" and streams[3] == b""
+    assert streams[0] != b""
