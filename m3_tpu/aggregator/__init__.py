@@ -1,0 +1,25 @@
+"""TPU-native streaming metrics aggregator.
+
+Re-design of the reference's ``src/aggregator``: the sharded in-memory
+rollup engine (per-metric Counter/Timer/Gauge elements keyed by
+(id, aggregation key), windowed by storage-policy resolution, drained by a
+leader-driven flush loop) becomes **array programming over a fixed-capacity
+slot arena**:
+
+* host side owns the string metric IDs and a slot allocator
+  (``engine.MetricMap``), mirroring the reference's find-or-create Entry
+  path (aggregator/map.go:149, entry.go:264);
+* device side holds per-(window, slot) statistic tensors and ingests
+  batches with scatter reductions (``arena.py``), mirroring
+  GenericElem.AddUnion -> Counter/Gauge.Update / Timer.AddBatch;
+* flush (``GenericElem.Consume`` generic_elem.go:271) becomes one
+  vectorized drain of a closed window ring row: all 22 aggregation
+  outputs computed as lanes, masked by each slot's compressed
+  aggregation-type ID.
+"""
+
+from m3_tpu.aggregator.arena import (
+    CounterArena,
+    GaugeArena,
+    TimerArena,
+)

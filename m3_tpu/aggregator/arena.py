@@ -1,0 +1,583 @@
+"""Device-side aggregation arenas: per-(window, slot) statistic tensors.
+
+This is the TPU re-design of the reference's per-metric aggregation
+objects (``src/aggregator/aggregation/counter.go:31-70``, ``gauge.go:31-99``,
+``timer.go:31-100``) and the window-keyed element values
+(``src/aggregator/aggregator/generic_elem.go:181-196`` AddUnion window
+alignment).  Instead of one heap object per (metric, window), each metric
+type owns flat statistic tensors of shape ``(W * C,)`` — a ring of W
+resolution windows by C metric slots — and an ingest batch is a handful of
+scatter reductions:
+
+    sum/count/sumsq  ->  .at[idx].add
+    min/max          ->  .at[idx].min / .at[idx].max
+    last (by time)   ->  lexicographic sort (slot, time, -arrival) +
+                         conditional scatter of per-slot winners
+
+Timer quantiles are **exact**: samples append into a per-window device
+buffer; flush lex-sorts (slot, value) pairs and reads ranks
+``ceil(q*n)`` per segment — stronger than the reference's
+Cormode-Muthukrishnan eps-approximate stream (quantile/cm/stream.go), and
+TPU-shaped (one big radix sort instead of pointer chasing).  A
+bit-faithful host CM stream lives in ``quantile_cm.py`` for parity tests.
+
+All 22 aggregation outputs (src/metrics/aggregation/type.go:34-55) are
+computed as lanes of a (C, L) matrix at window drain; the caller masks
+lanes by each slot's compressed AggregationID.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from m3_tpu.metrics.aggregation import AggregationType
+
+I64_MIN = np.iinfo(np.int64).min
+I64_MAX = np.iinfo(np.int64).max
+
+# Fixed output-lane order for non-quantile statistics.  Quantile lanes are
+# appended after these, in the order of the arena's `quantiles` tuple.
+SCALAR_LANES = (
+    AggregationType.LAST,
+    AggregationType.MIN,
+    AggregationType.MAX,
+    AggregationType.MEAN,
+    AggregationType.COUNT,
+    AggregationType.SUM,
+    AggregationType.SUM_SQ,
+    AggregationType.STDEV,
+)
+
+
+def _stdev(count, sum_sq, sum_):
+    """Sample stdev from moments (reference aggregation/common.go:29-36)."""
+    div = count * (count - 1)
+    num = count * sum_sq - sum_ * sum_
+    return jnp.where(div <= 0, 0.0, jnp.sqrt(jnp.abs(num) / jnp.where(div == 0, 1, div)))
+
+
+# ---------------------------------------------------------------------------
+# Counter arena (int64 values; reference aggregation/counter.go).
+# ---------------------------------------------------------------------------
+
+
+class CounterState(NamedTuple):
+    sum: jnp.ndarray  # i64 (W*C,)
+    sum_sq: jnp.ndarray  # i64
+    count: jnp.ndarray  # i64
+    max: jnp.ndarray  # i64, identity I64_MIN
+    min: jnp.ndarray  # i64, identity I64_MAX
+    last_at: jnp.ndarray  # i64 (C,) — per-slot last write time, for expiry
+
+
+def counter_init(num_windows: int, capacity: int) -> CounterState:
+    n = num_windows * capacity
+    return CounterState(
+        sum=jnp.zeros(n, jnp.int64),
+        sum_sq=jnp.zeros(n, jnp.int64),
+        count=jnp.zeros(n, jnp.int64),
+        max=jnp.full(n, I64_MIN, jnp.int64),
+        min=jnp.full(n, I64_MAX, jnp.int64),
+        last_at=jnp.zeros(capacity, jnp.int64),
+    )
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def counter_ingest(
+    state: CounterState,
+    idx: jnp.ndarray,  # i32 (N,) flattened window*C + slot; >= W*C to drop
+    slots: jnp.ndarray,  # i32 (N,)
+    values: jnp.ndarray,  # i64 (N,)
+    times: jnp.ndarray,  # i64 (N,)
+) -> CounterState:
+    """Counter.Update for a batch (reference counter.go:53-76)."""
+    return CounterState(
+        sum=state.sum.at[idx].add(values, mode="drop"),
+        sum_sq=state.sum_sq.at[idx].add(values * values, mode="drop"),
+        count=state.count.at[idx].add(1, mode="drop"),
+        max=state.max.at[idx].max(values, mode="drop"),
+        min=state.min.at[idx].min(values, mode="drop"),
+        last_at=state.last_at.at[slots].max(times, mode="drop"),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("capacity",))
+def counter_consume(state: CounterState, window: jnp.ndarray, capacity: int):
+    """Drain one window row -> (C, L) lane matrix (reference counter.go
+    accessors Sum/SumSq/Count/Max/Min/Mean/Stdev; Last is invalid for
+    counters and emitted as NaN)."""
+    off = window * capacity
+    sl = lambda a: jax.lax.dynamic_slice_in_dim(a, off, capacity)
+    s = sl(state.sum).astype(jnp.float64)
+    ssq = sl(state.sum_sq).astype(jnp.float64)
+    cnt = sl(state.count)
+    cntf = cnt.astype(jnp.float64)
+    mean = jnp.where(cnt == 0, 0.0, s / jnp.where(cnt == 0, 1, cnt))
+    lanes = jnp.stack(
+        [
+            jnp.full(capacity, jnp.nan),  # LAST
+            jnp.where(cnt == 0, 0.0, sl(state.min).astype(jnp.float64)),
+            jnp.where(cnt == 0, 0.0, sl(state.max).astype(jnp.float64)),
+            mean,
+            cntf,
+            s,
+            ssq,
+            _stdev(cntf, ssq, s),
+        ],
+        axis=1,
+    )
+    return lanes, cnt
+
+
+@functools.partial(jax.jit, donate_argnums=0, static_argnames=("capacity",))
+def counter_reset_window(state: CounterState, window: jnp.ndarray, capacity: int) -> CounterState:
+    off = window * capacity
+    upd = lambda a, v: jax.lax.dynamic_update_slice_in_dim(
+        a, jnp.full(capacity, v, a.dtype), off, 0
+    )
+    return CounterState(
+        sum=upd(state.sum, 0),
+        sum_sq=upd(state.sum_sq, 0),
+        count=upd(state.count, 0),
+        max=upd(state.max, I64_MIN),
+        min=upd(state.min, I64_MAX),
+        last_at=state.last_at,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Gauge arena (float64 values; reference aggregation/gauge.go).
+# ---------------------------------------------------------------------------
+
+
+class GaugeState(NamedTuple):
+    last: jnp.ndarray  # f64 (W*C,)
+    last_time: jnp.ndarray  # i64 (W*C,) — timestamp backing `last`
+    sum: jnp.ndarray  # f64
+    sum_sq: jnp.ndarray  # f64
+    count: jnp.ndarray  # i64
+    max: jnp.ndarray  # f64, identity -inf (NaN surfaced when count==0)
+    min: jnp.ndarray  # f64, identity +inf
+    last_at: jnp.ndarray  # i64 (C,)
+
+
+def gauge_init(num_windows: int, capacity: int) -> GaugeState:
+    n = num_windows * capacity
+    return GaugeState(
+        last=jnp.zeros(n, jnp.float64),
+        last_time=jnp.zeros(n, jnp.int64),
+        sum=jnp.zeros(n, jnp.float64),
+        sum_sq=jnp.zeros(n, jnp.float64),
+        count=jnp.zeros(n, jnp.int64),
+        max=jnp.full(n, -jnp.inf, jnp.float64),
+        min=jnp.full(n, jnp.inf, jnp.float64),
+        last_at=jnp.zeros(capacity, jnp.int64),
+    )
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def gauge_ingest(
+    state: GaugeState,
+    idx: jnp.ndarray,  # i32 (N,) flattened; >= W*C to drop
+    slots: jnp.ndarray,  # i32 (N,)
+    values: jnp.ndarray,  # f64 (N,)
+    times: jnp.ndarray,  # i64 (N,)
+) -> GaugeState:
+    """Gauge.Update for a batch (reference gauge.go:53-104).
+
+    Semantics mirrored: `last` tracks the value with the greatest
+    timestamp, first arrival winning ties (gauge.go:82-91 only updates
+    when strictly after); count includes NaN values but sum/min/max
+    ignore them (gauge.go:57-63,95-103).
+    """
+    n = values.shape[0]
+    nan = jnp.isnan(values)
+    safe = jnp.where(nan, 0.0, values)
+
+    # Per-slot winner for `last`: sort by (idx asc, time asc, arrival
+    # desc); the final element of each idx-segment is (max time, min
+    # arrival).  Conditional scatter beats the stored (time, arrival)
+    # only when strictly newer.
+    arrival_desc = jnp.arange(n - 1, -1, -1, dtype=jnp.int32)
+    s_idx, _s_time, _s_arr, s_val, s_times = jax.lax.sort(
+        (idx, times, arrival_desc, values, times), num_keys=3
+    )
+    is_winner = jnp.concatenate([s_idx[1:] != s_idx[:-1], jnp.ones(1, bool)])
+    old_time = state.last_time[jnp.clip(s_idx, 0, state.last_time.shape[0] - 1)]
+    take = is_winner & (s_times > old_time)
+    widx = jnp.where(take, s_idx, state.last.shape[0])  # OOB -> dropped
+
+    return GaugeState(
+        last=state.last.at[widx].set(s_val, mode="drop"),
+        last_time=state.last_time.at[widx].set(s_times, mode="drop"),
+        sum=state.sum.at[idx].add(safe, mode="drop"),
+        sum_sq=state.sum_sq.at[idx].add(safe * safe, mode="drop"),
+        count=state.count.at[idx].add(1, mode="drop"),
+        max=state.max.at[idx].max(jnp.where(nan, -jnp.inf, values), mode="drop"),
+        min=state.min.at[idx].min(jnp.where(nan, jnp.inf, values), mode="drop"),
+        last_at=state.last_at.at[slots].max(times, mode="drop"),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("capacity",))
+def gauge_consume(state: GaugeState, window: jnp.ndarray, capacity: int):
+    off = window * capacity
+    sl = lambda a: jax.lax.dynamic_slice_in_dim(a, off, capacity)
+    s, ssq, cnt = sl(state.sum), sl(state.sum_sq), sl(state.count)
+    cntf = cnt.astype(jnp.float64)
+    mx, mn = sl(state.max), sl(state.min)
+    mean = jnp.where(cnt == 0, 0.0, s / jnp.where(cnt == 0, 1, cnt))
+    lanes = jnp.stack(
+        [
+            sl(state.last),
+            jnp.where(jnp.isinf(mn), jnp.nan, mn),  # NaN until a value seen
+            jnp.where(jnp.isinf(mx), jnp.nan, mx),
+            mean,
+            cntf,
+            s,
+            ssq,
+            _stdev(cntf, ssq, s),
+        ],
+        axis=1,
+    )
+    return lanes, cnt
+
+
+@functools.partial(jax.jit, donate_argnums=0, static_argnames=("capacity",))
+def gauge_reset_window(state: GaugeState, window: jnp.ndarray, capacity: int) -> GaugeState:
+    off = window * capacity
+    upd = lambda a, v: jax.lax.dynamic_update_slice_in_dim(
+        a, jnp.full(capacity, v, a.dtype), off, 0
+    )
+    return GaugeState(
+        last=upd(state.last, 0.0),
+        last_time=upd(state.last_time, 0),
+        sum=upd(state.sum, 0.0),
+        sum_sq=upd(state.sum_sq, 0.0),
+        count=upd(state.count, 0),
+        max=upd(state.max, -jnp.inf),
+        min=upd(state.min, jnp.inf),
+        last_at=state.last_at,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Timer arena (float64 values + exact quantiles; reference
+# aggregation/timer.go + quantile/cm/stream.go).
+# ---------------------------------------------------------------------------
+
+
+class TimerState(NamedTuple):
+    sum: jnp.ndarray  # f64 (W*C,)
+    sum_sq: jnp.ndarray  # f64
+    count: jnp.ndarray  # i64
+    sample_slot: jnp.ndarray  # i32 (W, S) — slot per buffered sample
+    sample_val: jnp.ndarray  # f64 (W, S)
+    sample_n: jnp.ndarray  # i64 (W,) — write offsets (may exceed S: overflow)
+    last_at: jnp.ndarray  # i64 (C,)
+
+
+def timer_init(num_windows: int, capacity: int, sample_capacity: int) -> TimerState:
+    n = num_windows * capacity
+    return TimerState(
+        sum=jnp.zeros(n, jnp.float64),
+        sum_sq=jnp.zeros(n, jnp.float64),
+        count=jnp.zeros(n, jnp.int64),
+        sample_slot=jnp.full((num_windows, sample_capacity), capacity, jnp.int32),
+        sample_val=jnp.zeros((num_windows, sample_capacity), jnp.float64),
+        sample_n=jnp.zeros(num_windows, jnp.int64),
+        last_at=jnp.zeros(capacity, jnp.int64),
+    )
+
+
+@functools.partial(jax.jit, donate_argnums=0, static_argnames=("capacity",))
+def timer_ingest(
+    state: TimerState,
+    windows: jnp.ndarray,  # i32 (N,) window ring index per sample; >= W drops
+    slots: jnp.ndarray,  # i32 (N,)
+    values: jnp.ndarray,  # f64 (N,)
+    times: jnp.ndarray,  # i64 (N,)
+    capacity: int,
+) -> TimerState:
+    """Timer.AddBatch for a batch of (slot, value) samples
+    (reference timer.go:55-76): moments scatter-add plus sample append.
+
+    Samples append into each window's buffer at offsets
+    ``sample_n[w] + rank-within-batch``; indices beyond S drop (the
+    moment stats stay exact; quantiles degrade — counted by the caller
+    via sample_n overflow).
+    """
+    num_w, scap = state.sample_slot.shape
+    idx = windows * capacity + slots
+    oob = (windows < 0) | (windows >= num_w)
+    idx = jnp.where(oob, num_w * capacity, idx)
+
+    # Rank of each sample within its window for this batch: sort by
+    # window, rank = position - first-position-of-window.
+    n = values.shape[0]
+    order_key = jnp.where(oob, num_w, windows)
+    s_w, s_slot, s_val = jax.lax.sort(
+        (order_key, slots, values), num_keys=1
+    )
+    pos = jnp.arange(n, dtype=jnp.int64)
+    first_of_w = jnp.searchsorted(s_w, s_w, side="left")
+    rank = pos - first_of_w
+    base = state.sample_n[jnp.clip(s_w, 0, num_w - 1)]
+    dst = base + rank
+    flat = jnp.where(
+        (s_w < num_w) & (dst < scap), s_w.astype(jnp.int64) * scap + dst, num_w * scap
+    )
+    per_w_counts = jnp.bincount(order_key, length=num_w)
+
+    return TimerState(
+        sum=state.sum.at[idx].add(values, mode="drop"),
+        sum_sq=state.sum_sq.at[idx].add(values * values, mode="drop"),
+        count=state.count.at[idx].add(1, mode="drop"),
+        sample_slot=state.sample_slot.ravel()
+        .at[flat]
+        .set(s_slot, mode="drop")
+        .reshape(num_w, scap),
+        sample_val=state.sample_val.ravel()
+        .at[flat]
+        .set(s_val, mode="drop")
+        .reshape(num_w, scap),
+        sample_n=state.sample_n + per_w_counts,
+        last_at=state.last_at.at[slots].max(times, mode="drop"),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("capacity", "quantiles"))
+def timer_consume(
+    state: TimerState,
+    window: jnp.ndarray,
+    capacity: int,
+    quantiles: tuple,
+):
+    """Drain one timer window -> (C, L + Q) lanes.
+
+    Exact quantiles via lex-sort of (slot, value) and per-segment rank
+    reads at ``ceil(q*n)`` (the reference CM stream targets the same rank
+    within eps error — quantile/cm/stream.go:239-247).
+    """
+    num_w, scap = state.sample_slot.shape
+    off = window * capacity
+    sl = lambda a: jax.lax.dynamic_slice_in_dim(a, off, capacity)
+    s, ssq, cnt = sl(state.sum), sl(state.sum_sq), sl(state.count)
+    cntf = cnt.astype(jnp.float64)
+    mean = jnp.where(cnt == 0, 0.0, s / jnp.where(cnt == 0, 1, cnt))
+
+    slots_w = jax.lax.dynamic_index_in_dim(state.sample_slot, window, keepdims=False)
+    vals_w = jax.lax.dynamic_index_in_dim(state.sample_val, window, keepdims=False)
+    s_slot, s_val = jax.lax.sort((slots_w, vals_w), num_keys=2)
+
+    seg_start = jnp.searchsorted(s_slot, jnp.arange(capacity, dtype=jnp.int32))
+    seg_end = jnp.searchsorted(
+        s_slot, jnp.arange(capacity, dtype=jnp.int32), side="right"
+    )
+    seg_n = (seg_end - seg_start).astype(jnp.float64)
+
+    mn = s_val[jnp.clip(seg_start, 0, scap - 1)]
+    mx = s_val[jnp.clip(seg_end - 1, 0, scap - 1)]
+    empty = seg_n == 0
+    mn = jnp.where(empty, 0.0, mn)
+    mx = jnp.where(empty, 0.0, mx)
+
+    qlanes = []
+    for q in quantiles:
+        ranks = jnp.ceil(q * seg_n).astype(jnp.int64) - 1
+        ranks = jnp.clip(ranks, 0, jnp.maximum(seg_n.astype(jnp.int64) - 1, 0))
+        qv = s_val[jnp.clip(seg_start + ranks, 0, scap - 1)]
+        qlanes.append(jnp.where(empty, 0.0, qv))
+
+    lanes = jnp.stack(
+        [
+            jnp.full(capacity, jnp.nan),  # LAST (invalid for timers)
+            mn,
+            mx,
+            mean,
+            cntf,
+            s,
+            ssq,
+            _stdev(cntf, ssq, s),
+            *qlanes,
+        ],
+        axis=1,
+    )
+    return lanes, cnt
+
+
+@functools.partial(jax.jit, donate_argnums=0, static_argnames=("capacity",))
+def timer_reset_window(state: TimerState, window: jnp.ndarray, capacity: int) -> TimerState:
+    num_w, scap = state.sample_slot.shape
+    off = window * capacity
+    upd = lambda a, v: jax.lax.dynamic_update_slice_in_dim(
+        a, jnp.full(capacity, v, a.dtype), off, 0
+    )
+    return TimerState(
+        sum=upd(state.sum, 0.0),
+        sum_sq=upd(state.sum_sq, 0.0),
+        count=upd(state.count, 0),
+        sample_slot=jax.lax.dynamic_update_slice(
+            state.sample_slot,
+            jnp.full((1, scap), capacity, jnp.int32),
+            (window.astype(jnp.int32), jnp.int32(0)),
+        ),
+        sample_val=state.sample_val,
+        sample_n=state.sample_n.at[window].set(0),
+        last_at=state.last_at,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Thin stateful wrappers used by the engine.
+# ---------------------------------------------------------------------------
+
+
+class _ScalarLanesMixin:
+    @property
+    def lane_types(self):
+        return SCALAR_LANES
+
+    def lane_for_type(self, t: AggregationType) -> int | None:
+        return SCALAR_LANES.index(t) if t in SCALAR_LANES else None
+
+
+class CounterArena(_ScalarLanesMixin):
+    """Counter slots over a W-window ring (reference counter.go semantics)."""
+
+    def __init__(self, num_windows: int, capacity: int):
+        self.num_windows = num_windows
+        self.capacity = capacity
+        self.state = counter_init(num_windows, capacity)
+
+    def ingest(self, windows, slots, values, times):
+        idx = jnp.where(
+            (windows < 0) | (windows >= self.num_windows),
+            self.num_windows * self.capacity,
+            windows * self.capacity + slots,
+        ).astype(jnp.int64)
+        self.state = counter_ingest(self.state, idx, slots, values.astype(jnp.int64), times)
+
+    def consume(self, window: int):
+        return counter_consume(self.state, jnp.int32(window), self.capacity)
+
+    def reset_window(self, window: int):
+        self.state = counter_reset_window(self.state, jnp.int32(window), self.capacity)
+
+
+class GaugeArena(_ScalarLanesMixin):
+    def __init__(self, num_windows: int, capacity: int):
+        self.num_windows = num_windows
+        self.capacity = capacity
+        self.state = gauge_init(num_windows, capacity)
+
+    def ingest(self, windows, slots, values, times):
+        idx = jnp.where(
+            (windows < 0) | (windows >= self.num_windows),
+            self.num_windows * self.capacity,
+            windows * self.capacity + slots,
+        ).astype(jnp.int64)
+        self.state = gauge_ingest(self.state, idx, slots, values.astype(jnp.float64), times)
+
+    def consume(self, window: int):
+        return gauge_consume(self.state, jnp.int32(window), self.capacity)
+
+    def reset_window(self, window: int):
+        self.state = gauge_reset_window(self.state, jnp.int32(window), self.capacity)
+
+
+class TimerArena:
+    DEFAULT_QUANTILES = (0.5, 0.95, 0.99)
+
+    def __init__(
+        self,
+        num_windows: int,
+        capacity: int,
+        sample_capacity: int,
+        quantiles: tuple = DEFAULT_QUANTILES,
+    ):
+        self.num_windows = num_windows
+        self.capacity = capacity
+        self.sample_capacity = sample_capacity
+        self.quantiles = tuple(quantiles)
+        self.state = timer_init(num_windows, capacity, sample_capacity)
+
+    def ingest(self, windows, slots, values, times):
+        """Append a batch; grows the per-window sample buffer first if the
+        batch would overflow it (the reference CM stream never drops
+        samples — stream.go AddBatch — so neither do we; growth is
+        geometric to amortize the re-jit)."""
+        windows_np = np.asarray(windows)
+        in_range = (windows_np >= 0) & (windows_np < self.num_windows)
+        per_w = np.bincount(
+            windows_np[in_range], minlength=self.num_windows
+        )
+        needed = int((np.asarray(self.state.sample_n) + per_w).max())
+        if needed > self.sample_capacity:
+            self._grow(needed)
+        self.state = timer_ingest(
+            self.state,
+            jnp.asarray(windows_np.astype(np.int32)),
+            slots,
+            values.astype(jnp.float64),
+            times,
+            self.capacity,
+        )
+
+    def _grow(self, needed: int) -> None:
+        new_cap = self.sample_capacity
+        while new_cap < needed:
+            new_cap *= 2
+        pad = new_cap - self.sample_capacity
+        self.state = TimerState(
+            sum=self.state.sum,
+            sum_sq=self.state.sum_sq,
+            count=self.state.count,
+            sample_slot=jnp.pad(
+                self.state.sample_slot,
+                ((0, 0), (0, pad)),
+                constant_values=self.capacity,
+            ),
+            sample_val=jnp.pad(self.state.sample_val, ((0, 0), (0, pad))),
+            sample_n=self.state.sample_n,
+            last_at=self.state.last_at,
+        )
+        self.sample_capacity = new_cap
+
+    def consume(self, window: int):
+        return timer_consume(
+            self.state, jnp.int32(window), self.capacity, self.quantiles
+        )
+
+    def reset_window(self, window: int):
+        self.state = timer_reset_window(self.state, jnp.int32(window), self.capacity)
+
+    @property
+    def lane_types(self):
+        """Primary type per lane; quantile-aliased types (e.g. MEDIAN ==
+        P50) resolve through lane_for_type."""
+        qtypes = []
+        for q in self.quantiles:
+            primary = next(
+                (
+                    t
+                    for t in AggregationType
+                    if t is not AggregationType.MEDIAN and t.quantile() == q
+                ),
+                AggregationType.UNKNOWN,
+            )
+            qtypes.append(primary)
+        return SCALAR_LANES + tuple(qtypes)
+
+    def lane_for_type(self, t: AggregationType) -> int | None:
+        if t in SCALAR_LANES:
+            return SCALAR_LANES.index(t)
+        q = t.quantile()
+        if q is not None and q in self.quantiles:
+            return len(SCALAR_LANES) + self.quantiles.index(q)
+        return None

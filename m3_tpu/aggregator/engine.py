@@ -1,0 +1,375 @@
+"""Aggregator engine: host control plane over the device arenas.
+
+Re-design of the reference's object-per-metric engine
+(``src/aggregator/aggregator/aggregator.go:263`` AddUntimed →
+``shard.go:171`` → ``map.go:149`` find-or-create Entry →
+``entry.go:264`` resolve metadata → per-(id, aggregation key) element →
+``generic_elem.go:181`` AddUnion; flush via ``list.go:289``
+baseMetricList.Flush → ``generic_elem.go:271`` Consume).
+
+Here the per-shard state is three fixed-capacity device arenas (counter /
+gauge / timer) per storage-policy resolution.  The host owns:
+
+* ``MetricMap`` — metric ID bytes → (type, slot, aggregation bitmask),
+  the analogue of map.go's entry map + shard_insert_queue slot creation;
+* window bookkeeping — ring index = (aligned_nanos // resolution) % W,
+  the analogue of generic_elem's startAligned-keyed values list;
+* ``consume`` — drains every window whose end <= target, computes the
+  (C, lanes) output matrix on device, masks each slot's requested
+  aggregation types, and emits (id, type, time, value) tuples through a
+  flush handler, the analogue of Consume + flushLocalFn.
+
+Batched adds take numpy arrays; ID→slot resolution is vectorized through
+a Python dict once per unique ID (new series only), then cached in the
+caller-visible ``resolve`` arrays — mirroring how the reference amortizes
+entry lookup with rate-limited entry creation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import zlib
+from typing import Callable, Dict, List, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from m3_tpu.aggregator.arena import CounterArena, GaugeArena, TimerArena
+from m3_tpu.metrics.aggregation import AggregationID, AggregationType
+from m3_tpu.metrics.policy import StoragePolicy
+from m3_tpu.metrics.types import MetricType
+
+
+@dataclasses.dataclass(frozen=True)
+class AggregatorOptions:
+    """Sizing knobs (reference aggregator/options.go, collapsed to the
+    arena geometry that matters on device)."""
+
+    capacity: int = 1 << 20  # metric slots per type per shard
+    num_windows: int = 2  # ring of open resolution windows
+    timer_sample_capacity: int = 1 << 24
+    quantiles: tuple = (0.5, 0.95, 0.99)
+    storage_policies: tuple = (StoragePolicy.parse("10s:2d"),)
+
+
+@dataclasses.dataclass
+class FlushedMetric:
+    """One flushed aggregate batch: parallel arrays."""
+
+    policy: StoragePolicy
+    timestamp_nanos: int
+    slots: np.ndarray  # int32
+    types: np.ndarray  # int8 AggregationType values
+    values: np.ndarray  # float64
+
+
+FlushHandler = Callable[["MetricList", FlushedMetric], None]
+
+
+class MetricMap:
+    """(ID, aggregation key) → slot allocator for one metric type.
+
+    The reference keys aggregation elements by (id, aggregation key)
+    (map.go:149 entry map; entry.go:264 one elem per key), so the same
+    metric ID written with two different aggregation sets produces both
+    sets of outputs — mirrored here by keying slots on (id, mask).
+
+    Slots are dense int32; freed slots recycle through a free list (the
+    reference GCs idle entries via lastAccess; expiry here drains the
+    arena's device-side last_at column through MetricList.expire).
+    """
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._slots: Dict[tuple, int] = {}
+        self._ids: List[bytes | None] = []
+        self._free: List[int] = []
+        self.agg_mask = np.zeros(capacity, np.uint64)
+
+    def __len__(self) -> int:
+        return len(self._slots)
+
+    def id_of(self, slot: int) -> bytes | None:
+        return self._ids[slot] if slot < len(self._ids) else None
+
+    def resolve(self, ids: Sequence[bytes], agg_id: AggregationID, mt: MetricType) -> np.ndarray:
+        """Find-or-create slots for a batch of IDs."""
+        mask = self._mask_for(agg_id, mt)
+        slots = np.empty(len(ids), np.int32)
+        get = self._slots.get
+        missing: List[int] = []
+        for i, mid in enumerate(ids):
+            s = get((mid, mask))
+            if s is None:
+                missing.append(i)
+            else:
+                slots[i] = s
+        for i in missing:
+            mid = ids[i]
+            s = self._slots.get((mid, mask))
+            if s is None:
+                s = self._allocate(mid, mask)
+                self.agg_mask[s] = np.uint64(mask)
+            slots[i] = s
+        return slots
+
+    def _mask_for(self, agg_id: AggregationID, mt: MetricType) -> int:
+        """Compressed mask of the requested types that are valid for this
+        metric type (the reference validates per type: aggregation
+        type.go IsValidForCounter/Timer/Gauge)."""
+        m = 0
+        for t in agg_id.types_for(mt):
+            if t.is_valid_for(mt):
+                m |= 1 << int(t)
+        return m
+
+    def _allocate(self, mid: bytes, mask: int) -> int:
+        if self._free:
+            s = self._free.pop()
+            self._ids[s] = mid
+        else:
+            s = len(self._ids)
+            if s >= self.capacity:
+                raise RuntimeError(
+                    f"metric map capacity {self.capacity} exhausted"
+                )
+            self._ids.append(mid)
+        self._slots[(mid, mask)] = s
+        return s
+
+    def release(self, slot: int) -> None:
+        mid = self._ids[slot]
+        if mid is None:
+            return
+        mask = int(self.agg_mask[slot])
+        self._slots.pop((mid, mask), None)
+        self._ids[slot] = None
+        self.agg_mask[slot] = 0
+        self._free.append(slot)
+
+
+class MetricList:
+    """All state for one (shard, storage policy) pair: three arenas plus
+    window bookkeeping (reference list.go baseMetricList keyed by
+    (resolution, flushOffset))."""
+
+    def __init__(self, policy: StoragePolicy, opts: AggregatorOptions):
+        self.policy = policy
+        self.opts = opts
+        self.resolution = policy.resolution.window_nanos
+        W, C = opts.num_windows, opts.capacity
+        self.counters = CounterArena(W, C)
+        self.gauges = GaugeArena(W, C)
+        self.timers = TimerArena(W, C, opts.timer_sample_capacity, opts.quantiles)
+        self.maps = {
+            MetricType.COUNTER: MetricMap(C),
+            MetricType.GAUGE: MetricMap(C),
+            MetricType.TIMER: MetricMap(C),
+        }
+        # Earliest window (aligned nanos) not yet consumed.  Windows in
+        # [consumed_until, +W*resolution) are open; later ones rejected
+        # (bufferFuture) and earlier dropped (bufferPast) — the
+        # reference's too-early/too-late errors (entry.go).
+        self.consumed_until: int | None = None
+        self.drops = 0
+
+    def _arena(self, mt: MetricType):
+        return {
+            MetricType.COUNTER: self.counters,
+            MetricType.GAUGE: self.gauges,
+            MetricType.TIMER: self.timers,
+        }[mt]
+
+    def add_batch(
+        self,
+        mt: MetricType,
+        ids: Sequence[bytes],
+        values: np.ndarray,
+        times: np.ndarray,
+        agg_id: AggregationID = AggregationID.DEFAULT,
+    ) -> None:
+        slots = self.maps[mt].resolve(ids, agg_id, mt)
+        self.add_batch_slots(mt, slots, values, times)
+
+    def add_batch_slots(
+        self,
+        mt: MetricType,
+        slots: np.ndarray,
+        values: np.ndarray,
+        times: np.ndarray,
+    ) -> None:
+        """Pure device path: slots already resolved (the hot loop)."""
+        r = self.resolution
+        W = self.opts.num_windows
+        aligned = (times // r) * r
+        if self.consumed_until is None:
+            self.consumed_until = int(aligned.min())
+        base = self.consumed_until
+        offset = (aligned - base) // r
+        in_range = (offset >= 0) & (offset < W)
+        self.drops += int((~in_range).sum())
+        windows = np.where(in_range, (aligned // r) % W, W).astype(np.int32)
+        self._arena(mt).ingest(
+            jnp.asarray(windows), jnp.asarray(slots), jnp.asarray(values), jnp.asarray(times)
+        )
+
+    def open_windows(self, now_nanos: int) -> List[int]:
+        """Closed windows that can actually hold data.
+
+        Ingest only accepts timestamps in
+        [consumed_until, consumed_until + W*resolution) — so after an
+        idle gap only the first W windows past consumed_until need a
+        device drain; the rest are provably empty and are skipped by
+        advancing consumed_until directly (avoids one (C, lanes)
+        device->host transfer per empty elapsed window).
+        """
+        if self.consumed_until is None:
+            return []
+        r = self.resolution
+        out = []
+        t = self.consumed_until
+        while t + r <= now_nanos and len(out) < self.opts.num_windows:
+            out.append(t)
+            t += r
+        return out
+
+    def consume(self, target_nanos: int, flush_handler: FlushHandler | None = None):
+        """Drain every closed window (reference generic_elem.go:271
+        Consume: windows with start+resolution <= target)."""
+        results = []
+        for start in self.open_windows(target_nanos):
+            w = (start // self.resolution) % self.opts.num_windows
+            ts = start + self.resolution  # end-of-window timestamp
+            for mt in (MetricType.COUNTER, MetricType.GAUGE, MetricType.TIMER):
+                arena = self._arena(mt)
+                lanes, counts = arena.consume(w)
+                flushed = self._emit(mt, arena, lanes, counts, ts)
+                if flushed is not None:
+                    results.append(flushed)
+                    if flush_handler is not None:
+                        flush_handler(self, flushed)
+                arena.reset_window(w)
+            self.consumed_until = start + self.resolution
+        if self.consumed_until is not None:
+            r = self.resolution
+            floor_target = (target_nanos // r) * r
+            if floor_target > self.consumed_until:
+                # Idle gap beyond the window ring: skip empty windows.
+                self.consumed_until = floor_target
+        return results
+
+    def expire(self, now_nanos: int, ttl_nanos: int) -> int:
+        """Release slots idle for longer than ttl (the reference GCs
+        entries via lastAccess + entryTTL — map.go deleteExpired /
+        entry.go ShouldExpire).  Reads the device last_at column, frees
+        matching slots in every map, and clears their last_at."""
+        released = 0
+        for mt in (MetricType.COUNTER, MetricType.GAUGE, MetricType.TIMER):
+            arena = self._arena(mt)
+            last_at = np.asarray(arena.state.last_at)
+            stale = np.nonzero((last_at > 0) & (last_at < now_nanos - ttl_nanos))[0]
+            if stale.size == 0:
+                continue
+            m = self.maps[mt]
+            for s in stale:
+                m.release(int(s))
+            arena.state = arena.state._replace(
+                last_at=arena.state.last_at.at[jnp.asarray(stale)].set(0)
+            )
+            released += stale.size
+        return released
+
+    def _emit(self, mt, arena, lanes, counts, ts) -> FlushedMetric | None:
+        lanes = np.asarray(lanes)
+        counts = np.asarray(counts)
+        active = np.nonzero(counts > 0)[0]
+        if active.size == 0:
+            return None
+        mask = self.maps[mt].agg_mask[active]
+        out_slots: List[np.ndarray] = []
+        out_types: List[np.ndarray] = []
+        out_vals: List[np.ndarray] = []
+        for t in AggregationType:
+            if not t.is_valid():
+                continue
+            lane_i = arena.lane_for_type(t)
+            if lane_i is None:
+                continue
+            want = (mask >> np.uint64(int(t))) & np.uint64(1)
+            sel = np.nonzero(want.astype(bool))[0]
+            if sel.size == 0:
+                continue
+            rows = active[sel]
+            out_slots.append(rows.astype(np.int32))
+            out_types.append(np.full(rows.size, int(t), np.int8))
+            out_vals.append(lanes[rows, lane_i])
+        if not out_slots:
+            return None
+        return FlushedMetric(
+            policy=self.policy,
+            timestamp_nanos=ts,
+            slots=np.concatenate(out_slots),
+            types=np.concatenate(out_types),
+            values=np.concatenate(out_vals),
+        )
+
+
+class AggregatorShard:
+    """One aggregator shard: a MetricList per storage policy
+    (reference shard.go:171 AddUntimed + list registry)."""
+
+    def __init__(self, shard_id: int, opts: AggregatorOptions):
+        self.shard_id = shard_id
+        self.opts = opts
+        self.lists = {sp: MetricList(sp, opts) for sp in opts.storage_policies}
+
+    def add_batch(self, mt, ids, values, times, agg_id=AggregationID.DEFAULT):
+        for ml in self.lists.values():
+            ml.add_batch(mt, ids, values, times, agg_id)
+
+    def consume(self, target_nanos: int, flush_handler=None):
+        out = []
+        for ml in self.lists.values():
+            out.extend(ml.consume(target_nanos, flush_handler))
+        return out
+
+
+class Aggregator:
+    """Top-level aggregator (reference aggregator.go:101): routes metrics
+    to shards by murmur-style hash and drives consume across shards.
+
+    Single-host form; the multi-device form shards the slot axis over a
+    mesh (m3_tpu.parallel) so each device owns capacity/D slots.
+    """
+
+    def __init__(self, num_shards: int = 1, opts: AggregatorOptions | None = None):
+        self.opts = opts or AggregatorOptions()
+        self.shards = [AggregatorShard(i, self.opts) for i in range(num_shards)]
+
+    def shard_for(self, mid: bytes) -> AggregatorShard:
+        # Reference uses murmur3(id) % numShards (aggregator.go:505,
+        # sharding/shardset.go:148); any stable hash serves the same role.
+        return self.shards[zlib_crc(mid) % len(self.shards)]
+
+    def add_untimed_batch(self, mt, ids, values, times, agg_id=AggregationID.DEFAULT):
+        if len(self.shards) == 1:
+            self.shards[0].add_batch(mt, ids, values, times, agg_id)
+            return
+        by_shard: Dict[int, List[int]] = {}
+        for i, mid in enumerate(ids):
+            by_shard.setdefault(zlib_crc(mid) % len(self.shards), []).append(i)
+        for sid, idxs in by_shard.items():
+            sel = np.asarray(idxs)
+            self.shards[sid].add_batch(
+                mt, [ids[i] for i in idxs], values[sel], times[sel], agg_id
+            )
+
+    def consume(self, target_nanos: int, flush_handler=None):
+        out = []
+        for sh in self.shards:
+            out.extend(sh.consume(target_nanos, flush_handler))
+        return out
+
+
+def zlib_crc(b: bytes) -> int:
+    return zlib.crc32(b)

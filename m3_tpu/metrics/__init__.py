@@ -1,0 +1,12 @@
+"""Metrics domain: metric types, aggregation types, storage policies,
+transformations, and pipelines.
+
+TPU-native re-design of the reference's ``src/metrics`` tree.  Scalar
+semantics follow the reference exactly; every transform and aggregation
+additionally ships a batched JAX form operating over (series x time)
+tensors so the aggregator / downsampler hot paths run on device.
+"""
+
+from m3_tpu.metrics.types import MetricType, Datapoint
+from m3_tpu.metrics.aggregation import AggregationType, AggregationID
+from m3_tpu.metrics.policy import Resolution, StoragePolicy

@@ -1,0 +1,155 @@
+"""Transformations applied inside rollup pipelines.
+
+Reference parity: ``src/metrics/transformation/type.go:39-49`` (enum:
+Absolute/PerSecond/Increase/Add/Reset), ``unary.go`` (absolute, add),
+``binary.go`` (perSecond, increase), ``unary_multi.go`` (reset: emits the
+datapoint plus a zero one second later).
+
+Two forms of every transform:
+
+* scalar — Datapoint -> Datapoint, bit-faithful to the reference, used by
+  the host-side oracle and tests;
+* batched — ``jnp`` arrays of shape (..., T) of values + timestamps, with a
+  carried ``prev`` lane for binary transforms, used by the aggregator
+  Consume path on device.  NaN marks "empty datapoint" exactly as the
+  reference uses an empty datapoint sentinel.
+"""
+
+from __future__ import annotations
+
+import enum
+import math
+from typing import Callable, Tuple
+
+import jax.numpy as jnp
+
+from m3_tpu.metrics.types import Datapoint, EMPTY_DATAPOINT
+
+_NANOS_PER_SECOND = 1_000_000_000
+
+
+class TransformationType(enum.IntEnum):
+    """Reference src/metrics/transformation/type.go:39-49."""
+
+    UNKNOWN = 0
+    ABSOLUTE = 1
+    PER_SECOND = 2
+    INCREASE = 3
+    ADD = 4
+    RESET = 5
+
+    def is_unary(self) -> bool:
+        return self in (TransformationType.ABSOLUTE, TransformationType.ADD)
+
+    def is_binary(self) -> bool:
+        return self in (TransformationType.PER_SECOND, TransformationType.INCREASE)
+
+    def is_unary_multi(self) -> bool:
+        return self is TransformationType.RESET
+
+
+# ---------------------------------------------------------------------------
+# Scalar (host/oracle) forms.
+# ---------------------------------------------------------------------------
+
+def absolute(dp: Datapoint) -> Datapoint:
+    """Reference unary.go:35-40."""
+    return Datapoint(dp.time_nanos, abs(dp.value))
+
+
+def make_add() -> Callable[[Datapoint], Datapoint]:
+    """Stateful running sum; NaN treated as zero (reference unary.go:42-54)."""
+    state = {"curr": 0.0}
+
+    def add(dp: Datapoint) -> Datapoint:
+        if not math.isnan(dp.value):
+            state["curr"] += dp.value
+        return Datapoint(dp.time_nanos, state["curr"])
+
+    return add
+
+
+def per_second(prev: Datapoint, curr: Datapoint) -> Datapoint:
+    """Reference binary.go perSecond: skips NaN, requires increasing time
+    and non-decreasing value, rate per second."""
+    if (
+        prev.time_nanos >= curr.time_nanos
+        or math.isnan(prev.value)
+        or math.isnan(curr.value)
+    ):
+        return EMPTY_DATAPOINT
+    diff = curr.value - prev.value
+    if diff < 0:
+        return EMPTY_DATAPOINT
+    rate = diff * _NANOS_PER_SECOND / (curr.time_nanos - prev.time_nanos)
+    return Datapoint(curr.time_nanos, rate)
+
+
+def increase(prev: Datapoint, curr: Datapoint) -> Datapoint:
+    """Reference binary.go increase: NaN prev treated as 0."""
+    if prev.time_nanos >= curr.time_nanos:
+        return EMPTY_DATAPOINT
+    if math.isnan(curr.value):
+        return EMPTY_DATAPOINT
+    prev_value = 0.0 if math.isnan(prev.value) else prev.value
+    diff = curr.value - prev_value
+    if diff < 0:
+        return EMPTY_DATAPOINT
+    return Datapoint(curr.time_nanos, diff)
+
+
+def reset(dp: Datapoint) -> Tuple[Datapoint, Datapoint]:
+    """Reference unary_multi.go:28-46: the datapoint plus a zero 1s later."""
+    return dp, Datapoint(dp.time_nanos + _NANOS_PER_SECOND, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# Batched (device) forms.  values/times shaped (..., T); prev_* shaped (...).
+# Each binary transform returns (out_values, new_prev_value, new_prev_time):
+# out[t] = f(prev_chain[t], curr[t]) where prev_chain is the shifted series
+# seeded with the carried prev lane — one jnp expression, no scan needed
+# because both binary transforms only look one step back.
+# ---------------------------------------------------------------------------
+
+def batched_absolute(values: jnp.ndarray) -> jnp.ndarray:
+    return jnp.abs(values)
+
+
+def batched_add(values: jnp.ndarray, prev_sum: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Running sum along the trailing axis seeded with prev_sum."""
+    contrib = jnp.where(jnp.isnan(values), 0.0, values)
+    out = jnp.cumsum(contrib, axis=-1) + prev_sum[..., None]
+    return out, out[..., -1]
+
+
+def _shift_with_prev(arr: jnp.ndarray, prev: jnp.ndarray) -> jnp.ndarray:
+    return jnp.concatenate([prev[..., None], arr[..., :-1]], axis=-1)
+
+
+def batched_per_second(
+    values: jnp.ndarray,
+    times: jnp.ndarray,
+    prev_value: jnp.ndarray,
+    prev_time: jnp.ndarray,
+) -> jnp.ndarray:
+    prev_v = _shift_with_prev(values, prev_value)
+    prev_t = _shift_with_prev(times, prev_time)
+    diff = values - prev_v
+    dt = times - prev_t
+    bad = (dt <= 0) | jnp.isnan(prev_v) | jnp.isnan(values) | (diff < 0)
+    rate = diff * float(_NANOS_PER_SECOND) / jnp.where(dt == 0, 1, dt)
+    return jnp.where(bad, jnp.nan, rate)
+
+
+def batched_increase(
+    values: jnp.ndarray,
+    times: jnp.ndarray,
+    prev_value: jnp.ndarray,
+    prev_time: jnp.ndarray,
+) -> jnp.ndarray:
+    prev_v = _shift_with_prev(values, prev_value)
+    prev_t = _shift_with_prev(times, prev_time)
+    prev_v = jnp.where(jnp.isnan(prev_v), 0.0, prev_v)
+    diff = values - prev_v
+    bad = (times - prev_t <= 0) | jnp.isnan(values) | (diff < 0)
+    return jnp.where(bad, jnp.nan, diff)

@@ -1,0 +1,387 @@
+"""Aggregator: device arenas vs reference scalar semantics, CM stream
+parity, engine windowing/flush."""
+
+import math
+
+import numpy as np
+import pytest
+
+from m3_tpu.aggregator.arena import CounterArena, GaugeArena, TimerArena
+from m3_tpu.aggregator.engine import (
+    Aggregator,
+    AggregatorOptions,
+    MetricList,
+)
+from m3_tpu.aggregator.quantile_cm import Stream
+from m3_tpu.metrics.aggregation import AggregationID, AggregationType
+from m3_tpu.metrics.policy import StoragePolicy
+from m3_tpu.metrics.types import MetricType
+
+import jax.numpy as jnp
+
+R = 10 * 10**9  # 10s resolution
+
+
+def _lane(arena, lanes, t: AggregationType):
+    return np.asarray(lanes)[:, arena.lane_types.index(t)]
+
+
+class TestCounterArena:
+    def test_moments_match_reference_semantics(self):
+        a = CounterArena(num_windows=2, capacity=8)
+        rng = np.random.default_rng(0)
+        slots = rng.integers(0, 8, 100).astype(np.int32)
+        vals = rng.integers(-50, 100, 100).astype(np.int64)
+        times = np.arange(100, dtype=np.int64)
+        a.ingest(jnp.zeros(100, jnp.int32), jnp.asarray(slots), jnp.asarray(vals), jnp.asarray(times))
+        lanes, counts = a.consume(0)
+        counts = np.asarray(counts)
+        for s in range(8):
+            mine = vals[slots == s]
+            assert counts[s] == mine.size
+            assert _lane(a, lanes, AggregationType.SUM)[s] == mine.sum()
+            assert _lane(a, lanes, AggregationType.MIN)[s] == mine.min()
+            assert _lane(a, lanes, AggregationType.MAX)[s] == mine.max()
+            np.testing.assert_allclose(
+                _lane(a, lanes, AggregationType.MEAN)[s], mine.mean()
+            )
+            # stdev per reference common.go:29 (sample stdev from moments)
+            if mine.size > 1:
+                np.testing.assert_allclose(
+                    _lane(a, lanes, AggregationType.STDEV)[s],
+                    np.std(mine.astype(np.float64), ddof=1),
+                    rtol=1e-9,
+                )
+
+    def test_window_isolation_and_reset(self):
+        a = CounterArena(num_windows=2, capacity=4)
+        a.ingest(
+            jnp.asarray(np.array([0, 1], np.int32)),
+            jnp.asarray(np.array([2, 2], np.int32)),
+            jnp.asarray(np.array([5, 7], np.int64)),
+            jnp.asarray(np.array([1, 2], np.int64)),
+        )
+        lanes0, c0 = a.consume(0)
+        lanes1, c1 = a.consume(1)
+        assert _lane(a, lanes0, AggregationType.SUM)[2] == 5
+        assert _lane(a, lanes1, AggregationType.SUM)[2] == 7
+        a.reset_window(0)
+        lanes0b, c0b = a.consume(0)
+        assert np.asarray(c0b)[2] == 0
+        assert _lane(a, lanes1, AggregationType.SUM)[2] == 7
+
+
+class TestGaugeArena:
+    def test_last_max_timestamp_wins(self):
+        a = GaugeArena(num_windows=1, capacity=4)
+        # arrivals out of order; slot 1: t=30 value 3.0 must win
+        wins = np.zeros(5, np.int32)
+        slots = np.array([1, 1, 1, 2, 2], np.int32)
+        vals = np.array([1.0, 3.0, 2.0, 9.0, 8.0])
+        times = np.array([10, 30, 20, 5, 5], np.int64)
+        a.ingest(jnp.asarray(wins), jnp.asarray(slots), jnp.asarray(vals), jnp.asarray(times))
+        lanes, _ = a.consume(0)
+        assert _lane(a, lanes, AggregationType.LAST)[1] == 3.0
+        # equal timestamps: first arrival wins (reference gauge.go:82-91)
+        assert _lane(a, lanes, AggregationType.LAST)[2] == 9.0
+
+    def test_equal_timestamp_across_batches_keeps_first(self):
+        a = GaugeArena(num_windows=1, capacity=2)
+        z = jnp.zeros(1, jnp.int32)
+        s = jnp.asarray(np.array([0], np.int32))
+        t = jnp.asarray(np.array([100], np.int64))
+        a.ingest(z, s, jnp.asarray(np.array([1.5])), t)
+        a.ingest(z, s, jnp.asarray(np.array([2.5])), t)  # same ts: no update
+        lanes, _ = a.consume(0)
+        assert _lane(a, lanes, AggregationType.LAST)[0] == 1.5
+
+    def test_nan_counted_but_not_summed(self):
+        a = GaugeArena(num_windows=1, capacity=2)
+        z = jnp.zeros(3, jnp.int32)
+        s = jnp.asarray(np.array([0, 0, 0], np.int32))
+        vals = jnp.asarray(np.array([1.0, np.nan, 3.0]))
+        t = jnp.asarray(np.array([1, 2, 3], np.int64))
+        a.ingest(z, s, vals, t)
+        lanes, counts = a.consume(0)
+        assert np.asarray(counts)[0] == 3  # NaN counted (gauge.go:85 count++)
+        assert _lane(a, lanes, AggregationType.SUM)[0] == 4.0
+        assert _lane(a, lanes, AggregationType.MIN)[0] == 1.0
+        assert _lane(a, lanes, AggregationType.MAX)[0] == 3.0
+
+
+class TestTimerArena:
+    def test_exact_quantiles(self):
+        a = TimerArena(num_windows=1, capacity=4, sample_capacity=1 << 12)
+        rng = np.random.default_rng(42)
+        n = 3000
+        slots = rng.integers(0, 4, n).astype(np.int32)
+        vals = rng.normal(100.0, 15.0, n)
+        times = np.arange(n, dtype=np.int64)
+        a.ingest(jnp.zeros(n, jnp.int32), jnp.asarray(slots), jnp.asarray(vals), jnp.asarray(times))
+        lanes, counts = a.consume(0)
+        for s in range(4):
+            mine = np.sort(vals[slots == s])
+            cnt = mine.size
+            assert np.asarray(counts)[s] == cnt
+            for q, t in ((0.5, AggregationType.P50), (0.95, AggregationType.P95), (0.99, AggregationType.P99)):
+                rank = max(int(math.ceil(q * cnt)) - 1, 0)
+                assert _lane(a, lanes, t)[s] == mine[rank]
+            assert _lane(a, lanes, AggregationType.MIN)[s] == mine[0]
+            assert _lane(a, lanes, AggregationType.MAX)[s] == mine[-1]
+
+    def test_multi_batch_append(self):
+        a = TimerArena(num_windows=2, capacity=2, sample_capacity=64)
+        for batch in range(3):
+            a.ingest(
+                jnp.zeros(4, jnp.int32),
+                jnp.asarray(np.array([0, 0, 1, 1], np.int32)),
+                jnp.asarray(np.arange(4, dtype=np.float64) + 10 * batch),
+                jnp.asarray(np.arange(4, dtype=np.int64)),
+            )
+        lanes, counts = a.consume(0)
+        assert np.asarray(counts)[0] == 6
+        assert _lane(a, lanes, AggregationType.MAX)[0] == 21.0
+        a.reset_window(0)
+        lanes, counts = a.consume(0)
+        assert np.asarray(counts)[0] == 0
+
+
+class TestCMStreamParity:
+    """The CM stream is eps-approximate; exact sorted quantiles must fall
+    within its error bound, and on small inputs it is exact."""
+
+    def test_small_exact(self):
+        s = Stream([0.5, 0.95, 0.99])
+        s.add_batch([5.0, 1.0, 3.0])
+        s.flush()
+        assert s.quantile(0.5) == 3.0
+
+    def test_large_within_eps(self):
+        rng = np.random.default_rng(7)
+        vals = rng.uniform(0, 1000, 50_000)
+        s = Stream([0.5, 0.95, 0.99])
+        s.add_batch(list(vals))
+        s.flush()
+        sv = np.sort(vals)
+        n = sv.size
+        for q in (0.5, 0.95, 0.99):
+            got = s.quantile(q)
+            # rank error bound: eps * n (cm guarantees biased-quantile eps)
+            lo = sv[max(int((q - 0.01) * n), 0)]
+            hi = sv[min(int((q + 0.01) * n), n - 1)]
+            assert lo <= got <= hi, (q, lo, got, hi)
+
+    def test_min_max(self):
+        s = Stream([0.5])
+        s.add_batch([4.0, 2.0, 9.0, 7.0])
+        s.flush()
+        assert s.min() == 2.0
+        assert s.max() == 9.0
+
+    def test_empty(self):
+        s = Stream([0.5])
+        s.flush()
+        assert s.quantile(0.5) == 0.0
+
+    def test_device_quantiles_within_cm_bound(self):
+        """Device-exact and reference-algorithm quantiles agree within eps."""
+        rng = np.random.default_rng(3)
+        vals = rng.normal(50, 10, 20_000)
+        cm = Stream([0.5, 0.95, 0.99])
+        cm.add_batch(list(vals))
+        cm.flush()
+
+        a = TimerArena(num_windows=1, capacity=1, sample_capacity=1 << 15)
+        n = vals.size
+        a.ingest(
+            jnp.zeros(n, jnp.int32),
+            jnp.zeros(n, jnp.int32),
+            jnp.asarray(vals),
+            jnp.arange(n, dtype=jnp.int64),
+        )
+        lanes, _ = a.consume(0)
+        sv = np.sort(vals)
+        for q, t in ((0.5, AggregationType.P50), (0.95, AggregationType.P95), (0.99, AggregationType.P99)):
+            exact = float(_lane(a, lanes, t)[0])
+            approx = cm.quantile(q)
+            lo = sv[max(int((q - 0.005) * n), 0)]
+            hi = sv[min(int((q + 0.005) * n), n - 1)]
+            assert lo <= exact <= hi
+            assert lo <= approx <= hi
+
+
+class TestEngine:
+    def _opts(self):
+        return AggregatorOptions(
+            capacity=64,
+            num_windows=2,
+            timer_sample_capacity=1 << 10,
+            storage_policies=(StoragePolicy.parse("10s:2d"),),
+        )
+
+    def test_counter_flush_default_sum(self):
+        agg = Aggregator(num_shards=1, opts=self._opts())
+        ids = [b"cpu.load", b"cpu.load", b"mem.used"]
+        vals = np.array([3, 4, 10], np.int64)
+        times = np.array([R + 1, R + 2, R + 3], np.int64)
+        agg.add_untimed_batch(MetricType.COUNTER, ids, vals, times)
+        flushed = agg.consume(2 * R + 1)
+        assert len(flushed) == 1
+        f = flushed[0]
+        ml = agg.shards[0].lists[StoragePolicy.parse("10s:2d")]
+        got = {}
+        for slot, t, v in zip(f.slots, f.types, f.values):
+            mid = ml.maps[MetricType.COUNTER].id_of(int(slot))
+            got[(mid, AggregationType(int(t)))] = v
+        assert got[(b"cpu.load", AggregationType.SUM)] == 7.0
+        assert got[(b"mem.used", AggregationType.SUM)] == 10.0
+        assert f.timestamp_nanos == 2 * R
+
+    def test_custom_aggregation_id(self):
+        agg = Aggregator(num_shards=1, opts=self._opts())
+        aid = AggregationID.compress([AggregationType.MIN, AggregationType.MAX])
+        agg.add_untimed_batch(
+            MetricType.GAUGE,
+            [b"g", b"g"],
+            np.array([2.0, 8.0]),
+            np.array([R + 1, R + 2], np.int64),
+            agg_id=aid,
+        )
+        f = agg.consume(2 * R + 1)[0]
+        types = set(AggregationType(int(t)) for t in f.types)
+        assert types == {AggregationType.MIN, AggregationType.MAX}
+
+    def test_windows_drain_in_order(self):
+        agg = Aggregator(num_shards=1, opts=self._opts())
+        # two consecutive windows
+        agg.add_untimed_batch(
+            MetricType.COUNTER,
+            [b"c", b"c"],
+            np.array([1, 2], np.int64),
+            np.array([R + 1, 2 * R + 1], np.int64),
+        )
+        flushed = agg.consume(3 * R + 1)
+        assert len(flushed) == 2
+        assert flushed[0].timestamp_nanos == 2 * R
+        assert flushed[1].timestamp_nanos == 3 * R
+        assert flushed[0].values[0] == 1.0
+        assert flushed[1].values[0] == 2.0
+
+    def test_late_metrics_dropped(self):
+        agg = Aggregator(num_shards=1, opts=self._opts())
+        ml = agg.shards[0].lists[StoragePolicy.parse("10s:2d")]
+        agg.add_untimed_batch(
+            MetricType.COUNTER, [b"c"], np.array([1], np.int64), np.array([5 * R], np.int64)
+        )
+        agg.consume(6 * R + 1)
+        # now a metric for the already-consumed window
+        agg.add_untimed_batch(
+            MetricType.COUNTER, [b"c"], np.array([9], np.int64), np.array([R], np.int64)
+        )
+        assert ml.drops == 1
+        flushed = agg.consume(7 * R)
+        assert flushed == []
+
+    def test_timer_sample_buffer_grows_no_drops(self):
+        opts = AggregatorOptions(
+            capacity=8,
+            num_windows=2,
+            timer_sample_capacity=8,  # force growth: 100 samples
+            storage_policies=(StoragePolicy.parse("10s:2d"),),
+        )
+        agg = Aggregator(num_shards=1, opts=opts)
+        vals = np.arange(1, 101, dtype=np.float64)
+        agg.add_untimed_batch(
+            MetricType.TIMER, [b"lat"] * 100, vals, np.full(100, R + 5, np.int64)
+        )
+        f = agg.consume(2 * R + 1)[0]
+        got = {AggregationType(int(t)): v for t, v in zip(f.types, f.values)}
+        assert got[AggregationType.MAX] == 100.0
+        assert got[AggregationType.P50] == 50.0
+        ml = agg.shards[0].lists[StoragePolicy.parse("10s:2d")]
+        assert ml.timers.sample_capacity >= 100
+
+    def test_same_id_two_aggregation_keys(self):
+        # Reference keys elements by (id, aggregation key): both sets emit.
+        agg = Aggregator(num_shards=1, opts=self._opts())
+        t = np.array([R + 1], np.int64)
+        agg.add_untimed_batch(
+            MetricType.GAUGE, [b"g"], np.array([5.0]), t,
+            agg_id=AggregationID.compress([AggregationType.MIN]),
+        )
+        agg.add_untimed_batch(
+            MetricType.GAUGE, [b"g"], np.array([7.0]), t,
+            agg_id=AggregationID.compress([AggregationType.MAX]),
+        )
+        flushed = agg.consume(2 * R + 1)
+        types = {AggregationType(int(t)) for f in flushed for t in f.types}
+        assert types == {AggregationType.MIN, AggregationType.MAX}
+
+    def test_invalid_types_filtered_from_mask(self):
+        # LAST is invalid for counters (reference IsValidForCounter).
+        agg = Aggregator(num_shards=1, opts=self._opts())
+        agg.add_untimed_batch(
+            MetricType.COUNTER, [b"c"], np.array([5], np.int64),
+            np.array([R + 1], np.int64),
+            agg_id=AggregationID.compress([AggregationType.LAST, AggregationType.SUM]),
+        )
+        f = agg.consume(2 * R + 1)[0]
+        types = {AggregationType(int(t)) for t in f.types}
+        assert types == {AggregationType.SUM}
+
+    def test_idle_gap_skips_empty_windows(self):
+        agg = Aggregator(num_shards=1, opts=self._opts())
+        ml = agg.shards[0].lists[StoragePolicy.parse("10s:2d")]
+        agg.add_untimed_batch(
+            MetricType.COUNTER, [b"c"], np.array([1], np.int64),
+            np.array([R + 1], np.int64),
+        )
+        agg.consume(2 * R)
+        # 1 hour idle: consume must not drain 360 windows
+        target = 2 * R + 360 * R + 5
+        assert len(ml.open_windows(target)) <= ml.opts.num_windows
+        agg.consume(target)
+        assert ml.consumed_until == (target // R) * R
+        # fresh ingest at the new watermark still flushes
+        agg.add_untimed_batch(
+            MetricType.COUNTER, [b"c"], np.array([2], np.int64),
+            np.array([ml.consumed_until + 1], np.int64),
+        )
+        f = agg.consume(ml.consumed_until + R + 1)
+        assert len(f) == 1 and f[0].values[0] == 2.0
+
+    def test_expire_recycles_slots(self):
+        agg = Aggregator(num_shards=1, opts=self._opts())
+        ml = agg.shards[0].lists[StoragePolicy.parse("10s:2d")]
+        agg.add_untimed_batch(
+            MetricType.COUNTER, [b"old"], np.array([1], np.int64),
+            np.array([R + 1], np.int64),
+        )
+        agg.consume(2 * R + 1)
+        assert len(ml.maps[MetricType.COUNTER]) == 1
+        released = ml.expire(now_nanos=100 * R, ttl_nanos=10 * R)
+        assert released == 1
+        assert len(ml.maps[MetricType.COUNTER]) == 0
+        # slot is recycled for a new series
+        agg.add_untimed_batch(
+            MetricType.COUNTER, [b"new"], np.array([2], np.int64),
+            np.array([100 * R + 1], np.int64),
+        )
+        assert len(ml.maps[MetricType.COUNTER]) == 1
+
+    def test_timer_quantile_flush(self):
+        agg = Aggregator(num_shards=1, opts=self._opts())
+        vals = np.arange(1, 101, dtype=np.float64)
+        agg.add_untimed_batch(
+            MetricType.TIMER,
+            [b"lat"] * 100,
+            vals,
+            np.full(100, R + 5, np.int64),
+        )
+        f = agg.consume(2 * R + 1)[0]
+        got = {AggregationType(int(t)): v for t, v in zip(f.types, f.values)}
+        assert got[AggregationType.P50] == 50.0
+        assert got[AggregationType.P95] == 95.0
+        assert got[AggregationType.P99] == 99.0
+        assert got[AggregationType.MAX] == 100.0
+        np.testing.assert_allclose(got[AggregationType.MEAN], vals.mean())

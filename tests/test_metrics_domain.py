@@ -1,0 +1,142 @@
+"""Metrics domain: aggregation types/IDs, policies, transformations."""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from m3_tpu.metrics.aggregation import (
+    AggregationID,
+    AggregationType,
+    DEFAULT_COUNTER_TYPES,
+    DEFAULT_GAUGE_TYPES,
+    DEFAULT_TIMER_TYPES,
+)
+from m3_tpu.metrics.policy import (
+    Resolution,
+    StoragePolicy,
+    parse_duration,
+    format_duration,
+)
+from m3_tpu.metrics import transformation as tf
+from m3_tpu.metrics.types import Datapoint, MetricType
+
+
+class TestAggregationTypes:
+    def test_quantiles(self):
+        assert AggregationType.P50.quantile() == 0.5
+        assert AggregationType.MEDIAN.quantile() == 0.5
+        assert AggregationType.P9999.quantile() == 0.9999
+        assert AggregationType.SUM.quantile() is None
+
+    def test_validity_per_metric_type(self):
+        assert AggregationType.SUM.is_valid_for(MetricType.COUNTER)
+        assert not AggregationType.LAST.is_valid_for(MetricType.COUNTER)
+        assert AggregationType.LAST.is_valid_for(MetricType.GAUGE)
+        assert not AggregationType.P99.is_valid_for(MetricType.GAUGE)
+        assert AggregationType.P99.is_valid_for(MetricType.TIMER)
+
+    def test_id_roundtrip(self):
+        types = (AggregationType.SUM, AggregationType.P99, AggregationType.LAST)
+        aid = AggregationID.compress(types)
+        assert set(aid.decompress()) == set(types)
+        assert aid.contains(AggregationType.P99)
+        assert not aid.contains(AggregationType.MIN)
+
+    def test_default_id_resolves_per_type(self):
+        aid = AggregationID.DEFAULT
+        assert aid.is_default()
+        assert aid.types_for(MetricType.COUNTER) == DEFAULT_COUNTER_TYPES
+        assert aid.types_for(MetricType.GAUGE) == DEFAULT_GAUGE_TYPES
+        assert aid.types_for(MetricType.TIMER) == DEFAULT_TIMER_TYPES
+
+
+class TestPolicies:
+    def test_parse_duration(self):
+        assert parse_duration("10s") == 10_000_000_000
+        assert parse_duration("2d") == 2 * 24 * 3600 * 10**9
+        assert parse_duration("1h30m") == 5400 * 10**9
+        with pytest.raises(ValueError):
+            parse_duration("xyz")
+
+    def test_format_duration(self):
+        assert format_duration(10_000_000_000) == "10s"
+        assert format_duration(60_000_000_000) == "1m"
+
+    def test_storage_policy_parse_roundtrip(self):
+        sp = StoragePolicy.parse("10s:2d")
+        assert sp.resolution.window_nanos == 10 * 10**9
+        assert sp.retention_nanos == 2 * 24 * 3600 * 10**9
+        assert str(sp) == "10s:2d"
+        sp2 = StoragePolicy.parse("1m@1s:40d")
+        assert sp2.resolution.precision_nanos == 10**9
+
+    def test_policy_ordering(self):
+        a = StoragePolicy.parse("10s:2d")
+        b = StoragePolicy.parse("1m:40d")
+        assert a < b
+
+
+class TestScalarTransforms:
+    def test_absolute(self):
+        assert tf.absolute(Datapoint(5, -3.0)).value == 3.0
+
+    def test_add_running_sum_skips_nan(self):
+        add = tf.make_add()
+        assert add(Datapoint(1, 2.0)).value == 2.0
+        assert add(Datapoint(2, math.nan)).value == 2.0
+        assert add(Datapoint(3, 3.0)).value == 5.0
+
+    def test_per_second(self):
+        out = tf.per_second(Datapoint(0, 10.0), Datapoint(2_000_000_000, 30.0))
+        assert out.value == 10.0
+        # decreasing value -> empty
+        out = tf.per_second(Datapoint(0, 30.0), Datapoint(10**9, 10.0))
+        assert math.isnan(out.value)
+        # non-increasing time -> empty
+        out = tf.per_second(Datapoint(5, 1.0), Datapoint(5, 2.0))
+        assert math.isnan(out.value)
+
+    def test_increase_nan_prev_is_zero(self):
+        out = tf.increase(Datapoint(0, math.nan), Datapoint(10**9, 7.0))
+        assert out.value == 7.0
+
+    def test_reset_emits_zero_one_second_later(self):
+        dp, zero = tf.reset(Datapoint(10**9, 5.0))
+        assert dp.value == 5.0
+        assert zero.time_nanos == 2 * 10**9 and zero.value == 0.0
+
+
+class TestBatchedTransforms:
+    def test_batched_per_second_matches_scalar(self):
+        times = np.array([10, 20, 30, 45], np.int64) * 10**9
+        vals = np.array([1.0, 4.0, 4.0, 10.0])
+        prev_t, prev_v = np.int64(0), 0.0
+        out = tf.batched_per_second(
+            jnp.asarray(vals), jnp.asarray(times), jnp.asarray(prev_v), jnp.asarray(prev_t)
+        )
+        expect = []
+        p = Datapoint(int(prev_t), prev_v)
+        for t, v in zip(times, vals):
+            got = tf.per_second(p, Datapoint(int(t), float(v)))
+            expect.append(got.value)
+            p = Datapoint(int(t), float(v))
+        np.testing.assert_allclose(np.asarray(out), expect)
+
+    def test_batched_increase_matches_scalar(self):
+        times = np.array([10, 20, 30], np.int64) * 10**9
+        vals = np.array([5.0, 3.0, 9.0])  # dip -> empty at idx 1
+        out = tf.batched_increase(
+            jnp.asarray(vals), jnp.asarray(times), jnp.asarray(np.nan), jnp.asarray(np.int64(0))
+        )
+        out = np.asarray(out)
+        assert out[0] == 5.0  # NaN prev treated as 0
+        assert math.isnan(out[1])
+        assert out[2] == 6.0
+
+    def test_batched_add(self):
+        vals = jnp.asarray(np.array([1.0, np.nan, 2.0]))
+        out, carry = tf.batched_add(vals, jnp.asarray(0.0))
+        np.testing.assert_allclose(np.asarray(out), [1.0, 1.0, 3.0])
+        assert float(carry) == 3.0
