@@ -54,6 +54,32 @@ SCALAR_LANES = (
 )
 
 
+def raw(jitted):
+    """The traceable python function behind a jitted arena op, for
+    composing arena ops inside larger jit/shard_map programs."""
+    return getattr(jitted, "__wrapped__", jitted)
+
+
+def pad_slots(slots: np.ndarray, capacity: int) -> np.ndarray:
+    """Pad a slot array to the next power of two with the drop sentinel
+    (slot == capacity scatters out of range under mode='drop'), bounding
+    the number of distinct shapes the *_clear_slots jits see."""
+    n = max(1, len(slots))
+    padded = 1 << (n - 1).bit_length()
+    out = np.full(padded, capacity, np.int32)
+    out[: len(slots)] = slots
+    return out
+
+
+def flat_window_index(windows, slots, num_windows: int, capacity: int):
+    """Flatten (window ring index, slot) to the arena's (W*C,) index;
+    out-of-ring windows map to the drop sentinel W*C."""
+    oob = (windows < 0) | (windows >= num_windows)
+    return jnp.where(
+        oob, num_windows * capacity, windows * capacity + slots
+    ).astype(jnp.int64)
+
+
 def _stdev(count, sum_sq, sum_):
     """Sample stdev from moments (reference aggregation/common.go:29-36)."""
     div = count * (count - 1)
@@ -147,6 +173,33 @@ def counter_reset_window(state: CounterState, window: jnp.ndarray, capacity: int
         max=upd(state.max, I64_MIN),
         min=upd(state.min, I64_MAX),
         last_at=state.last_at,
+    )
+
+
+@functools.partial(jax.jit, donate_argnums=0, static_argnames=("num_windows", "capacity"))
+def counter_clear_slots(
+    state: CounterState, slots: jnp.ndarray, num_windows: int, capacity: int
+) -> CounterState:
+    """Zero a set of slots across every window ring row (slot free; the
+    reference deletes the whole Entry object — map.go deleteExpired — so
+    a recycled slot must not inherit un-drained window stats)."""
+    idx = (
+        jnp.arange(num_windows, dtype=jnp.int64)[:, None] * capacity + slots[None, :]
+    ).ravel()
+    # Padded sentinel slots (== capacity) must not alias slot 0 of the
+    # next window row: route them to the global OOB drop index.
+    idx = jnp.where(
+        (slots[None, :] >= capacity).repeat(num_windows, 0).ravel(),
+        num_windows * capacity,
+        idx,
+    )
+    return CounterState(
+        sum=state.sum.at[idx].set(0, mode="drop"),
+        sum_sq=state.sum_sq.at[idx].set(0, mode="drop"),
+        count=state.count.at[idx].set(0, mode="drop"),
+        max=state.max.at[idx].set(I64_MIN, mode="drop"),
+        min=state.min.at[idx].set(I64_MAX, mode="drop"),
+        last_at=state.last_at.at[slots].set(0, mode="drop"),
     )
 
 
@@ -263,6 +316,32 @@ def gauge_reset_window(state: GaugeState, window: jnp.ndarray, capacity: int) ->
         max=upd(state.max, -jnp.inf),
         min=upd(state.min, jnp.inf),
         last_at=state.last_at,
+    )
+
+
+@functools.partial(jax.jit, donate_argnums=0, static_argnames=("num_windows", "capacity"))
+def gauge_clear_slots(
+    state: GaugeState, slots: jnp.ndarray, num_windows: int, capacity: int
+) -> GaugeState:
+    idx = (
+        jnp.arange(num_windows, dtype=jnp.int64)[:, None] * capacity + slots[None, :]
+    ).ravel()
+    # Padded sentinel slots (== capacity) must not alias slot 0 of the
+    # next window row: route them to the global OOB drop index.
+    idx = jnp.where(
+        (slots[None, :] >= capacity).repeat(num_windows, 0).ravel(),
+        num_windows * capacity,
+        idx,
+    )
+    return GaugeState(
+        last=state.last.at[idx].set(0.0, mode="drop"),
+        last_time=state.last_time.at[idx].set(0, mode="drop"),
+        sum=state.sum.at[idx].set(0.0, mode="drop"),
+        sum_sq=state.sum_sq.at[idx].set(0.0, mode="drop"),
+        count=state.count.at[idx].set(0, mode="drop"),
+        max=state.max.at[idx].set(-jnp.inf, mode="drop"),
+        min=state.min.at[idx].set(jnp.inf, mode="drop"),
+        last_at=state.last_at.at[slots].set(0, mode="drop"),
     )
 
 
@@ -433,6 +512,43 @@ def timer_reset_window(state: TimerState, window: jnp.ndarray, capacity: int) ->
     )
 
 
+@functools.partial(jax.jit, donate_argnums=0, static_argnames=("num_windows", "capacity"))
+def timer_clear_slots(
+    state: TimerState, slots: jnp.ndarray, num_windows: int, capacity: int
+) -> TimerState:
+    """Clear freed timer slots: zero the moment rows and retarget their
+    buffered samples to the drop sentinel so a recycled slot's quantiles
+    don't include the previous occupant's samples."""
+    idx = (
+        jnp.arange(num_windows, dtype=jnp.int64)[:, None] * capacity + slots[None, :]
+    ).ravel()
+    # Padded sentinel slots (== capacity) must not alias slot 0 of the
+    # next window row: route them to the global OOB drop index.
+    idx = jnp.where(
+        (slots[None, :] >= capacity).repeat(num_windows, 0).ravel(),
+        num_windows * capacity,
+        idx,
+    )
+    sorted_slots = jnp.sort(slots.astype(jnp.int32))
+    flat = state.sample_slot.ravel()
+    pos = jnp.clip(
+        jnp.searchsorted(sorted_slots, flat), 0, sorted_slots.shape[0] - 1
+    )
+    hit = sorted_slots[pos] == flat
+    new_sample_slot = jnp.where(hit, jnp.int32(capacity), flat).reshape(
+        state.sample_slot.shape
+    )
+    return TimerState(
+        sum=state.sum.at[idx].set(0.0, mode="drop"),
+        sum_sq=state.sum_sq.at[idx].set(0.0, mode="drop"),
+        count=state.count.at[idx].set(0, mode="drop"),
+        sample_slot=new_sample_slot,
+        sample_val=state.sample_val,
+        sample_n=state.sample_n,
+        last_at=state.last_at.at[slots].set(0, mode="drop"),
+    )
+
+
 # ---------------------------------------------------------------------------
 # Thin stateful wrappers used by the engine.
 # ---------------------------------------------------------------------------
@@ -456,11 +572,7 @@ class CounterArena(_ScalarLanesMixin):
         self.state = counter_init(num_windows, capacity)
 
     def ingest(self, windows, slots, values, times):
-        idx = jnp.where(
-            (windows < 0) | (windows >= self.num_windows),
-            self.num_windows * self.capacity,
-            windows * self.capacity + slots,
-        ).astype(jnp.int64)
+        idx = flat_window_index(windows, slots, self.num_windows, self.capacity)
         self.state = counter_ingest(self.state, idx, slots, values.astype(jnp.int64), times)
 
     def consume(self, window: int):
@@ -468,6 +580,14 @@ class CounterArena(_ScalarLanesMixin):
 
     def reset_window(self, window: int):
         self.state = counter_reset_window(self.state, jnp.int32(window), self.capacity)
+
+    def clear_slots(self, slots):
+        self.state = counter_clear_slots(
+            self.state,
+            jnp.asarray(pad_slots(np.asarray(slots), self.capacity)),
+            self.num_windows,
+            self.capacity,
+        )
 
 
 class GaugeArena(_ScalarLanesMixin):
@@ -477,11 +597,7 @@ class GaugeArena(_ScalarLanesMixin):
         self.state = gauge_init(num_windows, capacity)
 
     def ingest(self, windows, slots, values, times):
-        idx = jnp.where(
-            (windows < 0) | (windows >= self.num_windows),
-            self.num_windows * self.capacity,
-            windows * self.capacity + slots,
-        ).astype(jnp.int64)
+        idx = flat_window_index(windows, slots, self.num_windows, self.capacity)
         self.state = gauge_ingest(self.state, idx, slots, values.astype(jnp.float64), times)
 
     def consume(self, window: int):
@@ -489,6 +605,14 @@ class GaugeArena(_ScalarLanesMixin):
 
     def reset_window(self, window: int):
         self.state = gauge_reset_window(self.state, jnp.int32(window), self.capacity)
+
+    def clear_slots(self, slots):
+        self.state = gauge_clear_slots(
+            self.state,
+            jnp.asarray(pad_slots(np.asarray(slots), self.capacity)),
+            self.num_windows,
+            self.capacity,
+        )
 
 
 class TimerArena:
@@ -506,6 +630,9 @@ class TimerArena:
         self.sample_capacity = sample_capacity
         self.quantiles = tuple(quantiles)
         self.state = timer_init(num_windows, capacity, sample_capacity)
+        # Host shadow of state.sample_n: avoids a device sync per ingest
+        # batch just to run the overflow check.
+        self._sample_n_host = np.zeros(num_windows, np.int64)
 
     def ingest(self, windows, slots, values, times):
         """Append a batch; grows the per-window sample buffer first if the
@@ -517,7 +644,8 @@ class TimerArena:
         per_w = np.bincount(
             windows_np[in_range], minlength=self.num_windows
         )
-        needed = int((np.asarray(self.state.sample_n) + per_w).max())
+        self._sample_n_host += per_w
+        needed = int(self._sample_n_host.max())
         if needed > self.sample_capacity:
             self._grow(needed)
         self.state = timer_ingest(
@@ -556,6 +684,15 @@ class TimerArena:
 
     def reset_window(self, window: int):
         self.state = timer_reset_window(self.state, jnp.int32(window), self.capacity)
+        self._sample_n_host[window] = 0
+
+    def clear_slots(self, slots):
+        self.state = timer_clear_slots(
+            self.state,
+            jnp.asarray(pad_slots(np.asarray(slots), self.capacity)),
+            self.num_windows,
+            self.capacity,
+        )
 
     @property
     def lane_types(self):

@@ -262,7 +262,10 @@ class MetricList:
         """Release slots idle for longer than ttl (the reference GCs
         entries via lastAccess + entryTTL — map.go deleteExpired /
         entry.go ShouldExpire).  Reads the device last_at column, frees
-        matching slots in every map, and clears their last_at."""
+        matching slots in every map, and clears all of each freed slot's
+        arena state (last_at + every window-ring row + buffered samples),
+        so a recycled slot cannot inherit the previous occupant's
+        un-drained aggregates."""
         released = 0
         for mt in (MetricType.COUNTER, MetricType.GAUGE, MetricType.TIMER):
             arena = self._arena(mt)
@@ -273,9 +276,7 @@ class MetricList:
             m = self.maps[mt]
             for s in stale:
                 m.release(int(s))
-            arena.state = arena.state._replace(
-                last_at=arena.state.last_at.at[jnp.asarray(stale)].set(0)
-            )
+            arena.clear_slots(stale.astype(np.int32))
             released += stale.size
         return released
 
@@ -346,10 +347,13 @@ class Aggregator:
         self.opts = opts or AggregatorOptions()
         self.shards = [AggregatorShard(i, self.opts) for i in range(num_shards)]
 
-    def shard_for(self, mid: bytes) -> AggregatorShard:
+    def shard_index(self, mid: bytes) -> int:
         # Reference uses murmur3(id) % numShards (aggregator.go:505,
         # sharding/shardset.go:148); any stable hash serves the same role.
-        return self.shards[zlib_crc(mid) % len(self.shards)]
+        return zlib_crc(mid) % len(self.shards)
+
+    def shard_for(self, mid: bytes) -> AggregatorShard:
+        return self.shards[self.shard_index(mid)]
 
     def add_untimed_batch(self, mt, ids, values, times, agg_id=AggregationID.DEFAULT):
         if len(self.shards) == 1:
@@ -357,7 +361,7 @@ class Aggregator:
             return
         by_shard: Dict[int, List[int]] = {}
         for i, mid in enumerate(ids):
-            by_shard.setdefault(zlib_crc(mid) % len(self.shards), []).append(i)
+            by_shard.setdefault(self.shard_index(mid), []).append(i)
         for sid, idxs in by_shard.items():
             sel = np.asarray(idxs)
             self.shards[sid].add_batch(

@@ -369,6 +369,44 @@ class TestEngine:
         )
         assert len(ml.maps[MetricType.COUNTER]) == 1
 
+    def test_expire_clears_undrained_window_state(self):
+        # Regression: a slot freed with un-drained window stats must not
+        # leak them into the next occupant of the same slot.
+        agg = Aggregator(num_shards=1, opts=self._opts())
+        ml = agg.shards[0].lists[StoragePolicy.parse("10s:2d")]
+        for mt, val in (
+            (MetricType.COUNTER, np.array([100], np.int64)),
+            (MetricType.GAUGE, np.array([100.0])),
+            (MetricType.TIMER, np.array([100.0])),
+        ):
+            agg.add_untimed_batch(mt, [b"old"], val, np.array([R + 1], np.int64))
+        # Never consumed: stats sit in the open window when expire runs.
+        released = ml.expire(now_nanos=100 * R, ttl_nanos=10 * R)
+        assert released == 3
+        for mt, val in (
+            (MetricType.COUNTER, np.array([7], np.int64)),
+            (MetricType.GAUGE, np.array([7.0])),
+            (MetricType.TIMER, np.array([7.0])),
+        ):
+            # Re-ingest into the *recycled* slot and the *same* ring row.
+            ml.consumed_until = None
+            agg.add_untimed_batch(mt, [b"new"], val, np.array([R + 1], np.int64))
+        flushed = agg.consume(2 * R + 1)
+        assert flushed
+        expect = {
+            AggregationType.SUM: 7.0,
+            AggregationType.COUNT: 1.0,
+            AggregationType.LAST: 7.0,
+            AggregationType.MEAN: 7.0,
+            AggregationType.P50: 7.0,
+            AggregationType.MAX: 7.0,
+        }
+        for f in flushed:
+            got = {AggregationType(int(t)): v for t, v in zip(f.types, f.values)}
+            for t, want in expect.items():
+                if t in got:
+                    assert got[t] == want, (t, got)
+
     def test_timer_quantile_flush(self):
         agg = Aggregator(num_shards=1, opts=self._opts())
         vals = np.arange(1, 101, dtype=np.float64)
