@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 MASK52 = (1 << 52) - 1
 MASK63 = (1 << 63) - 1
@@ -172,12 +173,85 @@ def floor_parts(bits):
     return ipart, frac_zero
 
 
+def _div_u128_by_small(hi, lo, d):
+    """floor((hi·2^64 + lo) / d) and remainder, for d < 2^20 and quotient
+    < 2^64: base-2^32 long division, fully vectorized."""
+    hi, lo, d = _u(hi), _u(lo), _u(d)
+    m32 = _u(0xFFFFFFFF)
+    q = _u(0)
+    r = _u(0)
+    for digit in (hi >> _u(32), hi & m32, lo >> _u(32), lo & m32):
+        cur = (r << _u(32)) | digit  # r < d < 2^20 ⇒ cur < 2^52
+        qd = cur // d
+        r = cur - qd * d
+        q = (q << _u(32)) | qd
+    return q, r
+
+
+def int_div_pow10(i, k):
+    """Bits of `float64(i) / 10^k` for int64 i and 0 <= k <= 6, matching
+    the reference's two-step IEEE computation bit-for-bit — including its
+    double rounding for |i| > 2^53.
+
+    The decoder's int-optimization inverse (reference `m3tsz.go:120-131`
+    convertFromIntFloat) computes `float64(v) / multiplier`: an RNE
+    int→float64 conversion followed by an IEEE division.  TPU's emulated
+    f64 divide is not correctly rounded, so both steps run in integer
+    arithmetic: the existing exact conversion (`uint_to_f64_bits`), then
+    a long division of the 53-bit mantissa by 10^k with guard-bit +
+    remainder-as-sticky rounding.
+    """
+    i = jnp.asarray(i, I64)
+    k = jnp.asarray(k, I64)
+    sign = (i < 0).astype(U64) << _u(63)
+    a = jnp.abs(i).astype(U64)
+    d = jnp.asarray(np.array([10**p for p in range(7)], np.uint64))[jnp.clip(k, 0, 6)]
+
+    # Step 1: float64(|i|) with round-to-nearest-even.
+    fbits = uint_to_f64_bits(a)
+    mant, exp2 = _mantissa_and_exp2(jnp.maximum(fbits, _u(1 << 52)))
+    # (|i| >= 1 ⇒ normal; the max() only guards the a == 0 lane.)
+
+    # Step 2: mant·2^exp2 / d.  With mant in [2^52, 2^53) and
+    # t = ld + 2, q = floor(mant·2^t/d) lands in (2^53, 2^55).
+    ld = msb_index(d).astype(I64)
+    t = ld + jnp.int64(2)
+    tu = t.astype(U64)  # t in [2, 21]: the 128-bit shift never wraps
+    hi = mant >> (_u(64) - tu)
+    lo = mant << tu
+    q, r = _div_u128_by_small(hi, lo, d)
+
+    # Normalize to exactly 54 bits (53 mantissa + 1 guard).
+    over = q >= _u(1 << 54)
+    sticky_extra = over & ((q & _u(1)) == _u(1))
+    q = jnp.where(over, q >> _u(1), q)
+    t = jnp.where(over, t - 1, t)
+
+    guard = (q & _u(1)) == _u(1)
+    m = q >> _u(1)  # 53 bits, in [2^52, 2^53)
+    sticky = (r != _u(0)) | sticky_extra
+    round_up = guard & (sticky | ((m & _u(1)) == _u(1)))
+    m = m + round_up.astype(U64)
+    carried = m >= _u(1 << 53)
+    m = jnp.where(carried, m >> _u(1), m)
+    # value = m·2^(exp2 - t + 1); biased exponent encodes m·2^(eb - 1075).
+    E = exp2 - t + jnp.int64(1) + carried.astype(I64)
+    bits = sign | ((E + jnp.int64(1075)).astype(U64) << _u(52)) | (m & _u(MASK52))
+    return jnp.where(a == _u(0), sign, bits)
+
+
 def uint_to_f64_bits(i):
-    """Positive integer (< 2^53) to float64 bits, exact."""
+    """Positive uint64 to float64 bits: exact below 2^53, round-to-
+    nearest-even above (the IEEE int→double conversion)."""
     i = _u(i)
     L = msb_index(jnp.maximum(i, _u(1)))
-    shift = _u(52) - L
-    mant = i << shift
-    eb = _u(1075 - 52) + L  # = 1023 + L
+    small = L <= _u(52)
+    mant_small = i << jnp.where(small, _u(52) - L, _u(0))
+    m = _round_shift_right_even(i, jnp.where(small, _u(0), L - _u(52)))
+    carried = m >= _u(1 << 53)
+    m = jnp.where(carried, m >> _u(1), m)
+    L_big = L + carried.astype(U64)
+    mant = jnp.where(small, mant_small, m)
+    eb = _u(1023) + jnp.where(small, L, L_big)
     bits = (eb << _u(52)) | (mant & _u(MASK52))
     return jnp.where(i == _u(0), _u(0), bits)

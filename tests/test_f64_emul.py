@@ -82,3 +82,26 @@ def test_uint_to_f64_bits():
     got = np.asarray(jax.jit(fe.uint_to_f64_bits)(arr))
     for i, g in zip(ints, got):
         assert int(g) == f2b(float(i)), f"uint_to_f64({i})"
+
+
+def test_int_div_pow10_matches_ieee_division():
+    # The decoder's int-mode inverse: float64(i) / 10^k, RNE-exact.
+    import numpy as np
+    import jax.numpy as jnp
+    from m3_tpu.encoding import f64_emul as fe
+
+    rng = np.random.default_rng(123)
+    for k in range(7):
+        i = np.concatenate([
+            rng.integers(-(10**15), 10**15, 5000),
+            rng.integers(-1000, 1000, 500),
+            np.array([0, 1, -1, 5, -5, 10**6, -(10**6), 2**53 - 1,
+                      -(2**53 - 1), 76468]),
+        ])
+        bits = np.asarray(
+            fe.int_div_pow10(jnp.asarray(i), jnp.asarray(np.full(len(i), k))),
+            np.uint64,
+        )
+        got = bits.view(np.float64)
+        want = i.astype(np.float64) / np.float64(10.0**k)
+        assert (got == want).all(), (k, i[got != want][0])
