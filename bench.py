@@ -1,0 +1,129 @@
+"""Headline benchmark: batched M3TSZ decode on the attached accelerator.
+
+BASELINE config #2 — "Batched M3TSZ decode: 100K series × 720-pt blocks
+(2h @10s) — parallel ReaderIterator".  The reference baseline is the one
+authoritative in-repo number: 69,272 ns per ~720-pt block decode ≈ 10.4M
+datapoints/s/core (`src/dbnode/encoding/m3tsz/decoder_benchmark_test.go:34`,
+see BASELINE.md).
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+import m3_tpu  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+import functools
+
+from m3_tpu.encoding.m3tsz_jax import decode_batch_device, encode_batch
+
+
+@functools.partial(jax.jit, static_argnames=("max_points",))
+def _decode_to_values(words, nbits, max_points: int):
+    """Full device decode: packed streams -> (ts, float64 values).
+
+    Includes the int-mode payload -> float conversion (payload / 10^mult)
+    so the timed region covers everything the Go ReaderIterator does."""
+    ts, payload, meta, err, prec = decode_batch_device(words, nbits, max_points)
+    isf = (meta & 8) != 0
+    mult = (meta & 7).astype(jnp.int64)
+    # TPU's emulated f64 divide is not correctly rounded; the exact
+    # integer-emulated division (f64_emul.int_div_pow10) matches the
+    # reference's IEEE `float64(v) / multiplier` bit-for-bit.
+    from m3_tpu.encoding import f64_emul as fe
+
+    ibits = fe.int_div_pow10(payload.astype(jnp.int64), mult)
+    vbits = jnp.where(isf, payload, ibits)
+    return ts, jax.lax.bitcast_convert_type(vbits, jnp.float64), meta, err | prec
+
+GO_BASELINE_DPS = 720 / 69_272e-9  # ≈ 10.39M datapoints/s/core
+
+START = 1_600_000_000 * 10**9
+
+
+def _make_corpus(S: int, T: int, seed: int = 42):
+    """Realistic gauge series: 2h of 10s-spaced samples with jitter in
+    value but regular timestamps (the common Prometheus shape)."""
+    rng = np.random.default_rng(seed)
+    ts = np.tile(START + np.arange(1, T + 1) * 10 * 10**9, (S, 1)).astype(np.int64)
+    base = rng.uniform(10, 1000, (S, 1))
+    vals = np.round(base + rng.normal(0, base * 0.05, (S, T)), 2)
+    starts = np.full(S, START, np.int64)
+    return ts, vals, starts
+
+
+def _pack(streams, pad_words: int):
+    """Byte streams -> (S, pad_words) uint64 big-endian word arrays + bit
+    lengths, the decoder's input layout."""
+    S = len(streams)
+    words = np.zeros((S, pad_words), np.uint64)
+    nbits = np.zeros(S, np.int64)
+    for i, s in enumerate(streams):
+        nbits[i] = len(s) * 8
+        padded = s + b"\x00" * (-len(s) % 8)
+        w = np.frombuffer(padded, dtype=">u8").astype(np.uint64)
+        words[i, : len(w)] = w
+    return words, nbits
+
+
+def main() -> None:
+    S = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    T = int(sys.argv[2]) if len(sys.argv) > 2 else 720
+    enc_chunk = 8192
+
+    ts, vals, starts = _make_corpus(S, T)
+    streams = []
+    for lo in range(0, S, enc_chunk):
+        hi = min(lo + enc_chunk, S)
+        chunk, fb = encode_batch(
+            ts[lo:hi], vals[lo:hi], starts[lo:hi], out_words=T * 40 // 64 + 8
+        )
+        assert not fb.any()
+        streams.extend(chunk)
+
+    pad_words = max(len(s) for s in streams) // 8 + 2
+    words_np, nbits_np = _pack(streams, pad_words)
+    words = jnp.asarray(words_np)
+    nbits = jnp.asarray(nbits_np)
+
+    # max_points includes the end-of-stream slot.
+    run = lambda: jax.block_until_ready(
+        _decode_to_values(words, nbits, max_points=T + 1)
+    )
+    out = run()  # compile
+    # Sanity: decoded values must match the corpus bit-exactly.
+    dec_ts = np.asarray(out[0][:, :T])
+    dec_vals = np.asarray(out[1][:, :T])
+    errs = np.asarray(out[3])
+    assert not errs.any(), f"{errs.sum()} series failed to decode"
+    assert np.array_equal(dec_ts, ts) and np.array_equal(dec_vals, vals)
+
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        run()
+        best = min(best, time.perf_counter() - t0)
+    dps = S * T / best
+    print(
+        json.dumps(
+            {
+                "metric": "m3tsz_batched_decode_datapoints_per_sec",
+                "value": round(dps),
+                "unit": f"datapoints/s ({S}x{T} blocks, {jax.devices()[0].device_kind})",
+                "vs_baseline": round(dps / GO_BASELINE_DPS, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

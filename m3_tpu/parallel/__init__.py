@@ -1,0 +1,14 @@
+from m3_tpu.parallel.mesh import MeshTopology, make_mesh
+from m3_tpu.parallel.sharded_agg import (
+    ShardedAggregatorState,
+    sharded_init,
+    sharded_ingest_consume,
+)
+
+__all__ = [
+    "MeshTopology",
+    "make_mesh",
+    "ShardedAggregatorState",
+    "sharded_init",
+    "sharded_ingest_consume",
+]

@@ -1,0 +1,184 @@
+"""Multi-device aggregator: the full ingest→rollup step over a mesh.
+
+This is the distribution layer the reference builds from sharded
+placements + TChannel fan-out (`src/aggregator/aggregator/aggregator.go:505`
+shardFor, `src/aggregator/sharding`) and multi-stage forwarded rollups
+(`src/aggregator/aggregator/forwarded_writer.go`), re-designed as one SPMD
+program:
+
+* every logical shard's arenas live as a leading axis of the state arrays,
+  laid out over the mesh's ``shard`` axis;
+* ingest batches arrive pre-routed per shard (host shard router =
+  murmur3 % num_shards, as `sharding/shardset.go:148`) and each device
+  scatters only its own block — zero cross-device traffic on the hot path,
+  exactly the property the reference's shard ownership gives it;
+* window drain computes per-shard lanes locally, then the cross-shard
+  rollup stage (the reference forwards partial aggregates between
+  aggregator instances over TCP) is a single ``psum`` over the shard axis
+  riding ICI.
+
+State is replicated over the ``replica`` axis (the RF axis of an M3
+placement); because the program is deterministic SPMD, replicas stay
+bit-identical without the reference's leader/follower flush protocol
+(`aggregator/aggregator/follower_flush_mgr.go`) — the election only picks
+who *emits*.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from m3_tpu.aggregator import arena as _arena
+from m3_tpu.parallel.mesh import REPLICA_AXIS, SHARD_AXIS, MeshTopology
+
+
+_raw = _arena.raw
+
+
+class ShardedAggregatorState(NamedTuple):
+    counters: _arena.CounterState  # arrays with leading (num_shards,) axis
+    gauges: _arena.GaugeState
+    timers: _arena.TimerState
+
+
+def sharded_init(
+    topo: MeshTopology,
+    num_windows: int,
+    capacity: int,
+    sample_capacity: int,
+) -> ShardedAggregatorState:
+    """Per-shard arenas, placed: shard axis over the mesh's shard axis,
+    replicated over the replica axis."""
+    D = topo.num_shards
+
+    def rep(state):
+        return jax.tree.map(
+            lambda a: jax.device_put(
+                jnp.broadcast_to(a[None], (D,) + a.shape), topo.sharded()
+            ),
+            state,
+        )
+
+    return ShardedAggregatorState(
+        counters=rep(_arena.counter_init(num_windows, capacity)),
+        gauges=rep(_arena.gauge_init(num_windows, capacity)),
+        timers=rep(_arena.timer_init(num_windows, capacity, sample_capacity)),
+    )
+
+
+class ShardedBatch(NamedTuple):
+    """One pre-routed ingest batch: leading axis = logical shard."""
+
+    windows: jnp.ndarray  # i32 (D, N) ring index per sample; OOB drops
+    slots: jnp.ndarray  # i32 (D, N)
+    counter_values: jnp.ndarray  # i64 (D, N)
+    gauge_values: jnp.ndarray  # f64 (D, N)
+    timer_values: jnp.ndarray  # f64 (D, N)
+    times: jnp.ndarray  # i64 (D, N)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("topo", "num_windows", "capacity", "quantiles"),
+    donate_argnums=(1,),
+)
+def sharded_ingest_consume(
+    topo: MeshTopology,
+    state: ShardedAggregatorState,
+    batch: ShardedBatch,
+    window: jnp.ndarray,  # i32 scalar: ring index to drain after ingest
+    num_windows: int,
+    capacity: int,
+    quantiles: tuple = (0.5, 0.95, 0.99),
+):
+    """The framework's "training step": ingest a routed batch into every
+    shard's arenas, drain one window (then reset its ring row, as the
+    single-device engine pairs consume with reset_window), and produce
+    both the per-shard lane matrices and the cross-shard global rollup.
+
+    Returns (new_state, lanes) where lanes is a dict:
+      counter/gauge/timer -> ((D, C, L) lanes, (D, C) counts), sharded
+      rollup              -> (C, 4) global [sum, count, min, max] across
+                            shards (the forwarded-pipeline stage, via
+                            psum/pmin/pmax); min/max are NaN for slots
+                            with no gauge samples on any shard
+    """
+    mesh = topo.mesh
+
+    def local_step(state, batch, window):
+        # Each device sees a (1, ...) block: its own shard.
+        sq = lambda tree: jax.tree.map(lambda a: a[0], tree)
+        st = ShardedAggregatorState(*map(sq, state))
+        b = ShardedBatch(*(a[0] for a in batch))
+
+        idx = _arena.flat_window_index(b.windows, b.slots, num_windows, capacity)
+
+        counters = _raw(_arena.counter_ingest)(
+            st.counters, idx, b.slots, b.counter_values, b.times
+        )
+        gauges = _raw(_arena.gauge_ingest)(
+            st.gauges, idx, b.slots, b.gauge_values, b.times
+        )
+        timers = _raw(_arena.timer_ingest)(
+            st.timers, b.windows, b.slots, b.timer_values, b.times, capacity
+        )
+
+        c_lanes, c_cnt = _raw(_arena.counter_consume)(counters, window, capacity)
+        g_lanes, g_cnt = _raw(_arena.gauge_consume)(gauges, window, capacity)
+        t_lanes, t_cnt = _raw(_arena.timer_consume)(
+            timers, window, capacity, quantiles
+        )
+
+        # The drained window's ring row resets for reuse (engine.py
+        # consume() pairs every drain with reset_window).
+        counters = _raw(_arena.counter_reset_window)(counters, window, capacity)
+        gauges = _raw(_arena.gauge_reset_window)(gauges, window, capacity)
+        timers = _raw(_arena.timer_reset_window)(timers, window, capacity)
+
+        # Cross-shard rollup stage: the multi-stage pipeline's second hop.
+        # Sum/count roll up by psum; min/max by pmin/pmax over real values,
+        # with the all-shards-empty NaN sentinel restored afterwards.
+        g_sum = jax.lax.psum(
+            jnp.nan_to_num(g_lanes[:, 5]) + c_lanes[:, 5], SHARD_AXIS
+        )
+        g_count = jax.lax.psum(c_lanes[:, 4] + g_lanes[:, 4], SHARD_AXIS)
+        g_min = jax.lax.pmin(
+            jnp.where(jnp.isnan(g_lanes[:, 1]), jnp.inf, g_lanes[:, 1]), SHARD_AXIS
+        )
+        g_max = jax.lax.pmax(
+            jnp.where(jnp.isnan(g_lanes[:, 2]), -jnp.inf, g_lanes[:, 2]), SHARD_AXIS
+        )
+        g_min = jnp.where(jnp.isposinf(g_min), jnp.nan, g_min)
+        g_max = jnp.where(jnp.isneginf(g_max), jnp.nan, g_max)
+        rollup = jnp.stack([g_sum, g_count, g_min, g_max], axis=1)
+
+        new_state = ShardedAggregatorState(counters, gauges, timers)
+        ex = lambda tree: jax.tree.map(lambda a: a[None], tree)
+        lanes = {
+            "counter": (c_lanes[None], c_cnt[None]),
+            "gauge": (g_lanes[None], g_cnt[None]),
+            "timer": (t_lanes[None], t_cnt[None]),
+            "rollup": rollup,
+        }
+        return ShardedAggregatorState(*map(ex, new_state)), lanes
+
+    shard_spec = jax.tree.map(lambda _: P(SHARD_AXIS), state)
+    batch_spec = ShardedBatch(*(P(SHARD_AXIS) for _ in batch))
+    out_lane_spec = {
+        "counter": (P(SHARD_AXIS), P(SHARD_AXIS)),
+        "gauge": (P(SHARD_AXIS), P(SHARD_AXIS)),
+        "timer": (P(SHARD_AXIS), P(SHARD_AXIS)),
+        "rollup": P(),
+    }
+    return jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(shard_spec, batch_spec, P()),
+        out_specs=(shard_spec, out_lane_spec),
+        check_vma=False,
+    )(state, batch, window)
