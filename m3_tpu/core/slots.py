@@ -1,0 +1,68 @@
+"""Host-side slot allocator: string IDs → dense device array slots.
+
+The device only ever sees dense integer slots; the host owns the ID
+dictionary.  This replaces the reference's concurrent-map + async insert
+queue series registration (`src/dbnode/storage/shard.go:906`
+TryRetrieveSeriesAndIncrementReaderWriterCount miss →
+`shard_insert_queue.go` batched creation) — on TPU the "insert queue" is
+just dictionary fills amortized over a batch, and the arena capacity is
+fixed per shard (SURVEY.md §7 hard part #5).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+
+class SlotAllocator:
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._slots: Dict[bytes, int] = {}
+        self._ids: List[bytes | None] = []
+        self._free: List[int] = []
+
+    def __len__(self) -> int:
+        return len(self._slots)
+
+    def __contains__(self, sid: bytes) -> bool:
+        return sid in self._slots
+
+    def get(self, sid: bytes) -> int | None:
+        return self._slots.get(sid)
+
+    def id_of(self, slot: int) -> bytes | None:
+        return self._ids[slot] if slot < len(self._ids) else None
+
+    def resolve(self, ids: Sequence[bytes]) -> np.ndarray:
+        """Find-or-create slots for a batch of IDs (vectorized fast path
+        for all-known batches)."""
+        out = np.empty(len(ids), np.int32)
+        get = self._slots.get
+        for i, sid in enumerate(ids):
+            s = get(sid)
+            if s is None:
+                s = self._allocate(sid)
+            out[i] = s
+        return out
+
+    def _allocate(self, sid: bytes) -> int:
+        if self._free:
+            s = self._free.pop()
+            self._ids[s] = sid
+        else:
+            s = len(self._ids)
+            if s >= self.capacity:
+                raise RuntimeError(f"slot capacity {self.capacity} exhausted")
+            self._ids.append(sid)
+        self._slots[sid] = s
+        return s
+
+    def release(self, slot: int) -> None:
+        sid = self._ids[slot]
+        if sid is None:
+            return
+        del self._slots[sid]
+        self._ids[slot] = None
+        self._free.append(slot)

@@ -1,0 +1,166 @@
+"""Commit log: the write-ahead log for crash recovery.
+
+Equivalent of the reference's async batched WAL
+(`src/dbnode/persist/fs/commitlog/commit_log.go:716 Write / :733
+WriteBatch`, chunked writer with size+checksum headers `writer.go:43-52`,
+fsync policy, reader/iterator for bootstrap `iterator.go`).  Differences
+by design: entries are struct-framed binary (not msgpack — SURVEY.md §7
+"what deliberately does NOT carry over"), and batching is explicit (the
+ingest path is already batched arrays, so the WAL appends whole batches,
+not per-sample enqueues).
+
+Chunk layout:  [payload_len u32][payload_adler u32][header_adler u32]
+               [payload]
+Entry layout within a payload: repeated
+  [ns_len u8][ns][id_len u16][id][timestamp i64][value f64][unit u8]
+  [annot_len u16][annot]
+
+A torn final chunk (crash mid-write) fails its checksum and is dropped by
+the reader, truncating recovery to the last complete chunk — the same
+guarantee the reference's chunked writer provides.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import time
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Iterator
+
+import numpy as np
+
+from m3_tpu.persist.digest import digest
+
+_CHUNK_HDR = struct.Struct("<III")
+
+
+@dataclass(frozen=True)
+class CommitLogEntry:
+    series_id: bytes
+    timestamp: int
+    value: float
+    unit: int = 0
+    annotation: bytes = b""
+    namespace: bytes = b"default"
+
+
+class FsyncPolicy:
+    NEVER = "never"
+    EVERY_WRITE = "every_write"
+    INTERVAL = "interval"
+
+
+class CommitLogWriter:
+    """Appends batches as checksummed chunks; rotate() starts a new file
+    (the reference rotates on block boundaries for cleanup —
+    commit_log.go NotifyOpts/rotation)."""
+
+    def __init__(self, root, fsync: str = FsyncPolicy.INTERVAL,
+                 fsync_interval_s: float = 1.0):
+        self.dir = Path(root) / "commitlogs"
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.fsync = fsync
+        self.fsync_interval_s = fsync_interval_s
+        self._last_fsync = 0.0
+        self._f = None
+        self._seq = self._next_seq()
+        self.rotate()
+
+    def _next_seq(self) -> int:
+        seqs = [int(p.stem.split("-")[1]) for p in self.dir.glob("commitlog-*.db")]
+        return max(seqs, default=-1) + 1
+
+    @property
+    def path(self) -> Path:
+        return self.dir / f"commitlog-{self._seq}.db"
+
+    def rotate(self) -> Path | None:
+        """Close the active log and open the next one; returns the path
+        of the ROTATED-OUT file (None on first open)."""
+        old = None
+        if self._f:
+            old = self.path
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self._f.close()
+            self._seq += 1
+        self._f = open(self.path, "ab")
+        return old
+
+    def write_batch(self, ids: list[bytes], timestamps: np.ndarray,
+                    values: np.ndarray, unit: int = 0,
+                    annotations: list[bytes] | None = None,
+                    namespace: bytes = b"default") -> None:
+        parts = []
+        for i, sid in enumerate(ids):
+            ann = annotations[i] if annotations else b""
+            parts.append(struct.pack("<B", len(namespace)))
+            parts.append(namespace)
+            parts.append(struct.pack("<H", len(sid)))
+            parts.append(sid)
+            parts.append(struct.pack("<qdB", int(timestamps[i]), float(values[i]), unit))
+            parts.append(struct.pack("<H", len(ann)))
+            parts.append(ann)
+        payload = b"".join(parts)
+        pd = digest(payload)
+        hdr_body = struct.pack("<II", len(payload), pd)
+        chunk = hdr_body + struct.pack("<I", digest(hdr_body)) + payload
+        self._f.write(chunk)
+        if self.fsync == FsyncPolicy.EVERY_WRITE:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+        elif self.fsync == FsyncPolicy.INTERVAL:
+            now = time.monotonic()
+            if now - self._last_fsync >= self.fsync_interval_s:
+                self._f.flush()
+                os.fsync(self._f.fileno())
+                self._last_fsync = now
+
+    def close(self) -> None:
+        if self._f:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self._f.close()
+            self._f = None
+
+
+def read_commitlog(path) -> Iterator[CommitLogEntry]:
+    """Yields entries from one log file; stops (without raising) at the
+    first torn/corrupt chunk — the crash-recovery contract."""
+    raw = Path(path).read_bytes()
+    pos = 0
+    while pos + _CHUNK_HDR.size <= len(raw):
+        plen, pdig, hdig = _CHUNK_HDR.unpack_from(raw, pos)
+        if digest(raw[pos : pos + 8]) != hdig:
+            return
+        pos += _CHUNK_HDR.size
+        payload = raw[pos : pos + plen]
+        if len(payload) < plen or digest(payload) != pdig:
+            return
+        pos += plen
+        epos = 0
+        while epos < plen:
+            (nslen,) = struct.unpack_from("<B", payload, epos)
+            epos += 1
+            ns = payload[epos : epos + nslen]
+            epos += nslen
+            (idlen,) = struct.unpack_from("<H", payload, epos)
+            epos += 2
+            sid = payload[epos : epos + idlen]
+            epos += idlen
+            ts, val, unit = struct.unpack_from("<qdB", payload, epos)
+            epos += 17
+            (alen,) = struct.unpack_from("<H", payload, epos)
+            epos += 2
+            ann = payload[epos : epos + alen]
+            epos += alen
+            yield CommitLogEntry(sid, ts, val, unit, ann, ns)
+
+
+def list_commitlogs(root) -> list[Path]:
+    d = Path(root) / "commitlogs"
+    if not d.exists():
+        return []
+    return sorted(d.glob("commitlog-*.db"), key=lambda p: int(p.stem.split("-")[1]))

@@ -1,0 +1,222 @@
+"""Device series buffer: the in-memory mutable head of every series.
+
+Re-design of the reference's per-series `dbBuffer`
+(`src/dbnode/storage/series/buffer.go:221-247` BufferBucketVersions per
+block start; `Write` classifies warm/cold vs bufferPast/bufferFuture
+`buffer.go:290-413`; `WarmFlush` merges bucket streams `buffer.go:634`).
+Instead of an encoder object per (series, block), the whole shard buffers
+into a ring of **append logs on device** — one per open block window:
+
+    slot (W, S) i32 | ts (W, S) i64 | val (W, S) f64 | n (W,)
+
+Ingest is a single scatter per batch (same layout as the timer sample
+arenas).  Seal/flush drains a window with one lex-sort by
+(slot, ts, arrival) + last-write-wins dedupe — the analogue of the
+reference's bucket-merge at flush, where later writes at the same
+timestamp win (buffer.go conflict resolution on merge) — and hands the
+host sorted runs ready for the batched M3TSZ encoder.
+
+Out-of-window writes (cold writes / too-late / too-future) never touch the
+device: the host routes them to a per-block overflow list, flushed as a
+higher fileset volume (the reference's cold flush,
+`storage/coldflush.go` + `fs_merge_with_mem.go`).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class BufferState(NamedTuple):
+    slot: jnp.ndarray  # i32 (W, S); capacity = empty sentinel
+    ts: jnp.ndarray  # i64 (W, S)
+    val: jnp.ndarray  # f64 (W, S)
+    n: jnp.ndarray  # i64 (W,)
+
+
+def buffer_init(num_windows: int, sample_capacity: int, slot_capacity: int) -> BufferState:
+    return BufferState(
+        slot=jnp.full((num_windows, sample_capacity), slot_capacity, jnp.int32),
+        ts=jnp.full((num_windows, sample_capacity), jnp.iinfo(jnp.int64).max, jnp.int64),
+        val=jnp.zeros((num_windows, sample_capacity), jnp.float64),
+        n=jnp.zeros(num_windows, jnp.int64),
+    )
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def buffer_append(
+    state: BufferState,
+    windows: jnp.ndarray,  # i32 (N,) ring row per sample; OOB drops
+    slots: jnp.ndarray,  # i32 (N,)
+    ts: jnp.ndarray,  # i64 (N,)
+    vals: jnp.ndarray,  # f64 (N,)
+) -> BufferState:
+    num_w, scap = state.slot.shape
+    n = slots.shape[0]
+    oob = (windows < 0) | (windows >= num_w)
+    wkey = jnp.where(oob, num_w, windows)
+    # Stable sort by window keeps arrival order within each window.
+    s_w, s_slot, s_ts, s_val = jax.lax.sort(
+        (wkey, slots, ts, vals), num_keys=1, is_stable=True
+    )
+    pos = jnp.arange(n, dtype=jnp.int64)
+    rank = pos - jnp.searchsorted(s_w, s_w, side="left")
+    base = state.n[jnp.clip(s_w, 0, num_w - 1)]
+    dst = base + rank
+    flat = jnp.where(
+        (s_w < num_w) & (dst < scap), s_w.astype(jnp.int64) * scap + dst, num_w * scap
+    )
+    per_w = jnp.bincount(wkey, length=num_w)
+    return BufferState(
+        slot=state.slot.ravel().at[flat].set(s_slot, mode="drop").reshape(num_w, scap),
+        ts=state.ts.ravel().at[flat].set(s_ts, mode="drop").reshape(num_w, scap),
+        val=state.val.ravel().at[flat].set(s_val, mode="drop").reshape(num_w, scap),
+        n=state.n + per_w,
+    )
+
+
+@jax.jit
+def buffer_drain(state: BufferState, window: jnp.ndarray):
+    """One window -> (slot, ts, val, keep) sorted by (slot, ts).
+
+    keep masks out empty sentinel entries and duplicate (slot, ts) pairs
+    — the *last arrival* wins, matching the reference's merge rule where
+    a later write at the same timestamp supersedes.
+    """
+    slot_w = jax.lax.dynamic_index_in_dim(state.slot, window, keepdims=False)
+    ts_w = jax.lax.dynamic_index_in_dim(state.ts, window, keepdims=False)
+    val_w = jax.lax.dynamic_index_in_dim(state.val, window, keepdims=False)
+    scap = slot_w.shape[0]
+    # arrival descending so the latest write sorts first within (slot, ts)
+    arr_desc = jnp.arange(scap - 1, -1, -1, dtype=jnp.int64)
+    s_slot, s_ts, _arr, s_val = jax.lax.sort(
+        (slot_w, ts_w, arr_desc, val_w), num_keys=3
+    )
+    first = jnp.concatenate(
+        [jnp.ones(1, bool), (s_slot[1:] != s_slot[:-1]) | (s_ts[1:] != s_ts[:-1])]
+    )
+    return s_slot, s_ts, s_val, first
+
+
+class ShardBuffer:
+    """Host wrapper owning one shard's buffer ring + overflow lists."""
+
+    def __init__(self, block_size_nanos: int, num_windows: int,
+                 sample_capacity: int, slot_capacity: int):
+        self.block_size = block_size_nanos
+        self.num_windows = num_windows
+        self.sample_capacity = sample_capacity
+        self.slot_capacity = slot_capacity
+        self.state = buffer_init(num_windows, sample_capacity, slot_capacity)
+        self._n_host = np.zeros(num_windows, np.int64)
+        # block_start -> ring row for open windows
+        self.open_blocks: dict[int, int] = {}
+        # block_start -> [(slot, ts, val)] host overflow (cold writes)
+        self.cold: dict[int, list] = {}
+
+    def _row_for(self, block_start: int) -> int:
+        return (block_start // self.block_size) % self.num_windows
+
+    def write(self, slots: np.ndarray, ts: np.ndarray, vals: np.ndarray,
+              open_starts: set[int]) -> int:
+        """Append a batch.  open_starts = block starts currently accepting
+        warm writes (decided by the shard: retention/bufferPast/Future).
+        Returns count of samples routed to the cold path."""
+        block_starts = (ts // self.block_size) * self.block_size
+        warm = np.isin(block_starts, list(open_starts))
+        ncold = int((~warm).sum())
+        if ncold:
+            for bs in np.unique(block_starts[~warm]):
+                sel = (~warm) & (block_starts == bs)
+                self.cold.setdefault(int(bs), []).append(
+                    (slots[sel].copy(), ts[sel].copy(), vals[sel].copy())
+                )
+        if warm.any():
+            wslots, wts, wvals = slots[warm], ts[warm], vals[warm]
+            wstarts = block_starts[warm]
+            rows = ((wstarts // self.block_size) % self.num_windows).astype(np.int32)
+            for bs in np.unique(wstarts):
+                self.open_blocks[int(bs)] = self._row_for(int(bs))
+            per_row = np.bincount(rows, minlength=self.num_windows)
+            self._n_host += per_row
+            if self._n_host.max() > self.sample_capacity:
+                self._grow(int(self._n_host.max()))
+            self.state = buffer_append(
+                self.state,
+                jnp.asarray(rows),
+                jnp.asarray(wslots.astype(np.int32)),
+                jnp.asarray(wts.astype(np.int64)),
+                jnp.asarray(wvals.astype(np.float64)),
+            )
+        return ncold
+
+    def _grow(self, needed: int) -> None:
+        new_cap = self.sample_capacity
+        while new_cap < needed:
+            new_cap *= 2
+        pad = new_cap - self.sample_capacity
+        imax = np.iinfo(np.int64).max
+        self.state = BufferState(
+            slot=jnp.pad(self.state.slot, ((0, 0), (0, pad)),
+                         constant_values=self.slot_capacity),
+            ts=jnp.pad(self.state.ts, ((0, 0), (0, pad)), constant_values=imax),
+            val=jnp.pad(self.state.val, ((0, 0), (0, pad))),
+            n=self.state.n,
+        )
+        self.sample_capacity = new_cap
+
+    def drain(self, block_start: int):
+        """Seal one open block: device sort+dedupe, then host-side
+        ragged split.  Returns (slots, ts, vals) sorted by (slot, ts)
+        with duplicates resolved last-write-wins; clears the window."""
+        row = self.open_blocks.pop(block_start, None)
+        if row is None:
+            return (np.empty(0, np.int32), np.empty(0, np.int64), np.empty(0))
+        s_slot, s_ts, s_val, first = buffer_drain(self.state, jnp.int32(row))
+        s_slot = np.asarray(s_slot)
+        keep = np.asarray(first) & (s_slot < self.slot_capacity)
+        out = (s_slot[keep], np.asarray(s_ts)[keep], np.asarray(s_val)[keep])
+        self._reset_row(row)
+        return out
+
+    def _reset_row(self, row: int) -> None:
+        imax = np.iinfo(np.int64).max
+        self.state = BufferState(
+            slot=self.state.slot.at[row].set(self.slot_capacity),
+            ts=self.state.ts.at[row].set(imax),
+            val=self.state.val,
+            n=self.state.n.at[row].set(0),
+        )
+        self._n_host[row] = 0
+
+    def drain_cold(self, block_start: int):
+        """Pull the overflow list for one block (sorted, deduped)."""
+        parts = self.cold.pop(block_start, None)
+        if not parts:
+            return (np.empty(0, np.int32), np.empty(0, np.int64), np.empty(0))
+        slots = np.concatenate([p[0] for p in parts]).astype(np.int32)
+        ts = np.concatenate([p[1] for p in parts]).astype(np.int64)
+        vals = np.concatenate([p[2] for p in parts]).astype(np.float64)
+        # last arrival wins on duplicate (slot, ts)
+        arrival = np.arange(len(slots))
+        order = np.lexsort((-arrival, ts, slots))
+        slots, ts, vals = slots[order], ts[order], vals[order]
+        first = np.ones(len(slots), bool)
+        first[1:] = (slots[1:] != slots[:-1]) | (ts[1:] != ts[:-1])
+        return slots[first], ts[first], vals[first]
+
+    def read_window(self, block_start: int, slot: int):
+        """Read one series' points from an open (unsealed) block — the
+        read path's buffer component (buffer.go:705 ReadEncoded)."""
+        row = self.open_blocks.get(block_start)
+        if row is None:
+            return np.empty(0, np.int64), np.empty(0)
+        s_slot, s_ts, s_val, first = buffer_drain(self.state, jnp.int32(row))
+        s_slot = np.asarray(s_slot)
+        keep = np.asarray(first) & (s_slot == slot)
+        return np.asarray(s_ts)[keep], np.asarray(s_val)[keep]

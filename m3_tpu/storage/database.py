@@ -1,0 +1,355 @@
+"""The TSDB engine: database → namespace → shard (→ device buffers).
+
+Structural equivalent of the reference's storage hierarchy
+(`src/dbnode/storage/database.go:739 db.Write`, `namespace.go:698`,
+`shard.go:867-1008 writeAndIndex`, read `shard.go:1079 ReadEncoded`,
+flush orchestration `mediator.go:284 ongoingTick` + `flush.go`), with the
+TPU-shaped substitutions:
+
+* per-series encoder objects → one per-shard device append-log ring
+  (`storage/buffer.py`) + batched M3TSZ encode at seal time;
+* the lock-free series map + insert queue → a host `SlotAllocator`;
+* warm flush → `DataFileSetWriter.write_all` of batch-encoded streams;
+* cold writes → host overflow lists flushed as higher fileset volumes
+  (reference `coldflush.go` + `fs/merger.go`: we merge the existing
+  volume's streams with the cold points and write volume+1);
+* commit log → WAL appends per ingest batch before buffering.
+
+Reads serve from sealed filesets (scalar/batched decode) merged with the
+open in-memory window — the same two-source merge the reference does with
+`series buffer streams` + `block retriever` (`shard.go:1079`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import zlib
+from pathlib import Path
+from typing import Dict, Iterable, List, Sequence
+
+import numpy as np
+
+from m3_tpu.core.slots import SlotAllocator
+from m3_tpu.index.doc import Document
+from m3_tpu.index.namespace_index import NamespaceIndex
+from m3_tpu.index.search import Query
+from m3_tpu.encoding.m3tsz import decode_series, encode_series
+from m3_tpu.encoding.m3tsz_jax import decode_batch, encode_batch
+from m3_tpu.persist.commitlog import CommitLogWriter, list_commitlogs, read_commitlog
+from m3_tpu.persist.fs import DataFileSetReader, DataFileSetWriter, list_filesets
+from m3_tpu.storage.buffer import ShardBuffer
+
+
+@dataclasses.dataclass(frozen=True)
+class NamespaceOptions:
+    """Retention/block options (reference `src/dbnode/namespace/options.go`:
+    RetentionOptions blockSize/retentionPeriod/bufferPast/bufferFuture)."""
+
+    block_size_nanos: int = 2 * 3600 * 10**9
+    retention_nanos: int = 48 * 3600 * 10**9
+    buffer_past_nanos: int = 10 * 60 * 10**9
+    buffer_future_nanos: int = 2 * 60 * 10**9
+    cold_writes_enabled: bool = True
+    num_shards: int = 4
+    slot_capacity: int = 1 << 17
+    sample_capacity: int = 1 << 18
+
+
+@dataclasses.dataclass(frozen=True)
+class DatabaseOptions:
+    root: str = "m3tpu_data"
+    commitlog_enabled: bool = True
+
+
+def shard_for_id(sid: bytes, num_shards: int) -> int:
+    """Stable hash routing (reference murmur3(id) % N,
+    `sharding/shardset.go:148-163`)."""
+    return zlib.crc32(sid) % num_shards
+
+
+class Shard:
+    def __init__(self, namespace: str, shard_id: int, opts: NamespaceOptions, root: str):
+        self.namespace = namespace
+        self.shard_id = shard_id
+        self.opts = opts
+        self.root = root
+        self.slots = SlotAllocator(opts.slot_capacity)
+        # Ring must cover (bufferPast + bufferFuture) / blockSize + 2 blocks.
+        span = opts.buffer_past_nanos + opts.buffer_future_nanos
+        num_windows = max(2, span // opts.block_size_nanos + 2)
+        self.buffer = ShardBuffer(
+            opts.block_size_nanos, int(num_windows), opts.sample_capacity,
+            opts.slot_capacity,
+        )
+        self.flushed_blocks: set[int] = set()
+        for bs, _vol in list_filesets(root, namespace, shard_id):
+            self.flushed_blocks.add(bs)
+
+    # -- write path --------------------------------------------------------
+
+    def open_starts(self, now_nanos: int) -> set[int]:
+        """Block starts accepting warm writes at `now` (reference
+        buffer.go:311-398: [now-bufferPast, now+bufferFuture])."""
+        bsz = self.opts.block_size_nanos
+        lo = (now_nanos - self.opts.buffer_past_nanos) // bsz * bsz
+        hi = (now_nanos + self.opts.buffer_future_nanos) // bsz * bsz
+        return {bs for bs in range(lo, hi + bsz, bsz) if bs not in self.flushed_blocks}
+
+    def write_batch(self, ids: Sequence[bytes], ts: np.ndarray, vals: np.ndarray,
+                    now_nanos: int) -> int:
+        slots = self.slots.resolve(ids)
+        return self.buffer.write(slots, ts, vals, self.open_starts(now_nanos))
+
+    # -- flush path --------------------------------------------------------
+
+    def _encode_runs(self, slots: np.ndarray, ts: np.ndarray, vals: np.ndarray,
+                     block_start: int) -> list[tuple[bytes, bytes]]:
+        """(sorted, deduped) flat runs -> [(id, m3tsz stream)] via the
+        batched device encoder; fallback series use the scalar oracle."""
+        if len(slots) == 0:
+            return []
+        uniq, starts_idx, counts = np.unique(slots, return_index=True, return_counts=True)
+        S, T = len(uniq), int(counts.max())
+        tmat = np.zeros((S, T), np.int64)
+        vmat = np.zeros((S, T), np.float64)
+        for r, (i0, c) in enumerate(zip(starts_idx, counts)):
+            tmat[r, :c] = ts[i0 : i0 + c]
+            vmat[r, :c] = vals[i0 : i0 + c]
+            if c < T:  # pad with the last sample (ignored via counts)
+                tmat[r, c:] = tmat[r, c - 1]
+                vmat[r, c:] = vmat[r, c - 1]
+        starts = np.full(S, block_start, np.int64)
+        streams, fallback = encode_batch(
+            tmat, vmat, starts, counts=counts, out_words=max(16, T * 40 // 64 + 8)
+        )
+        out = []
+        for r, slot in enumerate(uniq):
+            sid = self.slots.id_of(int(slot))
+            if sid is None:
+                continue
+            if fallback[r]:
+                pts = list(zip(tmat[r, : counts[r]].tolist(), vmat[r, : counts[r]].tolist()))
+                stream = encode_series(pts, start=block_start)
+            else:
+                stream = streams[r]
+            out.append((sid, stream))
+        return out
+
+    def warm_flush(self, block_start: int) -> int:
+        """Seal + persist one block (reference buffer.go:634 WarmFlush →
+        persist_manager flush).  Returns series flushed."""
+        slots, ts, vals = self.buffer.drain(block_start)
+        series = self._encode_runs(slots, ts, vals, block_start)
+        DataFileSetWriter(
+            self.root, self.namespace, self.shard_id, block_start,
+            self.opts.block_size_nanos, volume=0,
+        ).write_all(series)
+        self.flushed_blocks.add(block_start)
+        return len(series)
+
+    def cold_flush(self) -> int:
+        """Merge cold overflow writes with the existing volume and write
+        volume+1 (reference coldflush.go + fs/merger.go)."""
+        flushed = 0
+        for block_start in sorted(self.buffer.cold.keys()):
+            slots, ts, vals = self.buffer.drain_cold(block_start)
+            if len(slots) == 0:
+                continue
+            merged: Dict[bytes, Dict[int, float]] = {}
+            vol = -1
+            for bs, v in list_filesets(self.root, self.namespace, self.shard_id):
+                if bs == block_start:
+                    vol = v
+            if vol >= 0:
+                r = DataFileSetReader(
+                    self.root, self.namespace, self.shard_id, block_start, vol
+                )
+                for sid, seg in r.read_all():
+                    merged[sid] = {d.timestamp: d.value for d in decode_series(seg)}
+            for slot, t, v in zip(slots, ts, vals):
+                sid = self.slots.id_of(int(slot))
+                if sid is None:
+                    continue
+                merged.setdefault(sid, {})[int(t)] = float(v)
+            series = []
+            for sid, pts in merged.items():
+                items = sorted(pts.items())
+                series.append((sid, encode_series(items, start=block_start)))
+            DataFileSetWriter(
+                self.root, self.namespace, self.shard_id, block_start,
+                self.opts.block_size_nanos, volume=vol + 1,
+            ).write_all(series)
+            self.flushed_blocks.add(block_start)
+            flushed += len(series)
+        return flushed
+
+    # -- read path ---------------------------------------------------------
+
+    def read(self, sid: bytes, start_nanos: int, end_nanos: int) -> list[tuple[int, float]]:
+        bsz = self.opts.block_size_nanos
+        out: list[tuple[int, float]] = []
+        slot = self.slots.get(sid)
+        lo = start_nanos // bsz * bsz
+        filesets = dict(list_filesets(self.root, self.namespace, self.shard_id))
+        for bs in range(lo, end_nanos + bsz, bsz):
+            if bs in filesets:
+                try:
+                    r = DataFileSetReader(
+                        self.root, self.namespace, self.shard_id, bs, filesets[bs]
+                    )
+                    seg = r.read(sid)
+                    if seg:
+                        out.extend((d.timestamp, d.value) for d in decode_series(seg))
+                except FileNotFoundError:
+                    pass
+            if slot is not None and bs in self.buffer.open_blocks:
+                ts, vals = self.buffer.read_window(bs, slot)
+                out.extend(zip(ts.tolist(), vals.tolist()))
+        return [(t, v) for t, v in sorted(out) if start_nanos <= t < end_nanos]
+
+
+class Namespace:
+    def __init__(self, name: str, opts: NamespaceOptions, root: str):
+        self.name = name
+        self.opts = opts
+        self.root = root
+        self.shards = [Shard(name, i, opts, root) for i in range(opts.num_shards)]
+        self.index = NamespaceIndex(opts.block_size_nanos, root, name)
+
+    def write_tagged_batch(self, docs: Sequence[Document], ts: np.ndarray,
+                           vals: np.ndarray, now_nanos: int) -> int:
+        """Write + index tagged series (reference WriteTagged
+        `database.go:771` → shard writeAndIndex → nsIndex.WriteBatch)."""
+        self.index.write_batch(list(docs), ts)
+        return self.write_batch([d.id for d in docs], ts, vals, now_nanos)
+
+    def query_ids(self, q: Query, start: int, end: int) -> list[Document]:
+        """Index query → matching series documents (reference db.QueryIDs
+        → nsIndex.Query `storage/index.go:1483`)."""
+        return self.index.query(q, start, end)
+
+    def write_batch(self, ids: Sequence[bytes], ts: np.ndarray, vals: np.ndarray,
+                    now_nanos: int) -> int:
+        by_shard: Dict[int, List[int]] = {}
+        for i, sid in enumerate(ids):
+            by_shard.setdefault(shard_for_id(sid, self.opts.num_shards), []).append(i)
+        ncold = 0
+        for sh, idxs in by_shard.items():
+            sel = np.asarray(idxs)
+            ncold += self.shards[sh].write_batch(
+                [ids[i] for i in idxs], ts[sel], vals[sel], now_nanos
+            )
+        return ncold
+
+    def read(self, sid: bytes, start: int, end: int) -> list[tuple[int, float]]:
+        return self.shards[shard_for_id(sid, self.opts.num_shards)].read(sid, start, end)
+
+    def tick(self, now_nanos: int) -> dict:
+        """Seal + warm-flush every open block that has left the warm
+        window (mediator.go tick → flush), then cold-flush overflow."""
+        stats = {"warm_flushed": 0, "cold_flushed": 0, "index_sealed": 0}
+        sealed_blocks: set[int] = set()
+        for shard in self.shards:
+            open_now = shard.open_starts(now_nanos)
+            for bs in sorted(set(shard.buffer.open_blocks) - open_now):
+                stats["warm_flushed"] += shard.warm_flush(bs)
+                sealed_blocks.add(bs)
+            if self.opts.cold_writes_enabled:
+                stats["cold_flushed"] += shard.cold_flush()
+        # Index blocks seal alongside their data blocks (reference index
+        # flush rides the same mediator file-system pass, mediator.go:318).
+        for bs in sorted(sealed_blocks):
+            if self.index.seal_block(bs) is not None:
+                stats["index_sealed"] += 1
+        return stats
+
+
+class Database:
+    """Top-level engine (reference storage/database.go db struct;
+    `Write` :739, `ReadEncoded` via namespaces, `Bootstrap` :1199)."""
+
+    def __init__(self, opts: DatabaseOptions | None = None,
+                 namespaces: Dict[str, NamespaceOptions] | None = None):
+        self.opts = opts or DatabaseOptions()
+        Path(self.opts.root).mkdir(parents=True, exist_ok=True)
+        self.namespaces: Dict[str, Namespace] = {}
+        for name, nopts in (namespaces or {"default": NamespaceOptions()}).items():
+            self.namespaces[name] = Namespace(name, nopts, self.opts.root)
+        self.commitlog = (
+            CommitLogWriter(self.opts.root) if self.opts.commitlog_enabled else None
+        )
+        self.bootstrapped = False
+
+    def write_batch(self, namespace: str, ids: Sequence[bytes], ts, vals,
+                    now_nanos: int | None = None) -> int:
+        ns = self.namespaces[namespace]
+        ts = np.asarray(ts, np.int64)
+        vals = np.asarray(vals, np.float64)
+        if now_nanos is None:
+            now_nanos = int(ts.max())
+        if self.commitlog is not None:
+            self.commitlog.write_batch(list(ids), ts, vals,
+                                       namespace=namespace.encode())
+        return ns.write_batch(ids, ts, vals, now_nanos)
+
+    def write_tagged_batch(self, namespace: str, docs: Sequence[Document], ts, vals,
+                           now_nanos: int | None = None) -> int:
+        ns = self.namespaces[namespace]
+        ts = np.asarray(ts, np.int64)
+        vals = np.asarray(vals, np.float64)
+        if now_nanos is None:
+            now_nanos = int(ts.max())
+        if self.commitlog is not None:
+            self.commitlog.write_batch([d.id for d in docs], ts, vals,
+                                       namespace=namespace.encode())
+        return ns.write_tagged_batch(docs, ts, vals, now_nanos)
+
+    def query_ids(self, namespace: str, q: Query, start: int, end: int):
+        return self.namespaces[namespace].query_ids(q, start, end)
+
+    def read(self, namespace: str, sid: bytes, start: int, end: int):
+        return self.namespaces[namespace].read(sid, start, end)
+
+    def tick(self, now_nanos: int) -> dict:
+        stats = {}
+        for name, ns in self.namespaces.items():
+            stats[name] = ns.tick(now_nanos)
+        return stats
+
+    def bootstrap(self) -> dict:
+        """fs → commitlog bootstrap chain (reference
+        `storage/bootstrap/process.go` + bootstrapper/README.md: filesets
+        first, then WAL replay for whatever isn't in a fileset)."""
+        replayed = 0
+        for log in list_commitlogs(self.opts.root):
+            if self.commitlog is not None and log == self.commitlog.path:
+                continue
+            per_ns: Dict[str, list] = {}
+            for e in read_commitlog(log):
+                per_ns.setdefault(e.namespace.decode(), []).append(e)
+            for name, entries in per_ns.items():
+                ns = self.namespaces.get(name)
+                if ns is None:
+                    continue
+                ts = np.asarray([e.timestamp for e in entries], np.int64)
+                vals = np.asarray([e.value for e in entries], np.float64)
+                ids = [e.series_id for e in entries]
+                now = int(ts.max())
+                # Replay skips blocks already covered by a checkpointed
+                # fileset (the fs bootstrapper's unfulfilled-ranges rule).
+                keep = np.ones(len(ts), bool)
+                for i, sid in enumerate(ids):
+                    sh = ns.shards[shard_for_id(sid, ns.opts.num_shards)]
+                    bs = int(ts[i]) // ns.opts.block_size_nanos * ns.opts.block_size_nanos
+                    if bs in sh.flushed_blocks:
+                        keep[i] = False
+                if keep.any():
+                    ids_kept = [ids[i] for i in np.nonzero(keep)[0]]
+                    replayed += len(ids_kept)
+                    ns.write_batch(ids_kept, ts[keep], vals[keep], now)
+        self.bootstrapped = True
+        return {"commitlog_replayed": replayed}
+
+    def close(self) -> None:
+        if self.commitlog is not None:
+            self.commitlog.close()

@@ -1,0 +1,220 @@
+"""End-to-end storage slice: write → seal → fileset → read-back, WAL
+recovery, cold writes (SURVEY.md §7 Phase 2's acceptance: write, flush,
+read back bit-identical)."""
+
+import numpy as np
+import pytest
+
+from m3_tpu.encoding.m3tsz import decode_series, encode_series
+from m3_tpu.persist.bloom import BloomFilter
+from m3_tpu.persist.commitlog import (
+    CommitLogWriter, FsyncPolicy, list_commitlogs, read_commitlog,
+)
+from m3_tpu.persist.fs import DataFileSetReader, DataFileSetWriter, list_filesets
+from m3_tpu.storage.database import (
+    Database, DatabaseOptions, NamespaceOptions, shard_for_id,
+)
+
+BLOCK = 2 * 3600 * 10**9
+START = (1_700_000_000 * 10**9) // BLOCK * BLOCK  # block-aligned
+
+
+def _ns_opts(**kw):
+    defaults = dict(
+        block_size_nanos=BLOCK,
+        retention_nanos=48 * 3600 * 10**9,
+        buffer_past_nanos=10 * 60 * 10**9,
+        buffer_future_nanos=2 * 60 * 10**9,
+        num_shards=2,
+        slot_capacity=1 << 10,
+        sample_capacity=1 << 12,
+    )
+    defaults.update(kw)
+    return NamespaceOptions(**defaults)
+
+
+@pytest.fixture
+def db(tmp_path):
+    d = Database(
+        DatabaseOptions(root=str(tmp_path)), {"default": _ns_opts()}
+    )
+    yield d
+    d.close()
+
+
+class TestFileSet:
+    def test_roundtrip_and_lookup_ladder(self, tmp_path):
+        series = []
+        for i in range(300):
+            sid = f"series-{i:04d}".encode()
+            pts = [(START + j * 10**10, float(i) + j * 0.25) for j in range(50)]
+            series.append((sid, encode_series(pts, start=START)))
+        DataFileSetWriter(tmp_path, "ns", 3, START, BLOCK).write_all(series)
+        r = DataFileSetReader(tmp_path, "ns", 3, START, 0)
+        assert len(r) == 300
+        assert r.info.num_series == 300
+        seg = r.read(b"series-0123")
+        want = dict(series)[b"series-0123"]
+        assert seg == want
+        assert r.read(b"missing-id") is None
+        got = dict(r.read_all())
+        assert got == dict(series)
+
+    def test_checkpoint_gates_visibility(self, tmp_path):
+        DataFileSetWriter(tmp_path, "ns", 0, START, BLOCK).write_all(
+            [(b"a", encode_series([(START + 10**9, 1.0)], start=START))]
+        )
+        from m3_tpu.persist.fs import fileset_path
+        fileset_path(tmp_path, "ns", 0, START, 0, "checkpoint").unlink()
+        with pytest.raises(FileNotFoundError):
+            DataFileSetReader(tmp_path, "ns", 0, START, 0)
+        assert list_filesets(tmp_path, "ns", 0) == []
+
+    def test_corruption_detected(self, tmp_path):
+        DataFileSetWriter(tmp_path, "ns", 0, START, BLOCK).write_all(
+            [(b"a", encode_series([(START + 10**9, 1.0)], start=START))]
+        )
+        from m3_tpu.persist.fs import fileset_path
+        p = fileset_path(tmp_path, "ns", 0, START, 0, "data")
+        raw = bytearray(p.read_bytes())
+        raw[0] ^= 0xFF
+        p.write_bytes(bytes(raw))
+        with pytest.raises(ValueError):
+            DataFileSetReader(tmp_path, "ns", 0, START, 0)
+
+
+class TestBloom:
+    def test_no_false_negatives(self):
+        ids = [f"metric-{i}".encode() for i in range(5000)]
+        bf = BloomFilter.from_estimate(len(ids))
+        bf.add_batch(ids)
+        assert bf.contains_batch(ids).all()
+        other = [f"absent-{i}".encode() for i in range(5000)]
+        fp = bf.contains_batch(other).mean()
+        assert fp < 0.05
+        bf2 = BloomFilter.from_bytes(bf.to_bytes())
+        assert bf2.contains_batch(ids).all()
+
+
+class TestCommitLog:
+    def test_roundtrip(self, tmp_path):
+        w = CommitLogWriter(tmp_path, fsync=FsyncPolicy.EVERY_WRITE)
+        w.write_batch([b"a", b"b"], np.array([1, 2]), np.array([1.5, 2.5]))
+        w.write_batch([b"c"], np.array([3]), np.array([-0.5]))
+        w.close()
+        logs = list_commitlogs(tmp_path)
+        assert len(logs) == 1
+        entries = list(read_commitlog(logs[0]))
+        assert [(e.series_id, e.timestamp, e.value) for e in entries] == [
+            (b"a", 1, 1.5), (b"b", 2, 2.5), (b"c", 3, -0.5),
+        ]
+
+    def test_torn_chunk_truncates(self, tmp_path):
+        w = CommitLogWriter(tmp_path, fsync=FsyncPolicy.EVERY_WRITE)
+        w.write_batch([b"a"], np.array([1]), np.array([1.0]))
+        w.write_batch([b"b"], np.array([2]), np.array([2.0]))
+        w.close()
+        log = list_commitlogs(tmp_path)[0]
+        raw = log.read_bytes()
+        log.write_bytes(raw[:-3])  # torn final chunk
+        entries = list(read_commitlog(log))
+        assert [e.series_id for e in entries] == [b"a"]
+
+
+class TestDatabase:
+    def test_write_flush_read_bit_identical(self, db, tmp_path):
+        ids = [f"cpu.util.host{i:03d}".encode() for i in range(200)]
+        T = 60
+        all_ids, all_ts, all_vals = [], [], []
+        rng = np.random.default_rng(7)
+        base = rng.uniform(10, 100, len(ids))
+        for j in range(T):
+            t = START + (j + 1) * 10 * 10**9
+            all_ids.extend(ids)
+            all_ts.extend([t] * len(ids))
+            all_vals.extend(np.round(base + rng.normal(0, 1, len(ids)), 2).tolist())
+        order = rng.permutation(len(all_ids))
+        db.write_batch(
+            "default",
+            [all_ids[i] for i in order],
+            np.asarray(all_ts)[order],
+            np.asarray(all_vals)[order],
+        )
+        # Read from the open buffer (pre-flush).
+        got = db.read("default", ids[5], START, START + BLOCK)
+        want = sorted(
+            (all_ts[i], all_vals[i])
+            for i in range(len(all_ids))
+            if all_ids[i] == ids[5]
+        )
+        assert got == want
+
+        # Tick past the warm window: block seals + flushes.
+        now = START + BLOCK + db.namespaces["default"].opts.buffer_past_nanos + 10**9
+        stats = db.tick(now)
+        assert stats["default"]["warm_flushed"] == len(ids)
+
+        # Post-flush reads hit the fileset; values must be identical.
+        got2 = db.read("default", ids[5], START, START + BLOCK)
+        assert got2 == want
+
+        # The persisted stream must be byte-identical to a direct scalar
+        # encode of the same points (the golden-contract guarantee).
+        sh = db.namespaces["default"].shards[
+            shard_for_id(ids[5], 2)
+        ]
+        r = DataFileSetReader(tmp_path, "default", sh.shard_id, START, 0)
+        seg = r.read(ids[5])
+        assert seg == encode_series(want, start=START)
+
+    def test_commitlog_bootstrap_recovers_unflushed(self, tmp_path):
+        opts = DatabaseOptions(root=str(tmp_path))
+        db1 = Database(opts, {"default": _ns_opts()})
+        ids = [b"m1", b"m2"]
+        ts = np.array([START + 10**10, START + 2 * 10**10], np.int64)
+        db1.write_batch("default", ids, ts, np.array([1.25, 2.5]))
+        db1.close()  # crash before any flush
+
+        db2 = Database(opts, {"default": _ns_opts()})
+        assert db2.read("default", b"m1", START, START + BLOCK) == []
+        rep = db2.bootstrap()
+        assert rep["commitlog_replayed"] == 2
+        assert db2.read("default", b"m1", START, START + BLOCK) == [
+            (START + 10**10, 1.25)
+        ]
+        db2.close()
+
+    def test_cold_write_flushes_as_new_volume(self, db, tmp_path):
+        ns = db.namespaces["default"]
+        t_warm = START + 10 * 10**9
+        db.write_batch("default", [b"s"], np.array([t_warm]), np.array([1.0]))
+        now = START + BLOCK + ns.opts.buffer_past_nanos + 10**9
+        db.tick(now)
+        # A late write into the already-flushed block → cold path.
+        t_late = START + 20 * 10**9
+        ncold = db.write_batch(
+            "default", [b"s"], np.array([t_late]), np.array([2.0]), now_nanos=now
+        )
+        assert ncold == 1
+        db.tick(now + 10**9)
+        sh = ns.shards[shard_for_id(b"s", 2)]
+        filesets = list_filesets(tmp_path, "default", sh.shard_id)
+        assert filesets == [(START, 1)]  # volume 1 supersedes
+        got = db.read("default", b"s", START, START + BLOCK)
+        assert got == [(t_warm, 1.0), (t_late, 2.0)]
+
+    def test_out_of_order_within_block(self, db):
+        ts = np.array([START + 3 * 10**10, START + 1 * 10**10, START + 2 * 10**10])
+        db.write_batch("default", [b"x"] * 3, ts, np.array([3.0, 1.0, 2.0]))
+        got = db.read("default", b"x", START, START + BLOCK)
+        assert got == [
+            (START + 1 * 10**10, 1.0),
+            (START + 2 * 10**10, 2.0),
+            (START + 3 * 10**10, 3.0),
+        ]
+
+    def test_duplicate_timestamp_last_write_wins(self, db):
+        t = START + 10**10
+        db.write_batch("default", [b"d", b"d"], np.array([t, t]), np.array([1.0, 9.0]))
+        got = db.read("default", b"d", START, START + BLOCK)
+        assert got == [(t, 9.0)]
