@@ -1,0 +1,28 @@
+"""Document model for the inverted index.
+
+Equivalent of `src/m3ninx/doc`: a document is a series ID plus (name,
+value) field pairs — i.e. the tag set of a time series.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Field:
+    name: bytes
+    value: bytes
+
+
+@dataclass(frozen=True)
+class Document:
+    id: bytes
+    fields: tuple[Field, ...] = ()
+
+    @classmethod
+    def from_tags(cls, sid: bytes, tags: dict[bytes, bytes]) -> "Document":
+        return cls(sid, tuple(Field(n, v) for n, v in sorted(tags.items())))
+
+    def tags(self) -> dict[bytes, bytes]:
+        return {f.name: f.value for f in self.fields}

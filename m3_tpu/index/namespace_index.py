@@ -1,0 +1,111 @@
+"""Namespace index: time-blocked segments over the segment library.
+
+Equivalent of `src/dbnode/storage/index` (`nsIndex`, `index.go:97`): an
+active mutable segment per index block start receiving tagged writes
+(`WriteBatch` `index.go:624`), sealed to an immutable segment at flush
+(the reference compacts mutable → FST via the segment builder), and
+`Query` (`index.go:1483`) executing a boolean query across every block
+segment overlapping the query range, unioning series IDs.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+from m3_tpu.index.doc import Document
+from m3_tpu.index.search import Query, execute_segment
+from m3_tpu.index.segment import MutableSegment, SealedSegment
+
+
+class NamespaceIndex:
+    def __init__(self, block_size_nanos: int, root: str | None = None,
+                 namespace: str = "default"):
+        self.block_size = block_size_nanos
+        self.root = root
+        self.namespace = namespace
+        self.mutable: dict[int, MutableSegment] = {}
+        self.sealed: dict[int, SealedSegment] = {}
+        # block_start -> (generation, sealed view) memo so read-heavy
+        # workloads don't rebuild term tables per query.
+        self._mutable_view: dict[int, tuple[int, SealedSegment]] = {}
+        if root is not None:
+            self._load_sealed()
+
+    # -- write path --------------------------------------------------------
+
+    def _block_for(self, ts_nanos: int) -> int:
+        return ts_nanos // self.block_size * self.block_size
+
+    def write_batch(self, docs: list[Document], ts_nanos: np.ndarray) -> None:
+        """Index each tagged series in the block its timestamp falls in
+        (reference forward-index semantics simplified: one insert per
+        (doc, block))."""
+        for doc, t in zip(docs, ts_nanos):
+            bs = self._block_for(int(t))
+            seg = self.mutable.get(bs)
+            if seg is None:
+                seg = self.mutable[bs] = MutableSegment()
+            seg.insert(doc)
+
+    # -- seal/persist ------------------------------------------------------
+
+    def _seg_path(self, block_start: int) -> Path:
+        return (
+            Path(self.root) / "index" / self.namespace / f"segment-{block_start}.db"
+        )
+
+    def seal_block(self, block_start: int) -> SealedSegment | None:
+        """Mutable -> sealed (+ persisted when rooted); reference index
+        flush writes the FST fileset (`storage/index.go` flush +
+        `m3ninx/index/segment/fst/writer.go`)."""
+        m = self.mutable.pop(block_start, None)
+        self._mutable_view.pop(block_start, None)
+        if m is None or len(m) == 0:
+            return None
+        sealed = m.seal()
+        if block_start in self.sealed:
+            from m3_tpu.index.segment import merge_segments
+
+            sealed = merge_segments([self.sealed[block_start], sealed])
+        self.sealed[block_start] = sealed
+        if self.root is not None:
+            p = self._seg_path(block_start)
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_bytes(sealed.to_bytes())
+        return sealed
+
+    def _load_sealed(self) -> None:
+        d = Path(self.root) / "index" / self.namespace
+        if not d.exists():
+            return
+        for f in d.glob("segment-*.db"):
+            bs = int(f.stem.split("-")[1])
+            self.sealed[bs] = SealedSegment.from_bytes(f.read_bytes())
+
+    # -- query path --------------------------------------------------------
+
+    def query(self, q: Query, start_nanos: int, end_nanos: int) -> list[Document]:
+        """Matching documents across all block segments overlapping
+        [start, end); deduped by series ID."""
+        out: dict[bytes, Document] = {}
+        lo = self._block_for(start_nanos)
+        for bs in sorted(set(self.mutable) | set(self.sealed)):
+            if bs + self.block_size <= start_nanos or bs >= end_nanos:
+                continue
+            segs = []
+            if bs in self.sealed:
+                segs.append(self.sealed[bs])
+            if bs in self.mutable:
+                m = self.mutable[bs]
+                memo = self._mutable_view.get(bs)
+                if memo is None or memo[0] != m.generation:
+                    memo = (m.generation, m.seal())
+                    self._mutable_view[bs] = memo
+                segs.append(memo[1])
+            for seg in segs:
+                for did in execute_segment(seg, q):
+                    doc = seg.doc(int(did))
+                    out.setdefault(doc.id, doc)
+        return list(out.values())

@@ -1,0 +1,102 @@
+"""Postings lists: sorted doc-ID arrays on host, bitset algebra on device.
+
+Equivalent of `src/m3ninx/postings` (+ `postings/roaring`): the reference
+stores postings as roaring bitmaps and runs boolean set algebra during
+search.  Here a postings list is a sorted int32 numpy array (the roaring
+analogue for host-side construction/serialization), and **query-time set
+algebra runs on device as dense bitset ops** — AND/OR/NOT over uint64
+word tensors is exactly the kind of wide elementwise arithmetic the VPU
+eats, and it batches across query nodes (one (Q, W) tensor for Q clauses
+rather than Q pointer-chased bitmap walks).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def to_bitset(postings: np.ndarray, num_docs: int) -> np.ndarray:
+    """Sorted doc-id array -> uint64 bitset words."""
+    nwords = (num_docs + 63) // 64
+    words = np.zeros(nwords, np.uint64)
+    if len(postings):
+        np.bitwise_or.at(
+            words,
+            postings // 64,
+            np.uint64(1) << (postings % 64).astype(np.uint64),
+        )
+    return words
+
+
+def from_bitset(words: np.ndarray, num_docs: int | None = None) -> np.ndarray:
+    """Bitset words -> sorted doc-id array."""
+    words = np.asarray(words, np.uint64)
+    bits = np.unpackbits(words.view(np.uint8), bitorder="little")
+    ids = np.nonzero(bits)[0]
+    if num_docs is not None:
+        ids = ids[ids < num_docs]
+    return ids.astype(np.int32)
+
+
+@jax.jit
+def bs_and(a, b):
+    return a & b
+
+
+@jax.jit
+def bs_or(a, b):
+    return a | b
+
+
+@jax.jit
+def bs_andnot(a, b):
+    return a & ~b
+
+
+@functools.partial(jax.jit, static_argnames=("num_docs",))
+def bs_not(a, num_docs: int):
+    nwords = a.shape[-1]
+    full = ~jnp.zeros_like(a)
+    tail_bits = num_docs % 64
+    mask = jnp.where(
+        jnp.arange(nwords) < num_docs // 64,
+        jnp.uint64(0xFFFFFFFFFFFFFFFF),
+        jnp.where(
+            jnp.arange(nwords) == num_docs // 64,
+            jnp.uint64((1 << tail_bits) - 1 if tail_bits else 0),
+            jnp.uint64(0),
+        ),
+    )
+    return (~a) & mask
+
+
+@jax.jit
+def bs_any_and(queries, target):
+    """(Q, W) & (W,) -> (Q,) does-intersect flags: batched pre-filter for
+    multi-clause queries."""
+    return jnp.any(queries & target[None, :] != 0, axis=1)
+
+
+@jax.jit
+def bs_count(a):
+    """Population count per bitset (row-wise if 2-D)."""
+    bytes_ = jax.lax.bitcast_convert_type(a, jnp.uint8)
+    return jnp.sum(
+        jax.lax.population_count(bytes_), axis=tuple(range(bytes_.ndim - 2, bytes_.ndim))
+    ) if a.ndim > 1 else jnp.sum(jax.lax.population_count(bytes_))
+
+
+def intersect_sorted(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    return np.intersect1d(a, b, assume_unique=True)
+
+
+def union_sorted(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    return np.union1d(a, b)
+
+
+def difference_sorted(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    return np.setdiff1d(a, b, assume_unique=True)

@@ -1,0 +1,163 @@
+"""Inverted index: segments, postings algebra, boolean search, namespace
+index integration with the database (tagged write → query → read)."""
+
+import numpy as np
+import pytest
+
+from m3_tpu.index import postings as ps
+from m3_tpu.index.doc import Document
+from m3_tpu.index.namespace_index import NamespaceIndex
+from m3_tpu.index.search import (
+    All, Conjunction, Disjunction, FieldExists, Negation, Regexp, Term,
+    execute_segment,
+)
+from m3_tpu.index.segment import MutableSegment, SealedSegment, merge_segments
+
+BLOCK = 2 * 3600 * 10**9
+START = (1_700_000_000 * 10**9) // BLOCK * BLOCK
+
+
+def _docs(n=100):
+    out = []
+    for i in range(n):
+        out.append(
+            Document.from_tags(
+                f"cpu.util.host{i:03d}".encode(),
+                {
+                    b"__name__": b"cpu_util",
+                    b"host": f"host{i:03d}".encode(),
+                    b"dc": b"us-east" if i % 2 == 0 else b"eu-west",
+                    b"role": b"db" if i % 10 == 0 else b"web",
+                },
+            )
+        )
+    return out
+
+
+@pytest.fixture
+def sealed():
+    m = MutableSegment()
+    m.insert_batch(_docs())
+    return m.seal()
+
+
+class TestSegment:
+    def test_term_lookup(self, sealed):
+        p = sealed.postings_term(b"dc", b"us-east")
+        assert len(p) == 50
+        assert sealed.postings_term(b"dc", b"nope").size == 0
+        assert sealed.postings_term(b"nope", b"x").size == 0
+
+    def test_duplicate_insert_is_idempotent(self):
+        m = MutableSegment()
+        d = _docs(1)[0]
+        assert m.insert(d) == m.insert(d) == 0
+        assert len(m) == 1
+
+    def test_serialization_roundtrip(self, sealed):
+        back = SealedSegment.from_bytes(sealed.to_bytes())
+        assert back.num_docs == sealed.num_docs
+        assert back.fields() == sealed.fields()
+        np.testing.assert_array_equal(
+            back.postings_term(b"role", b"db"), sealed.postings_term(b"role", b"db")
+        )
+        assert back.doc(3).tags() == sealed.doc(3).tags()
+
+    def test_merge_dedupes(self, sealed):
+        m2 = MutableSegment()
+        m2.insert_batch(_docs(150))  # 100 overlap + 50 new
+        merged = merge_segments([sealed, m2.seal()])
+        assert merged.num_docs == 150
+
+
+class TestSearch:
+    def test_conjunction(self, sealed):
+        p = execute_segment(sealed, Conjunction(Term(b"dc", b"us-east"), Term(b"role", b"db")))
+        # role=db at i%10==0, dc=us-east at i%2==0 → i%10==0 qualifies
+        assert len(p) == 10
+
+    def test_disjunction_negation(self, sealed):
+        p = execute_segment(
+            sealed, Disjunction(Term(b"role", b"db"), Term(b"dc", b"eu-west"))
+        )
+        assert len(p) == 10 + 50  # disjoint sets: db is always even (us-east)
+        p2 = execute_segment(sealed, Negation(Term(b"dc", b"eu-west")))
+        assert len(p2) == 50
+
+    def test_regexp_and_field_exists(self, sealed):
+        p = execute_segment(sealed, Regexp(b"host", b"host00.*"))
+        assert len(p) == 10
+        assert len(execute_segment(sealed, FieldExists(b"host"))) == 100
+        assert len(execute_segment(sealed, All())) == 100
+
+    def test_bitset_path_matches_host_path(self):
+        # Cross 2^16 docs to exercise the device bitset executor.
+        from m3_tpu.index import search as s
+
+        m = MutableSegment()
+        n = s.DEVICE_BITSET_THRESHOLD + 10
+        for i in range(n):
+            m.insert(
+                Document.from_tags(
+                    f"id{i}".encode(), {b"p": b"even" if i % 2 == 0 else b"odd"}
+                )
+            )
+        seg = m.seal()
+        q = Conjunction(Term(b"p", b"even"), Negation(Regexp(b"p", b"od.")))
+        dev = execute_segment(seg, q)
+        host = s._exec_host(seg, q)
+        np.testing.assert_array_equal(dev, host)
+
+
+class TestPostingsBitset:
+    def test_roundtrip_and_ops(self):
+        a = np.asarray(sorted(np.random.default_rng(0).choice(1000, 200, False)), np.int32)
+        b = np.asarray(sorted(np.random.default_rng(1).choice(1000, 300, False)), np.int32)
+        wa, wb = ps.to_bitset(a, 1000), ps.to_bitset(b, 1000)
+        np.testing.assert_array_equal(ps.from_bitset(wa, 1000), a)
+        import jax.numpy as jnp
+
+        got_and = ps.from_bitset(np.asarray(ps.bs_and(jnp.asarray(wa), jnp.asarray(wb))), 1000)
+        np.testing.assert_array_equal(got_and, np.intersect1d(a, b))
+        got_not = ps.from_bitset(np.asarray(ps.bs_not(jnp.asarray(wa), 1000)), 1000)
+        np.testing.assert_array_equal(got_not, np.setdiff1d(np.arange(1000), a))
+
+
+class TestNamespaceIndex:
+    def test_blocked_query_and_persistence(self, tmp_path):
+        idx = NamespaceIndex(BLOCK, str(tmp_path), "ns")
+        docs = _docs(20)
+        ts = np.full(20, START + 10**10, np.int64)
+        idx.write_batch(docs, ts)
+        # Query hits the mutable segment.
+        got = idx.query(Term(b"role", b"db"), START, START + BLOCK)
+        assert {d.id for d in got} == {b"cpu.util.host000", b"cpu.util.host010"}
+        # Seal + reload from disk.
+        idx.seal_block(START)
+        idx2 = NamespaceIndex(BLOCK, str(tmp_path), "ns")
+        got2 = idx2.query(Term(b"role", b"db"), START, START + BLOCK)
+        assert {d.id for d in got2} == {d.id for d in got}
+        # Out-of-range query misses.
+        assert idx2.query(All(), START + BLOCK, START + 2 * BLOCK) == []
+
+
+class TestDatabaseTagged:
+    def test_write_tagged_query_read(self, tmp_path):
+        from m3_tpu.storage.database import Database, DatabaseOptions, NamespaceOptions
+
+        db = Database(
+            DatabaseOptions(root=str(tmp_path)),
+            {"default": NamespaceOptions(num_shards=2, slot_capacity=1 << 10,
+                                         sample_capacity=1 << 12)},
+        )
+        docs = _docs(10)
+        t = START + 10**10
+        db.write_tagged_batch(
+            "default", docs, np.full(10, t, np.int64), np.arange(10, dtype=np.float64)
+        )
+        hits = db.query_ids("default", Term(b"dc", b"eu-west"), START, START + BLOCK)
+        assert len(hits) == 5
+        for d in hits:
+            pts = db.read("default", d.id, START, START + BLOCK)
+            assert len(pts) == 1 and pts[0][0] == t
+        db.close()
