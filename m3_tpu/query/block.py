@@ -1,0 +1,91 @@
+"""Columnar block model: the unit of data between storage and functions.
+
+Equivalent of `src/query/block` (`column.go`, series/step iterators in
+`types.go`): a block is a (series × step) matrix of float64 samples on a
+regular step grid, plus per-series metadata (tags).  Where the reference
+exposes pull-based iterators consumed one step/series at a time, the TPU
+form IS the matrix — every function is an array op over it, NaN marks
+missing samples (Prometheus staleness semantics).
+
+`RawBlock` carries irregular raw datapoints (padded (S, P) with counts)
+for temporal functions that need the actual samples within each window
+(rate & friends, *_over_time) — mirroring how the reference's temporal
+nodes re-read raw series rather than pre-aligned steps
+(`src/query/functions/temporal/base.go:102-230`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class SeriesMeta:
+    """Tags for one series (reference block.SeriesMeta)."""
+
+    tags: tuple[tuple[bytes, bytes], ...]
+
+    @classmethod
+    def from_dict(cls, d: dict[bytes, bytes]) -> "SeriesMeta":
+        return cls(tuple(sorted(d.items())))
+
+    def as_dict(self) -> dict[bytes, bytes]:
+        return dict(self.tags)
+
+    def drop(self, names: set[bytes]) -> "SeriesMeta":
+        return SeriesMeta(tuple((n, v) for n, v in self.tags if n not in names))
+
+    def keep(self, names: set[bytes]) -> "SeriesMeta":
+        return SeriesMeta(tuple((n, v) for n, v in self.tags if n in names))
+
+    def drop_name(self) -> "SeriesMeta":
+        return self.drop({b"__name__"})
+
+
+@dataclasses.dataclass
+class Block:
+    """Step-aligned block: values[s, t] at step_times[t] (NaN = no sample)."""
+
+    step_times: np.ndarray  # (T,) int64 UnixNanos
+    values: np.ndarray  # (S, T) float64
+    series: list[SeriesMeta]
+
+    @property
+    def num_series(self) -> int:
+        return self.values.shape[0]
+
+    @property
+    def num_steps(self) -> int:
+        return self.values.shape[1]
+
+    def with_values(self, values, series: list[SeriesMeta] | None = None) -> "Block":
+        return Block(self.step_times, np.asarray(values),
+                     series if series is not None else self.series)
+
+
+@dataclasses.dataclass
+class RawBlock:
+    """Irregular raw datapoints per series, time-sorted and right-padded."""
+
+    ts: np.ndarray  # (S, P) int64; padded tail = i64 max
+    values: np.ndarray  # (S, P) float64
+    counts: np.ndarray  # (S,) int64 real points per series
+    series: list[SeriesMeta]
+
+    @classmethod
+    def from_lists(cls, pts: list[list[tuple[int, float]]],
+                   series: list[SeriesMeta]) -> "RawBlock":
+        S = len(pts)
+        P = max((len(p) for p in pts), default=0)
+        P = max(P, 1)
+        ts = np.full((S, P), np.iinfo(np.int64).max, np.int64)
+        vals = np.full((S, P), np.nan)
+        counts = np.zeros(S, np.int64)
+        for i, p in enumerate(pts):
+            counts[i] = len(p)
+            if p:
+                ts[i, : len(p)] = [t for t, _ in p]
+                vals[i, : len(p)] = [v for _, v in p]
+        return cls(ts, vals, counts, series)

@@ -1,0 +1,309 @@
+"""Query engine: parse → evaluate → step-aligned block.
+
+Equivalent of `src/query/executor` (`engine.ExecuteExpr` `engine.go:111`:
+parse → logical plan → DAG of transforms pulling blocks).  The evaluator
+walks the AST depth-first; leaves fetch raw series through a Storage
+interface (the fanout/m3db adapter seam, `query/storage/fanout`), and
+every interior node is a whole-block array op (`temporal.py`,
+`functions.py`) instead of a per-step iterator chain.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Protocol
+
+import jax.numpy as jnp
+import numpy as np
+
+from m3_tpu.query import functions as fn
+from m3_tpu.query import temporal as tp
+from m3_tpu.query.block import Block, RawBlock, SeriesMeta
+from m3_tpu.query.promql import (
+    Aggregation, BinaryOp, Call, Expr, LabelMatcher, NumberLiteral,
+    StringLiteral, Unary, VectorSelector, parse,
+)
+
+LOOKBACK_NANOS = 5 * 60 * 10**9  # Prometheus default lookback delta
+
+_TEMPORAL_SUM = {"sum_over_time", "count_over_time", "avg_over_time",
+                 "stddev_over_time", "stdvar_over_time"}
+_TEMPORAL_MINMAXQ = {"min_over_time", "max_over_time", "quantile_over_time"}
+_TEMPORAL_RATE = {"rate", "increase", "delta", "irate", "idelta"}
+_TEMPORAL_REG = {"deriv", "predict_linear"}
+_TEMPORAL_ALL = (_TEMPORAL_SUM | _TEMPORAL_MINMAXQ | _TEMPORAL_RATE
+                 | _TEMPORAL_REG | {"last_over_time", "present_over_time"})
+
+
+class Storage(Protocol):
+    def fetch_raw(self, name: bytes | None, matchers: tuple[LabelMatcher, ...],
+                  start_nanos: int, end_nanos: int) -> RawBlock: ...
+
+
+@dataclass
+class _Scalar:
+    value: float
+
+
+class Engine:
+    """reference `executor/engine.go:47 NewEngine`."""
+
+    def __init__(self, storage: Storage, lookback_nanos: int = LOOKBACK_NANOS):
+        self.storage = storage
+        self.lookback = lookback_nanos
+
+    # -- public API --------------------------------------------------------
+
+    def execute_range(self, query: str, start_nanos: int, end_nanos: int,
+                      step_nanos: int) -> Block:
+        """PromQL range query (reference api/v1 native read →
+        ExecuteExpr)."""
+        ast = parse(query)
+        steps = np.arange(start_nanos, end_nanos + 1, step_nanos, dtype=np.int64)
+        out = self._eval(ast, steps)
+        if isinstance(out, _Scalar):
+            return Block(steps, np.full((1, len(steps)), out.value),
+                         [SeriesMeta(())])
+        return out
+
+    def execute_instant(self, query: str, time_nanos: int) -> Block:
+        return self.execute_range(query, time_nanos, time_nanos, 10**9)
+
+    # -- evaluation --------------------------------------------------------
+
+    def _eval(self, e: Expr, steps: np.ndarray):
+        if isinstance(e, NumberLiteral):
+            return _Scalar(e.value)
+        if isinstance(e, StringLiteral):
+            return e.value
+        if isinstance(e, Unary):
+            v = self._eval(e.expr, steps)
+            if e.op == "+":
+                return v
+            if isinstance(v, _Scalar):
+                return _Scalar(-v.value)
+            return v.with_values(-v.values)
+        if isinstance(e, VectorSelector):
+            if e.range_nanos:
+                raise ValueError("range selector outside temporal function")
+            return self._eval_instant_selector(e, steps)
+        if isinstance(e, Call):
+            return self._eval_call(e, steps)
+        if isinstance(e, Aggregation):
+            return self._eval_aggregation(e, steps)
+        if isinstance(e, BinaryOp):
+            return self._eval_binary(e, steps)
+        raise ValueError(f"cannot evaluate {e}")
+
+    def _fetch(self, sel: VectorSelector, steps: np.ndarray, range_nanos: int):
+        start = int(steps[0]) - range_nanos - sel.offset_nanos
+        end = int(steps[-1]) - sel.offset_nanos
+        raw = self.storage.fetch_raw(sel.name, sel.matchers, start, end)
+        eval_steps = steps - sel.offset_nanos
+        return raw, eval_steps
+
+    def _eval_instant_selector(self, sel: VectorSelector, steps: np.ndarray) -> Block:
+        raw, eval_steps = self._fetch(sel, steps, self.lookback)
+        vals = np.asarray(
+            tp.last_over_time(jnp.asarray(raw.ts), jnp.asarray(raw.values),
+                              jnp.asarray(eval_steps), self.lookback)
+        )
+        return Block(steps, vals, raw.series)
+
+    def _eval_call(self, call: Call, steps: np.ndarray):
+        f = call.func
+        if f in _TEMPORAL_ALL:
+            q = 0.0
+            sel_arg = call.args[-1]
+            extra = 0.0
+            if f == "quantile_over_time":
+                q = self._scalar_arg(call.args[0], steps)
+                sel_arg = call.args[1]
+            elif f == "predict_linear":
+                sel_arg = call.args[0]
+                extra = self._scalar_arg(call.args[1], steps)
+            if not isinstance(sel_arg, VectorSelector) or sel_arg.range_nanos == 0:
+                raise ValueError(f"{f} requires a range selector")
+            raw, eval_steps = self._fetch(sel_arg, steps, sel_arg.range_nanos)
+            ts_j = jnp.asarray(raw.ts)
+            vals_j = jnp.asarray(np.nan_to_num(raw.values))
+            st_j = jnp.asarray(eval_steps)
+            rng = sel_arg.range_nanos
+            if f in _TEMPORAL_SUM:
+                out = tp.sum_count_family(ts_j, vals_j, st_j, rng, f)
+            elif f in _TEMPORAL_MINMAXQ:
+                W = tp.window_pad_for(raw.counts, raw.ts, rng)
+                out = tp.minmax_quantile_family(ts_j, vals_j, st_j, rng, f, W, q)
+            elif f in _TEMPORAL_RATE:
+                out = tp.rate_family(ts_j, vals_j, st_j, rng, f)
+            elif f in _TEMPORAL_REG:
+                out = tp.regression_family(ts_j, vals_j, st_j, rng, f, extra)
+            elif f == "last_over_time":
+                out = tp.last_over_time(ts_j, vals_j, st_j, rng)
+            else:  # present_over_time
+                out = tp.sum_count_family(ts_j, vals_j, st_j, rng, "count_over_time")
+                out = jnp.where(jnp.isnan(out), out, jnp.minimum(out, 1.0))
+            metas = [m.drop_name() for m in raw.series]
+            return Block(steps, np.asarray(out), metas)
+
+        if f == "histogram_quantile":
+            q = self._scalar_arg(call.args[0], steps)
+            block = self._eval(call.args[1], steps)
+            return fn.histogram_quantile(block, q)
+        if f in fn._UNARY:
+            return fn.unary_math(self._eval(call.args[0], steps), f)
+        if f == "round":
+            nearest = (self._scalar_arg(call.args[1], steps)
+                       if len(call.args) > 1 else 1.0)
+            return fn.round_fn(self._eval(call.args[0], steps), nearest)
+        if f == "clamp":
+            return fn.clamp(self._eval(call.args[0], steps),
+                            self._scalar_arg(call.args[1], steps),
+                            self._scalar_arg(call.args[2], steps))
+        if f == "clamp_min":
+            return fn.clamp(self._eval(call.args[0], steps),
+                            lo=self._scalar_arg(call.args[1], steps))
+        if f == "clamp_max":
+            return fn.clamp(self._eval(call.args[0], steps),
+                            hi=self._scalar_arg(call.args[1], steps))
+        if f == "scalar":
+            b = self._eval(call.args[0], steps)
+            if b.num_series == 1:
+                return b.with_values(b.values)
+            return _Scalar(float("nan"))
+        if f == "vector":
+            v = self._scalar_arg(call.args[0], steps)
+            return Block(steps, np.full((1, len(steps)), v), [SeriesMeta(())])
+        if f == "absent":
+            b = self._eval(call.args[0], steps)
+            present = (~np.isnan(b.values)).any(axis=0) if b.num_series else (
+                np.zeros(len(steps), bool))
+            vals = np.where(present, np.nan, 1.0)[None, :]
+            return Block(steps, vals, [SeriesMeta(())])
+        if f == "label_replace":
+            return self._label_replace(call, steps)
+        if f == "label_join":
+            return self._label_join(call, steps)
+        if f == "timestamp":
+            b = self._eval(call.args[0], steps)
+            tvals = np.broadcast_to(steps.astype(np.float64) / 1e9, b.values.shape)
+            return b.with_values(np.where(np.isnan(b.values), np.nan, tvals),
+                                 [m.drop_name() for m in b.series])
+        if f in ("time",):
+            return _Scalar(float("nan"))  # resolved per-step below
+        raise ValueError(f"unsupported function {f!r}")
+
+    def _label_replace(self, call: Call, steps: np.ndarray) -> Block:
+        import re as _re
+
+        b = self._eval(call.args[0], steps)
+        dst = self._string_arg(call.args[1]).encode()
+        repl = self._string_arg(call.args[2])
+        src = self._string_arg(call.args[3]).encode()
+        regex = _re.compile(self._string_arg(call.args[4]))
+        metas = []
+        for m in b.series:
+            tags = m.as_dict()
+            val = tags.get(src, b"").decode()
+            mm = regex.fullmatch(val)
+            if mm:
+                new = mm.expand(repl.replace("$", "\\")).encode()
+                if new:
+                    tags[dst] = new
+                else:
+                    tags.pop(dst, None)
+            metas.append(SeriesMeta.from_dict(tags))
+        return Block(b.step_times, b.values, metas)
+
+    def _label_join(self, call: Call, steps: np.ndarray) -> Block:
+        b = self._eval(call.args[0], steps)
+        dst = self._string_arg(call.args[1]).encode()
+        sep = self._string_arg(call.args[2]).encode()
+        srcs = [self._string_arg(a).encode() for a in call.args[3:]]
+        metas = []
+        for m in b.series:
+            tags = m.as_dict()
+            joined = sep.join(tags.get(s, b"") for s in srcs)
+            if joined:
+                tags[dst] = joined
+            else:
+                tags.pop(dst, None)
+            metas.append(SeriesMeta.from_dict(tags))
+        return Block(b.step_times, b.values, metas)
+
+    def _eval_aggregation(self, agg: Aggregation, steps: np.ndarray) -> Block:
+        block = self._eval(agg.expr, steps)
+        by = set(agg.by) if agg.by is not None else None
+        without = set(agg.without) if agg.without is not None else None
+        if agg.op in ("topk", "bottomk"):
+            k = int(self._scalar_arg(agg.param, steps))
+            return fn.topk_bottomk(block, k, agg.op, by, without)
+        if agg.op == "quantile":
+            q = self._scalar_arg(agg.param, steps)
+            return fn.aggregate(block, "quantile", by, without, q)
+        if agg.op == "group":
+            out = fn.aggregate(block, "count", by, without)
+            return out.with_values(np.where(np.isnan(out.values), np.nan, 1.0))
+        return fn.aggregate(block, agg.op, by, without)
+
+    def _eval_binary(self, b: BinaryOp, steps: np.ndarray):
+        lhs = self._eval(b.lhs, steps)
+        rhs = self._eval(b.rhs, steps)
+        sl, sr = isinstance(lhs, _Scalar), isinstance(rhs, _Scalar)
+        if b.op in ("and", "or", "unless"):
+            return self._set_op(b, lhs, rhs)
+        if sl and sr:
+            with np.errstate(all="ignore"):
+                v = float(fn._BINOPS[b.op](lhs.value, rhs.value))
+            if b.op in fn._COMPARISONS:
+                v = 1.0 if v else 0.0
+            return _Scalar(v)
+        if sr:
+            return fn.scalar_binary(lhs, b.op, rhs.value, False, b.bool_mode)
+        if sl:
+            return fn.scalar_binary(rhs, b.op, lhs.value, True, b.bool_mode)
+        return fn.vector_binary(
+            lhs, rhs, b.op,
+            set(b.on) if b.on is not None else None,
+            set(b.ignoring) if b.ignoring is not None else None,
+            b.bool_mode,
+        )
+
+    def _set_op(self, b: BinaryOp, lhs: Block, rhs: Block) -> Block:
+        on = set(b.on) if b.on is not None else None
+        ig = set(b.ignoring) if b.ignoring is not None else None
+        rkeys = {fn._match_key(m, on, ig): i for i, m in enumerate(rhs.series)}
+        if b.op == "or":
+            extra_rows = [i for i, m in enumerate(rhs.series)
+                          if fn._match_key(m, on, ig) not in
+                          {fn._match_key(x, on, ig) for x in lhs.series}]
+            vals = np.concatenate([lhs.values, rhs.values[extra_rows]]) if extra_rows \
+                else lhs.values
+            metas = lhs.series + [rhs.series[i] for i in extra_rows]
+            return Block(lhs.step_times, vals, metas)
+        out = np.full_like(lhs.values, np.nan)
+        for i, m in enumerate(lhs.series):
+            j = rkeys.get(fn._match_key(m, on, ig))
+            if b.op == "and":
+                if j is not None:
+                    out[i] = np.where(~np.isnan(rhs.values[j]), lhs.values[i], np.nan)
+            else:  # unless
+                if j is None:
+                    out[i] = lhs.values[i]
+                else:
+                    out[i] = np.where(np.isnan(rhs.values[j]), lhs.values[i], np.nan)
+        return lhs.with_values(out)
+
+    # -- helpers -----------------------------------------------------------
+
+    def _scalar_arg(self, e: Expr, steps: np.ndarray) -> float:
+        v = self._eval(e, steps)
+        if isinstance(v, _Scalar):
+            return v.value
+        raise ValueError("expected scalar argument")
+
+    def _string_arg(self, e: Expr) -> str:
+        if isinstance(e, StringLiteral):
+            return e.value
+        raise ValueError("expected string argument")

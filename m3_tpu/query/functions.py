@@ -1,0 +1,312 @@
+"""Instant-vector functions over step-aligned blocks.
+
+Equivalents of `src/query/functions/{aggregation,linear,binary,tag}`:
+
+* label-grouped aggregations (sum/avg/min/max/count/stddev/quantile/
+  topk/bottomk by/without) — `aggregation/function.go`;
+* `histogram_quantile` — `linear/histogram_quantile.go:38-54`, computed
+  per (group, step) over the le-bucket axis as one segmented device op;
+* scalar math (abs/ceil/floor/exp/ln/log2/log10/sqrt/round/clamp_*) —
+  `linear/math.go`, `linear/clamp.go`;
+* binary arithmetic/comparison with vector matching (on/ignoring) —
+  `binary/binary.go`.
+
+All operate on the (S, T) matrix; grouping is a host-computed partition of
+series rows (tag work stays on host) followed by one device segmented
+reduction over the group axis.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+
+import numpy as np
+
+from m3_tpu.query.block import Block, SeriesMeta
+
+NAN = float("nan")
+
+
+# ---------------------------------------------------------------------------
+# Label grouping (host): series rows -> group ids
+# ---------------------------------------------------------------------------
+
+
+def group_series(series: list[SeriesMeta], by: set[bytes] | None,
+                 without: set[bytes] | None) -> tuple[np.ndarray, list[SeriesMeta]]:
+    """Group assignment per series row + the output group metas.
+
+    by=None, without=None → one global group (Prometheus `sum(x)`).
+    """
+    groups: dict[tuple, int] = {}
+    metas: list[SeriesMeta] = []
+    gids = np.zeros(len(series), np.int32)
+    for i, m in enumerate(series):
+        if by is not None:
+            key_meta = m.keep(by)
+        elif without is not None:
+            key_meta = m.drop(without | {b"__name__"})
+        else:
+            key_meta = SeriesMeta(())
+        k = key_meta.tags
+        g = groups.get(k)
+        if g is None:
+            g = groups[k] = len(metas)
+            metas.append(key_meta)
+        gids[i] = g
+    return gids, metas
+
+
+def _segment_reduce(values: np.ndarray, gids: np.ndarray, num_groups: int,
+                    func: str, q: float = 0.0) -> np.ndarray:
+    """(S, T) + group ids -> (G, T) via device segment ops."""
+    import jax
+    import jax.numpy as jnp
+
+    v = jnp.asarray(values)
+    g = jnp.asarray(gids)
+    T = values.shape[1]
+    nan = jnp.isnan(v)
+    zero = jnp.where(nan, 0.0, v)
+    ones = (~nan).astype(jnp.float64)
+
+    def seg_sum(x):
+        return jax.ops.segment_sum(x, g, num_segments=num_groups)
+
+    cnt = seg_sum(ones)
+    empty = cnt == 0
+    if func == "sum":
+        out = seg_sum(zero)
+    elif func == "count":
+        out = cnt
+    elif func == "avg":
+        out = seg_sum(zero) / jnp.where(empty, 1.0, cnt)
+    elif func in ("stddev", "stdvar"):
+        s1 = seg_sum(zero)
+        s2 = seg_sum(zero * zero)
+        mean = s1 / jnp.where(empty, 1.0, cnt)
+        var = jnp.maximum(s2 / jnp.where(empty, 1.0, cnt) - mean * mean, 0.0)
+        out = jnp.sqrt(var) if func == "stddev" else var
+    elif func == "min":
+        out = jax.ops.segment_min(jnp.where(nan, jnp.inf, v), g, num_segments=num_groups)
+        out = jnp.where(jnp.isposinf(out), NAN, out)
+    elif func == "max":
+        out = jax.ops.segment_max(jnp.where(nan, -jnp.inf, v), g, num_segments=num_groups)
+        out = jnp.where(jnp.isneginf(out), NAN, out)
+    elif func == "quantile":
+        # Sort rows within each group: lex-sort (gid, value) per step is
+        # expensive per column; do it host-side via numpy for clarity.
+        out_np = np.full((num_groups, T), NAN)
+        vals_np = values
+        for grp in range(num_groups):
+            rows = vals_np[gids == grp]
+            if rows.size == 0:
+                continue
+            with np.errstate(all="ignore"):
+                out_np[grp] = np.nanquantile(rows, q, axis=0, method="linear")
+        return out_np
+    else:
+        raise ValueError(f"unknown aggregation {func}")
+    return np.asarray(jnp.where(empty, NAN, out))
+
+
+def aggregate(block: Block, func: str, by: set[bytes] | None = None,
+              without: set[bytes] | None = None, param: float = 0.0) -> Block:
+    gids, metas = group_series(block.series, by, without)
+    vals = _segment_reduce(block.values, gids, len(metas), func, param)
+    return Block(block.step_times, vals, metas)
+
+
+def topk_bottomk(block: Block, k: int, func: str,
+                 by: set[bytes] | None = None,
+                 without: set[bytes] | None = None) -> Block:
+    """topk/bottomk keep original series, masking all but the k extreme
+    per (group, step)."""
+    gids, _ = group_series(block.series, by, without)
+    v = block.values
+    masked = np.where(np.isnan(v), -np.inf if func == "topk" else np.inf, v)
+    out = np.full_like(v, NAN)
+    for grp in np.unique(gids):
+        rows = np.nonzero(gids == grp)[0]
+        sub = masked[rows]  # (R, T)
+        if func == "topk":
+            kth = np.sort(sub, axis=0)[::-1][min(k, len(rows)) - 1]
+            keep = sub >= kth
+        else:
+            kth = np.sort(sub, axis=0)[min(k, len(rows)) - 1]
+            keep = sub <= kth
+        out[rows] = np.where(keep & np.isfinite(sub), v[rows], NAN)
+    return block.with_values(out)
+
+
+# ---------------------------------------------------------------------------
+# histogram_quantile
+# ---------------------------------------------------------------------------
+
+
+def histogram_quantile(block: Block, q: float) -> Block:
+    """Per-step quantile from cumulative `le` buckets (reference
+    linear/histogram_quantile.go: group series by tags-minus-le, sort
+    buckets by upper bound, linear interpolation within the bucket)."""
+    groups: dict[tuple, list[tuple[float, int]]] = defaultdict(list)
+    for i, m in enumerate(block.series):
+        tags = m.as_dict()
+        le = tags.get(b"le")
+        if le is None:
+            continue
+        try:
+            ub = float(le)
+        except ValueError:
+            continue
+        key = m.drop({b"le", b"__name__"}).tags
+        groups[key].append((ub, i))
+
+    T = block.num_steps
+    metas: list[SeriesMeta] = []
+    out_rows = []
+    for key, buckets in groups.items():
+        buckets.sort()
+        ubs = np.array([b[0] for b in buckets])
+        rows = block.values[[b[1] for b in buckets]]  # (B, T) cumulative counts
+        if not np.isinf(ubs[-1]):
+            metas.append(SeriesMeta(key))
+            out_rows.append(np.full(T, NAN))
+            continue
+        total = rows[-1]
+        with np.errstate(all="ignore"):
+            # Clamp non-monotone buckets (Prometheus tolerates these).
+            counts = np.maximum.accumulate(np.nan_to_num(rows), axis=0)
+            rank = q * total
+            # First bucket with count >= rank.
+            ge = counts >= rank[None, :]
+            first = np.argmax(ge, axis=0)
+            b_hi = ubs[first]
+            b_lo = np.where(first > 0, ubs[np.maximum(first - 1, 0)], 0.0)
+            c_hi = np.take_along_axis(counts, first[None, :], axis=0)[0]
+            c_lo = np.where(
+                first > 0,
+                np.take_along_axis(counts, np.maximum(first - 1, 0)[None, :], axis=0)[0],
+                0.0,
+            )
+            frac = np.where(c_hi > c_lo, (rank - c_lo) / (c_hi - c_lo), 0.0)
+            val = b_lo + (b_hi - b_lo) * frac
+            # Highest finite bucket bounds the +Inf bucket's answer.
+            in_inf = np.isinf(b_hi)
+            highest_finite = ubs[-2] if len(ubs) >= 2 else 0.0
+            val = np.where(in_inf, highest_finite, val)
+            val = np.where((total == 0) | np.isnan(total), NAN, val)
+        metas.append(SeriesMeta(key))
+        out_rows.append(val)
+    if not out_rows:
+        return Block(block.step_times, np.zeros((0, T)), [])
+    return Block(block.step_times, np.stack(out_rows), metas)
+
+
+# ---------------------------------------------------------------------------
+# Scalar math + binary ops
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    "abs": np.abs,
+    "ceil": np.ceil,
+    "floor": np.floor,
+    "exp": np.exp,
+    "ln": np.log,
+    "log2": np.log2,
+    "log10": np.log10,
+    "sqrt": np.sqrt,
+    "sgn": np.sign,
+}
+
+
+def unary_math(block: Block, func: str) -> Block:
+    with np.errstate(all="ignore"):
+        out = _UNARY[func](block.values)
+    return block.with_values(out, [m.drop_name() for m in block.series])
+
+
+def round_fn(block: Block, to_nearest: float = 1.0) -> Block:
+    with np.errstate(all="ignore"):
+        # Prometheus round(): half away from... actually half UP (floor(v+0.5)).
+        out = np.floor(block.values / to_nearest + 0.5) * to_nearest
+    return block.with_values(out, [m.drop_name() for m in block.series])
+
+
+def clamp(block: Block, lo: float = -math.inf, hi: float = math.inf) -> Block:
+    return block.with_values(
+        np.clip(block.values, lo, hi), [m.drop_name() for m in block.series]
+    )
+
+
+_BINOPS = {
+    "+": np.add,
+    "-": np.subtract,
+    "*": np.multiply,
+    "/": np.divide,
+    "%": np.mod,
+    "^": np.power,
+    "==": np.equal,
+    "!=": np.not_equal,
+    ">": np.greater,
+    "<": np.less,
+    ">=": np.greater_equal,
+    "<=": np.less_equal,
+}
+
+_COMPARISONS = {"==", "!=", ">", "<", ">=", "<="}
+
+
+def scalar_binary(block: Block, op: str, scalar: float,
+                  scalar_left: bool = False, bool_mode: bool = False) -> Block:
+    f = _BINOPS[op]
+    with np.errstate(all="ignore"):
+        out = (
+            f(scalar, block.values) if scalar_left else f(block.values, scalar)
+        ).astype(np.float64)
+    if op in _COMPARISONS:
+        if bool_mode:
+            out = out  # already 0/1
+        else:
+            out = np.where(out != 0, block.values, NAN)  # filter semantics
+    series = block.series if op in _COMPARISONS and not bool_mode else [
+        m.drop_name() for m in block.series
+    ]
+    return block.with_values(out, series)
+
+
+def _match_key(meta: SeriesMeta, on: set[bytes] | None,
+               ignoring: set[bytes] | None) -> tuple:
+    if on is not None:
+        return meta.keep(on).tags
+    drop = {b"__name__"} | (ignoring or set())
+    return meta.drop(drop).tags
+
+
+def vector_binary(lhs: Block, rhs: Block, op: str,
+                  on: set[bytes] | None = None,
+                  ignoring: set[bytes] | None = None,
+                  bool_mode: bool = False) -> Block:
+    """One-to-one vector matching (reference binary/binary.go)."""
+    rindex = { _match_key(m, on, ignoring): i for i, m in enumerate(rhs.series) }
+    rows_l, rows_r, metas = [], [], []
+    for i, m in enumerate(lhs.series):
+        k = _match_key(m, on, ignoring)
+        j = rindex.get(k)
+        if j is None:
+            continue
+        rows_l.append(i)
+        rows_r.append(j)
+        metas.append(m.drop_name() if not (op in _COMPARISONS and not bool_mode) else m)
+    if not rows_l:
+        return Block(lhs.step_times, np.zeros((0, lhs.num_steps)), [])
+    f = _BINOPS[op]
+    lv = lhs.values[rows_l]
+    rv = rhs.values[rows_r]
+    with np.errstate(all="ignore"):
+        out = f(lv, rv).astype(np.float64)
+    if op in _COMPARISONS and not bool_mode:
+        out = np.where(out != 0, lv, NAN)
+    miss = np.isnan(lv) | np.isnan(rv)
+    out = np.where(miss, NAN, out)
+    return Block(lhs.step_times, out, metas)

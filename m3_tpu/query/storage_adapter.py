@@ -1,0 +1,62 @@
+"""Storage adapter: PromQL selectors → index query → raw series blocks.
+
+Equivalent of `src/query/storage/m3` (FetchCompressed
+`m3/storage.go:215-225`: label matchers → index FetchTagged → decoded
+series) without the network hop — the engine and the database share a
+process, as in the reference's embedded coordinator mode.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from m3_tpu.index.search import (
+    All, Conjunction, Negation, Query, Regexp, Term,
+)
+from m3_tpu.query.block import RawBlock, SeriesMeta
+from m3_tpu.query.promql import LabelMatcher
+from m3_tpu.storage.database import Database
+
+
+def matchers_to_query(name: bytes | None,
+                      matchers: tuple[LabelMatcher, ...]) -> Query:
+    """Label matchers → boolean index query (reference storage/m3
+    FetchOptionsToM3Options + idx query conversion)."""
+    parts: list[Query] = []
+    if name is not None:
+        parts.append(Term(b"__name__", name))
+    for m in matchers:
+        if m.op == "=":
+            parts.append(Term(m.name, m.value))
+        elif m.op == "!=":
+            parts.append(Negation(Term(m.name, m.value)))
+        elif m.op == "=~":
+            parts.append(Regexp(m.name, m.value))
+        elif m.op == "!~":
+            parts.append(Negation(Regexp(m.name, m.value)))
+        else:
+            raise ValueError(f"bad matcher op {m.op}")
+    if not parts:
+        return All()
+    if len(parts) == 1 and not isinstance(parts[0], Negation):
+        return parts[0]
+    return Conjunction(*parts)
+
+
+class DatabaseStorage:
+    """Engine Storage implementation over one Database namespace."""
+
+    def __init__(self, db: Database, namespace: str = "default"):
+        self.db = db
+        self.namespace = namespace
+
+    def fetch_raw(self, name, matchers, start_nanos, end_nanos) -> RawBlock:
+        q = matchers_to_query(name, matchers)
+        docs = self.db.query_ids(self.namespace, q, start_nanos, end_nanos)
+        docs.sort(key=lambda d: d.id)
+        pts = []
+        metas = []
+        for d in docs:
+            pts.append(self.db.read(self.namespace, d.id, start_nanos, end_nanos))
+            metas.append(SeriesMeta(tuple(sorted(d.tags().items()))))
+        return RawBlock.from_lists(pts, metas)

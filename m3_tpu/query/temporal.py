@@ -1,0 +1,231 @@
+"""Temporal (sliding-window) functions as batched stencil kernels.
+
+Equivalent of `src/query/functions/temporal`: rate/irate/delta/idelta/
+increase (`rate.go:34-49` with the extrapolated-rate math of
+`standardRateFunc`), *_over_time aggregations (`aggregation.go`), and
+deriv/predict_linear (`linear_regression.go`).  The reference walks each
+series' datapoints per step with per-series goroutine batches
+(`base.go:172-230`); here every (series, step) window is computed at once:
+
+* window boundaries via two vmapped `searchsorted`s over the sorted
+  per-series timestamps → (S, T) lo/hi index matrices;
+* sum/count/avg/stddev + the rate family read **prefix sums** and
+  boundary gathers — O(S·(P+T)) with no window materialization;
+* min/max/quantile gather a bounded (S, T, W) window tensor (W = max
+  points per window, a static pad) — the stencil form.
+
+Counter-reset correction and extrapolation follow the Prometheus
+algorithm the reference implements (rate.go standardRateFunc: adjust by
+cumulative resets, extrapolate to window edges capped at half the average
+sample spacing).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NAN = jnp.float64(jnp.nan)
+
+
+def _window_bounds(ts, step_times, range_nanos):
+    """(S, T) lo/hi: half-open [lo, hi) indices of samples in
+    (step - range, step] per series."""
+    starts = step_times - range_nanos  # (T,)
+    lo = jax.vmap(lambda row: jnp.searchsorted(row, starts, side="right"))(ts)
+    hi = jax.vmap(lambda row: jnp.searchsorted(row, step_times, side="right"))(ts)
+    return lo.astype(jnp.int32), hi.astype(jnp.int32)
+
+
+def _prefix(vals):
+    """Exclusive prefix sum with leading zero: (S, P+1)."""
+    return jnp.concatenate(
+        [jnp.zeros((vals.shape[0], 1), vals.dtype), jnp.cumsum(vals, axis=1)], axis=1
+    )
+
+
+def _gather_rows(a, idx):
+    """a (S, P), idx (S, T) -> a[s, idx[s, t]]."""
+    return jnp.take_along_axis(a, idx, axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("func",))
+def sum_count_family(ts, vals, step_times, range_nanos, func: str):
+    """sum/count/avg/stddev/stdvar_over_time via prefix sums."""
+    lo, hi = _window_bounds(ts, step_times, range_nanos)
+    n = (hi - lo).astype(jnp.float64)
+    c1 = _prefix(vals)
+    c2 = _prefix(vals * vals)
+    s1 = _gather_rows(c1, hi) - _gather_rows(c1, lo)
+    s2 = _gather_rows(c2, hi) - _gather_rows(c2, lo)
+    empty = n == 0
+    if func == "sum_over_time":
+        out = s1
+    elif func == "count_over_time":
+        out = n
+    elif func == "avg_over_time":
+        out = s1 / jnp.where(empty, 1.0, n)
+    else:  # stddev/stdvar: population (Prometheus semantics)
+        mean = s1 / jnp.where(empty, 1.0, n)
+        var = jnp.maximum(s2 / jnp.where(empty, 1.0, n) - mean * mean, 0.0)
+        out = jnp.sqrt(var) if func == "stddev_over_time" else var
+    return jnp.where(empty, NAN, out)
+
+
+@functools.partial(jax.jit, static_argnames=("func", "window_pad"))
+def minmax_quantile_family(ts, vals, step_times, range_nanos, func: str,
+                           window_pad: int, q: float = 0.0):
+    """min/max/quantile_over_time via the (S, T, W) gathered stencil."""
+    lo, hi = _window_bounds(ts, step_times, range_nanos)
+    S, P = vals.shape
+    W = window_pad
+    idx = lo[:, :, None] + jnp.arange(W, dtype=jnp.int32)[None, None, :]
+    valid = idx < hi[:, :, None]
+    idx = jnp.clip(idx, 0, P - 1)
+    g = jnp.take_along_axis(
+        vals[:, None, :], idx.reshape(S, -1)[:, None, :], axis=2
+    ).reshape(S, step_times.shape[0], W)
+    n = (hi - lo).astype(jnp.int32)
+    empty = n == 0
+    if func == "min_over_time":
+        out = jnp.min(jnp.where(valid, g, jnp.inf), axis=2)
+    elif func == "max_over_time":
+        out = jnp.max(jnp.where(valid, g, -jnp.inf), axis=2)
+    else:  # quantile_over_time (Prometheus: linear interpolation)
+        gs = jnp.sort(jnp.where(valid, g, jnp.inf), axis=2)
+        rank = q * (n.astype(jnp.float64) - 1.0)
+        lo_r = jnp.clip(
+            jnp.minimum(jnp.floor(rank).astype(jnp.int32), n - 1), 0, W - 1
+        )
+        hi_r = jnp.clip(jnp.minimum(lo_r + 1, n - 1), 0, W - 1)
+        frac = rank - lo_r.astype(jnp.float64)
+        v_lo = jnp.take_along_axis(gs, lo_r[:, :, None], axis=2)[:, :, 0]
+        v_hi = jnp.take_along_axis(gs, hi_r[:, :, None], axis=2)[:, :, 0]
+        out = v_lo + (v_hi - v_lo) * frac
+    return jnp.where(empty, NAN, out)
+
+
+@functools.partial(jax.jit, static_argnames=("func",))
+def rate_family(ts, vals, step_times, range_nanos, func: str):
+    """rate/increase/delta with Prometheus extrapolation
+    (reference rate.go:99-102 standardRateFunc); counter funcs apply
+    cumulative-reset correction."""
+    lo, hi = _window_bounds(ts, step_times, range_nanos)
+    n = hi - lo
+    has2 = n >= 2
+    P = vals.shape[1]
+    last_i = jnp.clip(hi - 1, 0, P - 1)
+    first_i = jnp.clip(lo, 0, P - 1)
+
+    is_counter = func in ("rate", "increase", "irate")
+    if is_counter:
+        prev = jnp.concatenate([vals[:, :1], vals[:, :-1]], axis=1)
+        # Prometheus counter correction: on reset (v < prev) add the full
+        # previous value (the counter restarted from zero).
+        resets = jnp.where(vals < prev, prev, 0.0)
+        resets = jnp.where(jnp.isnan(resets), 0.0, resets)
+        cum_resets = jnp.cumsum(resets, axis=1)
+        adj = vals + cum_resets
+    else:
+        adj = vals
+
+    v_first = _gather_rows(adj, first_i)
+    v_last = _gather_rows(adj, last_i)
+    t_first = _gather_rows(ts, first_i).astype(jnp.float64)
+    t_last = _gather_rows(ts, last_i).astype(jnp.float64)
+
+    if func in ("irate", "idelta"):
+        prev_i = jnp.clip(hi - 2, 0, P - 1)
+        v_prev = _gather_rows(adj, prev_i)
+        t_prev = _gather_rows(ts, prev_i).astype(jnp.float64)
+        dv = v_last - v_prev
+        dt = (t_last - t_prev) / 1e9
+        out = jnp.where(dt > 0, dv / dt if func == "irate" else dv, NAN)
+        return jnp.where(has2, out, NAN)
+
+    range_f = jnp.float64(range_nanos)
+    window_start = step_times.astype(jnp.float64) - range_f  # (T,)
+    window_end = step_times.astype(jnp.float64)
+
+    delta_v = v_last - v_first
+    sampled = t_last - t_first  # nanos
+    avg_dur = sampled / jnp.maximum(n.astype(jnp.float64) - 1.0, 1.0)
+    dur_start = t_first - window_start[None, :]
+    dur_end = window_end[None, :] - t_last
+
+    # Prometheus extrapolation: extend to the window edge unless the gap
+    # exceeds 1.1× the average sample spacing, then cap at avg/2.
+    extrap_start = jnp.where(dur_start < avg_dur * 1.1, dur_start, avg_dur / 2.0)
+    extrap_end = jnp.where(dur_end < avg_dur * 1.1, dur_end, avg_dur / 2.0)
+    if is_counter:
+        # A counter cannot extrapolate below zero: cap the start-side
+        # extension at the time it would take to reach zero.  Prometheus
+        # uses the RAW first sample here (pre reset-adjustment).
+        v_first_raw = _gather_rows(vals, first_i)
+        zero_dur = jnp.where(
+            (delta_v > 0) & (v_first_raw >= 0),
+            sampled * (v_first_raw / jnp.where(delta_v == 0, 1.0, delta_v)),
+            jnp.inf,
+        )
+        extrap_start = jnp.minimum(extrap_start, zero_dur)
+    factor = (sampled + extrap_start + extrap_end) / jnp.where(sampled == 0, 1.0, sampled)
+    extrapolated = delta_v * factor
+
+    if func == "rate":
+        out = extrapolated / (range_f / 1e9)
+    else:  # increase, delta
+        out = extrapolated
+    return jnp.where(has2 & (sampled > 0), out, NAN)
+
+
+@functools.partial(jax.jit, static_argnames=("func",))
+def regression_family(ts, vals, step_times, range_nanos, func: str,
+                      predict_offset_s: float = 0.0):
+    """deriv / predict_linear: least-squares slope over each window
+    (reference linear_regression.go), via prefix sums of (t, v, t·v, t²)
+    with per-window re-centering at the window end for stability."""
+    lo, hi = _window_bounds(ts, step_times, range_nanos)
+    n = (hi - lo).astype(jnp.float64)
+    # Center on the first step BEFORE the prefix sums: epoch-scale t²
+    # (~1e19) would otherwise swamp float64 and cancel catastrophically.
+    g_ref = step_times[0]
+    tsec = (ts - g_ref).astype(jnp.float64) / 1e9
+    ref = ((step_times - g_ref).astype(jnp.float64) / 1e9)[None, :]  # (1, T)
+
+    c_v = _prefix(vals)
+    c_t = _prefix(tsec)
+    c_tv = _prefix(tsec * vals)
+    c_tt = _prefix(tsec * tsec)
+    S_v = _gather_rows(c_v, hi) - _gather_rows(c_v, lo)
+    S_t = _gather_rows(c_t, hi) - _gather_rows(c_t, lo)
+    S_tv = _gather_rows(c_tv, hi) - _gather_rows(c_tv, lo)
+    S_tt = _gather_rows(c_tt, hi) - _gather_rows(c_tt, lo)
+    # Re-center times at the step time: t' = t - ref.
+    S_t_c = S_t - n * ref
+    S_tt_c = S_tt - 2 * ref * S_t + n * ref * ref
+    S_tv_c = S_tv - ref * S_v
+    denom = n * S_tt_c - S_t_c * S_t_c
+    slope = jnp.where(denom != 0, (n * S_tv_c - S_t_c * S_v) / denom, NAN)
+    intercept = (S_v - slope * S_t_c) / jnp.where(n == 0, 1.0, n)  # value at ref
+    ok = n >= 2
+    if func == "deriv":
+        return jnp.where(ok, slope, NAN)
+    return jnp.where(ok, intercept + slope * predict_offset_s, NAN)
+
+
+@jax.jit
+def last_over_time(ts, vals, step_times, range_nanos):
+    lo, hi = _window_bounds(ts, step_times, range_nanos)
+    P = vals.shape[1]
+    out = _gather_rows(vals, jnp.clip(hi - 1, 0, P - 1))
+    return jnp.where(hi > lo, out, NAN)
+
+
+def window_pad_for(counts: np.ndarray, ts: np.ndarray, range_nanos: int) -> int:
+    """Static W bound for the stencil kernels: the max observed points in
+    any range-length window, padded up (host-side, cheap)."""
+    max_c = int(counts.max()) if len(counts) else 1
+    return max(1, min(max_c, 4096))
