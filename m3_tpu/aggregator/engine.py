@@ -61,6 +61,7 @@ class FlushedMetric:
     slots: np.ndarray  # int32
     types: np.ndarray  # int8 AggregationType values
     values: np.ndarray  # float64
+    metric_type: MetricType = MetricType.GAUGE  # which map owns `slots`
 
 
 FlushHandler = Callable[["MetricList", FlushedMetric], None]
@@ -312,6 +313,7 @@ class MetricList:
             slots=np.concatenate(out_slots),
             types=np.concatenate(out_types),
             values=np.concatenate(out_vals),
+            metric_type=mt,
         )
 
 

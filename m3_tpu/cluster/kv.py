@@ -1,0 +1,135 @@
+"""Versioned KV store with watches: the cluster control plane.
+
+Reference parity: `src/cluster/kv` (`kv.Store`, `types.go:123`: Get/Set/
+SetIfNotExists/CheckAndSet with monotonically versioned values, watchable
+keys) and its in-memory fake (`kv/mem`) that backs every integration test.
+The production reference binds this to etcd; the TPU framework's control
+plane is host-side and deliberately etcd-compatible in shape — an etcd
+binding would implement this same interface.  File persistence gives
+single-host durability (placements/rules/flush-times survive restarts).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Callable, Dict, List
+
+
+@dataclass(frozen=True)
+class VersionedValue:
+    version: int
+    data: bytes
+
+
+class KVStore:
+    """In-memory versioned KV with watches; optionally file-backed."""
+
+    def __init__(self, root: str | None = None):
+        self._lock = threading.RLock()
+        self._data: Dict[str, VersionedValue] = {}
+        self._watchers: Dict[str, List[Callable[[VersionedValue], None]]] = {}
+        self._path = Path(root) / "kv.json" if root else None
+        if self._path and self._path.exists():
+            raw = json.loads(self._path.read_text())
+            self._data = {
+                k: VersionedValue(v["version"], bytes.fromhex(v["data"]))
+                for k, v in raw.items()
+            }
+
+    def _persist(self) -> None:
+        if self._path is None:
+            return
+        tmp = self._path.with_suffix(".tmp")
+        tmp.write_text(json.dumps({
+            k: {"version": v.version, "data": v.data.hex()}
+            for k, v in self._data.items()
+        }))
+        tmp.replace(self._path)
+
+    def get(self, key: str) -> VersionedValue | None:
+        with self._lock:
+            return self._data.get(key)
+
+    def set(self, key: str, data: bytes) -> int:
+        """Unconditional set; returns the new version."""
+        with self._lock:
+            cur = self._data.get(key)
+            v = (cur.version if cur else 0) + 1
+            self._data[key] = VersionedValue(v, data)
+            self._persist()
+            self._notify(key)
+            return v
+
+    def set_if_not_exists(self, key: str, data: bytes) -> int:
+        with self._lock:
+            if key in self._data:
+                raise KeyError(f"{key} already exists")
+            return self.set(key, data)
+
+    def check_and_set(self, key: str, expect_version: int, data: bytes) -> int:
+        """CAS (reference kv.Store.CheckAndSet): version 0 = must not
+        exist."""
+        with self._lock:
+            cur = self._data.get(key)
+            cur_v = cur.version if cur else 0
+            if cur_v != expect_version:
+                raise ValueError(
+                    f"version conflict on {key}: have {cur_v}, want {expect_version}"
+                )
+            return self.set(key, data)
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._data.pop(key, None)
+            self._persist()
+
+    def watch(self, key: str, fn: Callable[[VersionedValue], None]) -> None:
+        """Register a watcher; fired inline on every set (the reference
+        delivers via watch channels)."""
+        with self._lock:
+            self._watchers.setdefault(key, []).append(fn)
+            cur = self._data.get(key)
+        if cur is not None:
+            fn(cur)
+
+    def _notify(self, key: str) -> None:
+        cur = self._data[key]
+        for fn in self._watchers.get(key, []):
+            fn(cur)
+
+
+class LeaderElection:
+    """Leader election over the KV store's CAS (reference
+    `src/cluster/services/leader/client.go:32-70`, which campaigns via
+    etcd concurrency.Election; same protocol shape: the leader key holds
+    the leader's ID at a version, resign deletes it)."""
+
+    def __init__(self, kv: KVStore, electionid: str, instance_id: str):
+        self.kv = kv
+        self.key = f"_election/{electionid}"
+        self.instance_id = instance_id
+
+    def campaign(self) -> bool:
+        """Try to become leader; idempotent for the current leader."""
+        cur = self.kv.get(self.key)
+        if cur is None:
+            try:
+                self.kv.set_if_not_exists(self.key, self.instance_id.encode())
+                return True
+            except KeyError:
+                cur = self.kv.get(self.key)
+        return cur is not None and cur.data == self.instance_id.encode()
+
+    def leader(self) -> str | None:
+        cur = self.kv.get(self.key)
+        return cur.data.decode() if cur else None
+
+    def is_leader(self) -> bool:
+        return self.leader() == self.instance_id
+
+    def resign(self) -> None:
+        if self.is_leader():
+            self.kv.delete(self.key)

@@ -1,0 +1,167 @@
+"""Coordinator downsampler: rule-matched, in-process aggregation.
+
+Reference parity: `src/cmd/services/m3coordinator/downsample` — the
+coordinator embeds an aggregator in-process (`downsampler.go:94-103`),
+rule-matches every written sample (`metrics_appender.go`), feeds matched
+samples to the aggregator under each matched storage policy, and a flush
+handler writes aggregated output back through the ingest path
+(`flush_handler.go`).  Rollup rules synthesize new series
+(`rollup ID + pipeline`), aggregated under their own IDs.
+
+The TPU shape: matching is host work amortized by the per-ID cache;
+everything after ID resolution is the device arena path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from m3_tpu.aggregator.engine import AggregatorOptions, MetricList
+from m3_tpu.index.doc import Document
+from m3_tpu.metrics.aggregation import AggregationID, AggregationType
+from m3_tpu.metrics.policy import StoragePolicy
+from m3_tpu.metrics.rules import Matcher, RuleSet
+from m3_tpu.metrics.types import MetricType
+from m3_tpu.storage.database import Database
+
+
+@dataclass
+class DownsamplerOptions:
+    capacity: int = 1 << 16
+    num_windows: int = 4
+    timer_sample_capacity: int = 1 << 18
+    quantiles: tuple = (0.5, 0.95, 0.99)
+
+
+class Downsampler:
+    """One MetricList per matched storage policy; samples are appended
+    only to the lists their rules select (the reference's
+    metrics_appender resolves staged metadatas per sample)."""
+
+    def __init__(self, db: Database, ruleset: RuleSet,
+                 namespace: str = "default",
+                 opts: DownsamplerOptions | None = None,
+                 now_nanos: int = 0):
+        self.db = db
+        self.namespace = namespace
+        self.opts = opts or DownsamplerOptions()
+        self.matcher = Matcher(ruleset, now_nanos)
+        self._lists: Dict[StoragePolicy, MetricList] = {}
+        # output id -> tags for index writeback (rollup outputs carry
+        # their kept tags; mapping outputs keep the source's tags)
+        self._series_tags: Dict[bytes, dict] = {}
+
+    def output_namespace(self, sp: StoragePolicy) -> str:
+        """Aggregates write to the policy's own namespace (the reference
+        stores each resolution in its aggregated namespace — writing
+        into the raw namespace would interleave window aggregates with
+        raw samples of the same series)."""
+        return self.db.ensure_namespace(str(sp)).name
+
+    def _list_for(self, sp: StoragePolicy) -> MetricList:
+        ml = self._lists.get(sp)
+        if ml is None:
+            aopts = AggregatorOptions(
+                capacity=self.opts.capacity,
+                num_windows=self.opts.num_windows,
+                timer_sample_capacity=self.opts.timer_sample_capacity,
+                quantiles=self.opts.quantiles,
+                storage_policies=(sp,),
+            )
+            ml = self._lists[sp] = MetricList(sp, aopts)
+        return ml
+
+    def update_rules(self, ruleset: RuleSet, now_nanos: int) -> None:
+        self.matcher.update(ruleset, now_nanos)
+
+    # -- write path --------------------------------------------------------
+
+    def write_batch(self, docs: Sequence[Document], ts: np.ndarray,
+                    vals: np.ndarray,
+                    metric_type: MetricType = MetricType.GAUGE) -> np.ndarray:
+        """Match + append a batch.  Returns a keep-mask: False where a
+        drop-policy mapping says the raw sample must not be stored
+        (reference downsampler drop policies)."""
+        ts = np.asarray(ts, np.int64)
+        vals = np.asarray(vals, np.float64)
+        keep = np.ones(len(docs), bool)
+        # (policy, agg_id) -> (ids, idx list)
+        batches: Dict[tuple, List] = {}
+        for i, doc in enumerate(docs):
+            res = self.matcher.match(doc.id, doc.tags())
+            if res.drop:
+                keep[i] = False
+            for m in res.mappings:
+                self._series_tags.setdefault(doc.id, doc.tags())
+                for sp in m.policies:
+                    batches.setdefault((sp, m.aggregation_id, doc.id), []).append(i)
+            for r in res.rollups:
+                self._series_tags.setdefault(r.id, r.tags)
+                for sp in r.policies:
+                    batches.setdefault((sp, r.aggregation_id, r.id), []).append(i)
+        # Group by (policy, agg) for batched arena adds.
+        grouped: Dict[tuple, List] = {}
+        for (sp, agg, mid), idxs in batches.items():
+            g = grouped.setdefault((sp, agg), ([], [], []))
+            g[0].extend([mid] * len(idxs))
+            g[1].extend(idxs)
+        for (sp, agg), (ids, idxs, _) in grouped.items():
+            sel = np.asarray(idxs)
+            self._list_for(sp).add_batch(
+                metric_type, ids, vals[sel], ts[sel], agg
+            )
+        return keep
+
+    # -- flush path --------------------------------------------------------
+
+    def flush(self, now_nanos: int) -> int:
+        """Drain closed windows and write aggregates back to storage
+        (reference flush_handler.go → ingest write path).  Aggregated
+        series IDs carry the aggregation-type suffix (reference id
+        suffixing, e.g. `.p99` for timer quantiles)."""
+        written = 0
+        for sp, ml in self._lists.items():
+            for flushed in ml.consume(now_nanos):
+                owner = ml.maps[flushed.metric_type]
+                ids: List[bytes] = []
+                ts_out: List[int] = []
+                vals_out: List[float] = []
+                docs: List[Document] = []
+                mt = flushed.metric_type
+                defaults = AggregationID.DEFAULT.types_for(mt)
+                default_mask = 0
+                for t in defaults:
+                    default_mask |= 1 << int(t)
+                # Only a SINGLE-type default set may emit unsuffixed:
+                # multi-type sets (timers) would collide on one ID.
+                single_default = len(defaults) == 1
+                for slot, t_, v in zip(flushed.slots, flushed.types, flushed.values):
+                    at = AggregationType(int(t_))
+                    base = owner.id_of(int(slot))
+                    if base is None:
+                        continue
+                    # Reference naming: the default aggregation set for a
+                    # metric type emits unsuffixed IDs; anything else
+                    # carries the type suffix (types_options.go).
+                    is_default = (
+                        single_default
+                        and int(owner.agg_mask[int(slot)]) == default_mask
+                    )
+                    out_id = base if is_default else base + at.suffix
+                    tags = dict(self._series_tags.get(base) or {b"__name__": base})
+                    if not is_default and b"__name__" in tags:
+                        tags[b"__name__"] = tags[b"__name__"] + at.suffix
+                    docs.append(Document.from_tags(out_id, tags))
+                    ids.append(out_id)
+                    ts_out.append(flushed.timestamp_nanos)
+                    vals_out.append(float(v))
+                if ids:
+                    self.db.write_tagged_batch(
+                        self.output_namespace(sp), docs,
+                        np.asarray(ts_out, np.int64), np.asarray(vals_out),
+                    )
+                    written += len(ids)
+        return written

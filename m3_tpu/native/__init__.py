@@ -1,0 +1,133 @@
+"""Native host codec bindings (ctypes over native/m3tsz.cc).
+
+The runtime around the TPU compute path is native where the reference's
+hot scalar loops are: `m3tsz_encode`/`m3tsz_decode` are the C++ fast path
+for single-series encode/decode (the role of the reference's Go codec in
+`src/dbnode/encoding/m3tsz`), with the Python scalar codec as oracle and
+fallback for stream features the native path rejects (annotations,
+mid-stream time-unit changes).
+
+The shared object builds on demand with g++ into native/build/ and is
+cached; `available()` gates callers so a missing toolchain degrades to
+the Python path, never an error.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+_ROOT = Path(__file__).resolve().parent.parent.parent
+_SRC = _ROOT / "native" / "m3tsz.cc"
+_SO = _ROOT / "native" / "build" / "libm3tsz.so"
+
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    _SO.parent.mkdir(parents=True, exist_ok=True)
+    try:
+        subprocess.run(
+            # -ffp-contract=off: FMA contraction would change the rounding
+            # of the decoder's int_val accumulation vs strict IEEE.
+            ["g++", "-O2", "-ffp-contract=off", "-shared", "-fPIC",
+             "-o", str(_SO), str(_SRC)],
+            check=True, capture_output=True, timeout=120,
+        )
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError):
+        return False
+
+
+def _load():
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
+        if not _build():
+            return None
+    try:
+        lib = ctypes.CDLL(str(_SO))
+    except OSError:
+        return None
+    lib.m3tsz_encode.restype = ctypes.c_long
+    lib.m3tsz_encode.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_double),
+        ctypes.c_long, ctypes.c_int64, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_long,
+    ]
+    lib.m3tsz_decode.restype = ctypes.c_long
+    lib.m3tsz_decode.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_long, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_double),
+        ctypes.c_long,
+    ]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def encode_series(timestamps: np.ndarray, values: np.ndarray, start: int,
+                  unit: int = 1) -> bytes | None:
+    """Encode one series; None means unsupported input (use the Python
+    codec)."""
+    lib = _load()
+    if lib is None:
+        return None
+    ts = np.ascontiguousarray(timestamps, np.int64)
+    vals = np.ascontiguousarray(values, np.float64)
+    n = len(ts)
+    cap = max(64, n * 20 + 16)
+    while True:
+        out = np.empty(cap, np.uint8)
+        r = lib.m3tsz_encode(
+            ts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            vals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            n, start, unit,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), cap,
+        )
+        if r == -1:
+            cap *= 2
+            continue
+        if r < 0:
+            return None
+        return out[:r].tobytes()
+
+
+def decode_series(data: bytes, default_unit: int = 1,
+                  max_points: int | None = None):
+    """Decode one stream -> (ts, values) arrays; None = unsupported
+    stream feature (use the Python codec).  Raises ValueError on
+    corruption."""
+    lib = _load()
+    if lib is None:
+        return None
+    if not data:
+        return np.empty(0, np.int64), np.empty(0)
+    buf = np.frombuffer(data, np.uint8)
+    cap = max_points or max(16, len(data) * 2)
+    while True:
+        ts = np.empty(cap, np.int64)
+        vals = np.empty(cap, np.float64)
+        r = lib.m3tsz_decode(
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(data),
+            default_unit,
+            ts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            vals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), cap,
+        )
+        if r == -1:
+            cap *= 2
+            continue
+        if r == -2:
+            return None
+        if r < 0:
+            raise ValueError("corrupt m3tsz stream")
+        return ts[:r].copy(), vals[:r].copy()

@@ -43,7 +43,10 @@ class Storage(Protocol):
 
 @dataclass
 class _Scalar:
-    value: float
+    """A PromQL scalar: a float, or a per-step (T,) array (scalar(),
+    time()).  Binary ops broadcast arrays across the series axis."""
+
+    value: float | np.ndarray
 
 
 class Engine:
@@ -63,8 +66,10 @@ class Engine:
         steps = np.arange(start_nanos, end_nanos + 1, step_nanos, dtype=np.int64)
         out = self._eval(ast, steps)
         if isinstance(out, _Scalar):
-            return Block(steps, np.full((1, len(steps)), out.value),
-                         [SeriesMeta(())])
+            vals = np.broadcast_to(
+                np.asarray(out.value, np.float64), (1, len(steps))
+            ).copy()
+            return Block(steps, vals, [SeriesMeta(())])
         return out
 
     def execute_instant(self, query: str, time_nanos: int) -> Block:
@@ -169,9 +174,11 @@ class Engine:
                             hi=self._scalar_arg(call.args[1], steps))
         if f == "scalar":
             b = self._eval(call.args[0], steps)
+            if isinstance(b, _Scalar):
+                return b
             if b.num_series == 1:
-                return b.with_values(b.values)
-            return _Scalar(float("nan"))
+                return _Scalar(b.values[0].copy())
+            return _Scalar(np.full(len(steps), np.nan))
         if f == "vector":
             v = self._scalar_arg(call.args[0], steps)
             return Block(steps, np.full((1, len(steps)), v), [SeriesMeta(())])
@@ -190,8 +197,8 @@ class Engine:
             tvals = np.broadcast_to(steps.astype(np.float64) / 1e9, b.values.shape)
             return b.with_values(np.where(np.isnan(b.values), np.nan, tvals),
                                  [m.drop_name() for m in b.series])
-        if f in ("time",):
-            return _Scalar(float("nan"))  # resolved per-step below
+        if f == "time":
+            return _Scalar(steps.astype(np.float64) / 1e9)
         raise ValueError(f"unsupported function {f!r}")
 
     def _label_replace(self, call: Call, steps: np.ndarray) -> Block:
@@ -255,10 +262,11 @@ class Engine:
             return self._set_op(b, lhs, rhs)
         if sl and sr:
             with np.errstate(all="ignore"):
-                v = float(fn._BINOPS[b.op](lhs.value, rhs.value))
+                v = fn._BINOPS[b.op](lhs.value, rhs.value)
             if b.op in fn._COMPARISONS:
-                v = 1.0 if v else 0.0
-            return _Scalar(v)
+                v = np.asarray(v, np.float64) if isinstance(v, np.ndarray) \
+                    else (1.0 if v else 0.0)
+            return _Scalar(v if isinstance(v, np.ndarray) else float(v))
         if sr:
             return fn.scalar_binary(lhs, b.op, rhs.value, False, b.bool_mode)
         if sl:
@@ -298,8 +306,13 @@ class Engine:
     # -- helpers -----------------------------------------------------------
 
     def _scalar_arg(self, e: Expr, steps: np.ndarray) -> float:
+        """A static float parameter (topk k, quantile q, clamp bounds…).
+        Per-step scalars collapse to their first finite value."""
         v = self._eval(e, steps)
         if isinstance(v, _Scalar):
+            if isinstance(v.value, np.ndarray):
+                finite = v.value[np.isfinite(v.value)]
+                return float(finite[0]) if len(finite) else float("nan")
             return v.value
         raise ValueError("expected scalar argument")
 

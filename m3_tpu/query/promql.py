@@ -134,6 +134,13 @@ def _lex(s: str) -> list[_Tok]:
     return out
 
 
+def _unquote(text: str) -> str:
+    """Strip quotes and resolve escape sequences (Prometheus string
+    literals use Go escaping; \\" \\\\ \\n \\t etc.)."""
+    body = text[1:-1]
+    return body.encode("latin-1", "backslashreplace").decode("unicode_escape")
+
+
 def parse_duration(text: str) -> int:
     m = re.fullmatch(r"(\d+(?:\.\d+)?)(ms|s|m|h|d|w|y)", text)
     if not m:
@@ -227,26 +234,27 @@ class _Parser:
         return lhs
 
     def _parse_mul(self) -> Expr:
-        lhs = self._parse_pow()
+        lhs = self._parse_unary()
         while self.peek().text in ("*", "/", "%"):
             op = self.next().text
             bm, on, ig = self._bin_rhs(op)
-            lhs = BinaryOp(op, lhs, self._parse_pow(), bm, on, ig)
-        return lhs
-
-    def _parse_pow(self) -> Expr:
-        lhs = self._parse_unary()
-        if self.peek().text == "^":  # right-associative
-            self.next()
-            bm, on, ig = self._bin_rhs("^")
-            return BinaryOp("^", lhs, self._parse_pow(), bm, on, ig)
+            lhs = BinaryOp(op, lhs, self._parse_unary(), bm, on, ig)
         return lhs
 
     def _parse_unary(self) -> Expr:
+        # Unary binds LOOSER than ^ (Prometheus: -2^2 == -(2^2)).
         if self.peek().text in ("-", "+"):
             op = self.next().text
             return Unary(op, self._parse_unary())
-        return self._parse_postfix()
+        return self._parse_pow()
+
+    def _parse_pow(self) -> Expr:
+        lhs = self._parse_postfix()
+        if self.peek().text == "^":  # right-assoc; rhs may be unary (2^-3)
+            self.next()
+            bm, on, ig = self._bin_rhs("^")
+            return BinaryOp("^", lhs, self._parse_unary(), bm, on, ig)
+        return lhs
 
     def _parse_postfix(self) -> Expr:
         e = self._parse_primary()
@@ -289,7 +297,7 @@ class _Parser:
             val = self.next()
             if val.kind != "string":
                 raise ValueError("matcher value must be a string")
-            out.append(LabelMatcher(name, op, val.text[1:-1].encode()))
+            out.append(LabelMatcher(name, op, _unquote(val.text).encode()))
             if not self.accept(","):
                 break
         self.expect("}")
@@ -317,7 +325,7 @@ class _Parser:
             return NumberLiteral(parse_duration(t.text) / 1e9)
         if t.kind == "string":
             self.next()
-            return StringLiteral(t.text[1:-1])
+            return StringLiteral(_unquote(t.text))
         if t.text == "{":
             return VectorSelector(None, self._parse_matchers())
         if t.kind == "ident":

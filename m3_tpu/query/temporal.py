@@ -225,7 +225,17 @@ def last_over_time(ts, vals, step_times, range_nanos):
 
 
 def window_pad_for(counts: np.ndarray, ts: np.ndarray, range_nanos: int) -> int:
-    """Static W bound for the stencil kernels: the max observed points in
-    any range-length window, padded up (host-side, cheap)."""
-    max_c = int(counts.max()) if len(counts) else 1
-    return max(1, min(max_c, 4096))
+    """Static W bound for the stencil kernels: the exact maximum number
+    of samples any range-length window can contain, computed host-side
+    per series via a sliding searchsorted.  No silent cap — the (S, T, W)
+    gather tensor is as wide as the densest window requires; callers
+    chunk the series axis if that exceeds memory."""
+    best = 1
+    for s in range(len(counts)):
+        n = int(counts[s])
+        if n == 0:
+            continue
+        row = ts[s, :n]
+        lo = np.searchsorted(row, row - range_nanos, side="right")
+        best = max(best, int((np.arange(1, n + 1) - lo).max()))
+    return best

@@ -1,0 +1,220 @@
+"""HTTP API: the coordinator's front door (JSON write + PromQL read).
+
+Reference parity: `src/query/api/v1` — Prometheus-compatible query
+endpoints (`handler/prometheus/native/read.go:111` → engine), the JSON
+write endpoint (`api/v1/json/write`), and label/series metadata
+endpoints.  Response shapes follow the Prometheus HTTP API so Grafana
+pointed at `/api/v1/query_range` works unchanged — the same
+compatibility target the reference serves.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from m3_tpu.index.doc import Document
+from m3_tpu.index.search import All, FieldExists, Term
+from m3_tpu.query.engine import Engine
+from m3_tpu.query.storage_adapter import DatabaseStorage
+from m3_tpu.storage.database import Database
+
+_DUR_RE = re.compile(r"^(\d+(?:\.\d+)?)([smhdwy]|ms)$")
+
+
+def _parse_time(v: str) -> int:
+    """RFC3339-less Prometheus time params: unix seconds (float) → nanos."""
+    return int(float(v) * 1e9)
+
+
+def _parse_step(v: str) -> int:
+    m = _DUR_RE.match(v)
+    if m:
+        mult = {"ms": 1e6, "s": 1e9, "m": 60e9, "h": 3600e9, "d": 86400e9,
+                "w": 7 * 86400e9, "y": 365 * 86400e9}[m.group(2)]
+        return int(float(m.group(1)) * mult)
+    return int(float(v) * 1e9)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "m3tpu/0.1"
+    ctx = None  # set by make_server
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    # -- helpers -----------------------------------------------------------
+
+    def _json(self, code: int, obj) -> None:
+        data = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _error(self, code: int, msg: str) -> None:
+        self._json(code, {"status": "error", "error": msg})
+
+    def _body(self):
+        n = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(n)
+
+    # -- routes ------------------------------------------------------------
+
+    def do_GET(self):
+        u = urlparse(self.path)
+        q = parse_qs(u.query)
+        try:
+            if u.path == "/health":
+                return self._json(200, {"ok": True})
+            if u.path in ("/api/v1/query_range", "/api/v1/query"):
+                return self._query(u.path.endswith("query_range"), q)
+            if u.path == "/api/v1/labels":
+                return self._labels(q)
+            if u.path.startswith("/api/v1/label/") and u.path.endswith("/values"):
+                name = u.path[len("/api/v1/label/") : -len("/values")]
+                return self._label_values(name, q)
+            if u.path == "/api/v1/series":
+                return self._series(q)
+            return self._error(404, f"unknown path {u.path}")
+        except Exception as e:  # noqa: BLE001 — API boundary
+            return self._error(400, str(e))
+
+    def do_POST(self):
+        u = urlparse(self.path)
+        try:
+            if u.path == "/api/v1/json/write":
+                return self._write_json()
+            if u.path in ("/api/v1/query_range", "/api/v1/query"):
+                q = parse_qs(self._body().decode())
+                return self._query(u.path.endswith("query_range"), q)
+            return self._error(404, f"unknown path {u.path}")
+        except Exception as e:  # noqa: BLE001
+            return self._error(400, str(e))
+
+    # -- handlers ----------------------------------------------------------
+
+    def _write_json(self):
+        """reference api/v1/json/write: one sample or a list of
+        {tags: {..}, timestamp (unix s or nanos), value}."""
+        payload = json.loads(self._body())
+        samples = payload if isinstance(payload, list) else [payload]
+        docs, ts, vals = [], [], []
+        for s in samples:
+            tags = {k.encode(): v.encode() for k, v in s["tags"].items()}
+            name = tags.get(b"__name__", b"")
+            sid = name + b"{" + b",".join(
+                k + b"=" + v for k, v in sorted(tags.items()) if k != b"__name__"
+            ) + b"}"
+            docs.append(Document.from_tags(sid, tags))
+            t = s["timestamp"]
+            ts.append(int(t * 1e9) if t < 1e12 else int(t))
+            vals.append(float(s["value"]))
+        ctx = self.ctx
+        keep = np.ones(len(docs), bool)
+        if ctx.downsampler is not None:
+            keep = ctx.downsampler.write_batch(
+                docs, np.asarray(ts, np.int64), np.asarray(vals)
+            )
+        idx = np.nonzero(keep)[0]
+        if len(idx):
+            ctx.db.write_tagged_batch(
+                ctx.namespace,
+                [docs[i] for i in idx],
+                np.asarray(ts, np.int64)[idx],
+                np.asarray(vals)[idx],
+            )
+        return self._json(200, {"status": "success", "written": int(len(idx))})
+
+    def _query(self, is_range: bool, q):
+        query = q["query"][0]
+        if is_range:
+            start = _parse_time(q["start"][0])
+            end = _parse_time(q["end"][0])
+            step = _parse_step(q["step"][0])
+        else:
+            start = end = _parse_time(q["time"][0])
+            step = 10**9
+        block = self.ctx.engine.execute_range(query, start, end, step)
+        result = []
+        for i, meta in enumerate(block.series):
+            values = [
+                [t / 1e9, _fmt(v)]
+                for t, v in zip(block.step_times.tolist(), block.values[i])
+                if not math.isnan(v)
+            ]
+            if not values:
+                continue
+            metric = {k.decode(): v.decode() for k, v in meta.tags}
+            if is_range:
+                result.append({"metric": metric, "values": values})
+            else:
+                result.append({"metric": metric, "value": values[-1]})
+        return self._json(200, {
+            "status": "success",
+            "data": {
+                "resultType": "matrix" if is_range else "vector",
+                "result": result,
+            },
+        })
+
+    def _fetch_docs(self, q):
+        ctx = self.ctx
+        start = _parse_time(q.get("start", ["0"])[0])
+        end = _parse_time(q.get("end", [str(2**31)])[0])
+        return ctx.db.query_ids(ctx.namespace, All(), start, end)
+
+    def _labels(self, q):
+        names = set()
+        for d in self._fetch_docs(q):
+            names.update(k.decode() for k in d.tags())
+        return self._json(200, {"status": "success", "data": sorted(names)})
+
+    def _label_values(self, name, q):
+        values = set()
+        for d in self._fetch_docs(q):
+            v = d.tags().get(name.encode())
+            if v is not None:
+                values.add(v.decode())
+        return self._json(200, {"status": "success", "data": sorted(values)})
+
+    def _series(self, q):
+        out = [
+            {k.decode(): v.decode() for k, v in sorted(d.tags().items())}
+            for d in self._fetch_docs(q)
+        ]
+        return self._json(200, {"status": "success", "data": out})
+
+
+def _fmt(v: float) -> str:
+    return repr(float(v)) if v == v else "NaN"
+
+
+class ApiContext:
+    def __init__(self, db: Database, namespace: str = "default",
+                 downsampler=None):
+        self.db = db
+        self.namespace = namespace
+        self.downsampler = downsampler
+        self.engine = Engine(DatabaseStorage(db, namespace))
+
+
+def make_server(ctx: ApiContext, host: str = "127.0.0.1", port: int = 0):
+    """Returns a ThreadingHTTPServer bound to (host, port); port 0 picks
+    a free one (server.server_address[1])."""
+    handler = type("BoundHandler", (_Handler,), {"ctx": ctx})
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def serve_background(ctx: ApiContext, host: str = "127.0.0.1", port: int = 0):
+    srv = make_server(ctx, host, port)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv

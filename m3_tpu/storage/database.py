@@ -280,6 +280,18 @@ class Database:
         )
         self.bootstrapped = False
 
+    def ensure_namespace(self, name: str,
+                         opts: NamespaceOptions | None = None) -> Namespace:
+        """Create-if-missing (the reference adds namespaces dynamically
+        through KV-watched namespace metadata, dbnode/namespace/dynamic.go;
+        the coordinator provisions aggregated namespaces per policy)."""
+        ns = self.namespaces.get(name)
+        if ns is None:
+            ns = self.namespaces[name] = Namespace(
+                name, opts or NamespaceOptions(), self.opts.root
+            )
+        return ns
+
     def write_batch(self, namespace: str, ids: Sequence[bytes], ts, vals,
                     now_nanos: int | None = None) -> int:
         ns = self.namespaces[namespace]

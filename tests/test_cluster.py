@@ -1,0 +1,103 @@
+"""Cluster control plane: versioned KV + watches, leader election,
+placement algorithm add/remove/replace with staged shard states."""
+
+import pytest
+
+from m3_tpu.cluster.kv import KVStore, LeaderElection
+from m3_tpu.cluster.placement import (
+    Instance, Placement, PlacementService, ShardState, add_instance,
+    initial_placement, mark_available, remove_instance, replace_instance,
+)
+
+
+class TestKV:
+    def test_versioning_and_cas(self, tmp_path):
+        kv = KVStore(str(tmp_path))
+        assert kv.get("k") is None
+        assert kv.set("k", b"v1") == 1
+        assert kv.set("k", b"v2") == 2
+        with pytest.raises(ValueError):
+            kv.check_and_set("k", 1, b"v3")
+        assert kv.check_and_set("k", 2, b"v3") == 3
+        # persistence across instances
+        kv2 = KVStore(str(tmp_path))
+        assert kv2.get("k").data == b"v3"
+        assert kv2.get("k").version == 3
+
+    def test_watch(self, tmp_path):
+        kv = KVStore()
+        seen = []
+        kv.set("w", b"a")
+        kv.watch("w", lambda v: seen.append(v.data))
+        kv.set("w", b"b")
+        assert seen == [b"a", b"b"]
+
+    def test_election(self):
+        kv = KVStore()
+        e1 = LeaderElection(kv, "agg", "node1")
+        e2 = LeaderElection(kv, "agg", "node2")
+        assert e1.campaign()
+        assert not e2.campaign()
+        assert e2.leader() == "node1"
+        e1.resign()
+        assert e2.campaign()
+        assert e1.leader() == "node2"
+
+
+def _insts(n, groups=2):
+    return [Instance(f"i{k}", isolation_group=f"g{k % groups}") for k in range(n)]
+
+
+class TestPlacement:
+    def test_initial_balanced(self):
+        p = initial_placement(_insts(4), num_shards=16, rf=2)
+        p.validate()
+        loads = [len(i.shards) for i in p.instances.values()]
+        assert max(loads) - min(loads) <= 1
+        # replicas land in distinct isolation groups
+        for s in range(16):
+            groups = {i.isolation_group for i in p.instances_for_shard(s)}
+            assert len(groups) == 2
+
+    def test_add_instance_stages_handoff(self):
+        p = initial_placement(_insts(3), num_shards=12, rf=1)
+        p2 = add_instance(p, Instance("i3", isolation_group="g1"))
+        newcomer = p2.instances["i3"]
+        assert len(newcomer.shards) > 0
+        for s, a in newcomer.shards.items():
+            assert a.state == ShardState.INITIALIZING
+            assert a.source_id is not None
+            src = p2.instances[a.source_id]
+            assert src.shards[s].state == ShardState.LEAVING
+        p2.validate()  # leaving excluded, initializing counted
+        # cutover
+        s0 = next(iter(newcomer.shards))
+        src_id = newcomer.shards[s0].source_id
+        p3 = mark_available(p2, "i3", s0)
+        assert p3.instances["i3"].shards[s0].state == ShardState.AVAILABLE
+        assert s0 not in p3.instances[src_id].shards
+
+    def test_remove_instance(self):
+        p = initial_placement(_insts(4), num_shards=8, rf=2)
+        p2 = remove_instance(p, "i0")
+        for s, a in p2.instances["i0"].shards.items():
+            assert a.state == ShardState.LEAVING
+        p2.validate()
+
+    def test_replace_instance(self):
+        p = initial_placement(_insts(3), num_shards=9, rf=1)
+        owned = set(p.instances["i1"].shards)
+        p2 = replace_instance(p, "i1", Instance("i9", isolation_group="g9"))
+        assert set(p2.instances["i9"].shards) == owned
+        p2.validate()
+
+    def test_kv_roundtrip_and_service(self, tmp_path):
+        kv = KVStore(str(tmp_path))
+        svc = PlacementService(kv)
+        assert svc.get() is None
+        p = initial_placement(_insts(2), num_shards=4, rf=1)
+        svc.set(p)
+        back = svc.get()
+        assert back.num_shards == 4
+        assert set(back.instances) == {"i0", "i1"}
+        back.validate()

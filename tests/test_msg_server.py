@@ -1,0 +1,139 @@
+"""Message bus delivery semantics + the HTTP API served end-to-end."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from m3_tpu.cluster.kv import KVStore
+from m3_tpu.msg.bus import (
+    ConsumerService, ConsumptionType, MessageBus, Topic, TopicService,
+)
+
+BLOCK = 2 * 3600 * 10**9
+START = (1_700_000_000 * 10**9) // BLOCK * BLOCK
+
+
+class TestBus:
+    def _topic(self):
+        return Topic("aggregated_metrics", 4, (
+            ConsumerService("coordinator", ConsumptionType.SHARED),
+            ConsumerService("mirror", ConsumptionType.REPLICATED),
+        ))
+
+    def test_topic_kv_roundtrip(self):
+        kv = KVStore()
+        svc = TopicService(kv)
+        svc.set(self._topic())
+        t = svc.get("aggregated_metrics")
+        assert t.num_shards == 4
+        assert t.consumer_services[1].consumption == ConsumptionType.REPLICATED
+
+    def test_shared_vs_replicated(self):
+        bus = MessageBus(self._topic())
+        c1 = bus.register("coordinator", "c1")
+        c2 = bus.register("coordinator", "c2")
+        r1 = bus.register("mirror", "r1")
+        r2 = bus.register("mirror", "r2")
+        for i in range(10):
+            bus.publish(i % 4, b"m%d" % i)
+        got1, got2 = c1.poll(6), c2.poll(100)
+        assert len(got1) + len(got2) == 10  # shared: split
+        assert len(r1.poll(100)) == 10  # replicated: everyone sees all
+        assert len(r2.poll(100)) == 10
+        for m in got1 + got2:
+            c1.ack(m)
+        assert bus.unacked("coordinator") == 0
+
+    def test_retry_redelivers_unacked(self):
+        bus = MessageBus(self._topic(), retry_after_s=5.0)
+        c = bus.register("coordinator", "c1")
+        bus.publish(0, b"x", now_s=0.0)
+        (m,) = c.poll()
+        # no ack; before the deadline nothing requeues
+        assert bus.process_retries(now_s=3.0) == 0
+        assert bus.process_retries(now_s=6.0) == 1
+        (m2,) = c.poll()
+        assert m2.payload == b"x" and m2.retries == 1
+        c.ack(m2)
+        assert bus.unacked("coordinator") == 0
+
+
+@pytest.fixture
+def api(tmp_path):
+    from m3_tpu.server.http_api import ApiContext, serve_background
+    from m3_tpu.storage.database import Database, DatabaseOptions, NamespaceOptions
+
+    db = Database(
+        DatabaseOptions(root=str(tmp_path), commitlog_enabled=False),
+        {"default": NamespaceOptions(num_shards=1, slot_capacity=1 << 10,
+                                     sample_capacity=1 << 12)},
+    )
+    srv = serve_background(ApiContext(db))
+    port = srv.server_address[1]
+    yield f"http://127.0.0.1:{port}"
+    srv.shutdown()
+    db.close()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _post(url, obj):
+    req = urllib.request.Request(
+        url, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+class TestHttpApi:
+    def test_write_query_labels(self, api):
+        t0 = START / 1e9
+        samples = []
+        for host in ("a", "b"):
+            for j in range(40):
+                samples.append({
+                    "tags": {"__name__": "cpu", "host": host},
+                    "timestamp": t0 + 15 * (j + 1),
+                    "value": float(j) * (2.0 if host == "b" else 1.0),
+                })
+        out = _post(api + "/api/v1/json/write", samples)
+        assert out["written"] == 80
+
+        qr = _get(
+            api + f"/api/v1/query_range?query=cpu&start={t0+300}&end={t0+600}&step=15s"
+        )
+        assert qr["status"] == "success"
+        assert qr["data"]["resultType"] == "matrix"
+        assert len(qr["data"]["result"]) == 2
+
+        agg = _get(
+            api + "/api/v1/query_range?query="
+            + urllib.parse.quote('sum(rate(cpu[5m]))')
+            + f"&start={t0+600}&end={t0+615}&step=15s"
+        )
+        assert len(agg["data"]["result"]) == 1
+        v = float(agg["data"]["result"][0]["values"][0][1])
+        assert v == pytest.approx(3.0 / 15.0, rel=1e-6)
+
+        labels = _get(api + "/api/v1/labels")
+        assert labels["data"] == ["__name__", "host"]
+        values = _get(api + "/api/v1/label/host/values")
+        assert values["data"] == ["a", "b"]
+        series = _get(api + "/api/v1/series")
+        assert len(series["data"]) == 2
+
+    def test_error_handling(self, api):
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(api + "/api/v1/query_range?query=rate(&start=1&end=2&step=15s")
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e2:
+            _get(api + "/nope")
+        assert e2.value.code == 404

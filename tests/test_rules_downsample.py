@@ -1,0 +1,176 @@
+"""Rules engine (filters, mapping/rollup matching, versioned cutover) and
+the coordinator downsampler writing aggregates back to storage."""
+
+import numpy as np
+import pytest
+
+from m3_tpu.coordinator.downsample import Downsampler, DownsamplerOptions
+from m3_tpu.index.doc import Document
+from m3_tpu.metrics.aggregation import AggregationID, AggregationType
+from m3_tpu.metrics.filters import TagFilter, TagsFilter, glob_to_regex
+from m3_tpu.metrics.pipeline import (
+    AggregationOp, Pipeline, RollupOp,
+)
+from m3_tpu.metrics.policy import StoragePolicy
+from m3_tpu.metrics.rules import (
+    MappingRule, Matcher, RollupRule, RollupTarget, RuleSet, rollup_id,
+)
+from m3_tpu.metrics.types import MetricType
+from m3_tpu.storage.database import Database, DatabaseOptions, NamespaceOptions
+
+SP_10S = StoragePolicy.parse("10s:2d")
+SP_1M = StoragePolicy.parse("1m:40d")
+
+
+class TestFilters:
+    def test_glob(self):
+        assert glob_to_regex(b"web*").fullmatch(b"webserver")
+        assert not glob_to_regex(b"web*").fullmatch(b"a.webserver")
+        assert glob_to_regex(b"h?st").fullmatch(b"host")
+        assert glob_to_regex(b"{us,eu}-*").fullmatch(b"eu-west-1")
+        assert not glob_to_regex(b"{us,eu}-*").fullmatch(b"ap-south-1")
+
+    def test_tags_filter(self):
+        f = TagsFilter.parse("__name__:cpu.* dc:{us,eu}-* role:!db")
+        assert f.matches({b"__name__": b"cpu.util", b"dc": b"us-east", b"role": b"web"})
+        assert not f.matches({b"__name__": b"cpu.util", b"dc": b"us-east", b"role": b"db"})
+        assert not f.matches({b"__name__": b"mem.used", b"dc": b"us-east"})
+        # absent negated tag matches
+        assert f.matches({b"__name__": b"cpu.x", b"dc": b"eu-west"})
+
+
+def _ruleset():
+    return RuleSet(
+        version=1,
+        mapping_rules=[
+            MappingRule(
+                "cpu-10s", TagsFilter.parse("__name__:cpu.*"),
+                (SP_10S,),
+            ),
+            MappingRule(
+                "dropped", TagsFilter.parse("__name__:debug.*"),
+                (), drop=True,
+            ),
+            MappingRule(
+                "late-rule", TagsFilter.parse("__name__:cpu.*"),
+                (SP_1M,), cutover_nanos=10**18,
+            ),
+        ],
+        rollup_rules=[
+            RollupRule(
+                "per-dc", TagsFilter.parse("__name__:req.count"),
+                (
+                    RollupTarget(
+                        Pipeline((
+                            AggregationOp(AggregationType.SUM),
+                            RollupOp(b"req.count.by_dc", (b"dc",)),
+                        )),
+                        (SP_10S,),
+                    ),
+                ),
+            ),
+        ],
+    )
+
+
+class TestRules:
+    def test_mapping_match_and_cutover(self):
+        rs = _ruleset()
+        m = Matcher(rs, now_nanos=0)
+        res = m.match(b"id1", {b"__name__": b"cpu.util"})
+        assert len(res.mappings) == 1
+        assert res.mappings[0].policies == (SP_10S,)
+        # After the late rule's cutover both apply.
+        m.update(rs, now_nanos=2 * 10**18)
+        res2 = m.match(b"id1", {b"__name__": b"cpu.util"})
+        assert len(res2.mappings) == 2
+
+    def test_drop_policy(self):
+        m = Matcher(_ruleset(), 0)
+        res = m.match(b"d", {b"__name__": b"debug.heap"})
+        assert res.drop and not res.mappings
+
+    def test_rollup_match(self):
+        m = Matcher(_ruleset(), 0)
+        res = m.match(b"r", {b"__name__": b"req.count", b"dc": b"us", b"host": b"h1"})
+        assert len(res.rollups) == 1
+        r = res.rollups[0]
+        assert r.id == b"req.count.by_dc{dc=us}"
+        assert r.aggregation_id == AggregationID.compress([AggregationType.SUM])
+        assert r.pipeline.is_empty()
+
+    def test_rollup_id_stable_order(self):
+        rid, tags = rollup_id(b"n", {b"b": b"2", b"a": b"1"}, (b"a", b"b"))
+        assert rid == b"n{a=1,b=2}"
+        assert tags[b"__name__"] == b"n"
+
+    def test_tombstone(self):
+        rs = _ruleset()
+        rs.mapping_rules.append(
+            MappingRule("cpu-10s", TagsFilter.parse("__name__:cpu.*"),
+                        (), cutover_nanos=5, tombstoned=True)
+        )
+        active = rs.active_at(10)
+        assert all(r.name != "cpu-10s" for r in active.mapping_rules)
+
+
+BLOCK = 2 * 3600 * 10**9
+START = (1_700_000_000 * 10**9) // BLOCK * BLOCK
+R = 10 * 10**9
+
+
+class TestDownsampler:
+    def test_rollup_aggregate_written_back(self, tmp_path):
+        db = Database(
+            DatabaseOptions(root=str(tmp_path), commitlog_enabled=False),
+            {"default": NamespaceOptions(num_shards=1, slot_capacity=1 << 10,
+                                         sample_capacity=1 << 12)},
+        )
+        ds = Downsampler(db, _ruleset(),
+                         opts=DownsamplerOptions(capacity=1 << 10,
+                                                 timer_sample_capacity=1 << 12))
+        # 3 hosts × 2 dcs, one sample each in the same 10s window.
+        docs, vals = [], []
+        for dc in (b"us", b"eu"):
+            for h in range(3):
+                docs.append(Document.from_tags(
+                    b"req:" + dc + b":h%d" % h,
+                    {b"__name__": b"req.count", b"dc": dc, b"host": b"h%d" % h},
+                ))
+                vals.append(float(h + 1))
+        t0 = START + R + 1
+        keep = ds.write_batch(docs, np.full(6, t0, np.int64), np.asarray(vals),
+                              metric_type=MetricType.COUNTER)
+        assert keep.all()
+        written = ds.flush(START + 3 * R)
+        assert written >= 2
+        # Aggregates land in the policy's own namespace, never the raw one.
+        agg_ns = str(SP_10S)
+        assert agg_ns in db.namespaces
+        assert db.read("default", b"req.count.by_dc{dc=us}", START, START + BLOCK) == []
+        # sum per dc = 1+2+3 = 6, at the window-end timestamp.
+        pts = db.read(agg_ns, b"req.count.by_dc{dc=us}", START, START + BLOCK)
+        assert pts == [(START + 2 * R, 6.0)]
+        # rollup output is indexed with its tags
+        from m3_tpu.index.search import Term
+        hits = db.query_ids(agg_ns, Term(b"dc", b"eu"), START, START + BLOCK)
+        assert any(d.id == b"req.count.by_dc{dc=eu}" for d in hits)
+        db.close()
+
+    def test_drop_mask(self, tmp_path):
+        db = Database(
+            DatabaseOptions(root=str(tmp_path), commitlog_enabled=False),
+            {"default": NamespaceOptions(num_shards=1, slot_capacity=1 << 10,
+                                         sample_capacity=1 << 12)},
+        )
+        ds = Downsampler(db, _ruleset(),
+                         opts=DownsamplerOptions(capacity=1 << 10,
+                                                 timer_sample_capacity=1 << 12))
+        docs = [
+            Document.from_tags(b"a", {b"__name__": b"debug.x"}),
+            Document.from_tags(b"b", {b"__name__": b"cpu.x"}),
+        ]
+        keep = ds.write_batch(docs, np.full(2, START + 1, np.int64),
+                              np.ones(2))
+        assert list(keep) == [False, True]
+        db.close()
