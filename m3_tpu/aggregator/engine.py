@@ -28,13 +28,13 @@ entry lookup with rate-limited entry creation.
 from __future__ import annotations
 
 import dataclasses
-import zlib
 from typing import Callable, Dict, List, Sequence
 
 import jax.numpy as jnp
 import numpy as np
 
 from m3_tpu.aggregator.arena import CounterArena, GaugeArena, TimerArena
+from m3_tpu.core.hash import shard_for
 from m3_tpu.metrics.aggregation import AggregationID, AggregationType
 from m3_tpu.metrics.policy import StoragePolicy
 from m3_tpu.metrics.types import MetricType
@@ -350,9 +350,9 @@ class Aggregator:
         self.shards = [AggregatorShard(i, self.opts) for i in range(num_shards)]
 
     def shard_index(self, mid: bytes) -> int:
-        # Reference uses murmur3(id) % numShards (aggregator.go:505,
-        # sharding/shardset.go:148); any stable hash serves the same role.
-        return zlib_crc(mid) % len(self.shards)
+        # murmur3(id) % numShards, matching the reference router
+        # (aggregator.go:505, sharding/shardset.go:148).
+        return shard_for(mid, len(self.shards))
 
     def shard_for(self, mid: bytes) -> AggregatorShard:
         return self.shards[self.shard_index(mid)]
@@ -377,5 +377,3 @@ class Aggregator:
         return out
 
 
-def zlib_crc(b: bytes) -> int:
-    return zlib.crc32(b)

@@ -105,31 +105,87 @@ class LeaderElection:
     """Leader election over the KV store's CAS (reference
     `src/cluster/services/leader/client.go:32-70`, which campaigns via
     etcd concurrency.Election; same protocol shape: the leader key holds
-    the leader's ID at a version, resign deletes it)."""
+    the leader's ID at a version, resign deletes it).
 
-    def __init__(self, kv: KVStore, electionid: str, instance_id: str):
+    With ``ttl_nanos`` set, the leadership is a *lease* (etcd's session
+    TTL): the record carries an expiry, ``campaign(now)`` renews it for
+    the incumbent, and any candidate may take over an expired lease via
+    CAS — so a crashed leader is superseded after one TTL, the failover
+    behavior `election_mgr.go` gets from etcd sessions.  Without a TTL
+    the legacy hold-until-resign behavior is preserved.
+    """
+
+    def __init__(
+        self,
+        kv: KVStore,
+        electionid: str,
+        instance_id: str,
+        ttl_nanos: int | None = None,
+    ):
         self.kv = kv
         self.key = f"_election/{electionid}"
         self.instance_id = instance_id
+        self.ttl_nanos = ttl_nanos
 
-    def campaign(self) -> bool:
-        """Try to become leader; idempotent for the current leader."""
+    def _record(self, now_nanos: int | None):
         cur = self.kv.get(self.key)
         if cur is None:
-            try:
-                self.kv.set_if_not_exists(self.key, self.instance_id.encode())
-                return True
-            except KeyError:
-                cur = self.kv.get(self.key)
-        return cur is not None and cur.data == self.instance_id.encode()
+            return None, 0
+        try:
+            rec = json.loads(cur.data)
+            holder, expires = rec["id"], rec.get("expires")
+        except (ValueError, KeyError, TypeError):
+            holder, expires = cur.data.decode(), None  # legacy raw-ID record
+        if (
+            expires is not None
+            and now_nanos is not None
+            and expires <= now_nanos
+        ):
+            return None, cur.version  # lease expired: claimable via CAS
+        return holder, cur.version
 
-    def leader(self) -> str | None:
-        cur = self.kv.get(self.key)
-        return cur.data.decode() if cur else None
+    def _payload(self, now_nanos: int | None) -> bytes:
+        if self.ttl_nanos is None:
+            return self.instance_id.encode()
+        return json.dumps(
+            {"id": self.instance_id, "expires": now_nanos + self.ttl_nanos}
+        ).encode()
 
-    def is_leader(self) -> bool:
-        return self.leader() == self.instance_id
+    def _require_now(self, now_nanos: int | None) -> None:
+        # A TTL election silently degrading to a never-expiring lease on a
+        # legacy no-arg call would defeat failover — fail loudly instead.
+        if self.ttl_nanos is not None and now_nanos is None:
+            raise ValueError("ttl_nanos is set: pass now_nanos")
+
+    def campaign(self, now_nanos: int | None = None) -> bool:
+        """Try to become (or renew being) leader."""
+        self._require_now(now_nanos)
+        holder, version = self._record(now_nanos)
+        payload = self._payload(now_nanos)
+        if holder == self.instance_id and self.ttl_nanos is None:
+            return True
+        if holder is not None and holder != self.instance_id:
+            return False
+        try:
+            if version == 0:
+                self.kv.set_if_not_exists(self.key, payload)
+            else:
+                self.kv.check_and_set(self.key, version, payload)
+            return True
+        except (KeyError, ValueError):
+            # Lost the CAS race; we're leader only if the winner was us.
+            holder, _ = self._record(now_nanos)
+            return holder == self.instance_id
+
+    def leader(self, now_nanos: int | None = None) -> str | None:
+        self._require_now(now_nanos)
+        holder, _ = self._record(now_nanos)
+        return holder
+
+    def is_leader(self, now_nanos: int | None = None) -> bool:
+        return self.leader(now_nanos) == self.instance_id
 
     def resign(self) -> None:
-        if self.is_leader():
+        holder, _ = self._record(None)
+        if holder == self.instance_id:
             self.kv.delete(self.key)

@@ -23,8 +23,9 @@ open in-memory window — the same two-source merge the reference does with
 from __future__ import annotations
 
 import dataclasses
-import zlib
 from pathlib import Path
+
+from m3_tpu.core.hash import shard_for as hash_shard_for
 from typing import Dict, Iterable, List, Sequence
 
 import numpy as np
@@ -62,9 +63,14 @@ class DatabaseOptions:
 
 
 def shard_for_id(sid: bytes, num_shards: int) -> int:
-    """Stable hash routing (reference murmur3(id) % N,
-    `sharding/shardset.go:148-163`)."""
-    return zlib.crc32(sid) % num_shards
+    """murmur3(id) % N, bit-for-bit the reference's router
+    (`sharding/shardset.go:148-163`).
+
+    NOTE: data directories written before the crc32→murmur3 switch route
+    differently and are not readable by this build (no deployed data
+    exists; there is no migration path by design).
+    """
+    return hash_shard_for(sid, num_shards)
 
 
 class Shard:
