@@ -100,6 +100,10 @@ class FlushManager:
                 return
             except ValueError:
                 continue  # concurrent writer: re-read and re-merge
+        raise RuntimeError(
+            f"flush-times CAS on {self._times_key} lost 8 straight races; "
+            "watermark not persisted (restart would re-emit flushed windows)"
+        )
 
     def _collect_times(self) -> Dict[Tuple[int, str], int]:
         out: Dict[Tuple[int, str], int] = {}

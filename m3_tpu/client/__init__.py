@@ -1,0 +1,7 @@
+from m3_tpu.client.session import (
+    ConsistencyError,
+    ConsistencyLevel,
+    ReplicatedSession,
+)
+
+__all__ = ["ConsistencyError", "ConsistencyLevel", "ReplicatedSession"]

@@ -1,0 +1,224 @@
+"""Replica-aware session: per-shard fan-out with quorum consistency.
+
+Reference parity: `src/dbnode/client/session.go:1213-1400` (write fan-out
+to every replica owning the shard, success accumulation against the
+consistency level) and `src/dbnode/topology/consistency_level.go:36-46`
+(One / Majority / All; unstrict majority for reads/bootstrap).  The
+reference's per-host TChannel queues (`host_queue.go:1021`) become direct
+calls against per-instance `Database` handles — in-process here exactly
+like the reference's integration topology (fake cluster services,
+`src/dbnode/integration/fake/cluster_services.go`); the socket transport
+(server/rpc.py) carries the same session when instances are remote.
+
+Reads fan out to the shard's replicas, each replica returns its merged
+(buffer + fileset) series, and the session de-duplicates by timestamp —
+the job `encoding/multi_reader_iterator.go` does stream-wise in Go is a
+sorted dict-merge over (timestamp → value) here.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from m3_tpu.cluster.placement import Placement, ShardState
+from m3_tpu.core.hash import shard_for
+
+
+class ConsistencyLevel(enum.Enum):
+    """`topology/consistency_level.go:36-46`."""
+
+    ONE = "one"
+    UNSTRICT_MAJORITY = "unstrict_majority"
+    MAJORITY = "majority"
+    ALL = "all"
+
+    def required(self, replicas: int) -> int:
+        if self == ConsistencyLevel.ONE:
+            return 1
+        if self == ConsistencyLevel.ALL:
+            return replicas
+        return replicas // 2 + 1  # majority variants
+
+    @property
+    def strict(self) -> bool:
+        return self != ConsistencyLevel.UNSTRICT_MAJORITY
+
+
+class ConsistencyError(RuntimeError):
+    """Raised when fewer replicas succeeded than the level requires
+    (reference session write/fetch consistency errors)."""
+
+    def __init__(self, op: str, got: int, need: int, errors: list):
+        super().__init__(
+            f"{op}: {got}/{need} replica successes (errors: {errors})"
+        )
+        self.got = got
+        self.need = need
+        self.errors = errors
+
+
+class ReplicatedSession:
+    """Shard-routed, replica-fanned session over per-instance databases.
+
+    ``connections`` maps instance id → a Database-like handle (anything
+    with write_batch/write_tagged_batch/read/query_ids).  A handle of
+    None models a down instance; per-call exceptions count as replica
+    errors exactly like the reference's per-host op failures.
+    """
+
+    def __init__(
+        self,
+        placement: Placement,
+        connections: Dict[str, object],
+        write_level: ConsistencyLevel = ConsistencyLevel.MAJORITY,
+        read_level: ConsistencyLevel = ConsistencyLevel.UNSTRICT_MAJORITY,
+    ):
+        self.placement = placement
+        self.connections = connections
+        self.write_level = write_level
+        self.read_level = read_level
+
+    # ---- topology ----
+
+    def _replicas_for_shard(self, shard: int, for_read: bool = False) -> List[str]:
+        out = []
+        for inst in self.placement.instances_for_shard(shard):
+            st = inst.shards[shard].state
+            # Leaving instances still serve both paths.  Initializing
+            # ones take writes but are excluded from reads: they may not
+            # have bootstrapped yet, and counting their empty responses
+            # toward read quorum would present data loss as a consistent
+            # read (reference session.go readConsistencyAchieved counts
+            # Available hosts only).
+            ok_states = (ShardState.AVAILABLE, ShardState.LEAVING)
+            if not for_read:
+                ok_states += (ShardState.INITIALIZING,)
+            if st in ok_states:
+                out.append(inst.id)
+        return out
+
+    def _shard(self, sid: bytes) -> int:
+        return shard_for(sid, self.placement.num_shards)
+
+    # ---- write path (session.go:1213 Write → fan-out + accumulate) ----
+
+    def _fan_out(
+        self,
+        op: str,
+        shard: int,
+        level: ConsistencyLevel,
+        fn: Callable[[object], object],
+        for_read: bool = False,
+    ) -> List[object]:
+        replicas = self._replicas_for_shard(shard, for_read)
+        need = level.required(len(replicas))
+        results, errors = [], []
+        for iid in replicas:
+            conn = self.connections.get(iid)
+            if conn is None:
+                errors.append(f"{iid}: down")
+                continue
+            try:
+                results.append(fn(conn))
+            except Exception as e:  # per-replica failure, keep fanning
+                errors.append(f"{iid}: {e}")
+        if len(results) < need and level.strict:
+            raise ConsistencyError(op, len(results), need, errors)
+        if not results and not level.strict:
+            raise ConsistencyError(op, 0, 1, errors)
+        return results
+
+    def write_batch(
+        self,
+        namespace: str,
+        ids: Sequence[bytes],
+        ts,
+        vals,
+        now_nanos: int | None = None,
+    ) -> None:
+        ts = np.asarray(ts, np.int64)
+        vals = np.asarray(vals, np.float64)
+        by_shard: Dict[int, List[int]] = {}
+        for i, sid in enumerate(ids):
+            by_shard.setdefault(self._shard(sid), []).append(i)
+        for shard, idxs in by_shard.items():
+            sel = np.asarray(idxs)
+            sub_ids = [ids[i] for i in idxs]
+            self._fan_out(
+                "write",
+                shard,
+                self.write_level,
+                lambda db: db.write_batch(
+                    namespace, sub_ids, ts[sel], vals[sel], now_nanos
+                ),
+            )
+
+    def write_tagged_batch(
+        self, namespace: str, docs, ts, vals, now_nanos: int | None = None
+    ) -> None:
+        ts = np.asarray(ts, np.int64)
+        vals = np.asarray(vals, np.float64)
+        by_shard: Dict[int, List[int]] = {}
+        for i, d in enumerate(docs):
+            by_shard.setdefault(self._shard(d.id), []).append(i)
+        for shard, idxs in by_shard.items():
+            sel = np.asarray(idxs)
+            sub = [docs[i] for i in idxs]
+            self._fan_out(
+                "write_tagged",
+                shard,
+                self.write_level,
+                lambda db: db.write_tagged_batch(
+                    namespace, sub, ts[sel], vals[sel], now_nanos
+                ),
+            )
+
+    # ---- read path (session.go fetch fan-out + merge) ----
+
+    def fetch(
+        self, namespace: str, sid: bytes, start: int, end: int
+    ) -> List[Tuple[int, float]]:
+        """Fetch one series, merged across replicas, each point once."""
+        shard = self._shard(sid)
+        results = self._fan_out(
+            "fetch",
+            shard,
+            self.read_level,
+            lambda db: db.read(namespace, sid, start, end),
+            for_read=True,
+        )
+        merged: Dict[int, float] = {}
+        for pts in results:
+            for t, v in pts:
+                merged.setdefault(t, v)
+        return sorted(merged.items())
+
+    def fetch_tagged(
+        self, namespace: str, query, start: int, end: int
+    ) -> Dict[bytes, List[Tuple[int, float]]]:
+        """Index query + per-series fetch (session.go FetchTagged +
+        fetchTaggedResultsAccumulator).  The index query fans out to all
+        instances; read_level applies to how many must answer (the
+        reference applies the level per-shard over host responses)."""
+        docs: Dict[bytes, object] = {}
+        ok = 0
+        errors: List[str] = []
+        for iid, conn in self.connections.items():
+            if conn is None:
+                errors.append(f"{iid}: down")
+                continue
+            try:
+                for d in conn.query_ids(namespace, query, start, end):
+                    docs.setdefault(d.id, d)
+                ok += 1
+            except Exception as e:
+                errors.append(f"{iid}: {e}")
+        need = self.read_level.required(self.placement.replica_factor)
+        if (self.read_level.strict and ok < need) or ok == 0:
+            raise ConsistencyError("fetch_tagged", ok, max(need, 1), errors)
+        return {
+            sid: self.fetch(namespace, sid, start, end) for sid in sorted(docs)
+        }

@@ -1,0 +1,178 @@
+"""Anti-entropy repair + peers bootstrap across replica databases.
+
+Reference parity:
+
+* `src/dbnode/storage/repair.go:115-246` — shardRepairer fetches block
+  metadata (per-series checksums) from every replica, compares with
+  `ReplicaMetadataComparer` (`repair.go:162`), and streams differing
+  blocks, loading merged data back into the shard (`repair.go:348`).
+* `src/dbnode/storage/bootstrap/bootstrapper/peers/source.go` — a node
+  whose local filesets are missing (new node, wiped disk, placement
+  add/replace) streams whole blocks from replica peers and persists
+  them locally.
+
+Here replicas are per-instance `Database` handles (the same in-process
+topology the reference's integration tests use); metadata compare is a
+dict diff over per-series adler32 digests — the digest the reference
+filesets already carry (`src/dbnode/digest/digest.go:24-37`).  The
+device-side analogue (checksum compare across the replica mesh axis as
+a ppermute collective) lives in `m3_tpu/parallel/replication.py`.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from m3_tpu.encoding.m3tsz import decode_series, encode_series
+from m3_tpu.persist.digest import digest as checksum
+from m3_tpu.persist.fs import DataFileSetReader, DataFileSetWriter, list_filesets
+
+
+def block_metadata(
+    db, namespace: str, shard: int, block_start: int
+) -> Dict[bytes, int] | None:
+    """Per-series stream checksums for one flushed block, or None when
+    the replica has no fileset for it (reference
+    FetchBlocksMetadataRawV2, the metadata half of repair)."""
+    filesets = dict(list_filesets(db.opts.root, namespace, shard))
+    if block_start not in filesets:
+        return None
+    r = DataFileSetReader(
+        db.opts.root, namespace, shard, block_start, filesets[block_start]
+    )
+    return {sid: checksum(seg) for sid, seg in r.read_all()}
+
+
+class RepairReport(dict):
+    @property
+    def converged(self) -> bool:
+        return self["series_diff"] == 0 and self["blocks_missing"] == 0
+
+
+def repair_shard_block(
+    dbs: List[object], namespace: str, shard: int, block_start: int
+) -> RepairReport:
+    """Compare one (shard, block) across replicas; merge + rewrite where
+    they diverge (repair.go:115-246 + the load at :348).
+
+    Divergent replicas get a new fileset volume holding the union of all
+    replicas' points (last-writer-wins per timestamp is unnecessary: the
+    merge is per-timestamp first-seen, matching the session's read
+    de-dup).  Returns counts; a second call reports convergence.
+    """
+    metas = [block_metadata(db, namespace, shard, block_start) for db in dbs]
+    present = [m for m in metas if m is not None]
+    report = RepairReport(
+        replicas=len(dbs),
+        blocks_missing=sum(1 for m in metas if m is None),
+        series_checked=len(set().union(*present)) if present else 0,
+        series_diff=0,
+        repaired_replicas=0,
+    )
+    if not present:
+        return report
+
+    # Diff: any series whose checksum isn't identical on every replica
+    # (missing counts as different) — ReplicaMetadataComparer semantics.
+    # A series missing from one present replica yields {None, <ck>} here,
+    # so missing-vs-present and checksum-mismatch are both caught.
+    all_sids = sorted(set().union(*present))
+    divergent = [
+        sid
+        for sid in all_sids
+        if len({m.get(sid) for m in metas if m is not None}) > 1
+    ]
+    report["series_diff"] = len(divergent)
+    if not divergent and report["blocks_missing"] == 0:
+        return report
+
+    # Merge pass: union every replica's points for the whole block
+    # (streaming just the divergent series would also work; whole-block
+    # union keeps the rewrite one volume bump, like the cold-flush merge).
+    merged: Dict[bytes, Dict[int, float]] = {}
+    for db, meta in zip(dbs, metas):
+        if meta is None:
+            continue
+        filesets = dict(list_filesets(db.opts.root, namespace, shard))
+        r = DataFileSetReader(
+            db.opts.root, namespace, shard, block_start, filesets[block_start]
+        )
+        for sid, seg in r.read_all():
+            tgt = merged.setdefault(sid, {})
+            for d in decode_series(seg):
+                tgt.setdefault(d.timestamp, d.value)
+
+    series = [
+        (sid, encode_series(sorted(pts.items()), start=block_start))
+        for sid, pts in sorted(merged.items())
+    ]
+    merged_ck = {sid: checksum(seg) for sid, seg in series}
+    for db, meta in zip(dbs, metas):
+        if meta == merged_ck:
+            continue  # already converged replica: no rewrite
+        filesets = dict(list_filesets(db.opts.root, namespace, shard))
+        vol = filesets.get(block_start, -1) + 1
+        ns = db.namespaces[namespace]
+        DataFileSetWriter(
+            db.opts.root, namespace, shard, block_start,
+            ns.opts.block_size_nanos, volume=vol,
+        ).write_all(series)
+        ns.shards[shard].flushed_blocks.add(block_start)
+        report["repaired_replicas"] += 1
+    return report
+
+
+def repair_namespace(dbs: List[object], namespace: str) -> RepairReport:
+    """Repair every flushed (shard, block) seen on any replica."""
+    num_shards = dbs[0].namespaces[namespace].opts.num_shards
+    total = RepairReport(
+        replicas=len(dbs), blocks_missing=0, series_checked=0,
+        series_diff=0, repaired_replicas=0,
+    )
+    for shard in range(num_shards):
+        blocks = set()
+        for db in dbs:
+            blocks.update(
+                bs for bs, _ in list_filesets(db.opts.root, namespace, shard)
+            )
+        for bs in sorted(blocks):
+            rep = repair_shard_block(dbs, namespace, shard, bs)
+            for k in ("blocks_missing", "series_checked", "series_diff",
+                      "repaired_replicas"):
+                total[k] += rep[k]
+    return total
+
+
+def peers_bootstrap(
+    db, peers: List[object], namespace: str
+) -> Dict[str, int]:
+    """Fill every (shard, block) fileset missing locally from a replica
+    peer (bootstrapper/peers/source.go: stream blocks from peers and
+    persist, used on node add/replace and after data loss).
+
+    Copies the peer's encoded streams verbatim — bit-identical blocks,
+    so a follow-up repair pass reports convergence immediately.
+    """
+    ns = db.namespaces[namespace]
+    copied_blocks = copied_series = 0
+    for shard in range(ns.opts.num_shards):
+        local = dict(list_filesets(db.opts.root, namespace, shard))
+        for peer in peers:
+            if peer is None or peer is db:
+                continue
+            for bs, vol in list_filesets(peer.opts.root, namespace, shard):
+                if bs in local:
+                    continue
+                r = DataFileSetReader(
+                    peer.opts.root, namespace, shard, bs, vol
+                )
+                series = list(r.read_all())
+                DataFileSetWriter(
+                    db.opts.root, namespace, shard, bs,
+                    ns.opts.block_size_nanos, volume=0,
+                ).write_all(series)
+                ns.shards[shard].flushed_blocks.add(bs)
+                local[bs] = 0
+                copied_blocks += 1
+                copied_series += len(series)
+    return {"blocks": copied_blocks, "series": copied_series}

