@@ -25,6 +25,7 @@ import numpy as np
 
 from m3_tpu.cluster.placement import Placement, ShardState
 from m3_tpu.core.hash import shard_for
+from m3_tpu.storage.series_merge import merge_point_sources
 
 
 class ConsistencyLevel(enum.Enum):
@@ -190,18 +191,13 @@ class ReplicatedSession:
             lambda db: db.read(namespace, sid, start, end),
             for_read=True,
         )
-        merged: Dict[int, float] = {}
-        for pts in results:
-            for t, v in pts:
-                merged.setdefault(t, v)
-        return sorted(merged.items())
+        # One merge seam for every read path (series_merge): replicas
+        # should agree post-repair, so precedence is a tie-break only.
+        return merge_point_sources(results)
 
-    def fetch_tagged(
-        self, namespace: str, query, start: int, end: int
-    ) -> Dict[bytes, List[Tuple[int, float]]]:
-        """Index query + per-series fetch (session.go FetchTagged +
-        fetchTaggedResultsAccumulator).  The index query fans out to all
-        instances; read_level applies to how many must answer (the
+    def query_ids(self, namespace: str, query, start: int, end: int) -> List[object]:
+        """Index query fanned out to all instances, de-duplicated by
+        series ID; read_level applies to how many must answer (the
         reference applies the level per-shard over host responses)."""
         docs: Dict[bytes, object] = {}
         ok = 0
@@ -218,7 +214,15 @@ class ReplicatedSession:
                 errors.append(f"{iid}: {e}")
         need = self.read_level.required(self.placement.replica_factor)
         if (self.read_level.strict and ok < need) or ok == 0:
-            raise ConsistencyError("fetch_tagged", ok, max(need, 1), errors)
+            raise ConsistencyError("query_ids", ok, max(need, 1), errors)
+        return [docs[sid] for sid in sorted(docs)]
+
+    def fetch_tagged(
+        self, namespace: str, query, start: int, end: int
+    ) -> Dict[bytes, List[Tuple[int, float]]]:
+        """Index query + per-series fetch (session.go FetchTagged +
+        fetchTaggedResultsAccumulator)."""
         return {
-            sid: self.fetch(namespace, sid, start, end) for sid in sorted(docs)
+            d.id: self.fetch(namespace, d.id, start, end)
+            for d in self.query_ids(namespace, query, start, end)
         }

@@ -1,0 +1,113 @@
+"""Query fanout: multi-namespace, resolution-aware fetch + merge.
+
+Reference parity: `src/query/storage/fanout/storage.go:50,110,540` (fan
+queries across local namespaces and remote stores, merge results) and
+the namespace resolution logic of `src/query/storage/m3/storage.go:215-225`
+(pick, per query window, which retention/resolution namespaces must be
+consulted; consolidate multi-resolution data).
+
+Selection rule (resolveClusterNamespacesForQuery distilled):
+
+* Sources are (storage, resolution, retention) triples — e.g. the raw
+  10s/2d namespace plus downsampled 1m/30d and 1h/1y namespaces the
+  coordinator's rollup rules populate.
+* The finest-resolution source whose retention covers the whole query
+  window serves it alone (fast path — no merge cost).
+* Otherwise the window is partitioned into disjoint time bands, one per
+  source: the finest source serves the most recent band (everything its
+  retention covers), each coarser source serves only the strictly older
+  band beyond the next-finer source's retention.  Bands never overlap,
+  so coarse aggregate samples can never interleave with raw samples
+  over the same interval — the consolidation-by-coverage the reference
+  does when mixing resolutions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Sequence
+
+from m3_tpu.query.block import RawBlock, SeriesMeta
+from m3_tpu.storage.series_merge import merge_point_sources
+
+
+@dataclasses.dataclass(frozen=True)
+class FanoutSource:
+    """One queryable namespace (or remote store) + its storage policy."""
+
+    storage: object  # fetch_raw(name, matchers, start, end) -> RawBlock
+    resolution_nanos: int
+    retention_nanos: int
+
+
+class FanoutStorage:
+    """Engine-facing Storage over multiple namespaces/remotes."""
+
+    def __init__(
+        self,
+        sources: Sequence[FanoutSource],
+        now_fn: Callable[[], int] = time.time_ns,
+    ):
+        if not sources:
+            raise ValueError("fanout needs at least one source")
+        # finest resolution first
+        self.sources = sorted(sources, key=lambda s: s.resolution_nanos)
+        # Retention is measured from wall-clock now, NOT the query end:
+        # a short window queried far in the past would otherwise look
+        # "covered" by the raw namespace that retains nothing that old.
+        self.now_fn = now_fn
+
+    def _select(
+        self, start_nanos: int, end_nanos: int, now_nanos: int
+    ) -> List[FanoutSource]:
+        """Sources needed for the query window: the finest source serves
+        alone when its retention covers the whole window; otherwise all
+        overlapping sources, band-partitioned in fetch_raw (each range
+        gets the finest data available for it; sources whose band comes
+        out empty are skipped there, so no spurious coarse fetches)."""
+        finest = self.sources[0]
+        if now_nanos - finest.retention_nanos <= start_nanos:
+            return [finest]
+        return [
+            s
+            for s in self.sources
+            if now_nanos - s.retention_nanos < end_nanos
+        ]
+
+    def fetch_raw(
+        self,
+        name,
+        matchers,
+        start_nanos: int,
+        end_nanos: int,
+        now_nanos: int | None = None,
+    ) -> RawBlock:
+        now = self.now_fn() if now_nanos is None else now_nanos
+        chosen = self._select(start_nanos, end_nanos, now)
+        if len(chosen) == 1:
+            return chosen[0].storage.fetch_raw(
+                name, matchers, start_nanos, end_nanos
+            )
+        # Band partition: finest source serves its whole covered range;
+        # each coarser source only the strictly older remainder.  Bands
+        # are disjoint, so no cross-resolution interleaving can occur.
+        per_series: Dict[tuple, List[List[tuple]]] = {}
+        hi = end_nanos
+        for src in chosen:  # finest → coarsest
+            lo = max(start_nanos, now - src.retention_nanos)
+            if lo < hi:
+                blk = src.storage.fetch_raw(name, matchers, lo, hi)
+                for i, meta in enumerate(blk.series):
+                    c = int(blk.counts[i])
+                    pts = list(
+                        zip(blk.ts[i, :c].tolist(), blk.values[i, :c].tolist())
+                    )
+                    per_series.setdefault(meta.tags, []).append(pts)
+            hi = min(hi, lo)
+            if hi <= start_nanos:
+                break
+        keys = sorted(per_series)
+        pts_out = [merge_point_sources(per_series[k]) for k in keys]
+        metas = [SeriesMeta(k) for k in keys]
+        return RawBlock.from_lists(pts_out, metas)

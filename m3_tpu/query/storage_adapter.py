@@ -60,3 +60,24 @@ class DatabaseStorage:
             pts.append(self.db.read(self.namespace, d.id, start_nanos, end_nanos))
             metas.append(SeriesMeta(tuple(sorted(d.tags().items()))))
         return RawBlock.from_lists(pts, metas)
+
+
+class SessionStorage:
+    """Engine Storage over a ReplicatedSession: the coordinator-style
+    deployment where the query engine reaches storage through the
+    replica-merging client (`query/storage/m3/storage.go:215-225`
+    FetchCompressed → session.FetchTagged)."""
+
+    def __init__(self, session, namespace: str = "default"):
+        self.session = session
+        self.namespace = namespace
+
+    def fetch_raw(self, name, matchers, start_nanos, end_nanos) -> RawBlock:
+        q = matchers_to_query(name, matchers)
+        docs = self.session.query_ids(self.namespace, q, start_nanos, end_nanos)
+        pts = [
+            self.session.fetch(self.namespace, d.id, start_nanos, end_nanos)
+            for d in docs
+        ]
+        metas = [SeriesMeta(tuple(sorted(d.tags().items()))) for d in docs]
+        return RawBlock.from_lists(pts, metas)

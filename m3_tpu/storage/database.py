@@ -39,6 +39,7 @@ from m3_tpu.encoding.m3tsz_jax import decode_batch, encode_batch
 from m3_tpu.persist.commitlog import CommitLogWriter, list_commitlogs, read_commitlog
 from m3_tpu.persist.fs import DataFileSetReader, DataFileSetWriter, list_filesets
 from m3_tpu.storage.buffer import ShardBuffer
+from m3_tpu.storage.series_merge import merge_point_sources
 
 
 @dataclasses.dataclass(frozen=True)
@@ -191,12 +192,20 @@ class Shard:
 
     # -- read path ---------------------------------------------------------
 
-    def read(self, sid: bytes, start_nanos: int, end_nanos: int) -> list[tuple[int, float]]:
+    def read_sources(
+        self, sid: bytes, start_nanos: int, end_nanos: int
+    ) -> list[list[tuple[int, float]]]:
+        """Every source holding points for this series over the range,
+        ordered oldest-precedence-first for the merge seam
+        (series_merge.merge_point_sources): sealed fileset volume, open
+        warm buffer, pending cold overflow.  This is the seam the
+        reference builds from MultiReaderIterator + buffer streams
+        (`shard.go:1079` ReadEncoded gathering disk + memory streams)."""
         bsz = self.opts.block_size_nanos
-        out: list[tuple[int, float]] = []
         slot = self.slots.get(sid)
         lo = start_nanos // bsz * bsz
         filesets = dict(list_filesets(self.root, self.namespace, self.shard_id))
+        sources: list[list[tuple[int, float]]] = []
         for bs in range(lo, end_nanos + bsz, bsz):
             if bs in filesets:
                 try:
@@ -205,13 +214,30 @@ class Shard:
                     )
                     seg = r.read(sid)
                     if seg:
-                        out.extend((d.timestamp, d.value) for d in decode_series(seg))
+                        sources.append(
+                            [(d.timestamp, d.value) for d in decode_series(seg)]
+                        )
                 except FileNotFoundError:
                     pass
             if slot is not None and bs in self.buffer.open_blocks:
                 ts, vals = self.buffer.read_window(bs, slot)
-                out.extend(zip(ts.tolist(), vals.tolist()))
-        return [(t, v) for t, v in sorted(out) if start_nanos <= t < end_nanos]
+                sources.append(list(zip(ts.tolist(), vals.tolist())))
+            if slot is not None and bs in self.buffer.cold:
+                # Cold writes awaiting flush are readable immediately
+                # (the reference reads cold buckets too — versioned
+                # buckets in buffer.go:1016 serve un-flushed cold data).
+                pts: list[tuple[int, float]] = []
+                for cslots, cts, cvals in self.buffer.cold[bs]:
+                    m = cslots == slot
+                    pts.extend(zip(cts[m].tolist(), cvals[m].tolist()))
+                sources.append(pts)
+        return sources
+
+    def read(self, sid: bytes, start_nanos: int, end_nanos: int) -> list[tuple[int, float]]:
+        merged = merge_point_sources(
+            self.read_sources(sid, start_nanos, end_nanos)
+        )
+        return [(t, v) for t, v in merged if start_nanos <= t < end_nanos]
 
 
 class Namespace:

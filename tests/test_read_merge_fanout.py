@@ -1,0 +1,227 @@
+"""Read-path merge seam + query fanout.
+
+VERDICT round-2 criterion 5: a query spanning warm (open buffer), cold
+(pending overflow + flushed volumes) and replica data returns
+bit-identical points exactly once.  Models
+`src/dbnode/encoding/multi_reader_iterator.go` (multi-source merge) and
+`src/query/storage/m3/storage.go:215-225` + `fanout/storage.go`
+(resolution-aware namespace selection).
+"""
+
+import numpy as np
+import pytest
+
+from m3_tpu.client import ConsistencyLevel, ReplicatedSession
+from m3_tpu.cluster.placement import Instance, initial_placement
+from m3_tpu.query.block import SeriesMeta
+from m3_tpu.query.fanout import FanoutSource, FanoutStorage
+from m3_tpu.query.storage_adapter import DatabaseStorage, SessionStorage
+from m3_tpu.storage.database import Database, DatabaseOptions, NamespaceOptions
+from m3_tpu.storage.series_merge import merge_point_sources
+
+SEC = 10**9
+MIN = 60 * SEC
+HOUR = 3600 * SEC
+BLOCK = 2 * HOUR
+T0 = (1_600_000_000 * SEC) // BLOCK * BLOCK
+
+
+def test_merge_point_sources_later_wins():
+    a = [(1, 1.0), (2, 2.0)]
+    b = [(2, 20.0), (3, 3.0)]
+    assert merge_point_sources([a, b]) == [(1, 1.0), (2, 20.0), (3, 3.0)]
+    assert merge_point_sources([b, a]) == [(1, 1.0), (2, 2.0), (3, 3.0)]
+    assert merge_point_sources([]) == []
+
+
+class TestWarmColdMergedRead:
+    def _db(self, tmp_path):
+        return Database(
+            DatabaseOptions(root=str(tmp_path / "db"), commitlog_enabled=False),
+            namespaces={
+                "default": NamespaceOptions(
+                    num_shards=2, slot_capacity=128, sample_capacity=1024
+                )
+            },
+        )
+
+    def test_query_spans_flushed_warm_and_cold_pending(self, tmp_path):
+        """One series with points in: a flushed block (sealed fileset),
+        the open warm buffer, and an un-flushed cold overflow — one read
+        returns every point exactly once, bit-identical."""
+        db = self._db(tmp_path)
+        sid = b"spanning-series"
+        expected = []
+
+        # Block 0: warm-write, then tick seals + flushes it.
+        t_old = [T0 + k * 10 * SEC for k in range(1, 6)]
+        v_old = [float(k) + 0.125 for k in range(1, 6)]
+        expected += list(zip(t_old, v_old))
+        db.write_batch("default", [sid] * 5, np.array(t_old), np.array(v_old))
+        now1 = T0 + BLOCK + 11 * 60 * SEC
+        db.tick(now1)
+
+        # Block 1 (open): warm writes living in the device buffer.
+        t_warm = [T0 + BLOCK + k * 10 * SEC for k in range(1, 4)]
+        v_warm = [100.0 + k for k in range(1, 4)]
+        expected += list(zip(t_warm, v_warm))
+        db.write_batch(
+            "default", [sid] * 3, np.array(t_warm), np.array(v_warm),
+            now_nanos=now1,
+        )
+
+        # Cold write landing back in flushed block 0 (pending, unflushed).
+        t_cold = [T0 + 7 * 10 * SEC]
+        v_cold = [7.75]
+        expected += list(zip(t_cold, v_cold))
+        db.write_batch(
+            "default", [sid], np.array(t_cold), np.array(v_cold),
+            now_nanos=now1,
+        )
+
+        got = db.read("default", sid, T0, T0 + 2 * BLOCK)
+        assert got == sorted(expected)  # every point once, bit-identical
+
+        # After cold flush the same read returns the same answer.
+        db.tick(now1 + SEC)
+        assert db.read("default", sid, T0, T0 + 2 * BLOCK) == sorted(expected)
+
+    def test_duplicate_timestamp_last_write_wins(self, tmp_path):
+        db = self._db(tmp_path)
+        sid = b"dup"
+        t = T0 + 10 * SEC
+        db.write_batch("default", [sid], np.array([t]), np.array([1.0]))
+        now1 = T0 + BLOCK + 11 * 60 * SEC
+        db.tick(now1)  # flushes value 1.0
+        # Cold overwrite of the same timestamp.
+        db.write_batch("default", [sid], np.array([t]), np.array([2.0]),
+                       now_nanos=now1)
+        assert db.read("default", sid, T0, T0 + BLOCK) == [(t, 2.0)]
+
+
+class TestFanout:
+    class _FakeStorage:
+        """Storage stub returning a fixed per-series point list."""
+
+        def __init__(self, pts_by_tags):
+            self.pts_by_tags = pts_by_tags
+            self.calls = 0
+
+        def fetch_raw(self, name, matchers, start, end):
+            from m3_tpu.query.block import RawBlock
+
+            self.calls += 1
+            metas = [SeriesMeta(k) for k in sorted(self.pts_by_tags)]
+            pts = [
+                [(t, v) for t, v in self.pts_by_tags[m.tags]
+                 if start <= t < end]
+                for m in metas
+            ]
+            return RawBlock.from_lists(pts, metas)
+
+    def test_fast_path_single_covering_source(self):
+        tags = ((b"__name__", b"m"),)
+        fine = self._FakeStorage({tags: [(T0 + MIN, 1.0)]})
+        coarse = self._FakeStorage({tags: [(T0 + MIN, 9.0)]})
+        f = FanoutStorage([
+            FanoutSource(fine, 10 * SEC, 48 * HOUR),
+            FanoutSource(coarse, MIN, 30 * 24 * HOUR),
+        ])
+        blk = f.fetch_raw(b"m", (), T0, T0 + HOUR, now_nanos=T0 + HOUR)
+        assert fine.calls == 1 and coarse.calls == 0
+        assert blk.values[0, 0] == 1.0
+
+    def test_window_past_fine_retention_merges_coarse(self):
+        """Query starts beyond the raw namespace's retention: both
+        sources consulted; fine resolution wins where both have data,
+        coarse fills the old end."""
+        tags = ((b"__name__", b"m"),)
+        t_recent = T0 + 40 * HOUR
+        t_ancient = T0 + HOUR
+        fine = self._FakeStorage({tags: [(t_recent, 1.5)]})
+        coarse = self._FakeStorage(
+            {tags: [(t_ancient, 9.0), (t_recent, 9.5)]}
+        )
+        f = FanoutStorage([
+            FanoutSource(fine, 10 * SEC, 24 * HOUR),
+            FanoutSource(coarse, MIN, 365 * 24 * HOUR),
+        ])
+        now = T0 + 41 * HOUR
+        blk = f.fetch_raw(b"m", (), T0, now, now_nanos=now)
+        assert fine.calls == 1 and coarse.calls == 1
+        c = int(blk.counts[0])
+        pts = list(zip(blk.ts[0, :c].tolist(), blk.values[0, :c].tolist()))
+        # ancient point from coarse; recent point prefers fine (1.5).
+        assert pts == [(t_ancient, 9.0), (t_recent, 1.5)]
+
+    def test_band_partition_no_cross_resolution_interleave(self):
+        """Coarse samples inside the fine-covered band are excluded even
+        when their timestamps don't collide with fine samples."""
+        tags = ((b"__name__", b"m"),)
+        now = T0 + 48 * HOUR
+        t_fine = now - HOUR + 10 * SEC  # within fine retention
+        t_coarse_recent = now - HOUR + 30 * SEC  # also recent, 1m-aligned
+        t_old = T0 + HOUR  # beyond fine retention
+        fine = self._FakeStorage({tags: [(t_fine, 1.0)]})
+        coarse = self._FakeStorage(
+            {tags: [(t_old, 8.0), (t_coarse_recent, 9.0)]}
+        )
+        f = FanoutStorage([
+            FanoutSource(fine, 10 * SEC, 24 * HOUR),
+            FanoutSource(coarse, MIN, 365 * 24 * HOUR),
+        ])
+        blk = f.fetch_raw(b"m", (), T0, now, now_nanos=now)
+        c = int(blk.counts[0])
+        pts = list(zip(blk.ts[0, :c].tolist(), blk.values[0, :c].tolist()))
+        # coarse's recent point (9.0) must NOT appear: its band ends
+        # where fine's retention starts.
+        assert pts == [(t_old, 8.0), (t_fine, 1.0)]
+
+    def test_wallclock_now_default_protects_historical_queries(self):
+        """With no explicit now, retention is measured from wall-clock
+        now — a short window far in the past must route to the coarse
+        source that still retains it, not the raw one that doesn't."""
+        tags = ((b"__name__", b"m"),)
+        now = T0 + 100 * 24 * HOUR
+        t_old = T0 + HOUR
+        fine = self._FakeStorage({tags: []})
+        coarse = self._FakeStorage({tags: [(t_old, 5.0)]})
+        f = FanoutStorage(
+            [
+                FanoutSource(fine, 10 * SEC, 48 * HOUR),
+                FanoutSource(coarse, MIN, 365 * 24 * HOUR),
+            ],
+            now_fn=lambda: now,
+        )
+        blk = f.fetch_raw(b"m", (), T0, T0 + 2 * HOUR)  # no now passed
+        assert fine.calls == 0 and coarse.calls == 1
+        assert blk.values[0, 0] == 5.0
+
+    def test_session_storage_over_replicas(self, tmp_path):
+        """Engine reads through the replicated session return each point
+        once even though three replicas hold it."""
+        from m3_tpu.index.doc import Document
+
+        dbs = {
+            f"i{k}": Database(
+                DatabaseOptions(root=str(tmp_path / f"i{k}"),
+                                commitlog_enabled=False),
+                namespaces={"default": NamespaceOptions(
+                    num_shards=2, slot_capacity=64, sample_capacity=512)},
+            )
+            for k in range(3)
+        }
+        p = initial_placement([Instance(i) for i in dbs], num_shards=2, rf=3)
+        s = ReplicatedSession(p, dbs, write_level=ConsistencyLevel.ALL)
+        docs = [
+            Document.from_tags(
+                b"up{job=api}", {b"__name__": b"up", b"job": b"api"}
+            )
+        ]
+        ts = np.array([T0 + 10 * SEC, T0 + 20 * SEC])
+        for t in ts:
+            s.write_tagged_batch("default", docs, np.array([t]),
+                                 np.array([1.0]))
+        blk = SessionStorage(s).fetch_raw(b"up", (), T0, T0 + HOUR)
+        assert blk.counts.tolist() == [2]
+        assert blk.ts[0, :2].tolist() == ts.tolist()
