@@ -124,7 +124,9 @@ def _run_stage(S: int, T: int) -> float:
         # integer-emulated division (f64_emul.int_div_pow10) matches the
         # reference's IEEE `float64(v) / multiplier` bit-for-bit.
         ibits = fe.int_div_pow10(payload.astype(jnp.int64), mult)
-        vbits = jnp.where(isf, payload, ibits)
+        # where(uint64, int64) would value-promote both sides to float64 and
+        # destroy the bit patterns; reinterpret to a common dtype first.
+        vbits = jnp.where(isf, payload, jax.lax.bitcast_convert_type(ibits, jnp.uint64))
         return ts, jax.lax.bitcast_convert_type(vbits, jnp.float64), meta, err | prec
 
     ts, vals, starts = _make_corpus(S, T)

@@ -84,6 +84,49 @@ class NamespaceIndex:
             bs = int(f.stem.split("-")[1])
             self.sealed[bs] = SealedSegment.from_bytes(f.read_bytes())
 
+    def snapshot_mutable(self, snap_root: str) -> int:
+        """Persist a sealed VIEW of every mutable segment under
+        `snap_root` without sealing it — the index half of a buffer
+        snapshot (the reference's commitlog bootstrapper re-indexes from
+        WAL metadata; covered logs are cleaned once snapshotted, so the
+        snapshot must carry the un-flushed index state too)."""
+        written = 0
+        for bs, m in self.mutable.items():
+            if len(m) == 0:
+                continue
+            p = (
+                Path(snap_root) / "index" / self.namespace / f"segment-{bs}.db"
+            )
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_bytes(m.seal().to_bytes())
+            written += 1
+        return written
+
+    def restore_snapshot(self, snap_root: str) -> int:
+        """Install snapshot index segments as sealed segments (merging
+        with any already-sealed block).  Restored segments are re-persisted
+        under the MAIN root immediately: the covering snapshot (and the
+        WAL that carried the tags) may be cleaned up before this block
+        ever seals again, so the main index dir must be durable now."""
+        from m3_tpu.index.segment import merge_segments
+
+        d = Path(snap_root) / "index" / self.namespace
+        if not d.exists():
+            return 0
+        n = 0
+        for f in d.glob("segment-*.db"):
+            bs = int(f.stem.split("-")[1])
+            seg = SealedSegment.from_bytes(f.read_bytes())
+            if bs in self.sealed:
+                seg = merge_segments([self.sealed[bs], seg])
+            self.sealed[bs] = seg
+            if self.root is not None:
+                p = self._seg_path(bs)
+                p.parent.mkdir(parents=True, exist_ok=True)
+                p.write_bytes(seg.to_bytes())
+            n += 1
+        return n
+
     # -- query path --------------------------------------------------------
 
     def query(self, q: Query, start_nanos: int, end_nanos: int) -> list[Document]:

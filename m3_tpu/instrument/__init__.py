@@ -1,0 +1,268 @@
+"""Instrumentation substrate: metrics scopes + structured logging.
+
+Equivalent of the reference's `src/x/instrument` (`instrument.Options`
+bundling a tally metrics scope and a zap logger, threaded through every
+subsystem — e.g. `storage/mediator.go:58-72` defines a *Metrics struct of
+counters/timers; `aggregator/aggregator/map.go` likewise).  Tally's
+reporter plumbing collapses to an in-process registry that renders the
+Prometheus text exposition format — the reference's most common reporter
+— served by the HTTP API's /metrics handler.
+
+Design: a `Scope` is (prefix, tags); instruments are interned in one
+process-wide registry keyed by (full name, sorted tags) so concurrent
+subsystems share counters, exactly like tally scope reuse.  All mutation
+is lock-protected and O(1); timers keep bounded reservoirs for quantile
+summaries rather than unbounded sample lists.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from typing import Dict, Iterator, Tuple
+
+__all__ = [
+    "Counter", "Gauge", "Timer", "Scope", "Registry",
+    "root_scope", "new_registry", "logger",
+]
+
+_TagKey = Tuple[Tuple[str, str], ...]
+
+
+class Counter:
+    __slots__ = ("_v", "_lock")
+
+    def __init__(self):
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def inc(self, delta: int = 1) -> None:
+        with self._lock:
+            self._v += delta
+
+    @property
+    def value(self) -> int:
+        return self._v
+
+
+class Gauge:
+    __slots__ = ("_v", "_lock")
+
+    def __init__(self):
+        self._v = 0.0
+        self._lock = threading.Lock()
+
+    def update(self, value: float) -> None:
+        with self._lock:
+            self._v = float(value)
+
+    @property
+    def value(self) -> float:
+        return self._v
+
+
+class Timer:
+    """Duration recorder with a fixed-size uniform reservoir (Vitter's
+    algorithm R) — bounded memory, usable p50/p95/p99 summaries."""
+
+    __slots__ = ("_count", "_sum", "_max", "_reservoir", "_cap", "_lock", "_rng")
+
+    def __init__(self, reservoir: int = 512):
+        self._count = 0
+        self._sum = 0.0
+        self._max = 0.0
+        self._reservoir: list[float] = []
+        self._cap = reservoir
+        self._lock = threading.Lock()
+        self._rng = random.Random(1315)
+
+    def record(self, seconds: float) -> None:
+        with self._lock:
+            self._count += 1
+            self._sum += seconds
+            self._max = max(self._max, seconds)
+            if len(self._reservoir) < self._cap:
+                self._reservoir.append(seconds)
+            else:
+                j = self._rng.randrange(self._count)
+                if j < self._cap:
+                    self._reservoir[j] = seconds
+
+    def time(self) -> "_TimerCtx":
+        return _TimerCtx(self)
+
+    def summary(self) -> dict:
+        with self._lock:
+            if not self._count:
+                return {"count": 0, "sum": 0.0, "max": 0.0}
+            s = sorted(self._reservoir)
+            q = lambda p: s[min(len(s) - 1, int(p * len(s)))]
+            return {
+                "count": self._count, "sum": self._sum, "max": self._max,
+                "p50": q(0.50), "p95": q(0.95), "p99": q(0.99),
+            }
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+
+class _TimerCtx:
+    __slots__ = ("_t", "_start")
+
+    def __init__(self, t: Timer):
+        self._t = t
+
+    def __enter__(self):
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._t.record(time.perf_counter() - self._start)
+        return False
+
+
+class Registry:
+    """Process-wide instrument store; scopes are views into it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, _TagKey], Counter] = {}
+        self._gauges: Dict[Tuple[str, _TagKey], Gauge] = {}
+        self._timers: Dict[Tuple[str, _TagKey], Timer] = {}
+
+    def _get(self, store: dict, name: str, tags: _TagKey, factory):
+        with self._lock:
+            inst = store.get((name, tags))
+            if inst is None:
+                inst = store[(name, tags)] = factory()
+            return inst
+
+    # -- introspection ----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """{metric_name: value-or-summary} with tags rendered inline."""
+        out = {}
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            timers = dict(self._timers)
+        for (name, tags), c in counters.items():
+            out[_render_name(name, tags)] = c.value
+        for (name, tags), g in gauges.items():
+            out[_render_name(name, tags)] = g.value
+        for (name, tags), t in timers.items():
+            out[_render_name(name, tags)] = t.summary()
+        return out
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition (the /metrics payload)."""
+        lines = []
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            timers = dict(self._timers)
+        for (name, tags), c in sorted(counters.items()):
+            lines.append(f"{_prom_name(name, tags)} {c.value}")
+        for (name, tags), g in sorted(gauges.items()):
+            lines.append(f"{_prom_name(name, tags)} {g.value}")
+        for (name, tags), t in sorted(timers.items()):
+            s = t.summary()
+            base, lbl = name.replace(".", "_"), _prom_labels(tags)
+            lines.append(f"{base}_count{lbl} {s['count']}")
+            lines.append(f"{base}_sum{lbl} {s['sum']}")
+            for q in ("p50", "p95", "p99"):
+                if q in s:
+                    ql = _prom_labels(tags + (("quantile", q[1:]),))
+                    lines.append(f"{base}{ql} {s[q]}")
+        return "\n".join(lines) + "\n"
+
+    def scope(self, prefix: str = "", tags: dict | None = None) -> "Scope":
+        return Scope(self, prefix, tuple(sorted((tags or {}).items())))
+
+
+def _render_name(name: str, tags: _TagKey) -> str:
+    if not tags:
+        return name
+    return name + "{" + ",".join(f"{k}={v}" for k, v in tags) + "}"
+
+
+def _prom_labels(tags) -> str:
+    if not tags:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in tags) + "}"
+
+
+def _prom_name(name: str, tags: _TagKey) -> str:
+    return name.replace(".", "_") + _prom_labels(tags)
+
+
+class Scope:
+    """A (prefix, tags) view — `scope("db").counter("writes")` interns
+    db.writes in the registry (tally subscope semantics)."""
+
+    __slots__ = ("_reg", "_prefix", "_tags")
+
+    def __init__(self, registry: Registry, prefix: str, tags: _TagKey):
+        self._reg = registry
+        self._prefix = prefix
+        self._tags = tags
+
+    def _full(self, name: str) -> str:
+        return f"{self._prefix}.{name}" if self._prefix else name
+
+    def counter(self, name: str) -> Counter:
+        return self._reg._get(self._reg._counters, self._full(name), self._tags, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._reg._get(self._reg._gauges, self._full(name), self._tags, Gauge)
+
+    def timer(self, name: str) -> Timer:
+        return self._reg._get(self._reg._timers, self._full(name), self._tags, Timer)
+
+    def subscope(self, name: str) -> "Scope":
+        return Scope(self._reg, self._full(name), self._tags)
+
+    # Mediator and friends accept an `instrument` object exposing .scope()
+    def scope(self, name: str) -> "Scope":
+        return self.subscope(name)
+
+    def tagged(self, tags: dict) -> "Scope":
+        merged = dict(self._tags)
+        merged.update(tags)
+        return Scope(self._reg, self._prefix, tuple(sorted(merged.items())))
+
+    @property
+    def registry(self) -> Registry:
+        return self._reg
+
+
+_GLOBAL = Registry()
+
+
+def new_registry() -> Registry:
+    return Registry()
+
+
+def root_scope(prefix: str = "", tags: dict | None = None) -> Scope:
+    """The process-global scope (the reference's instrument.Options
+    default); tests build isolated registries via new_registry()."""
+    return _GLOBAL.scope(prefix, tags)
+
+
+def logger(name: str) -> logging.Logger:
+    """Structured logger (zap-equivalent): stdlib logging with a
+    consistent format, configured once."""
+    log = logging.getLogger(f"m3_tpu.{name}" if name else "m3_tpu")
+    root = logging.getLogger("m3_tpu")
+    if not root.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s %(name)s %(message)s"
+        ))
+        root.addHandler(h)
+        root.setLevel(logging.INFO)
+        root.propagate = False
+    return log

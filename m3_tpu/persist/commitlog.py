@@ -73,6 +73,11 @@ class CommitLogWriter:
         return max(seqs, default=-1) + 1
 
     @property
+    def seq(self) -> int:
+        """Sequence number of the ACTIVE log file."""
+        return self._seq
+
+    @property
     def path(self) -> Path:
         return self.dir / f"commitlog-{self._seq}.db"
 
@@ -159,8 +164,13 @@ def read_commitlog(path) -> Iterator[CommitLogEntry]:
             yield CommitLogEntry(sid, ts, val, unit, ann, ns)
 
 
+def commitlog_seq(path) -> int:
+    """Sequence number encoded in a commitlog filename."""
+    return int(Path(path).stem.split("-")[1])
+
+
 def list_commitlogs(root) -> list[Path]:
     d = Path(root) / "commitlogs"
     if not d.exists():
         return []
-    return sorted(d.glob("commitlog-*.db"), key=lambda p: int(p.stem.split("-")[1]))
+    return sorted(d.glob("commitlog-*.db"), key=commitlog_seq)

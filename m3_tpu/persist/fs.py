@@ -208,6 +208,29 @@ class DataFileSetReader:
         return len(self._index)
 
 
+def list_fileset_volumes(root, namespace: str, shard: int) -> list[tuple[int, int]]:
+    """EVERY checkpointed (block_start, volume) pair, including superseded
+    volumes — the cleanup path's view (reference files.go enumerates all
+    volumes; cleanup.go deletes out-of-retention and past-volume sets)."""
+    d = fileset_dir(root, namespace, shard)
+    if not d.exists():
+        return []
+    out = []
+    for f in d.glob("fileset-*-checkpoint.db"):
+        parts = f.stem.split("-")
+        out.append((int(parts[1]), int(parts[2])))
+    return sorted(out)
+
+
+def remove_fileset(root, namespace: str, shard: int, block_start: int, volume: int) -> None:
+    """Delete one fileset volume, checkpoint FIRST so a crash mid-delete
+    leaves an invisible (not half-readable) fileset."""
+    for t in ("checkpoint", "digest") + FILE_TYPES:
+        fileset_path(root, namespace, shard, block_start, volume, t).unlink(
+            missing_ok=True
+        )
+
+
 def list_filesets(root, namespace: str, shard: int) -> list[tuple[int, int]]:
     """(block_start, volume) pairs with a checkpoint present, sorted;
     only the max volume per block is returned (reference files.go

@@ -1,0 +1,98 @@
+"""Config-driven server assembly: one call from NodeConfig to a running
+node.
+
+Equivalent of the reference's monolithic startup
+(`src/dbnode/server/server.go:171 Run`: config → pools → topology →
+storage.NewDatabase → servers → bootstrap; and the query side
+`src/query/server/query.go:195`): build the instrument registry, the
+Database (with namespaces from config), bootstrap it, open the mediator
+loop, and serve the HTTP API.  `Assembly.close()` tears down in reverse
+order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from m3_tpu import instrument
+from m3_tpu.core.config import NodeConfig, load_config, parse_duration
+from m3_tpu.server.http_api import ApiContext, serve_background
+from m3_tpu.storage.database import Database, DatabaseOptions, NamespaceOptions
+from m3_tpu.storage.mediator import Mediator
+
+
+@dataclasses.dataclass
+class Assembly:
+    config: NodeConfig
+    registry: "instrument.Registry"
+    db: Database
+    mediator: Mediator | None
+    http_server: object | None
+
+    @property
+    def port(self) -> int | None:
+        return self.http_server.server_address[1] if self.http_server else None
+
+    def close(self) -> None:
+        if self.http_server is not None:
+            self.http_server.shutdown()
+            self.http_server.server_close()
+        if self.mediator is not None:
+            self.mediator.close()
+        self.db.close()
+
+
+def namespace_options(ns_cfg) -> NamespaceOptions:
+    return NamespaceOptions(
+        block_size_nanos=parse_duration(ns_cfg.block_size),
+        retention_nanos=parse_duration(ns_cfg.retention),
+        buffer_past_nanos=parse_duration(ns_cfg.buffer_past),
+        buffer_future_nanos=parse_duration(ns_cfg.buffer_future),
+        cold_writes_enabled=ns_cfg.cold_writes_enabled,
+        num_shards=ns_cfg.num_shards,
+    )
+
+
+def run_node(source, start_mediator: bool | None = None,
+             serve_http: bool = True) -> Assembly:
+    """Boot a node from a YAML path/string or a NodeConfig.
+
+    Mirrors server.Run's order: config validate → storage → bootstrap →
+    background maintenance → front door.
+    """
+    cfg = source if isinstance(source, NodeConfig) else load_config(source)
+    cfg.validate()
+    registry = instrument.new_registry()
+    scope = registry.scope(cfg.metrics_prefix)
+
+    db = Database(
+        DatabaseOptions(
+            root=cfg.db.root, commitlog_enabled=cfg.db.commitlog_enabled
+        ),
+        namespaces={
+            name: namespace_options(ns) for name, ns in cfg.db.namespaces.items()
+        },
+        instrument=scope,
+    )
+    db.bootstrap()
+
+    mediator = None
+    if cfg.mediator.enabled if start_mediator is None else start_mediator:
+        mediator = Mediator(
+            db,
+            tick_interval_s=parse_duration(cfg.mediator.tick_interval) / 1e9,
+            snapshot_every=cfg.mediator.snapshot_every,
+            cleanup_every=cfg.mediator.cleanup_every,
+            instrument=scope,
+        )
+        mediator.open()
+
+    http_server = None
+    if serve_http and cfg.coordinator is not None:
+        ctx = ApiContext(
+            db, namespace=cfg.coordinator.namespace, registry=registry
+        )
+        http_server = serve_background(
+            ctx, cfg.coordinator.listen_host, cfg.coordinator.listen_port
+        )
+    return Assembly(cfg, registry, db, mediator, http_server)

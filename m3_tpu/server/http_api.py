@@ -74,6 +74,8 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if u.path == "/health":
                 return self._json(200, {"ok": True})
+            if u.path == "/metrics":
+                return self._metrics()
             if u.path in ("/api/v1/query_range", "/api/v1/query"):
                 return self._query(u.path.endswith("query_range"), q)
             if u.path == "/api/v1/labels":
@@ -100,6 +102,19 @@ class _Handler(BaseHTTPRequestHandler):
             return self._error(400, str(e))
 
     # -- handlers ----------------------------------------------------------
+
+    def _metrics(self):
+        """Prometheus text exposition of the process registry (reference
+        x/instrument tally prometheus reporter + x/debug introspection)."""
+        reg = self.ctx.registry
+        if reg is None:
+            return self._error(404, "no instrument registry configured")
+        data = reg.render_prometheus().encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
 
     def _write_json(self):
         """reference api/v1/json/write: one sample or a list of
@@ -199,10 +214,11 @@ def _fmt(v: float) -> str:
 
 class ApiContext:
     def __init__(self, db: Database, namespace: str = "default",
-                 downsampler=None):
+                 downsampler=None, registry=None):
         self.db = db
         self.namespace = namespace
         self.downsampler = downsampler
+        self.registry = registry
         self.engine = Engine(DatabaseStorage(db, namespace))
 
 

@@ -103,6 +103,18 @@ def buffer_drain(state: BufferState, window: jnp.ndarray):
     return s_slot, s_ts, s_val, first
 
 
+def dedupe_last_write_wins(slots: np.ndarray, ts: np.ndarray, vals: np.ndarray):
+    """Sort by (slot, ts) and keep the LAST-arriving sample per (slot, ts)
+    — the one merge rule every host-side path shares (cold drain,
+    snapshot merge), mirroring the device path in `buffer_drain`."""
+    arrival = np.arange(len(slots))
+    order = np.lexsort((-arrival, ts, slots))
+    slots, ts, vals = slots[order], ts[order], vals[order]
+    first = np.ones(len(slots), bool)
+    first[1:] = (slots[1:] != slots[:-1]) | (ts[1:] != ts[:-1])
+    return slots[first], ts[first], vals[first]
+
+
 class ShardBuffer:
     """Host wrapper owning one shard's buffer ring + overflow lists."""
 
@@ -202,13 +214,20 @@ class ShardBuffer:
         slots = np.concatenate([p[0] for p in parts]).astype(np.int32)
         ts = np.concatenate([p[1] for p in parts]).astype(np.int64)
         vals = np.concatenate([p[2] for p in parts]).astype(np.float64)
-        # last arrival wins on duplicate (slot, ts)
-        arrival = np.arange(len(slots))
-        order = np.lexsort((-arrival, ts, slots))
-        slots, ts, vals = slots[order], ts[order], vals[order]
-        first = np.ones(len(slots), bool)
-        first[1:] = (slots[1:] != slots[:-1]) | (ts[1:] != ts[:-1])
-        return slots[first], ts[first], vals[first]
+        return dedupe_last_write_wins(slots, ts, vals)
+
+    def peek(self, block_start: int):
+        """Non-destructive drain of one open window: (slots, ts, vals)
+        sorted+deduped, state untouched — the snapshot read
+        (reference buffer.go:537 Snapshot streams the open buckets
+        without evicting them)."""
+        row = self.open_blocks.get(block_start)
+        if row is None:
+            return (np.empty(0, np.int32), np.empty(0, np.int64), np.empty(0))
+        s_slot, s_ts, s_val, first = buffer_drain(self.state, jnp.int32(row))
+        s_slot = np.asarray(s_slot)
+        keep = np.asarray(first) & (s_slot < self.slot_capacity)
+        return s_slot[keep], np.asarray(s_ts)[keep], np.asarray(s_val)[keep]
 
     def read_window(self, block_start: int, slot: int):
         """Read one series' points from an open (unsealed) block — the

@@ -23,6 +23,7 @@ open in-memory window — the same two-source merge the reference does with
 from __future__ import annotations
 
 import dataclasses
+import threading
 from pathlib import Path
 
 from m3_tpu.core.hash import shard_for as hash_shard_for
@@ -31,14 +32,21 @@ from typing import Dict, Iterable, List, Sequence
 import numpy as np
 
 from m3_tpu.core.slots import SlotAllocator
-from m3_tpu.index.doc import Document
+from m3_tpu.index.doc import Document, decode_tags, encode_tags
 from m3_tpu.index.namespace_index import NamespaceIndex
 from m3_tpu.index.search import Query
 from m3_tpu.encoding.m3tsz import decode_series, encode_series
 from m3_tpu.encoding.m3tsz_jax import decode_batch, encode_batch
-from m3_tpu.persist.commitlog import CommitLogWriter, list_commitlogs, read_commitlog
-from m3_tpu.persist.fs import DataFileSetReader, DataFileSetWriter, list_filesets
-from m3_tpu.storage.buffer import ShardBuffer
+from m3_tpu.persist.commitlog import (
+    CommitLogEntry, CommitLogWriter, commitlog_seq, list_commitlogs,
+    read_commitlog,
+)
+from m3_tpu.persist.fs import (
+    DataFileSetReader, DataFileSetWriter, list_fileset_volumes, list_filesets,
+    remove_fileset,
+)
+from m3_tpu.persist import snapshot as snap
+from m3_tpu.storage.buffer import ShardBuffer, dedupe_last_write_wins
 from m3_tpu.storage.series_merge import merge_point_sources
 
 
@@ -190,6 +198,30 @@ class Shard:
             flushed += len(series)
         return flushed
 
+    def snapshot_blocks(self, snap_root: str) -> int:
+        """Persist every un-flushed block (open warm window + pending cold
+        overflow) as a snapshot fileset under `snap_root` without touching
+        the live buffers (reference buffer.go:537 Snapshot).  Returns
+        series-blocks written."""
+        written = 0
+        for bs in sorted(set(self.buffer.open_blocks) | set(self.buffer.cold)):
+            slots, ts, vals = self.buffer.peek(bs)
+            parts = self.buffer.cold.get(bs, ())
+            if len(parts):
+                slots = np.concatenate([slots] + [p[0] for p in parts]).astype(np.int32)
+                ts = np.concatenate([ts] + [p[1] for p in parts]).astype(np.int64)
+                vals = np.concatenate([vals] + [p[2] for p in parts]).astype(np.float64)
+                slots, ts, vals = dedupe_last_write_wins(slots, ts, vals)
+            if len(slots) == 0:
+                continue
+            series = self._encode_runs(slots, ts, vals, bs)
+            DataFileSetWriter(
+                snap_root, self.namespace, self.shard_id, bs,
+                self.opts.block_size_nanos, volume=0,
+            ).write_all(series)
+            written += len(series)
+        return written
+
     # -- read path ---------------------------------------------------------
 
     def read_sources(
@@ -301,8 +333,18 @@ class Database:
     `Write` :739, `ReadEncoded` via namespaces, `Bootstrap` :1199)."""
 
     def __init__(self, opts: DatabaseOptions | None = None,
-                 namespaces: Dict[str, NamespaceOptions] | None = None):
+                 namespaces: Dict[str, NamespaceOptions] | None = None,
+                 instrument=None):
         self.opts = opts or DatabaseOptions()
+        self._scope = instrument.scope("db") if instrument is not None else None
+        # One engine-wide reentrant lock serializing state mutation:
+        # ingest batches (HTTP threads), the mediator's tick/snapshot/
+        # cleanup thread, bootstrap, and reads that walk buffer state.
+        # The reference uses fine-grained per-shard/series locks
+        # (shard.go RLock ladders); here every operation is already a
+        # whole-batch array program, so one coarse lock adds no
+        # meaningful serialization beyond what the batched design has.
+        self._mu = threading.RLock()
         Path(self.opts.root).mkdir(parents=True, exist_ok=True)
         self.namespaces: Dict[str, Namespace] = {}
         for name, nopts in (namespaces or {"default": NamespaceOptions()}).items():
@@ -331,10 +373,13 @@ class Database:
         vals = np.asarray(vals, np.float64)
         if now_nanos is None:
             now_nanos = int(ts.max())
-        if self.commitlog is not None:
-            self.commitlog.write_batch(list(ids), ts, vals,
-                                       namespace=namespace.encode())
-        return ns.write_batch(ids, ts, vals, now_nanos)
+        with self._mu:
+            if self.commitlog is not None:
+                self.commitlog.write_batch(list(ids), ts, vals,
+                                           namespace=namespace.encode())
+            if self._scope is not None:
+                self._scope.counter("writes").inc(len(ids))
+            return ns.write_batch(ids, ts, vals, now_nanos)
 
     def write_tagged_batch(self, namespace: str, docs: Sequence[Document], ts, vals,
                            now_nanos: int | None = None) -> int:
@@ -343,57 +388,198 @@ class Database:
         vals = np.asarray(vals, np.float64)
         if now_nanos is None:
             now_nanos = int(ts.max())
-        if self.commitlog is not None:
-            self.commitlog.write_batch([d.id for d in docs], ts, vals,
-                                       namespace=namespace.encode())
-        return ns.write_tagged_batch(docs, ts, vals, now_nanos)
+        with self._mu:
+            if self.commitlog is not None:
+                # Tags ride the annotation field so WAL replay can rebuild
+                # index documents (the reference's commitlog entries carry
+                # the series metadata for the same reason).
+                self.commitlog.write_batch(
+                    [d.id for d in docs], ts, vals, namespace=namespace.encode(),
+                    annotations=[encode_tags(d) for d in docs],
+                )
+            if self._scope is not None:
+                self._scope.counter("writes_tagged").inc(len(docs))
+            return ns.write_tagged_batch(docs, ts, vals, now_nanos)
 
     def query_ids(self, namespace: str, q: Query, start: int, end: int):
-        return self.namespaces[namespace].query_ids(q, start, end)
+        with self._mu:
+            return self.namespaces[namespace].query_ids(q, start, end)
 
     def read(self, namespace: str, sid: bytes, start: int, end: int):
-        return self.namespaces[namespace].read(sid, start, end)
+        if self._scope is not None:
+            self._scope.counter("reads").inc()
+        with self._mu:
+            return self.namespaces[namespace].read(sid, start, end)
 
     def tick(self, now_nanos: int) -> dict:
-        stats = {}
-        for name, ns in self.namespaces.items():
-            stats[name] = ns.tick(now_nanos)
+        with self._mu:
+            stats = {}
+            for name, ns in self.namespaces.items():
+                stats[name] = ns.tick(now_nanos)
+            return stats
+
+    def snapshot(self) -> dict:
+        """Capture every namespace's un-flushed buffers as snapshot
+        filesets (reference mediator.go:318 runFileSystemProcesses →
+        buffer.Snapshot; metadata commit gates visibility).  The commit
+        log rotates first so the snapshot covers everything in the
+        now-inactive logs — recovery then replays only seq >= the active
+        log (`snapshot_metadata_write.go` commitlog-identifier role)."""
+        with self._mu:
+            seq = snap.next_snapshot_seq(self.opts.root)
+            if self.commitlog is not None:
+                self.commitlog.rotate()
+                cl_seq = self.commitlog.seq
+            else:
+                cl_seq = 0
+            snap_root = str(snap.snapshot_data_root(self.opts.root, seq))
+            written = 0
+            index_segs = 0
+            for ns in self.namespaces.values():
+                for shard in ns.shards:
+                    written += shard.snapshot_blocks(snap_root)
+                index_segs += ns.index.snapshot_mutable(snap_root)
+            snap.commit_snapshot(self.opts.root, seq, cl_seq)
+            return {"seq": seq, "series_blocks": written, "index_segments": index_segs}
+
+    def cleanup(self, now_nanos: int) -> dict:
+        """Expired-data cleanup (reference `storage/cleanup.go`):
+        out-of-retention fileset volumes, superseded (non-max) volumes,
+        all-but-latest snapshots, and commitlogs fully covered by the
+        latest snapshot."""
+        stats = {"filesets": 0, "snapshots": 0, "commitlogs": 0}
+        with self._mu:
+            return self._cleanup_locked(now_nanos, stats)
+
+    def _cleanup_locked(self, now_nanos: int, stats: dict) -> dict:
+        for ns in self.namespaces.values():
+            cutoff = now_nanos - ns.opts.retention_nanos - ns.opts.block_size_nanos
+            for shard in ns.shards:
+                vols = list_fileset_volumes(self.opts.root, ns.name, shard.shard_id)
+                max_vol = {}
+                for bs, vol in vols:
+                    max_vol[bs] = max(max_vol.get(bs, -1), vol)
+                for bs, vol in vols:
+                    if bs <= cutoff or vol < max_vol[bs]:
+                        remove_fileset(self.opts.root, ns.name, shard.shard_id, bs, vol)
+                        stats["filesets"] += 1
+                        if bs <= cutoff:
+                            shard.flushed_blocks.discard(bs)
+        stats["snapshots"] = snap.prune_snapshots(self.opts.root, keep=1)
+        latest = snap.latest_snapshot(self.opts.root)
+        if latest is not None:
+            for log in list_commitlogs(self.opts.root):
+                if self.commitlog is not None and log == self.commitlog.path:
+                    continue
+                if commitlog_seq(log) < latest.commitlog_seq:
+                    log.unlink(missing_ok=True)
+                    stats["commitlogs"] += 1
         return stats
 
+    def _replay_entries(self, name: str, entries: list) -> int:
+        """Write recovered entries into a namespace's buffers, skipping
+        blocks already covered by a checkpointed fileset (the fs
+        bootstrapper's unfulfilled-ranges rule).  Entries whose
+        annotation carries encoded tags re-index their document too, so
+        recovery rebuilds the (unsealed) reverse index.  Never re-logs."""
+        ns = self.namespaces.get(name)
+        if ns is None:
+            return 0
+        ts = np.asarray([e.timestamp for e in entries], np.int64)
+        vals = np.asarray([e.value for e in entries], np.float64)
+        ids = [e.series_id for e in entries]
+        keep = np.ones(len(ts), bool)
+        # Lazy cache of fileset contents for flushed blocks touched by
+        # recovery: a point already in the fileset is a duplicate (drop);
+        # a point absent from it is a pending cold write that crashed
+        # before cold_flush — keep it, and write_batch re-routes it cold
+        # because the flushed block is not in open_starts.
+        flushed_pts: Dict[tuple, dict] = {}
+        for i, sid in enumerate(ids):
+            shard_id = shard_for_id(sid, ns.opts.num_shards)
+            sh = ns.shards[shard_id]
+            bs = int(ts[i]) // ns.opts.block_size_nanos * ns.opts.block_size_nanos
+            if bs not in sh.flushed_blocks:
+                continue
+            key = (shard_id, bs)
+            if key not in flushed_pts:
+                per_sid: dict = {}
+                for fbs, vol in list_filesets(self.opts.root, ns.name, shard_id):
+                    if fbs != bs:
+                        continue
+                    r = DataFileSetReader(self.opts.root, ns.name, shard_id, bs, vol)
+                    for fsid, seg in r.read_all():
+                        per_sid[fsid] = {
+                            d.timestamp for d in decode_series(seg)
+                        }
+                flushed_pts[key] = per_sid
+            if int(ts[i]) in flushed_pts[key].get(sid, ()):
+                keep[i] = False
+        if not keep.any():
+            return 0
+        kept = np.nonzero(keep)[0]
+        now = int(ts.max())
+        tagged_idx = []
+        tagged_docs = []
+        for i in kept:
+            ann = entries[i].annotation
+            doc = decode_tags(ids[i], ann) if ann else None
+            if doc is not None:
+                tagged_idx.append(i)
+                tagged_docs.append(doc)
+        if tagged_docs:
+            sel = np.asarray(tagged_idx)
+            ns.write_tagged_batch(tagged_docs, ts[sel], vals[sel], now)
+        tagged_set = set(tagged_idx)
+        plain = [i for i in kept if i not in tagged_set]
+        if plain:
+            sel = np.asarray(plain)
+            ns.write_batch([ids[i] for i in plain], ts[sel], vals[sel], now)
+        return len(kept)
+
     def bootstrap(self) -> dict:
-        """fs → commitlog bootstrap chain (reference
+        """fs → snapshot → commitlog bootstrap chain (reference
         `storage/bootstrap/process.go` + bootstrapper/README.md: filesets
-        first, then WAL replay for whatever isn't in a fileset)."""
+        first, then the latest snapshot, then WAL-tail replay for whatever
+        isn't covered — `bootstrapper/commitlog` reads snapshots + WAL)."""
+        with self._mu:
+            return self._bootstrap_locked()
+
+    def _bootstrap_locked(self) -> dict:
+        restored = 0
+        latest = snap.latest_snapshot(self.opts.root)
+        if latest is not None:
+            snap_root = str(snap.snapshot_data_root(self.opts.root, latest.seq))
+            for name, ns in self.namespaces.items():
+                ns.index.restore_snapshot(snap_root)
+                for shard in ns.shards:
+                    entries: list[CommitLogEntry] = []
+                    for bs, vol in list_filesets(snap_root, name, shard.shard_id):
+                        r = DataFileSetReader(snap_root, name, shard.shard_id, bs, vol)
+                        for sid, seg in r.read_all():
+                            entries.extend(
+                                CommitLogEntry(sid, d.timestamp, d.value,
+                                               namespace=name.encode())
+                                for d in decode_series(seg)
+                            )
+                    if entries:
+                        restored += self._replay_entries(name, entries)
         replayed = 0
+        min_seq = latest.commitlog_seq if latest is not None else -1
         for log in list_commitlogs(self.opts.root):
             if self.commitlog is not None and log == self.commitlog.path:
                 continue
+            if commitlog_seq(log) < min_seq:
+                continue  # fully covered by the snapshot
             per_ns: Dict[str, list] = {}
             for e in read_commitlog(log):
                 per_ns.setdefault(e.namespace.decode(), []).append(e)
             for name, entries in per_ns.items():
-                ns = self.namespaces.get(name)
-                if ns is None:
-                    continue
-                ts = np.asarray([e.timestamp for e in entries], np.int64)
-                vals = np.asarray([e.value for e in entries], np.float64)
-                ids = [e.series_id for e in entries]
-                now = int(ts.max())
-                # Replay skips blocks already covered by a checkpointed
-                # fileset (the fs bootstrapper's unfulfilled-ranges rule).
-                keep = np.ones(len(ts), bool)
-                for i, sid in enumerate(ids):
-                    sh = ns.shards[shard_for_id(sid, ns.opts.num_shards)]
-                    bs = int(ts[i]) // ns.opts.block_size_nanos * ns.opts.block_size_nanos
-                    if bs in sh.flushed_blocks:
-                        keep[i] = False
-                if keep.any():
-                    ids_kept = [ids[i] for i in np.nonzero(keep)[0]]
-                    replayed += len(ids_kept)
-                    ns.write_batch(ids_kept, ts[keep], vals[keep], now)
+                replayed += self._replay_entries(name, entries)
         self.bootstrapped = True
-        return {"commitlog_replayed": replayed}
+        return {"commitlog_replayed": replayed, "snapshot_restored": restored}
 
     def close(self) -> None:
-        if self.commitlog is not None:
-            self.commitlog.close()
+        with self._mu:
+            if self.commitlog is not None:
+                self.commitlog.close()
