@@ -1,0 +1,165 @@
+"""Shard-routed aggregator client: per-instance queues over TCP.
+
+Equivalent of the reference's aggregator client
+(`src/aggregator/client/tcp_client.go` shard-aware routing from the
+placement, `queue.go` per-instance buffered queues, `writer.go`
+encode+flush).  Samples are routed shard = murmur3(id) % num_shards
+(the aggregator's own router), buffered per owning instance, and
+flushed as framed `METRIC_BATCH` payloads by a background writer thread
+(or an explicit `flush()`).
+
+Replica fan-out: every AVAILABLE owner of the shard receives the batch
+(the reference writes to all instances in the shard's replica set —
+mirrored placements — and lets leader election pick the emitter)."""
+
+from __future__ import annotations
+
+import socket
+import threading
+from collections import defaultdict
+from typing import Callable, Dict, Tuple
+
+import numpy as np
+
+from m3_tpu.cluster.placement import Placement, ShardState
+from m3_tpu.core.hash import shard_for
+from m3_tpu.msg import protocol as wire
+
+
+class InstanceQueue:
+    """Buffered samples + a lazily-connected socket for one instance
+    (reference client/queue.go).  Connection errors park the buffer for
+    the next flush (bounded by max_queue_size, drop-oldest)."""
+
+    def __init__(self, address: Tuple[str, int], max_queue_size: int = 1 << 16):
+        self.address = address
+        self.max_queue_size = max_queue_size
+        self._mts: list[int] = []
+        self._ids: list[bytes] = []
+        self._values: list[float] = []
+        self._times: list[int] = []
+        self._sock: socket.socket | None = None
+        self._lock = threading.Lock()
+        self.dropped = 0
+        self.sent = 0
+
+    def enqueue(self, mt: int, mid: bytes, value: float, t: int) -> None:
+        with self._lock:
+            if len(self._ids) >= self.max_queue_size:
+                # drop-oldest (reference queue DropOldest strategy)
+                self._mts.pop(0)
+                self._ids.pop(0)
+                self._values.pop(0)
+                self._times.pop(0)
+                self.dropped += 1
+            self._mts.append(mt)
+            self._ids.append(mid)
+            self._values.append(value)
+            self._times.append(t)
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection(self.address, timeout=5.0)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = s
+        return self._sock
+
+    def flush(self) -> int:
+        with self._lock:
+            if not self._ids:
+                return 0
+            batch = wire.MetricBatch(
+                np.asarray(self._mts, np.uint8), self._ids,
+                np.asarray(self._values, np.float64),
+                np.asarray(self._times, np.int64),
+            )
+            self._mts, self._ids, self._values, self._times = [], [], [], []
+        payload = wire.encode_metric_batch(batch)
+        try:
+            sock = self._connect()
+            wire.send_frame(sock, wire.METRIC_BATCH, payload)
+        except OSError:
+            # park the batch back for the next flush (retry)
+            with self._lock:
+                self._mts = list(batch.metric_types) + self._mts
+                self._ids = list(batch.ids) + self._ids
+                self._values = list(batch.values) + self._values
+                self._times = list(batch.times) + self._times
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+            return 0
+        self.sent += len(batch.ids)
+        return len(batch.ids)
+
+    def close(self) -> None:
+        self.flush()
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+
+
+class AggregatorClient:
+    """Routes each sample to every available owner of its shard.
+
+    resolve(instance_id) -> (host, port) decouples placement identity
+    from addressing (the reference stores the endpoint in the placement
+    instance; tests pass a closure over ephemeral ports)."""
+
+    def __init__(self, placement: Placement,
+                 resolve: Callable[[str], Tuple[str, int]],
+                 flush_interval_s: float = 0.1,
+                 auto_flush: bool = False):
+        self.placement = placement
+        self.resolve = resolve
+        self.queues: Dict[str, InstanceQueue] = {}
+        self._flush_interval = flush_interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        if auto_flush:
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    def _queue_for(self, instance_id: str) -> InstanceQueue:
+        q = self.queues.get(instance_id)
+        if q is None:
+            q = self.queues[instance_id] = InstanceQueue(
+                self.resolve(instance_id)
+            )
+        return q
+
+    def write_untimed(self, mt: int, mid: bytes, value: float, t: int) -> int:
+        """Enqueue to every available owner; returns owners reached."""
+        shard = shard_for(mid, self.placement.num_shards)
+        n = 0
+        for inst in self.placement.instances_for_shard(shard):
+            a = inst.shards[shard]
+            if a.state == ShardState.LEAVING:
+                continue
+            self._queue_for(inst.id).enqueue(mt, mid, value, t)
+            n += 1
+        return n
+
+    def write_batch(self, mts, ids, values, times) -> int:
+        n = 0
+        for i, mid in enumerate(ids):
+            n += self.write_untimed(
+                int(mts[i]), mid, float(values[i]), int(times[i])
+            )
+        return n
+
+    def flush(self) -> int:
+        return sum(q.flush() for q in self.queues.values())
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._flush_interval):
+            self.flush()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+        for q in self.queues.values():
+            q.close()

@@ -151,6 +151,9 @@ _NESTED = {
     "coordinator": CoordinatorConfig,
     "mediator": MediatorConfig,
 }
+# Optional nested sections: an explicit `field: null` disables the
+# subsystem (yields None) instead of instantiating defaults.
+_NESTED_OPTIONAL = {"coordinator"}
 
 
 def _build(cls, data, path: str):
@@ -169,7 +172,10 @@ def _build(cls, data, path: str):
                 for name, nsv in (v or {}).items()
             }
         elif k in _NESTED:
-            kwargs[k] = _build(_NESTED[k], v, f"{path}.{k}")
+            if v is None and k in _NESTED_OPTIONAL:
+                kwargs[k] = None
+            else:
+                kwargs[k] = _build(_NESTED[k], v, f"{path}.{k}")
         else:
             kwargs[k] = v
     return cls(**kwargs)

@@ -173,9 +173,9 @@ class Registry:
             base, lbl = name.replace(".", "_"), _prom_labels(tags)
             lines.append(f"{base}_count{lbl} {s['count']}")
             lines.append(f"{base}_sum{lbl} {s['sum']}")
-            for q in ("p50", "p95", "p99"):
+            for q, frac in (("p50", "0.5"), ("p95", "0.95"), ("p99", "0.99")):
                 if q in s:
-                    ql = _prom_labels(tags + (("quantile", q[1:]),))
+                    ql = _prom_labels(tags + (("quantile", frac),))
                     lines.append(f"{base}{ql} {s[q]}")
         return "\n".join(lines) + "\n"
 

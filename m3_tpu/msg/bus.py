@@ -172,6 +172,16 @@ class MessageBus:
     def _ack(self, service: str, mid: int) -> None:
         if self._inflight[service].pop(mid, None) is not None:
             self.acked += 1
+            return
+        # The message may have been requeued by the retry sweep while the
+        # ack was in flight — an ack by id still settles it (the
+        # reference acks by message metadata, clearing retry queues too).
+        q = self._pending[service]
+        for m in q:
+            if m.id == mid:
+                q.remove(m)
+                self.acked += 1
+                return
 
     # -- retry loop --------------------------------------------------------
 

@@ -1,0 +1,176 @@
+"""Framed binary wire protocol: the socket data plane.
+
+Equivalent of the reference's two TCP wire stacks: the aggregator's
+rawtcp ingest protocol (protobuf `UnaggregatedIterator` loop,
+`src/aggregator/server/rawtcp/server.go:125`, messages encoded by
+`src/metrics/encoding/protobuf/unaggregated_iterator.go`) and m3msg's
+size-prefixed protobuf framing (`src/msg/protocol/proto/encoder.go:49-52`,
+`decoder.go:64`).  Protobuf collapses to struct-packed little-endian
+frames (SURVEY.md §7: msgpack/protobuf wire codecs deliberately do not
+carry over); the framing contract is the same: length prefix, checksum,
+typed payload, resynchronization-free streams.
+
+Frame layout:   [len u32][type u8][crc u32][payload: len bytes]
+                crc = adler32(type byte + payload) — a torn/corrupt frame
+                kills the connection (sender retries), never desyncs.
+
+Payload codecs:
+  METRIC_BATCH  untimed metric batch for aggregator ingest
+  BUS_*         publish/deliver/ack for the message bus transport
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from dataclasses import dataclass
+
+import numpy as np
+
+from m3_tpu.persist.digest import digest
+
+_HDR = struct.Struct("<IBI")
+MAX_FRAME = 64 << 20
+
+# frame types
+METRIC_BATCH = 1
+BUS_HELLO = 2
+BUS_PUBLISH = 3
+BUS_DELIVER = 4
+BUS_ACK = 5
+OK = 6
+ERROR = 7
+
+
+class ProtocolError(ConnectionError):
+    pass
+
+
+def send_frame(sock: socket.socket, ftype: int, payload: bytes) -> None:
+    crc = digest(bytes([ftype]) + payload)
+    sock.sendall(_HDR.pack(len(payload), ftype, crc) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    buf = bytearray()
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except (socket.timeout, TimeoutError):
+            if buf:
+                # A timeout after partial data would desync the stream —
+                # fatal; a timeout at a frame boundary is a clean poll.
+                raise ProtocolError("timeout mid-frame") from None
+            raise
+        if not chunk:
+            return None  # clean EOF only before a frame starts
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_frame(sock: socket.socket) -> tuple[int, bytes] | None:
+    """(type, payload) or None on EOF.  Raises ProtocolError on a torn
+    or corrupt frame — callers drop the connection (the reference's
+    decoder errors close the rawtcp conn the same way)."""
+    hdr = _recv_exact(sock, _HDR.size)
+    if hdr is None:
+        return None
+    plen, ftype, crc = _HDR.unpack(hdr)
+    if plen > MAX_FRAME:
+        raise ProtocolError(f"frame too large: {plen}")
+    payload = _recv_exact(sock, plen)
+    if payload is None:
+        raise ProtocolError("EOF mid-frame")
+    if digest(bytes([ftype]) + payload) != crc:
+        raise ProtocolError("frame checksum mismatch")
+    return ftype, payload
+
+
+# -- metric batch codec (the unaggregated wire form) ------------------------
+
+
+@dataclass(frozen=True)
+class MetricBatch:
+    """One ingest batch: parallel arrays + per-sample metric type.
+
+    metric_types: uint8 array (MetricType values); ids: list of bytes;
+    values/times: float64/int64 arrays; agg_id: compressed aggregation
+    bitmask applied to the whole batch (0 = default per-type)."""
+
+    metric_types: np.ndarray
+    ids: list
+    values: np.ndarray
+    times: np.ndarray
+    agg_id: int = 0
+
+
+def encode_metric_batch(b: MetricBatch) -> bytes:
+    parts = [struct.pack("<IQ", len(b.ids), b.agg_id)]
+    for i, sid in enumerate(b.ids):
+        parts.append(struct.pack("<BH", int(b.metric_types[i]), len(sid)))
+        parts.append(sid)
+        parts.append(struct.pack("<qd", int(b.times[i]), float(b.values[i])))
+    return b"".join(parts)
+
+
+def decode_metric_batch(raw: bytes) -> MetricBatch:
+    n, agg_id = struct.unpack_from("<IQ", raw, 0)
+    pos = 12
+    mts = np.empty(n, np.uint8)
+    ids = []
+    values = np.empty(n, np.float64)
+    times = np.empty(n, np.int64)
+    for i in range(n):
+        mt, idlen = struct.unpack_from("<BH", raw, pos)
+        pos += 3
+        ids.append(raw[pos : pos + idlen])
+        pos += idlen
+        t, v = struct.unpack_from("<qd", raw, pos)
+        pos += 16
+        mts[i] = mt
+        times[i] = t
+        values[i] = v
+    if pos != len(raw):
+        raise ProtocolError("metric batch trailing bytes")
+    return MetricBatch(mts, ids, values, times, agg_id)
+
+
+# -- bus transport payloads -------------------------------------------------
+
+
+def encode_bus_hello(service: str, instance_id: str) -> bytes:
+    s, i = service.encode(), instance_id.encode()
+    return struct.pack("<HH", len(s), len(i)) + s + i
+
+
+def decode_bus_hello(raw: bytes) -> tuple[str, str]:
+    ls, li = struct.unpack_from("<HH", raw, 0)
+    s = raw[4 : 4 + ls].decode()
+    i = raw[4 + ls : 4 + ls + li].decode()
+    return s, i
+
+
+def encode_bus_publish(shard: int, payload: bytes) -> bytes:
+    return struct.pack("<I", shard) + payload
+
+
+def decode_bus_publish(raw: bytes) -> tuple[int, bytes]:
+    (shard,) = struct.unpack_from("<I", raw, 0)
+    return shard, raw[4:]
+
+
+def encode_bus_deliver(mid: int, shard: int, payload: bytes) -> bytes:
+    return struct.pack("<QI", mid, shard) + payload
+
+
+def decode_bus_deliver(raw: bytes) -> tuple[int, int, bytes]:
+    mid, shard = struct.unpack_from("<QI", raw, 0)
+    return mid, shard, raw[12:]
+
+
+def encode_bus_ack(mid: int) -> bytes:
+    return struct.pack("<Q", mid)
+
+
+def decode_bus_ack(raw: bytes) -> int:
+    return struct.unpack_from("<Q", raw, 0)[0]

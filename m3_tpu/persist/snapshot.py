@@ -34,7 +34,7 @@ from pathlib import Path
 from m3_tpu.persist.digest import digest
 
 _META_MAGIC = b"M3TS"
-_META = struct.Struct("<QqI")  # seq, commitlog_seq, checksum-of-first-16
+# record layout: magic (4) + seq u64 + commitlog_seq i64 + adler32-of-first-20
 
 
 def snapshots_root(root) -> Path:

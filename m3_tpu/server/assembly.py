@@ -54,14 +54,24 @@ def namespace_options(ns_cfg) -> NamespaceOptions:
 
 
 def run_node(source, start_mediator: bool | None = None,
-             serve_http: bool = True) -> Assembly:
+             serve_http: bool = True, ruleset=None) -> Assembly:
     """Boot a node from a YAML path/string or a NodeConfig.
 
     Mirrors server.Run's order: config validate → storage → bootstrap →
-    background maintenance → front door.
+    background maintenance → front door.  `ruleset` (a
+    metrics.rules.RuleSet) is required when the coordinator config sets
+    `downsample: true` — rules are programmatic/KV objects in the
+    reference too (`metrics/rules` in etcd), not static YAML.
     """
+    from m3_tpu.core.config import ConfigError
+
     cfg = source if isinstance(source, NodeConfig) else load_config(source)
     cfg.validate()
+    if (cfg.coordinator is not None and cfg.coordinator.downsample
+            and ruleset is None):
+        raise ConfigError(
+            "coordinator.downsample=true requires run_node(..., ruleset=...)"
+        )
     registry = instrument.new_registry()
     scope = registry.scope(cfg.metrics_prefix)
 
@@ -89,8 +99,16 @@ def run_node(source, start_mediator: bool | None = None,
 
     http_server = None
     if serve_http and cfg.coordinator is not None:
+        downsampler = None
+        if cfg.coordinator.downsample:
+            from m3_tpu.coordinator.downsample import Downsampler
+
+            downsampler = Downsampler(
+                db, ruleset, namespace=cfg.coordinator.namespace
+            )
         ctx = ApiContext(
-            db, namespace=cfg.coordinator.namespace, registry=registry
+            db, namespace=cfg.coordinator.namespace, registry=registry,
+            downsampler=downsampler,
         )
         http_server = serve_background(
             ctx, cfg.coordinator.listen_host, cfg.coordinator.listen_port

@@ -1,0 +1,104 @@
+"""TCP ingest server: the aggregator's wire front door.
+
+Equivalent of the reference's rawtcp server
+(`src/aggregator/server/rawtcp/server.go:52 struct, :125 handle loop`):
+accept connections, iterate framed metric batches off each socket, and
+feed them to the aggregator.  The reference's per-message protobuf
+decode loop becomes one frame = one already-batched array payload — the
+batching the reference does in its client queues happens in the wire
+format itself, so the server's hot loop is decode → add_untimed_batch.
+
+A decode/protocol error closes the connection (rawtcp's error handling);
+the client reconnects and retries its queue.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+
+import numpy as np
+
+from m3_tpu.metrics.types import MetricType
+from m3_tpu.msg import protocol as wire
+
+
+def aggregator_sink(aggregator, lock: threading.Lock | None = None):
+    """Standard sink: group a wire batch by metric type (the engine
+    ingests one type per call, like the reference's per-union dispatch
+    in AddUntimed) and feed the aggregator under `lock`."""
+    lock = lock or threading.Lock()
+
+    def sink(batch: "wire.MetricBatch") -> None:
+        mts = np.asarray(batch.metric_types)
+        with lock:
+            for mt in np.unique(mts):
+                sel = np.nonzero(mts == mt)[0]
+                aggregator.add_untimed_batch(
+                    MetricType(int(mt)),
+                    [batch.ids[i] for i in sel],
+                    batch.values[sel],
+                    batch.times[sel],
+                )
+
+    return sink
+
+
+class _IngestHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        srv = self.server
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        while True:
+            try:
+                frame = wire.recv_frame(sock)
+            except (wire.ProtocolError, OSError):
+                if srv.scope is not None:
+                    srv.scope.counter("decode_errors").inc()
+                break
+            if frame is None:
+                break
+            ftype, payload = frame
+            if ftype != wire.METRIC_BATCH:
+                if srv.scope is not None:
+                    srv.scope.counter("unknown_frames").inc()
+                break
+            try:
+                batch = wire.decode_metric_batch(payload)
+            except (wire.ProtocolError, Exception):  # noqa: BLE001
+                if srv.scope is not None:
+                    srv.scope.counter("decode_errors").inc()
+                break
+            srv.sink(batch)
+            if srv.scope is not None:
+                srv.scope.counter("samples").inc(len(batch.ids))
+
+
+class IngestServer(socketserver.ThreadingTCPServer):
+    """sink(MetricBatch) is called per decoded frame — typically
+    `lambda b: aggregator.add_untimed_batch(b.metric_types, b.ids,
+    b.values, b.times)` behind a lock."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, sink, host: str = "127.0.0.1", port: int = 0,
+                 instrument=None):
+        self.sink = sink
+        self.scope = (
+            instrument.scope("ingest_tcp") if instrument is not None else None
+        )
+        super().__init__((host, port), _IngestHandler)
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+
+def serve_ingest_background(sink, host: str = "127.0.0.1", port: int = 0,
+                            instrument=None) -> IngestServer:
+    srv = IngestServer(sink, host, port, instrument)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv

@@ -359,12 +359,13 @@ class Database:
         """Create-if-missing (the reference adds namespaces dynamically
         through KV-watched namespace metadata, dbnode/namespace/dynamic.go;
         the coordinator provisions aggregated namespaces per policy)."""
-        ns = self.namespaces.get(name)
-        if ns is None:
-            ns = self.namespaces[name] = Namespace(
-                name, opts or NamespaceOptions(), self.opts.root
-            )
-        return ns
+        with self._mu:  # racing the mediator's namespace iteration
+            ns = self.namespaces.get(name)
+            if ns is None:
+                ns = self.namespaces[name] = Namespace(
+                    name, opts or NamespaceOptions(), self.opts.root
+                )
+            return ns
 
     def write_batch(self, namespace: str, ids: Sequence[bytes], ts, vals,
                     now_nanos: int | None = None) -> int:
@@ -476,7 +477,8 @@ class Database:
                     stats["commitlogs"] += 1
         return stats
 
-    def _replay_entries(self, name: str, entries: list) -> int:
+    def _replay_entries(self, name: str, entries: list,
+                        flushed_pts: Dict[tuple, dict] | None = None) -> int:
         """Write recovered entries into a namespace's buffers, skipping
         blocks already covered by a checkpointed fileset (the fs
         bootstrapper's unfulfilled-ranges rule).  Entries whose
@@ -493,15 +495,18 @@ class Database:
         # recovery: a point already in the fileset is a duplicate (drop);
         # a point absent from it is a pending cold write that crashed
         # before cold_flush — keep it, and write_batch re-routes it cold
-        # because the flushed block is not in open_starts.
-        flushed_pts: Dict[tuple, dict] = {}
+        # because the flushed block is not in open_starts.  The caller
+        # (bootstrap) shares one cache across all logs so each fileset
+        # decodes once, not once per commitlog file.
+        if flushed_pts is None:
+            flushed_pts = {}
         for i, sid in enumerate(ids):
             shard_id = shard_for_id(sid, ns.opts.num_shards)
             sh = ns.shards[shard_id]
             bs = int(ts[i]) // ns.opts.block_size_nanos * ns.opts.block_size_nanos
             if bs not in sh.flushed_blocks:
                 continue
-            key = (shard_id, bs)
+            key = (name, shard_id, bs)
             if key not in flushed_pts:
                 per_sid: dict = {}
                 for fbs, vol in list_filesets(self.opts.root, ns.name, shard_id):
@@ -547,6 +552,7 @@ class Database:
 
     def _bootstrap_locked(self) -> dict:
         restored = 0
+        flushed_pts: Dict[tuple, dict] = {}  # shared fileset-decode cache
         latest = snap.latest_snapshot(self.opts.root)
         if latest is not None:
             snap_root = str(snap.snapshot_data_root(self.opts.root, latest.seq))
@@ -563,7 +569,7 @@ class Database:
                                 for d in decode_series(seg)
                             )
                     if entries:
-                        restored += self._replay_entries(name, entries)
+                        restored += self._replay_entries(name, entries, flushed_pts)
         replayed = 0
         min_seq = latest.commitlog_seq if latest is not None else -1
         for log in list_commitlogs(self.opts.root):
@@ -575,7 +581,7 @@ class Database:
             for e in read_commitlog(log):
                 per_ns.setdefault(e.namespace.decode(), []).append(e)
             for name, entries in per_ns.items():
-                replayed += self._replay_entries(name, entries)
+                replayed += self._replay_entries(name, entries, flushed_pts)
         self.bootstrapped = True
         return {"commitlog_replayed": replayed, "snapshot_restored": restored}
 

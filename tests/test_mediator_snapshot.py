@@ -376,6 +376,18 @@ db:
         with pytest.raises(ConfigError, match="unknown field"):
             load_config("db: {rooot: /tmp/x}\n")
 
+    def test_coordinator_null_disables_http(self):
+        cfg = load_config("db: {root: /tmp/x}\ncoordinator: null\n")
+        assert cfg.coordinator is None
+
+    def test_downsample_requires_ruleset(self, tmp_path):
+        with pytest.raises(ConfigError, match="ruleset"):
+            run_node(f"""
+db: {{root: {tmp_path}}}
+coordinator: {{downsample: true}}
+mediator: {{enabled: false}}
+""")
+
 
 class TestAssembly:
     def test_run_node_end_to_end(self, tmp_path):
