@@ -1,0 +1,221 @@
+"""Device kernels for the query engine's hot per-step functions.
+
+The reference computes these per-series/per-step on the CPU with
+goroutine fan-out (`src/query/functions/linear/histogram_quantile.go:38-54`,
+`aggregation/function.go`, `binary/binary.go`); here each one is a
+single jitted array program over the whole (series × step) block — the
+TPU-shaped replacement for per-step loops.
+
+Ragged group structure (different bucket/row counts per group) is
+handled the TPU way: the host builds padded gather-index matrices once
+(cheap tag work it owns anyway), and the device kernel runs on dense
+(G, R_max, T) tensors with masks.  jit caches per shape, so repeated
+queries over the same block geometry pay tracing once.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NAN = float("nan")
+
+
+# ---------------------------------------------------------------------------
+# Padded group gather plans (host)
+# ---------------------------------------------------------------------------
+
+
+def group_plan(gids: np.ndarray, num_groups: int):
+    """(row_idx (G, R_max), mask (G, R_max)) gathering each group's rows."""
+    order = np.argsort(gids, kind="stable")
+    sorted_g = gids[order]
+    starts = np.searchsorted(sorted_g, np.arange(num_groups))
+    ends = np.searchsorted(sorted_g, np.arange(num_groups), side="right")
+    counts = ends - starts
+    r_max = max(1, int(counts.max(initial=0)))
+    idx = np.zeros((num_groups, r_max), np.int32)
+    mask = np.zeros((num_groups, r_max), bool)
+    for g in range(num_groups):
+        c = counts[g]
+        idx[g, :c] = order[starts[g] : ends[g]]
+        mask[g, :c] = True
+    return idx, mask
+
+
+# ---------------------------------------------------------------------------
+# Grouped quantile  (quantile(0.9, x) by (...))
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _group_quantile_kernel(values, idx, mask, q):
+    """(S, T), (G, R), (G, R) -> (G, T) linear-interpolated quantile over
+    present (non-NaN) rows — matches numpy nanquantile 'linear'."""
+    rows = values[idx]  # (G, R, T)
+    present = mask[:, :, None] & ~jnp.isnan(rows)
+    big = jnp.where(present, rows, jnp.inf)
+    s = jnp.sort(big, axis=1)  # present values first, inf after
+    n = present.sum(axis=1)  # (G, T)
+    # rank into the sorted axis: h = q*(n-1); linear interp between floor/ceil
+    h = q * (n - 1).astype(jnp.float64)
+    lo = jnp.clip(jnp.floor(h).astype(jnp.int32), 0, s.shape[1] - 1)
+    hi = jnp.clip(jnp.ceil(h).astype(jnp.int32), 0, s.shape[1] - 1)
+    v_lo = jnp.take_along_axis(s, lo[:, None, :], axis=1)[:, 0, :]
+    v_hi = jnp.take_along_axis(s, hi[:, None, :], axis=1)[:, 0, :]
+    frac = h - jnp.floor(h)
+    out = v_lo + (v_hi - v_lo) * frac
+    return jnp.where(n > 0, out, jnp.float64(NAN))
+
+
+def group_quantile(values: np.ndarray, gids: np.ndarray, num_groups: int,
+                   q: float) -> np.ndarray:
+    idx, mask = group_plan(gids, num_groups)
+    return np.asarray(
+        _group_quantile_kernel(
+            jnp.asarray(values), jnp.asarray(idx), jnp.asarray(mask),
+            jnp.float64(q),
+        )
+    )
+
+
+# ---------------------------------------------------------------------------
+# topk / bottomk
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("k", "top"))
+def _topk_mask_kernel(values, idx, mask, k: int, top: bool):
+    """Keep-mask (S, T): True where the row is among the k extreme in its
+    group at that step."""
+    S, T = values.shape
+    rows = values[idx]  # (G, R, T)
+    # Present = in-group and not NaN; ±Inf are real sample values and
+    # compete for rank slots (Prometheus topk keeps Inf).
+    present = mask[:, :, None] & ~jnp.isnan(rows)
+    key = jnp.where(present, rows, -jnp.inf if top else jnp.inf)
+    s = jnp.sort(key, axis=1)
+    R = s.shape[1]
+    # kth extreme per (group, step); groups with < k present rows keep all
+    kth = s[:, max(R - k, 0), :] if top else s[:, min(k - 1, R - 1), :]
+    keep_g = (key >= kth[:, None, :]) if top else (key <= kth[:, None, :])
+    keep_g = keep_g & present
+    # scatter (G, R, T) back to (S, T)
+    flat_idx = idx.reshape(-1)
+    keep_flat = keep_g.reshape(-1, T)
+    out = jnp.zeros((S, T), bool)
+    return out.at[flat_idx].max(keep_flat, mode="drop")
+
+
+def topk_mask(values: np.ndarray, gids: np.ndarray, num_groups: int,
+              k: int, top: bool) -> np.ndarray:
+    idx, mask = group_plan(gids, num_groups)
+    return np.asarray(
+        _topk_mask_kernel(jnp.asarray(values), jnp.asarray(idx),
+                          jnp.asarray(mask), k=int(k), top=bool(top))
+    )
+
+
+# ---------------------------------------------------------------------------
+# histogram_quantile
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _histogram_quantile_kernel(values, idx, nbuckets, ubs, q):
+    """values (S, T); idx (G, B) row index per bucket rank (le-ascending,
+    +Inf last when present); nbuckets (G,); ubs (G, B) upper bounds
+    (inf-padded).  Returns (G, T).
+
+    Mirrors the reference math (`linear/histogram_quantile.go`):
+    cumulative counts clamped monotone, rank = q * total, linear
+    interpolation inside the first bucket reaching the rank, +Inf bucket
+    answered by the highest finite bound."""
+    G, B = idx.shape
+    rows = values[idx]  # (G, B, T)
+    bpos = jnp.arange(B)[None, :]
+    valid = bpos < nbuckets[:, None]  # (G, B)
+    counts = jnp.where(valid[:, :, None], jnp.nan_to_num(rows), 0.0)
+    counts = jax.lax.cummax(counts, axis=1)
+    # total comes from the RAW +Inf-bucket sample: a NaN there must
+    # propagate to a NaN result (a nan_to_num'd total would silently
+    # substitute the previous bucket's cumulative count).
+    last = jnp.clip(nbuckets - 1, 0, B - 1)
+    total = jnp.take_along_axis(rows, last[:, None, None], axis=1)[:, 0, :]
+    rank = q * total
+    ge = (counts >= rank[:, None, :]) & valid[:, :, None]
+    first = jnp.argmax(ge, axis=1)  # (G, T)
+    take = lambda a, i: jnp.take_along_axis(a, i[:, None, :], axis=1)[:, 0, :]
+    b_hi = jnp.take_along_axis(ubs, first, axis=1)
+    prev = jnp.maximum(first - 1, 0)
+    b_lo = jnp.where(first > 0, jnp.take_along_axis(ubs, prev, axis=1), 0.0)
+    c_hi = take(counts, first)
+    c_lo = jnp.where(first > 0, take(counts, prev), 0.0)
+    frac = jnp.where(c_hi > c_lo, (rank - c_lo) / (c_hi - c_lo), 0.0)
+    val = b_lo + (b_hi - b_lo) * frac
+    # +Inf bucket → highest finite bound; a group with ONLY the +Inf
+    # bucket has no finite bound and answers 0.0 (host-code parity).
+    hf_idx = jnp.clip(nbuckets - 2, 0, B - 1)
+    highest_finite = jnp.where(
+        (nbuckets >= 2)[:, None],
+        jnp.take_along_axis(ubs, hf_idx[:, None], axis=1),
+        0.0,
+    )
+    val = jnp.where(jnp.isinf(b_hi), highest_finite, val)
+    bad = (total == 0) | jnp.isnan(total)
+    return jnp.where(bad, jnp.float64(NAN), val)
+
+
+def histogram_quantile_groups(values: np.ndarray, group_rows: list,
+                              group_ubs: list, q: float) -> np.ndarray:
+    """group_rows[g] = row indices le-ascending (+Inf last); group_ubs[g]
+    the matching upper bounds.  Returns (G, T)."""
+    G = len(group_rows)
+    B = max(len(r) for r in group_rows)
+    idx = np.zeros((G, B), np.int32)
+    ubs = np.full((G, B), np.inf)
+    nb = np.zeros(G, np.int32)
+    for g, (rows, u) in enumerate(zip(group_rows, group_ubs)):
+        idx[g, : len(rows)] = rows
+        ubs[g, : len(u)] = u
+        nb[g] = len(rows)
+    return np.asarray(
+        _histogram_quantile_kernel(
+            jnp.asarray(values), jnp.asarray(idx), jnp.asarray(nb),
+            jnp.asarray(ubs), jnp.float64(q),
+        )
+    )
+
+
+# ---------------------------------------------------------------------------
+# Binary ops with vector matching
+# ---------------------------------------------------------------------------
+
+COMPARISONS = {"==", "!=", ">", "<", ">=", "<="}
+
+
+@functools.partial(jax.jit, static_argnames=("op", "bool_mode"))
+def _vector_binary_kernel(lv, rv, op: str, bool_mode: bool):
+    ops = {
+        "+": jnp.add, "-": jnp.subtract, "*": jnp.multiply,
+        "/": jnp.divide, "%": jnp.mod, "^": jnp.power,
+        "==": jnp.equal, "!=": jnp.not_equal, ">": jnp.greater,
+        "<": jnp.less, ">=": jnp.greater_equal, "<=": jnp.less_equal,
+    }
+    out = ops[op](lv, rv).astype(jnp.float64)
+    if op in COMPARISONS and not bool_mode:
+        out = jnp.where(out != 0, lv, jnp.float64(NAN))
+    miss = jnp.isnan(lv) | jnp.isnan(rv)
+    return jnp.where(miss, jnp.float64(NAN), out)
+
+
+def vector_binary_matched(l_values: np.ndarray, r_values: np.ndarray,
+                          rows_l, rows_r, op: str,
+                          bool_mode: bool) -> np.ndarray:
+    """Gather matched rows on device and apply the op in one kernel."""
+    lv = jnp.asarray(l_values)[jnp.asarray(np.asarray(rows_l, np.int32))]
+    rv = jnp.asarray(r_values)[jnp.asarray(np.asarray(rows_r, np.int32))]
+    return np.asarray(_vector_binary_kernel(lv, rv, op=op, bool_mode=bool_mode))

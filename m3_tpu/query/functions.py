@@ -95,17 +95,9 @@ def _segment_reduce(values: np.ndarray, gids: np.ndarray, num_groups: int,
         out = jax.ops.segment_max(jnp.where(nan, -jnp.inf, v), g, num_segments=num_groups)
         out = jnp.where(jnp.isneginf(out), NAN, out)
     elif func == "quantile":
-        # Sort rows within each group: lex-sort (gid, value) per step is
-        # expensive per column; do it host-side via numpy for clarity.
-        out_np = np.full((num_groups, T), NAN)
-        vals_np = values
-        for grp in range(num_groups):
-            rows = vals_np[gids == grp]
-            if rows.size == 0:
-                continue
-            with np.errstate(all="ignore"):
-                out_np[grp] = np.nanquantile(rows, q, axis=0, method="linear")
-        return out_np
+        from m3_tpu.query.device_fns import group_quantile
+
+        return group_quantile(values, gids, num_groups, q)
     else:
         raise ValueError(f"unknown aggregation {func}")
     return np.asarray(jnp.where(empty, NAN, out))
@@ -123,20 +115,12 @@ def topk_bottomk(block: Block, k: int, func: str,
                  without: set[bytes] | None = None) -> Block:
     """topk/bottomk keep original series, masking all but the k extreme
     per (group, step)."""
-    gids, _ = group_series(block.series, by, without)
+    from m3_tpu.query.device_fns import topk_mask
+
+    gids, metas = group_series(block.series, by, without)
     v = block.values
-    masked = np.where(np.isnan(v), -np.inf if func == "topk" else np.inf, v)
-    out = np.full_like(v, NAN)
-    for grp in np.unique(gids):
-        rows = np.nonzero(gids == grp)[0]
-        sub = masked[rows]  # (R, T)
-        if func == "topk":
-            kth = np.sort(sub, axis=0)[::-1][min(k, len(rows)) - 1]
-            keep = sub >= kth
-        else:
-            kth = np.sort(sub, axis=0)[min(k, len(rows)) - 1]
-            keep = sub <= kth
-        out[rows] = np.where(keep & np.isfinite(sub), v[rows], NAN)
+    keep = topk_mask(v, gids, len(metas), int(k), func == "topk")
+    out = np.where(keep, v, NAN)
     return block.with_values(out)
 
 
@@ -162,42 +146,29 @@ def histogram_quantile(block: Block, q: float) -> Block:
         key = m.drop({b"le", b"__name__"}).tags
         groups[key].append((ub, i))
 
+    from m3_tpu.query.device_fns import histogram_quantile_groups
+
     T = block.num_steps
     metas: list[SeriesMeta] = []
-    out_rows = []
+    group_rows: list[list[int]] = []
+    group_ubs: list[np.ndarray] = []
+    nan_metas: list[SeriesMeta] = []
     for key, buckets in groups.items():
         buckets.sort()
         ubs = np.array([b[0] for b in buckets])
-        rows = block.values[[b[1] for b in buckets]]  # (B, T) cumulative counts
         if not np.isinf(ubs[-1]):
-            metas.append(SeriesMeta(key))
-            out_rows.append(np.full(T, NAN))
+            # no +Inf bucket → undefined (Prometheus returns NaN)
+            nan_metas.append(SeriesMeta(key))
             continue
-        total = rows[-1]
-        with np.errstate(all="ignore"):
-            # Clamp non-monotone buckets (Prometheus tolerates these).
-            counts = np.maximum.accumulate(np.nan_to_num(rows), axis=0)
-            rank = q * total
-            # First bucket with count >= rank.
-            ge = counts >= rank[None, :]
-            first = np.argmax(ge, axis=0)
-            b_hi = ubs[first]
-            b_lo = np.where(first > 0, ubs[np.maximum(first - 1, 0)], 0.0)
-            c_hi = np.take_along_axis(counts, first[None, :], axis=0)[0]
-            c_lo = np.where(
-                first > 0,
-                np.take_along_axis(counts, np.maximum(first - 1, 0)[None, :], axis=0)[0],
-                0.0,
-            )
-            frac = np.where(c_hi > c_lo, (rank - c_lo) / (c_hi - c_lo), 0.0)
-            val = b_lo + (b_hi - b_lo) * frac
-            # Highest finite bucket bounds the +Inf bucket's answer.
-            in_inf = np.isinf(b_hi)
-            highest_finite = ubs[-2] if len(ubs) >= 2 else 0.0
-            val = np.where(in_inf, highest_finite, val)
-            val = np.where((total == 0) | np.isnan(total), NAN, val)
         metas.append(SeriesMeta(key))
-        out_rows.append(val)
+        group_rows.append([b[1] for b in buckets])
+        group_ubs.append(ubs)
+    out_rows = []
+    if group_rows:
+        vals = histogram_quantile_groups(block.values, group_rows, group_ubs, q)
+        out_rows = list(vals)
+    out_rows += [np.full(T, NAN)] * len(nan_metas)
+    metas += nan_metas
     if not out_rows:
         return Block(block.step_times, np.zeros((0, T)), [])
     return Block(block.step_times, np.stack(out_rows), metas)
@@ -254,7 +225,7 @@ _BINOPS = {
     "<=": np.less_equal,
 }
 
-_COMPARISONS = {"==", "!=", ">", "<", ">=", "<="}
+from m3_tpu.query.device_fns import COMPARISONS as _COMPARISONS
 
 
 def scalar_binary(block: Block, op: str, scalar: float,
@@ -300,13 +271,9 @@ def vector_binary(lhs: Block, rhs: Block, op: str,
         metas.append(m.drop_name() if not (op in _COMPARISONS and not bool_mode) else m)
     if not rows_l:
         return Block(lhs.step_times, np.zeros((0, lhs.num_steps)), [])
-    f = _BINOPS[op]
-    lv = lhs.values[rows_l]
-    rv = rhs.values[rows_r]
-    with np.errstate(all="ignore"):
-        out = f(lv, rv).astype(np.float64)
-    if op in _COMPARISONS and not bool_mode:
-        out = np.where(out != 0, lv, NAN)
-    miss = np.isnan(lv) | np.isnan(rv)
-    out = np.where(miss, NAN, out)
+    from m3_tpu.query.device_fns import vector_binary_matched
+
+    out = vector_binary_matched(
+        lhs.values, rhs.values, rows_l, rows_r, op, bool_mode
+    )
     return Block(lhs.step_times, out, metas)

@@ -1,0 +1,185 @@
+"""Device query kernels vs numpy oracles on randomized blocks.
+
+The kernels replace the reference's per-step CPU loops
+(`linear/histogram_quantile.go`, `aggregation/function.go`,
+`binary/binary.go`); these tests pin them to straightforward numpy
+implementations over ragged random groups with NaN holes.
+"""
+
+import numpy as np
+import pytest
+
+from m3_tpu.query.device_fns import (
+    group_quantile, histogram_quantile_groups, topk_mask,
+    vector_binary_matched,
+)
+
+RNG = np.random.default_rng(7)
+
+
+def _block(S=37, T=11, nan_frac=0.2):
+    v = RNG.normal(0, 10, (S, T))
+    v[RNG.random((S, T)) < nan_frac] = np.nan
+    return v
+
+
+class TestGroupQuantile:
+    @pytest.mark.parametrize("q", [0.0, 0.5, 0.9, 1.0])
+    def test_matches_nanquantile(self, q):
+        v = _block()
+        gids = RNG.integers(0, 5, len(v)).astype(np.int32)
+        out = group_quantile(v, gids, 5, q)
+        for g in range(5):
+            rows = v[gids == g]
+            with np.errstate(all="ignore"):
+                import warnings
+
+                with warnings.catch_warnings():
+                    warnings.simplefilter("ignore")
+                    want = (
+                        np.nanquantile(rows, q, axis=0)
+                        if rows.size
+                        else np.full(v.shape[1], np.nan)
+                    )
+            np.testing.assert_allclose(out[g], want, rtol=1e-12, equal_nan=True)
+
+    def test_empty_group_is_nan(self):
+        v = _block(8, 4, 0.0)
+        gids = np.zeros(8, np.int32)  # group 1 empty
+        out = group_quantile(v, gids, 2, 0.5)
+        assert np.isnan(out[1]).all()
+
+
+class TestTopk:
+    @pytest.mark.parametrize("top", [True, False])
+    def test_matches_host_selection(self, top):
+        v = _block(20, 6, 0.15)
+        gids = RNG.integers(0, 3, 20).astype(np.int32)
+        k = 2
+        keep = topk_mask(v, gids, 3, k, top)
+        for g in range(3):
+            rows = np.nonzero(gids == g)[0]
+            for t in range(v.shape[1]):
+                col = v[rows, t]
+                present = ~np.isnan(col)
+                kept = keep[rows, t]
+                # NaN (absent) rows can never be kept
+                assert not np.any(kept & ~present)
+                npz = present.sum()
+                want_k = min(k, npz)
+                assert kept.sum() >= want_k or kept.sum() == npz
+                if want_k and kept.sum():
+                    extreme = np.sort(col[present])
+                    thresh = extreme[-want_k] if top else extreme[want_k - 1]
+                    if top:
+                        assert np.all(col[kept] >= thresh)
+                    else:
+                        assert np.all(col[kept] <= thresh)
+
+    def test_inf_competes_and_is_kept(self):
+        """Prometheus topk keeps Inf samples (they are real values)."""
+        v = np.asarray([[np.inf], [5.0], [3.0]])
+        gids = np.zeros(3, np.int32)
+        keep = topk_mask(v, gids, 1, 2, True)
+        assert keep[:, 0].tolist() == [True, True, False]
+        keep_b = topk_mask(v, gids, 1, 2, False)
+        assert keep_b[:, 0].tolist() == [False, True, True]
+
+
+class TestHistogramQuantile:
+    def _cumulative(self, G=4, B=6, T=9):
+        ubs = np.array([0.1, 0.5, 1.0, 5.0, 10.0, np.inf])[:B]
+        rows, all_ubs, vals = [], [], []
+        mat = []
+        for g in range(G):
+            raw = RNG.random((B, T)).cumsum(axis=0) * (g + 1)
+            base = len(mat)
+            mat.extend(raw)
+            rows.append(list(range(base, base + B)))
+            all_ubs.append(ubs)
+        return np.asarray(mat), rows, all_ubs
+
+    def test_monotone_in_q(self):
+        values, rows, ubs = self._cumulative()
+        v50 = histogram_quantile_groups(values, rows, ubs, 0.5)
+        v90 = histogram_quantile_groups(values, rows, ubs, 0.9)
+        assert np.all(v90 >= v50 - 1e-12)
+
+    def test_known_uniform_histogram(self):
+        # counts: 10 in (0,1], 10 in (1,2], inf carries total 20
+        T = 3
+        values = np.asarray([
+            np.full(T, 10.0), np.full(T, 20.0), np.full(T, 20.0),
+        ])
+        rows = [[0, 1, 2]]
+        ubs = [np.array([1.0, 2.0, np.inf])]
+        out = histogram_quantile_groups(values, rows, ubs, 0.5)
+        np.testing.assert_allclose(out[0], 1.0)  # median at bucket edge
+        out75 = histogram_quantile_groups(values, rows, ubs, 0.75)
+        np.testing.assert_allclose(out75[0], 1.5)  # interpolated
+        # +Inf-bucket quantile clamps to highest finite bound
+        out999 = histogram_quantile_groups(values, rows, ubs, 0.999)
+        assert np.all(out999[0] <= 2.0)
+
+    def test_nan_inf_bucket_sample_propagates(self):
+        """A NaN +Inf-bucket sample means total is unknown → NaN result
+        (the raw-total rule the host code had)."""
+        values = np.asarray([
+            [10.0, 10.0], [20.0, 20.0], [20.0, np.nan],
+        ])
+        out = histogram_quantile_groups(
+            values, [[0, 1, 2]], [np.array([1.0, 2.0, np.inf])], 0.5
+        )
+        assert not np.isnan(out[0, 0])
+        assert np.isnan(out[0, 1])
+
+    def test_only_inf_bucket_returns_zero(self):
+        values = np.asarray([[7.0]])
+        out = histogram_quantile_groups(
+            values, [[0]], [np.array([np.inf])], 0.5
+        )
+        np.testing.assert_allclose(out[0], 0.0)
+
+    def test_zero_total_is_nan(self):
+        values = np.zeros((2, 4))
+        out = histogram_quantile_groups(
+            values, [[0, 1]], [np.array([1.0, np.inf])], 0.9
+        )
+        assert np.isnan(out[0]).all()
+
+    def test_ragged_bucket_counts(self):
+        # group 0 has 3 buckets, group 1 has 2
+        values = np.asarray([
+            [5.0], [10.0], [10.0],    # g0: le 1, 2, inf
+            [4.0], [4.0],             # g1: le 1, inf
+        ])
+        out = histogram_quantile_groups(
+            values, [[0, 1, 2], [3, 4]],
+            [np.array([1.0, 2.0, np.inf]), np.array([1.0, np.inf])], 0.5,
+        )
+        np.testing.assert_allclose(out[0, 0], 1.0)
+        np.testing.assert_allclose(out[1, 0], 0.5)  # interpolates in (0,1]
+
+
+class TestVectorBinary:
+    def test_arithmetic_and_compare(self):
+        lv = _block(10, 5, 0.1)
+        rv = _block(10, 5, 0.1)
+        rows = list(range(10))
+        out = vector_binary_matched(lv, rv, rows, rows, "+", False)
+        want = lv + rv
+        want[np.isnan(lv) | np.isnan(rv)] = np.nan
+        np.testing.assert_allclose(out, want, equal_nan=True)
+        # filter-mode comparison keeps lhs where true, NaN elsewhere
+        outc = vector_binary_matched(lv, rv, rows, rows, ">", False)
+        with np.errstate(invalid="ignore"):
+            mask = lv > rv
+        want = np.where(mask, lv, np.nan)
+        want[np.isnan(lv) | np.isnan(rv)] = np.nan
+        np.testing.assert_allclose(outc, want, equal_nan=True)
+
+    def test_bool_mode(self):
+        lv = np.asarray([[1.0, 2.0]])
+        rv = np.asarray([[2.0, 1.0]])
+        out = vector_binary_matched(lv, rv, [0], [0], ">", True)
+        np.testing.assert_allclose(out, [[0.0, 1.0]])
