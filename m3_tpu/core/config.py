@@ -117,10 +117,16 @@ class CoordinatorConfig:
     listen_port: int = 0  # 0 = ephemeral
     namespace: str = "default"
     downsample: bool = False
+    carbon_listen_port: Optional[int] = None  # None = no carbon listener
+    tracing: bool = False
 
     def validate(self, errs: list) -> None:
         if not (0 <= self.listen_port < 65536):
             errs.append("coordinator.listen_port: out of range")
+        if self.carbon_listen_port is not None and not (
+            0 <= self.carbon_listen_port < 65536
+        ):
+            errs.append("coordinator.carbon_listen_port: out of range")
 
 
 @dataclasses.dataclass

@@ -1,0 +1,163 @@
+"""Tracing: in-process spans with a tracepoint registry.
+
+Equivalent of the reference's opentracing layer
+(`src/x/opentracing/tracing.go:31-59` pluggable backends) and its
+tracepoint name registries (`src/dbnode/tracepoint/tracepoint.go`,
+`src/query/tracepoint`): spans started at RPC/storage boundaries, named
+from a central registry so dashboards can rely on stable names.  The
+jaeger/lightstep reporter plumbing collapses to a bounded in-memory
+ring (zero egress environment) exposed for tests/debug handlers —
+the Tracer interface is the seam a real exporter would plug into.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+
+class Tracepoint:
+    """Stable span names (reference dbnode/tracepoint/tracepoint.go)."""
+
+    DB_WRITE_BATCH = "db.writeBatch"
+    DB_READ = "db.read"
+    DB_QUERY_IDS = "db.queryIDs"
+    DB_BOOTSTRAP = "db.bootstrap"
+    DB_TICK = "db.tick"
+    DB_SNAPSHOT = "db.snapshot"
+    ENGINE_EXECUTE = "query.engine.execute"
+    FETCH_COMPRESSED = "query.storage.fetchCompressed"
+    API_QUERY_RANGE = "api.queryRange"
+    API_WRITE = "api.write"
+    INGEST_TCP_BATCH = "ingest.tcp.batch"
+    AGG_CONSUME = "aggregator.consume"
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: int
+    span_id: int
+    parent_id: int | None
+    start_ns: int
+    end_ns: int = 0
+    tags: dict = field(default_factory=dict)
+    error: str | None = None
+
+    @property
+    def duration_ns(self) -> int:
+        return self.end_ns - self.start_ns
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name, "trace_id": self.trace_id,
+            "span_id": self.span_id, "parent_id": self.parent_id,
+            "start_ns": self.start_ns, "duration_ns": self.duration_ns,
+            "tags": self.tags, "error": self.error,
+        }
+
+
+class _ActiveSpan:
+    __slots__ = ("_tracer", "span")
+
+    def __init__(self, tracer: "Tracer", span: Span):
+        self._tracer = tracer
+        self.span = span
+
+    def set_tag(self, key: str, value) -> None:
+        self.span.tags[key] = value
+
+    def __enter__(self) -> "_ActiveSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc is not None:
+            self.span.error = f"{type(exc).__name__}: {exc}"
+        self._tracer._finish(self.span)
+        return False
+
+
+class _NoopSpan:
+    def set_tag(self, key: str, value) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class Tracer:
+    """Span factory + bounded finished-span ring; parentage flows
+    through a thread-local active-span stack (the opentracing
+    span-context propagation, in-process form)."""
+
+    def __init__(self, max_finished: int = 4096, enabled: bool = True):
+        self.enabled = enabled
+        self._ring: deque[Span] = deque(maxlen=max_finished)
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._next_id = 1
+
+    def _ids(self) -> int:
+        with self._lock:
+            i = self._next_id
+            self._next_id += 1
+            return i
+
+    def _stack(self) -> list:
+        s = getattr(self._tls, "stack", None)
+        if s is None:
+            s = self._tls.stack = []
+        return s
+
+    def start_span(self, name: str, tags: dict | None = None):
+        """Context manager: `with tracer.start_span(Tracepoint.DB_READ):`."""
+        if not self.enabled:
+            return NOOP_SPAN
+        stack = self._stack()
+        parent = stack[-1] if stack else None
+        span = Span(
+            name=name,
+            trace_id=parent.trace_id if parent else self._ids(),
+            span_id=self._ids(),
+            parent_id=parent.span_id if parent else None,
+            start_ns=time.monotonic_ns(),
+            tags=dict(tags or {}),
+        )
+        stack.append(span)
+        return _ActiveSpan(self, span)
+
+    def _finish(self, span: Span) -> None:
+        span.end_ns = time.monotonic_ns()
+        stack = self._stack()
+        if stack and stack[-1] is span:
+            stack.pop()
+        with self._lock:
+            self._ring.append(span)
+
+    # -- introspection -----------------------------------------------------
+
+    def finished(self, name: str | None = None) -> list[Span]:
+        with self._lock:
+            spans = list(self._ring)
+        return [s for s in spans if name is None or s.name == name]
+
+    def traces(self) -> dict[int, list[Span]]:
+        out: dict[int, list[Span]] = {}
+        for s in self.finished():
+            out.setdefault(s.trace_id, []).append(s)
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+
+NOOP_TRACER = Tracer(enabled=False)

@@ -52,9 +52,13 @@ class _Scalar:
 class Engine:
     """reference `executor/engine.go:47 NewEngine`."""
 
-    def __init__(self, storage: Storage, lookback_nanos: int = LOOKBACK_NANOS):
+    def __init__(self, storage: Storage, lookback_nanos: int = LOOKBACK_NANOS,
+                 tracer=None):
+        from m3_tpu.instrument.tracing import NOOP_TRACER
+
         self.storage = storage
         self.lookback = lookback_nanos
+        self.tracer = tracer if tracer is not None else NOOP_TRACER
 
     # -- public API --------------------------------------------------------
 
@@ -62,6 +66,15 @@ class Engine:
                       step_nanos: int) -> Block:
         """PromQL range query (reference api/v1 native read →
         ExecuteExpr)."""
+        from m3_tpu.instrument.tracing import Tracepoint
+
+        with self.tracer.start_span(Tracepoint.ENGINE_EXECUTE,
+                                    {"query": query}):
+            return self._execute_range(query, start_nanos, end_nanos,
+                                       step_nanos)
+
+    def _execute_range(self, query: str, start_nanos: int, end_nanos: int,
+                       step_nanos: int) -> Block:
         ast = parse(query)
         steps = np.arange(start_nanos, end_nanos + 1, step_nanos, dtype=np.int64)
         out = self._eval(ast, steps)

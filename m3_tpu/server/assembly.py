@@ -28,12 +28,21 @@ class Assembly:
     db: Database
     mediator: Mediator | None
     http_server: object | None
+    carbon_server: object | None = None
+    tracer: object | None = None
 
     @property
     def port(self) -> int | None:
         return self.http_server.server_address[1] if self.http_server else None
 
+    @property
+    def carbon_port(self) -> int | None:
+        return self.carbon_server.port if self.carbon_server else None
+
     def close(self) -> None:
+        if self.carbon_server is not None:
+            self.carbon_server.shutdown()
+            self.carbon_server.server_close()
         if self.http_server is not None:
             self.http_server.shutdown()
             self.http_server.server_close()
@@ -74,6 +83,11 @@ def run_node(source, start_mediator: bool | None = None,
         )
     registry = instrument.new_registry()
     scope = registry.scope(cfg.metrics_prefix)
+    tracer = None
+    if cfg.coordinator is not None and cfg.coordinator.tracing:
+        from m3_tpu.instrument.tracing import Tracer
+
+        tracer = Tracer()
 
     db = Database(
         DatabaseOptions(
@@ -83,34 +97,70 @@ def run_node(source, start_mediator: bool | None = None,
             name: namespace_options(ns) for name, ns in cfg.db.namespaces.items()
         },
         instrument=scope,
+        tracer=tracer,
     )
-    db.bootstrap()
+    # Tear down everything already started if a later step fails (e.g.
+    # the carbon port is taken) — a half-built node must not leak its
+    # mediator thread or bound HTTP socket.
+    asm = Assembly(cfg, registry, db, None, None, None, tracer)
+    try:
+        db.bootstrap()
 
-    mediator = None
-    if cfg.mediator.enabled if start_mediator is None else start_mediator:
-        mediator = Mediator(
-            db,
-            tick_interval_s=parse_duration(cfg.mediator.tick_interval) / 1e9,
-            snapshot_every=cfg.mediator.snapshot_every,
-            cleanup_every=cfg.mediator.cleanup_every,
-            instrument=scope,
-        )
-        mediator.open()
-
-    http_server = None
-    if serve_http and cfg.coordinator is not None:
-        downsampler = None
-        if cfg.coordinator.downsample:
-            from m3_tpu.coordinator.downsample import Downsampler
-
-            downsampler = Downsampler(
-                db, ruleset, namespace=cfg.coordinator.namespace
+        if cfg.mediator.enabled if start_mediator is None else start_mediator:
+            asm.mediator = Mediator(
+                db,
+                tick_interval_s=parse_duration(cfg.mediator.tick_interval) / 1e9,
+                snapshot_every=cfg.mediator.snapshot_every,
+                cleanup_every=cfg.mediator.cleanup_every,
+                instrument=scope,
             )
-        ctx = ApiContext(
-            db, namespace=cfg.coordinator.namespace, registry=registry,
-            downsampler=downsampler,
-        )
-        http_server = serve_background(
-            ctx, cfg.coordinator.listen_host, cfg.coordinator.listen_port
-        )
-    return Assembly(cfg, registry, db, mediator, http_server)
+            asm.mediator.open()
+
+        downsampler = None
+        if serve_http and cfg.coordinator is not None:
+            if cfg.coordinator.downsample:
+                from m3_tpu.coordinator.downsample import Downsampler
+
+                downsampler = Downsampler(
+                    db, ruleset, namespace=cfg.coordinator.namespace
+                )
+            ctx = ApiContext(
+                db, namespace=cfg.coordinator.namespace, registry=registry,
+                downsampler=downsampler, tracer=tracer,
+            )
+            asm.http_server = serve_background(
+                ctx, cfg.coordinator.listen_host, cfg.coordinator.listen_port
+            )
+        if (serve_http and cfg.coordinator is not None
+                and cfg.coordinator.carbon_listen_port is not None):
+            from m3_tpu.metrics.carbon import serve_carbon_background
+
+            ns_name = cfg.coordinator.namespace
+
+            def carbon_sink(docs, ts, vals, _ds=downsampler):
+                # Carbon rides the same downsample-then-write path as
+                # HTTP writes (the reference's carbon ingester feeds the
+                # downsampler too) so rules apply regardless of ingest
+                # protocol.
+                keep = None
+                if _ds is not None:
+                    keep = _ds.write_batch(docs, ts, vals)
+                if keep is not None:
+                    import numpy as _np
+
+                    idx = _np.nonzero(keep)[0]
+                    if not len(idx):
+                        return
+                    docs = [docs[i] for i in idx]
+                    ts, vals = ts[idx], vals[idx]
+                db.write_tagged_batch(ns_name, docs, ts, vals)
+
+            asm.carbon_server = serve_carbon_background(
+                carbon_sink,
+                cfg.coordinator.listen_host, cfg.coordinator.carbon_listen_port,
+                instrument=scope,
+            )
+    except BaseException:
+        asm.close()
+        raise
+    return asm

@@ -76,6 +76,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._json(200, {"ok": True})
             if u.path == "/metrics":
                 return self._metrics()
+            if u.path == "/debug/traces":
+                return self._traces()
             if u.path in ("/api/v1/query_range", "/api/v1/query"):
                 return self._query(u.path.endswith("query_range"), q)
             if u.path == "/api/v1/labels":
@@ -115,6 +117,17 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
+
+    def _traces(self):
+        """Recent finished spans (reference x/debug's introspection
+        bundles; jaeger exporter seam collapses to JSON-over-HTTP)."""
+        tr = self.ctx.tracer
+        if tr is None:
+            return self._error(404, "no tracer configured")
+        return self._json(200, {
+            "status": "success",
+            "data": [s.to_dict() for s in tr.finished()],
+        })
 
     def _write_json(self):
         """reference api/v1/json/write: one sample or a list of
@@ -214,12 +227,13 @@ def _fmt(v: float) -> str:
 
 class ApiContext:
     def __init__(self, db: Database, namespace: str = "default",
-                 downsampler=None, registry=None):
+                 downsampler=None, registry=None, tracer=None):
         self.db = db
         self.namespace = namespace
         self.downsampler = downsampler
         self.registry = registry
-        self.engine = Engine(DatabaseStorage(db, namespace))
+        self.tracer = tracer
+        self.engine = Engine(DatabaseStorage(db, namespace), tracer=tracer)
 
 
 def make_server(ctx: ApiContext, host: str = "127.0.0.1", port: int = 0):

@@ -46,6 +46,7 @@ from m3_tpu.persist.fs import (
     remove_fileset,
 )
 from m3_tpu.persist import snapshot as snap
+from m3_tpu.instrument.tracing import Tracepoint
 from m3_tpu.storage.buffer import ShardBuffer, dedupe_last_write_wins
 from m3_tpu.storage.series_merge import merge_point_sources
 
@@ -83,11 +84,13 @@ def shard_for_id(sid: bytes, num_shards: int) -> int:
 
 
 class Shard:
-    def __init__(self, namespace: str, shard_id: int, opts: NamespaceOptions, root: str):
+    def __init__(self, namespace: str, shard_id: int, opts: NamespaceOptions, root: str,
+                 block_cache=None):
         self.namespace = namespace
         self.shard_id = shard_id
         self.opts = opts
         self.root = root
+        self.block_cache = block_cache
         self.slots = SlotAllocator(opts.slot_capacity)
         # Ring must cover (bufferPast + bufferFuture) / blockSize + 2 blocks.
         span = opts.buffer_past_nanos + opts.buffer_future_nanos
@@ -195,6 +198,11 @@ class Shard:
                 self.opts.block_size_nanos, volume=vol + 1,
             ).write_all(series)
             self.flushed_blocks.add(block_start)
+            if self.block_cache is not None:
+                # volume+1 supersedes the cached volume's blocks
+                self.block_cache.invalidate_block(
+                    self.namespace, self.shard_id, block_start
+                )
             flushed += len(series)
         return flushed
 
@@ -241,14 +249,22 @@ class Shard:
         for bs in range(lo, end_nanos + bsz, bsz):
             if bs in filesets:
                 try:
-                    r = DataFileSetReader(
-                        self.root, self.namespace, self.shard_id, bs, filesets[bs]
-                    )
-                    seg = r.read(sid)
-                    if seg:
-                        sources.append(
-                            [(d.timestamp, d.value) for d in decode_series(seg)]
+                    if self.block_cache is not None:
+                        pts = self.block_cache.read_series(
+                            self.root, self.namespace, self.shard_id, bs,
+                            filesets[bs], sid,
                         )
+                    else:
+                        r = DataFileSetReader(
+                            self.root, self.namespace, self.shard_id, bs, filesets[bs]
+                        )
+                        seg = r.read(sid)
+                        pts = (
+                            [(d.timestamp, d.value) for d in decode_series(seg)]
+                            if seg else None
+                        )
+                    if pts:
+                        sources.append(pts)
                 except FileNotFoundError:
                     pass
             if slot is not None and bs in self.buffer.open_blocks:
@@ -273,11 +289,15 @@ class Shard:
 
 
 class Namespace:
-    def __init__(self, name: str, opts: NamespaceOptions, root: str):
+    def __init__(self, name: str, opts: NamespaceOptions, root: str,
+                 block_cache=None):
         self.name = name
         self.opts = opts
         self.root = root
-        self.shards = [Shard(name, i, opts, root) for i in range(opts.num_shards)]
+        self.shards = [
+            Shard(name, i, opts, root, block_cache)
+            for i in range(opts.num_shards)
+        ]
         self.index = NamespaceIndex(opts.block_size_nanos, root, name)
 
     def write_tagged_batch(self, docs: Sequence[Document], ts: np.ndarray,
@@ -334,9 +354,12 @@ class Database:
 
     def __init__(self, opts: DatabaseOptions | None = None,
                  namespaces: Dict[str, NamespaceOptions] | None = None,
-                 instrument=None):
+                 instrument=None, tracer=None):
+        from m3_tpu.instrument.tracing import NOOP_TRACER
+
         self.opts = opts or DatabaseOptions()
         self._scope = instrument.scope("db") if instrument is not None else None
+        self.tracer = tracer if tracer is not None else NOOP_TRACER
         # One engine-wide reentrant lock serializing state mutation:
         # ingest batches (HTTP threads), the mediator's tick/snapshot/
         # cleanup thread, bootstrap, and reads that walk buffer state.
@@ -346,9 +369,14 @@ class Database:
         # meaningful serialization beyond what the batched design has.
         self._mu = threading.RLock()
         Path(self.opts.root).mkdir(parents=True, exist_ok=True)
+        from m3_tpu.storage.block_cache import BlockCache
+
+        self.block_cache = BlockCache(instrument=instrument)
         self.namespaces: Dict[str, Namespace] = {}
         for name, nopts in (namespaces or {"default": NamespaceOptions()}).items():
-            self.namespaces[name] = Namespace(name, nopts, self.opts.root)
+            self.namespaces[name] = Namespace(
+                name, nopts, self.opts.root, self.block_cache
+            )
         self.commitlog = (
             CommitLogWriter(self.opts.root) if self.opts.commitlog_enabled else None
         )
@@ -363,7 +391,8 @@ class Database:
             ns = self.namespaces.get(name)
             if ns is None:
                 ns = self.namespaces[name] = Namespace(
-                    name, opts or NamespaceOptions(), self.opts.root
+                    name, opts or NamespaceOptions(), self.opts.root,
+                    self.block_cache,
                 )
             return ns
 
@@ -374,7 +403,9 @@ class Database:
         vals = np.asarray(vals, np.float64)
         if now_nanos is None:
             now_nanos = int(ts.max())
-        with self._mu:
+        with self._mu, self.tracer.start_span(
+            Tracepoint.DB_WRITE_BATCH, {"n": len(ids), "ns": namespace}
+        ):
             if self.commitlog is not None:
                 self.commitlog.write_batch(list(ids), ts, vals,
                                            namespace=namespace.encode())
@@ -389,7 +420,10 @@ class Database:
         vals = np.asarray(vals, np.float64)
         if now_nanos is None:
             now_nanos = int(ts.max())
-        with self._mu:
+        with self._mu, self.tracer.start_span(
+            Tracepoint.DB_WRITE_BATCH, {"n": len(docs), "ns": namespace,
+                                        "tagged": True}
+        ):
             if self.commitlog is not None:
                 # Tags ride the annotation field so WAL replay can rebuild
                 # index documents (the reference's commitlog entries carry
@@ -403,17 +437,19 @@ class Database:
             return ns.write_tagged_batch(docs, ts, vals, now_nanos)
 
     def query_ids(self, namespace: str, q: Query, start: int, end: int):
-        with self._mu:
+        with self._mu, self.tracer.start_span(
+            Tracepoint.DB_QUERY_IDS, {"ns": namespace}
+        ):
             return self.namespaces[namespace].query_ids(q, start, end)
 
     def read(self, namespace: str, sid: bytes, start: int, end: int):
         if self._scope is not None:
             self._scope.counter("reads").inc()
-        with self._mu:
+        with self._mu, self.tracer.start_span(Tracepoint.DB_READ):
             return self.namespaces[namespace].read(sid, start, end)
 
     def tick(self, now_nanos: int) -> dict:
-        with self._mu:
+        with self._mu, self.tracer.start_span(Tracepoint.DB_TICK):
             stats = {}
             for name, ns in self.namespaces.items():
                 stats[name] = ns.tick(now_nanos)
@@ -426,7 +462,7 @@ class Database:
         log rotates first so the snapshot covers everything in the
         now-inactive logs — recovery then replays only seq >= the active
         log (`snapshot_metadata_write.go` commitlog-identifier role)."""
-        with self._mu:
+        with self._mu, self.tracer.start_span(Tracepoint.DB_SNAPSHOT):
             seq = snap.next_snapshot_seq(self.opts.root)
             if self.commitlog is not None:
                 self.commitlog.rotate()
@@ -463,6 +499,9 @@ class Database:
                 for bs, vol in vols:
                     if bs <= cutoff or vol < max_vol[bs]:
                         remove_fileset(self.opts.root, ns.name, shard.shard_id, bs, vol)
+                        self.block_cache.invalidate_block(
+                            ns.name, shard.shard_id, bs
+                        )
                         stats["filesets"] += 1
                         if bs <= cutoff:
                             shard.flushed_blocks.discard(bs)
@@ -547,7 +586,7 @@ class Database:
         `storage/bootstrap/process.go` + bootstrapper/README.md: filesets
         first, then the latest snapshot, then WAL-tail replay for whatever
         isn't covered — `bootstrapper/commitlog` reads snapshots + WAL)."""
-        with self._mu:
+        with self._mu, self.tracer.start_span(Tracepoint.DB_BOOTSTRAP):
             return self._bootstrap_locked()
 
     def _bootstrap_locked(self) -> dict:

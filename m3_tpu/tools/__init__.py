@@ -1,0 +1,1 @@
+"""Operational tooling (reference `src/cmd/tools/*`)."""

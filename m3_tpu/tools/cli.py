@@ -1,0 +1,201 @@
+"""Ops tools: inspect and verify on-disk artifacts.
+
+Equivalents of the reference's `src/cmd/tools/*`: `read_data_files`
+(dump series from a fileset), `read_index_files` (dump index segment
+terms), `read_commitlog` (dump WAL entries), `verify_data_files`
+(checksum-verify every fileset), `clone_fileset`, and
+`query_index_segments` (run a term query against sealed segments).
+One binary, subcommand per tool, JSON-lines output for scripting.
+
+Usage:  python -m m3_tpu.tools.cli <tool> [args...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+from m3_tpu.encoding.m3tsz import decode_series
+from m3_tpu.persist.commitlog import list_commitlogs, read_commitlog
+from m3_tpu.persist.fs import (
+    DataFileSetReader, DataFileSetWriter, list_fileset_volumes, list_filesets,
+)
+
+
+def _out(obj) -> None:
+    sys.stdout.write(json.dumps(obj) + "\n")
+
+
+def _namespaces(root: str) -> list[str]:
+    d = Path(root) / "data"
+    return sorted(p.name for p in d.iterdir() if p.is_dir()) if d.exists() else []
+
+
+def _shards(root: str, ns: str) -> list[int]:
+    d = Path(root) / "data" / ns
+    return sorted(int(p.name) for p in d.iterdir() if p.name.isdigit()) if d.exists() else []
+
+
+def read_data_files(args) -> int:
+    """Dump every (id, points) from filesets (cmd/tools/read_data_files)."""
+    for ns in ([args.namespace] if args.namespace else _namespaces(args.root)):
+        for shard in ([args.shard] if args.shard is not None else _shards(args.root, ns)):
+            for bs, vol in list_filesets(args.root, ns, shard):
+                if args.block_start is not None and bs != args.block_start:
+                    continue
+                r = DataFileSetReader(args.root, ns, shard, bs, vol)
+                for sid, seg in r.read_all():
+                    if args.id and args.id.encode() != sid:
+                        continue
+                    pts = decode_series(seg)
+                    _out({
+                        "namespace": ns, "shard": shard, "block_start": bs,
+                        "volume": vol, "id": sid.decode(errors="replace"),
+                        "points": [[d.timestamp, d.value] for d in pts],
+                    })
+    return 0
+
+
+def read_index_files(args) -> int:
+    """Dump sealed index segments (cmd/tools/read_index_files)."""
+    from m3_tpu.index.segment import SealedSegment
+
+    d = Path(args.root) / "index"
+    for nsdir in sorted(d.iterdir()) if d.exists() else []:
+        for f in sorted(nsdir.glob("segment-*.db")):
+            seg = SealedSegment.from_bytes(f.read_bytes())
+            fields = {}
+            for name in seg.fields():
+                fields[name.decode(errors="replace")] = [
+                    v.decode(errors="replace") for v in seg.terms(name)
+                ]
+            _out({
+                "namespace": nsdir.name,
+                "block_start": int(f.stem.split("-")[1]),
+                "num_docs": len(seg),
+                "fields": fields,
+            })
+    return 0
+
+
+def read_commitlog_cmd(args) -> int:
+    """Dump WAL entries (cmd/tools/read_commitlog)."""
+    if not args.file and not args.root:
+        print("read_commitlog: provide a data root or --file", file=sys.stderr)
+        return 2
+    logs = [Path(args.file)] if args.file else list_commitlogs(args.root)
+    for log in logs:
+        for e in read_commitlog(log):
+            _out({
+                "log": log.name, "namespace": e.namespace.decode(),
+                "id": e.series_id.decode(errors="replace"),
+                "timestamp": e.timestamp, "value": e.value,
+            })
+    return 0
+
+
+def verify_data_files(args) -> int:
+    """Checksum-verify every fileset; exit 1 on any corruption
+    (cmd/tools/verify_data_files).  The reader validates checkpoint →
+    digest → per-file adler32 → per-segment checksums."""
+    bad = 0
+    for ns in _namespaces(args.root):
+        for shard in _shards(args.root, ns):
+            for bs, vol in list_fileset_volumes(args.root, ns, shard):
+                try:
+                    r = DataFileSetReader(args.root, ns, shard, bs, vol)
+                    n = sum(1 for _ in r.read_all())
+                    _out({"namespace": ns, "shard": shard, "block_start": bs,
+                          "volume": vol, "ok": True, "series": n})
+                except (ValueError, FileNotFoundError, EOFError) as e:
+                    bad += 1
+                    _out({"namespace": ns, "shard": shard, "block_start": bs,
+                          "volume": vol, "ok": False, "error": str(e)})
+    return 1 if bad else 0
+
+
+def clone_fileset(args) -> int:
+    """Copy one fileset to another root/namespace/shard, re-writing (and
+    re-checksumming) it (cmd/tools/clone_fileset)."""
+    r = DataFileSetReader(args.root, args.namespace, args.shard,
+                          args.block_start, args.volume)
+    series = list(r.read_all())
+    DataFileSetWriter(
+        args.dest_root, args.dest_namespace or args.namespace,
+        args.dest_shard if args.dest_shard is not None else args.shard,
+        args.block_start, r.info.block_size, volume=args.volume,
+    ).write_all(series)
+    _out({"cloned": len(series)})
+    return 0
+
+
+def query_index_segments(args) -> int:
+    """Run a term query against sealed segments
+    (cmd/tools/query_index_segments)."""
+    from m3_tpu.index.namespace_index import NamespaceIndex
+    from m3_tpu.index.search import Term
+
+    idx = NamespaceIndex(args.block_size, args.root, args.namespace)
+    q = Term(args.field.encode(), args.value.encode())
+    docs = idx.query(q, -(2**62), 2**62)
+    for d in docs:
+        _out({"id": d.id.decode(errors="replace"),
+              "tags": {k.decode(): v.decode() for k, v in d.tags().items()}})
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="m3tpu-tools", description=__doc__)
+    sub = p.add_subparsers(dest="tool", required=True)
+
+    rd = sub.add_parser("read_data_files")
+    rd.add_argument("root")
+    rd.add_argument("--namespace")
+    rd.add_argument("--shard", type=int)
+    rd.add_argument("--block-start", type=int, dest="block_start")
+    rd.add_argument("--id")
+    rd.set_defaults(fn=read_data_files)
+
+    ri = sub.add_parser("read_index_files")
+    ri.add_argument("root")
+    ri.set_defaults(fn=read_index_files)
+
+    rc = sub.add_parser("read_commitlog")
+    rc.add_argument("root", nargs="?")
+    rc.add_argument("--file")
+    rc.set_defaults(fn=read_commitlog_cmd)
+
+    vf = sub.add_parser("verify_data_files")
+    vf.add_argument("root")
+    vf.set_defaults(fn=verify_data_files)
+
+    cl = sub.add_parser("clone_fileset")
+    cl.add_argument("root")
+    cl.add_argument("namespace")
+    cl.add_argument("shard", type=int)
+    cl.add_argument("block_start", type=int)
+    cl.add_argument("dest_root")
+    cl.add_argument("--volume", type=int, default=0)
+    cl.add_argument("--dest-namespace", dest="dest_namespace")
+    cl.add_argument("--dest-shard", type=int, dest="dest_shard")
+    cl.set_defaults(fn=clone_fileset)
+
+    qi = sub.add_parser("query_index_segments")
+    qi.add_argument("root")
+    qi.add_argument("field")
+    qi.add_argument("value")
+    qi.add_argument("--namespace", default="default")
+    qi.add_argument("--block-size", type=int, dest="block_size",
+                    default=2 * 3600 * 10**9)
+    qi.set_defaults(fn=query_index_segments)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

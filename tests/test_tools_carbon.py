@@ -1,0 +1,222 @@
+"""Ops tools CLI, carbon line protocol, block cache, tracing.
+
+Reference models: `src/cmd/tools/*` (read/verify/clone tools),
+`src/metrics/carbon` + the coordinator carbon ingester,
+`src/dbnode/persist/fs/seek_manager.go` + WiredList caching,
+`src/x/opentracing` + tracepoint registries.
+"""
+
+import io
+import json
+import socket
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from m3_tpu.instrument.tracing import Tracepoint, Tracer
+from m3_tpu.metrics.carbon import (
+    document_to_path, parse_line, parse_lines, path_to_document,
+    serve_carbon_background,
+)
+from m3_tpu.storage.block_cache import BlockCache
+from m3_tpu.storage.database import Database, DatabaseOptions, NamespaceOptions
+from m3_tpu.tools import cli
+
+BLOCK = 2 * 3600 * 10**9
+START = (1_700_000_000 * 10**9) // BLOCK * BLOCK
+NS_OPTS = NamespaceOptions(num_shards=2, slot_capacity=1 << 10,
+                           sample_capacity=1 << 12)
+
+
+def _seeded_db(root):
+    db = Database(DatabaseOptions(root=str(root)), namespaces={"default": NS_OPTS})
+    ids = [b"cpu.a", b"cpu.b", b"mem.c"] * 4
+    ts = START + np.arange(12, dtype=np.int64) * 10**9
+    db.write_batch("default", ids, ts, np.arange(12.0))
+    db.tick(START + BLOCK + NS_OPTS.buffer_past_nanos + 10**9)
+    return db
+
+
+def _run_cli(argv, capsys):
+    rc = cli.main(argv)
+    out = capsys.readouterr().out
+    return rc, [json.loads(l) for l in out.splitlines() if l.strip()]
+
+
+class TestTools:
+    def test_read_data_files(self, tmp_path, capsys):
+        db = _seeded_db(tmp_path)
+        rc, rows = _run_cli(["read_data_files", str(tmp_path)], capsys)
+        assert rc == 0
+        ids = {r["id"] for r in rows}
+        assert ids == {"cpu.a", "cpu.b", "mem.c"}
+        for r in rows:
+            assert len(r["points"]) == 4
+        db.close()
+
+    def test_read_commitlog(self, tmp_path, capsys):
+        db = _seeded_db(tmp_path)
+        db.close()
+        rc, rows = _run_cli(["read_commitlog", str(tmp_path)], capsys)
+        assert rc == 0
+        assert len(rows) == 12
+        assert rows[0]["namespace"] == "default"
+
+    def test_verify_data_files_detects_corruption(self, tmp_path, capsys):
+        db = _seeded_db(tmp_path)
+        db.close()
+        rc, rows = _run_cli(["verify_data_files", str(tmp_path)], capsys)
+        assert rc == 0 and all(r["ok"] for r in rows)
+        # corrupt one data file
+        from m3_tpu.persist.fs import fileset_dir
+
+        victim = next(iter(fileset_dir(tmp_path, "default", 0).glob("*-data.db")))
+        raw = bytearray(victim.read_bytes())
+        if not raw:
+            pytest.skip("empty shard")
+        raw[len(raw) // 2] ^= 0xFF
+        victim.write_bytes(bytes(raw))
+        rc, rows = _run_cli(["verify_data_files", str(tmp_path)], capsys)
+        assert rc == 1
+        assert any(not r["ok"] for r in rows)
+
+    def test_clone_fileset(self, tmp_path, capsys):
+        db = _seeded_db(tmp_path)
+        db.close()
+        from m3_tpu.persist.fs import list_filesets
+
+        bs, vol = list_filesets(tmp_path, "default", 0)[0]
+        dest = tmp_path / "clone"
+        rc, rows = _run_cli([
+            "clone_fileset", str(tmp_path), "default", "0", str(bs), str(dest),
+            "--volume", str(vol),
+        ], capsys)
+        assert rc == 0 and rows[0]["cloned"] >= 1
+        rc2, rows2 = _run_cli(["verify_data_files", str(dest)], capsys)
+        assert rc2 == 0 and rows2
+
+
+class TestCarbon:
+    def test_parse_line(self):
+        s = parse_line(b"foo.bar.baz 42.5 1700000000")
+        assert s.path == b"foo.bar.baz"
+        assert s.value == 42.5
+        assert s.timestamp_nanos == 1_700_000_000 * 10**9
+
+    def test_parse_rejects_malformed(self):
+        for bad in (b"", b"# comment", b"noval 1", b"a..b 1 2",
+                    b".lead 1 2", b"trail. 1 2", b"x nanb 2", b"x 1 notts",
+                    b"x nan 1700000000"):
+            assert parse_line(bad) is None, bad
+
+    def test_now_timestamp(self):
+        s = parse_line(b"a.b 1 -1", now_nanos=123)
+        assert s.timestamp_nanos == 123
+
+    def test_path_document_roundtrip(self):
+        d = path_to_document(b"servers.web01.cpu")
+        assert d.tags()[b"__g1__"] == b"web01"
+        assert document_to_path(d) == b"servers.web01.cpu"
+
+    def test_tcp_ingest_end_to_end(self, tmp_path):
+        db = Database(DatabaseOptions(root=str(tmp_path)),
+                      namespaces={"default": NS_OPTS})
+        srv = serve_carbon_background(
+            lambda docs, ts, vals: db.write_tagged_batch("default", docs, ts, vals)
+        )
+        sock = socket.create_connection(("127.0.0.1", srv.port))
+        t0 = START // 10**9
+        lines = b"".join(
+            b"servers.web01.cpu %d %d\nbogus line\n" % (i, t0 + i)
+            for i in range(5)
+        )
+        sock.sendall(lines)
+        sock.close()
+        deadline = time.monotonic() + 60
+        pts = []
+        while time.monotonic() < deadline and len(pts) < 5:
+            pts = db.read("default", b"servers.web01.cpu", START, START + BLOCK)
+            time.sleep(0.05)
+        assert [v for _, v in pts] == [0.0, 1.0, 2.0, 3.0, 4.0]
+        # graphite tags are indexed
+        from m3_tpu.index.search import Term
+
+        docs = db.query_ids("default", Term(b"__g1__", b"web01"), START,
+                            START + BLOCK)
+        assert len(docs) == 1
+        srv.shutdown()
+        db.close()
+
+
+class TestBlockCache:
+    def test_hit_after_miss_and_lru_bound(self, tmp_path):
+        db = _seeded_db(tmp_path)
+        cache = db.block_cache
+        r1 = db.read("default", b"cpu.a", START, START + BLOCK)
+        stats1 = cache.stats
+        r2 = db.read("default", b"cpu.a", START, START + BLOCK)
+        assert r1 == r2 and len(r1) == 4
+        assert cache.stats["series_blocks"] == stats1["series_blocks"]
+        db.close()
+
+    def test_invalidation_on_cold_flush(self, tmp_path):
+        db = _seeded_db(tmp_path)
+        before = db.read("default", b"cpu.a", START, START + BLOCK)
+        # cold write into flushed block, then cold flush -> volume 1
+        late_t = START + 77 * 10**9
+        db.write_batch("default", [b"cpu.a"], np.asarray([late_t]),
+                       np.asarray([321.0]))
+        db.tick(START + BLOCK + NS_OPTS.buffer_past_nanos + 10**9)
+        after = dict(db.read("default", b"cpu.a", START, START + BLOCK))
+        assert after[late_t] == 321.0
+        assert len(after) == len(before) + 1
+        db.close()
+
+    def test_bounded(self, tmp_path):
+        c = BlockCache(max_readers=2, max_series_blocks=3)
+        for i in range(10):
+            c._series[("k", i)] = []
+            while len(c._series) > c.max_series_blocks:
+                c._series.popitem(last=False)
+        assert len(c._series) <= 3
+
+
+class TestTracing:
+    def test_span_nesting_and_ring(self):
+        tr = Tracer(max_finished=8)
+        with tr.start_span("outer") as outer:
+            with tr.start_span("inner") as inner:
+                inner.set_tag("k", 1)
+        spans = tr.finished()
+        byname = {s.name: s for s in spans}
+        assert byname["inner"].parent_id == byname["outer"].span_id
+        assert byname["inner"].trace_id == byname["outer"].trace_id
+        assert byname["inner"].tags == {"k": 1}
+        assert byname["outer"].duration_ns >= byname["inner"].duration_ns
+
+    def test_error_capture(self):
+        tr = Tracer()
+        with pytest.raises(ValueError):
+            with tr.start_span("boom"):
+                raise ValueError("nope")
+        assert "ValueError" in tr.finished("boom")[0].error
+
+    def test_db_tracepoints(self, tmp_path):
+        tr = Tracer()
+        db = Database(DatabaseOptions(root=str(tmp_path)),
+                      namespaces={"default": NS_OPTS}, tracer=tr)
+        db.write_batch("default", [b"x"], np.asarray([START]), np.asarray([1.0]))
+        db.read("default", b"x", START, START + BLOCK)
+        names = {s.name for s in tr.finished()}
+        assert Tracepoint.DB_WRITE_BATCH in names
+        assert Tracepoint.DB_READ in names
+        db.close()
+
+    def test_ring_bounded(self):
+        tr = Tracer(max_finished=4)
+        for i in range(20):
+            with tr.start_span(f"s{i}"):
+                pass
+        assert len(tr.finished()) == 4
