@@ -81,10 +81,16 @@ class KVStore:
                 )
             return self.set(key, data)
 
-    def delete(self, key: str) -> None:
+    def delete(self, key: str) -> bool:
+        """Returns whether the key existed."""
         with self._lock:
-            self._data.pop(key, None)
+            existed = self._data.pop(key, None) is not None
             self._persist()
+            return existed
+
+    def keys(self) -> list[str]:
+        with self._lock:
+            return sorted(self._data)
 
     def watch(self, key: str, fn: Callable[[VersionedValue], None]) -> None:
         """Register a watcher; fired inline on every set (the reference

@@ -1,0 +1,1 @@
+"""Collection agent (reference `src/collector`)."""

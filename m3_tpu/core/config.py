@@ -83,18 +83,39 @@ class NamespaceConfig:
 
 
 @dataclasses.dataclass
+class LimitsConfig:
+    """Per-query limits; 0 disables (reference storage/limits config)."""
+
+    max_docs_matched: int = 0
+    max_series_read: int = 0
+    max_bytes_read: int = 0
+    lookback: str = "5s"
+
+    def validate(self, errs: list) -> None:
+        try:
+            parse_duration(self.lookback)
+        except ConfigError as e:
+            errs.append(f"db.limits.lookback: {e}")
+        for f in ("max_docs_matched", "max_series_read", "max_bytes_read"):
+            if getattr(self, f) < 0:
+                errs.append(f"db.limits.{f}: must be >= 0")
+
+
+@dataclasses.dataclass
 class DBConfig:
     root: str = "m3tpu_data"
     commitlog_enabled: bool = True
     namespaces: Dict[str, NamespaceConfig] = dataclasses.field(
         default_factory=lambda: {"default": NamespaceConfig()}
     )
+    limits: LimitsConfig = dataclasses.field(default_factory=LimitsConfig)
 
     def validate(self, errs: list) -> None:
         if not self.namespaces:
             errs.append("db.namespaces: at least one namespace required")
         for name, ns in self.namespaces.items():
             ns.validate(f"db.namespaces.{name}", errs)
+        self.limits.validate(errs)
 
 
 @dataclasses.dataclass
@@ -172,7 +193,9 @@ def _build(cls, data, path: str):
     for k, v in data.items():
         if k not in fields:
             raise ConfigError(f"{path}.{k}: unknown field")
-        if k == "namespaces":
+        if k == "limits" and cls is DBConfig:
+            kwargs[k] = _build(LimitsConfig, v, f"{path}.limits")
+        elif k == "namespaces":
             kwargs[k] = {
                 name: _build(NamespaceConfig, nsv, f"{path}.namespaces.{name}")
                 for name, nsv in (v or {}).items()

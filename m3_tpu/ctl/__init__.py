@@ -1,0 +1,1 @@
+"""Rules management service (reference `src/ctl` — r2)."""

@@ -50,7 +50,14 @@ def parse_line(line: bytes, now_nanos: int = 0) -> CarbonSample | None:
         return None
     if math.isnan(value):
         return None
-    ts_nanos = now_nanos if ts == -1 else int(ts * 1e9)
+    if ts == -1:
+        ts_nanos = now_nanos
+    else:
+        # Non-finite or out-of-int64-range timestamps must be skipped,
+        # not crash the connection handler ("never fatal" contract).
+        if not math.isfinite(ts) or not (0 <= ts < 2**63 / 1e9):
+            return None
+        ts_nanos = int(ts * 1e9)
     return CarbonSample(path, value, ts_nanos)
 
 

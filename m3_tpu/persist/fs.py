@@ -166,7 +166,11 @@ class DataFileSetReader:
         self.info = FileSetInfo.from_bytes(p("info").read_bytes())
         self._index = self._parse_index(p("index").read_bytes())
         self._ids = [e.id for e in self._index]
-        self._data = p("data").read_bytes()
+        # Data segments are read on demand (seek + read per lookup, one
+        # sequential pass for read_all) — a long-lived reader (the block
+        # cache keeps up to 64 open) must not pin whole data files in
+        # memory; the reference's seek manager mmaps for the same reason.
+        self._data_path = p("data")
         self.bloom = BloomFilter.from_bytes(p("bloom").read_bytes())
 
     @staticmethod
@@ -192,17 +196,21 @@ class DataFileSetReader:
         if i < 0 or self._ids[i] != sid:
             return None
         e = self._index[i]
-        seg = self._data[e.offset : e.offset + e.length]
+        with open(self._data_path, "rb") as f:
+            f.seek(e.offset)
+            seg = f.read(e.length)
         if digest(seg) != e.checksum:
             raise ValueError(f"segment checksum mismatch for {sid!r}")
         return seg
 
     def read_all(self) -> Iterator[tuple[bytes, bytes]]:
-        for e in self._index:
-            seg = self._data[e.offset : e.offset + e.length]
-            if digest(seg) != e.checksum:
-                raise ValueError(f"segment checksum mismatch for {e.id!r}")
-            yield e.id, seg
+        with open(self._data_path, "rb") as f:
+            for e in self._index:  # index entries are offset-ordered
+                f.seek(e.offset)
+                seg = f.read(e.length)
+                if digest(seg) != e.checksum:
+                    raise ValueError(f"segment checksum mismatch for {e.id!r}")
+                yield e.id, seg
 
     def __len__(self) -> int:
         return len(self._index)

@@ -89,6 +89,17 @@ def run_node(source, start_mediator: bool | None = None,
 
         tracer = Tracer()
 
+    from m3_tpu.storage.limits import LimitsOptions, QueryLimits
+
+    limits = QueryLimits(
+        LimitsOptions(
+            max_docs_matched=cfg.db.limits.max_docs_matched,
+            max_series_read=cfg.db.limits.max_series_read,
+            max_bytes_read=cfg.db.limits.max_bytes_read,
+            lookback_s=parse_duration(cfg.db.limits.lookback) / 1e9,
+        ),
+        instrument=scope,
+    )
     db = Database(
         DatabaseOptions(
             root=cfg.db.root, commitlog_enabled=cfg.db.commitlog_enabled
@@ -98,6 +109,7 @@ def run_node(source, start_mediator: bool | None = None,
         },
         instrument=scope,
         tracer=tracer,
+        limits=limits,
     )
     # Tear down everything already started if a later step fails (e.g.
     # the carbon port is taken) — a half-built node must not leak its

@@ -24,6 +24,7 @@ from m3_tpu.index.search import All, FieldExists, Term
 from m3_tpu.query.engine import Engine
 from m3_tpu.query.storage_adapter import DatabaseStorage
 from m3_tpu.storage.database import Database
+from m3_tpu.storage.limits import QueryLimitExceeded
 
 _DUR_RE = re.compile(r"^(\d+(?:\.\d+)?)([smhdwy]|ms)$")
 
@@ -88,6 +89,8 @@ class _Handler(BaseHTTPRequestHandler):
             if u.path == "/api/v1/series":
                 return self._series(q)
             return self._error(404, f"unknown path {u.path}")
+        except QueryLimitExceeded as e:
+            return self._error(429, str(e))
         except Exception as e:  # noqa: BLE001 — API boundary
             return self._error(400, str(e))
 
@@ -100,6 +103,8 @@ class _Handler(BaseHTTPRequestHandler):
                 q = parse_qs(self._body().decode())
                 return self._query(u.path.endswith("query_range"), q)
             return self._error(404, f"unknown path {u.path}")
+        except QueryLimitExceeded as e:
+            return self._error(429, str(e))
         except Exception as e:  # noqa: BLE001
             return self._error(400, str(e))
 

@@ -47,6 +47,7 @@ from m3_tpu.persist.fs import (
 )
 from m3_tpu.persist import snapshot as snap
 from m3_tpu.instrument.tracing import Tracepoint
+from m3_tpu.storage.limits import NO_LIMITS, QueryLimits
 from m3_tpu.storage.buffer import ShardBuffer, dedupe_last_write_wins
 from m3_tpu.storage.series_merge import merge_point_sources
 
@@ -354,12 +355,13 @@ class Database:
 
     def __init__(self, opts: DatabaseOptions | None = None,
                  namespaces: Dict[str, NamespaceOptions] | None = None,
-                 instrument=None, tracer=None):
+                 instrument=None, tracer=None, limits: QueryLimits | None = None):
         from m3_tpu.instrument.tracing import NOOP_TRACER
 
         self.opts = opts or DatabaseOptions()
         self._scope = instrument.scope("db") if instrument is not None else None
         self.tracer = tracer if tracer is not None else NOOP_TRACER
+        self.limits = limits if limits is not None else NO_LIMITS
         # One engine-wide reentrant lock serializing state mutation:
         # ingest batches (HTTP threads), the mediator's tick/snapshot/
         # cleanup thread, bootstrap, and reads that walk buffer state.
@@ -440,13 +442,20 @@ class Database:
         with self._mu, self.tracer.start_span(
             Tracepoint.DB_QUERY_IDS, {"ns": namespace}
         ):
-            return self.namespaces[namespace].query_ids(q, start, end)
+            docs = self.namespaces[namespace].query_ids(q, start, end)
+        # windowed per-query limit (reference storage/limits: docs-matched)
+        self.limits.inc_docs(len(docs))
+        return docs
 
     def read(self, namespace: str, sid: bytes, start: int, end: int):
         if self._scope is not None:
             self._scope.counter("reads").inc()
+        self.limits.inc_series(1)
         with self._mu, self.tracer.start_span(Tracepoint.DB_READ):
-            return self.namespaces[namespace].read(sid, start, end)
+            pts = self.namespaces[namespace].read(sid, start, end)
+        # 16 bytes per (ts, value) sample — the bytes-read accounting unit
+        self.limits.inc_bytes(16 * len(pts))
+        return pts
 
     def tick(self, now_nanos: int) -> dict:
         with self._mu, self.tracer.start_span(Tracepoint.DB_TICK):

@@ -1,0 +1,101 @@
+"""Per-query resource limits with lookback windows.
+
+Equivalent of `src/dbnode/storage/limits`: global windowed limits on
+docs matched and series/bytes read — each limit accumulates within a
+lookback window and every query checks-and-adds before doing work;
+exceeding returns a typed error the API maps to HTTP 429/400 rather
+than letting one heavy query exhaust the node.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+
+class QueryLimitExceeded(RuntimeError):
+    def __init__(self, name: str, value: int, limit: int):
+        super().__init__(
+            f"query limit exceeded: {name} ({value} > {limit} within window)"
+        )
+        self.name = name
+
+
+class _WindowedLimit:
+    """check-and-add within a rolling lookback window
+    (reference limits/query_limits.go lookbackLimit)."""
+
+    def __init__(self, name: str, limit: int, lookback_s: float,
+                 now=time.monotonic):
+        self.name = name
+        self.limit = limit
+        self.lookback_s = lookback_s
+        self._now = now
+        self._value = 0
+        self._window_start = now()
+        self._lock = threading.Lock()
+
+    def inc(self, n: int) -> None:
+        if self.limit <= 0:  # disabled
+            return
+        with self._lock:
+            t = self._now()
+            if t - self._window_start >= self.lookback_s:
+                self._value = 0
+                self._window_start = t
+            self._value += n
+            if self._value > self.limit:
+                raise QueryLimitExceeded(self.name, self._value, self.limit)
+
+    @property
+    def current(self) -> int:
+        return self._value
+
+
+@dataclass(frozen=True)
+class LimitsOptions:
+    """0 disables a limit (the reference's default)."""
+
+    max_docs_matched: int = 0
+    max_series_read: int = 0
+    max_bytes_read: int = 0
+    lookback_s: float = 5.0
+
+
+class QueryLimits:
+    def __init__(self, opts: LimitsOptions | None = None, now=time.monotonic,
+                 instrument=None):
+        self.opts = opts or LimitsOptions()
+        self.docs = _WindowedLimit(
+            "docs-matched", self.opts.max_docs_matched, self.opts.lookback_s, now
+        )
+        self.series = _WindowedLimit(
+            "series-read", self.opts.max_series_read, self.opts.lookback_s, now
+        )
+        self.bytes = _WindowedLimit(
+            "bytes-read", self.opts.max_bytes_read, self.opts.lookback_s, now
+        )
+        self._scope = (
+            instrument.scope("query_limits") if instrument is not None else None
+        )
+
+    def inc_docs(self, n: int) -> None:
+        self._inc(self.docs, n)
+
+    def inc_series(self, n: int) -> None:
+        self._inc(self.series, n)
+
+    def inc_bytes(self, n: int) -> None:
+        self._inc(self.bytes, n)
+
+    def _inc(self, lim: _WindowedLimit, n: int) -> None:
+        try:
+            lim.inc(n)
+        except QueryLimitExceeded:
+            if self._scope is not None:
+                self._scope.counter(f"exceeded_{lim.name}").inc()
+            raise
+
+
+NO_LIMITS = QueryLimits(LimitsOptions())

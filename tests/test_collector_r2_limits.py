@@ -1,0 +1,250 @@
+"""Collector reporter, r2 rules service, query limits, rules JSON.
+
+Reference models: `src/collector/reporter` (client-side pre-aggregation),
+`src/ctl` (r2 rules CRUD with versioning), `src/dbnode/storage/limits`
+(windowed query limits), `src/metrics/rules/view` (rule serialization).
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from m3_tpu.cluster.kv import KVStore
+from m3_tpu.collector.reporter import Reporter
+from m3_tpu.ctl.r2 import RulesStore, VersionConflict, serve_r2_background
+from m3_tpu.metrics.aggregation import AggregationID, AggregationType
+from m3_tpu.metrics.filters import TagsFilter
+from m3_tpu.metrics.pipeline import (
+    AggregationOp, Pipeline, RollupOp, TransformationOp,
+)
+from m3_tpu.metrics.policy import StoragePolicy
+from m3_tpu.metrics.rules import MappingRule, RollupRule, RollupTarget, RuleSet
+from m3_tpu.metrics.rules_json import ruleset_from_json, ruleset_to_json
+from m3_tpu.metrics.transformation import TransformationType
+from m3_tpu.metrics.types import MetricType
+from m3_tpu.storage.limits import (
+    LimitsOptions, QueryLimitExceeded, QueryLimits,
+)
+
+
+def _ruleset():
+    return RuleSet(
+        namespace="default",
+        mapping_rules=[
+            MappingRule(
+                name="keep-web",
+                filter=TagsFilter.parse("role:web*"),
+                policies=(StoragePolicy.parse("10s:2d"),
+                          StoragePolicy.parse("1m:40d")),
+                aggregation_id=AggregationID.compress(
+                    [AggregationType.SUM, AggregationType.MAX]
+                ),
+            ),
+        ],
+        rollup_rules=[
+            RollupRule(
+                name="rollup-reqs",
+                filter=TagsFilter.parse("__name__:requests dc:us-*"),
+                targets=(RollupTarget(
+                    pipeline=Pipeline((
+                        AggregationOp(AggregationType.SUM),
+                        TransformationOp(TransformationType.PER_SECOND),
+                        RollupOp(b"requests_by_dc", (b"dc",)),
+                    )),
+                    policies=(StoragePolicy.parse("1m:40d"),),
+                ),),
+            ),
+        ],
+    )
+
+
+class TestRulesJSON:
+    def test_roundtrip(self):
+        rs = _ruleset()
+        d = ruleset_to_json(rs)
+        back = ruleset_from_json(json.loads(json.dumps(d)))
+        assert back.mapping_rules == rs.mapping_rules
+        assert back.rollup_rules == rs.rollup_rules
+
+    def test_matching_survives_roundtrip(self):
+        rs = ruleset_from_json(ruleset_to_json(_ruleset()))
+        active = rs.active_at(10**9)
+        m = active.forward_match({b"role": b"webserver"})
+        assert m.mappings
+        assert str(m.mappings[0].policies[0]) == "10s:2d"
+
+
+class TestR2Service:
+    def test_crud_with_versioning(self):
+        kv = KVStore()
+        store = RulesStore(kv)
+        srv = serve_r2_background(store)
+        base = f"http://127.0.0.1:{srv.server_address[1]}/api/v1/rules"
+
+        def req(method, path="", body=None):
+            r = urllib.request.Request(
+                base + path, method=method,
+                data=json.dumps(body).encode() if body is not None else None,
+            )
+            try:
+                with urllib.request.urlopen(r) as resp:
+                    return resp.status, json.load(resp)
+            except urllib.error.HTTPError as e:
+                return e.code, json.load(e)
+
+        rs_doc = ruleset_to_json(_ruleset())
+        code, out = req("PUT", "/default", rs_doc)
+        assert code == 200
+        v1 = out["version"]
+
+        code, out = req("GET", "/default")
+        assert code == 200 and out["mapping_rules"][0]["name"] == "keep-web"
+
+        # CAS: stale expected_version is rejected
+        doc2 = dict(rs_doc, expected_version=v1 + 999)
+        code, out = req("PUT", "/default", doc2)
+        assert code == 409
+
+        doc3 = dict(rs_doc, expected_version=v1)
+        doc3["mapping_rules"] = []
+        code, out = req("PUT", "/default", doc3)
+        assert code == 200 and out["version"] > v1
+
+        code, out = req("GET", "")
+        assert out["namespaces"] == ["default"]
+
+        code, out = req("DELETE", "/default")
+        assert code == 200
+        code, out = req("GET", "/default")
+        assert code == 404
+        srv.shutdown()
+
+    def test_store_create_only_conflict(self):
+        store = RulesStore(KVStore())
+        store.set("ns", _ruleset(), None)
+        with pytest.raises(VersionConflict):
+            store.set("ns", _ruleset(), None)
+
+    def test_delete_tombstones_and_notifies_watchers(self):
+        store = RulesStore(KVStore())
+        store.set("ns", _ruleset(), None)
+        seen = []
+        store.watch("ns", lambda vv: seen.append(json.loads(vv.data)))
+        assert store.delete("ns")
+        assert seen[-1].get("tombstoned") is True  # watcher observed it
+        assert store.get("ns") is None
+        assert store.namespaces() == []
+        # recreate continues the version history
+        out = store.set("ns", _ruleset(), None)
+        assert out.version >= 3
+
+    def test_watch_fires_on_update(self):
+        store = RulesStore(KVStore())
+        seen = []
+        store.set("ns", _ruleset(), None)
+        store.watch("ns", lambda vv: seen.append(vv.version))
+        rs = store.get("ns")
+        store.set("ns", rs, rs.version)
+        assert len(seen) >= 2  # initial + update
+
+
+class TestReporter:
+    def test_counter_folds_gauge_lasts_timers_raw(self):
+        sent = []
+        r = Reporter(lambda mt, mid, v, t: sent.append((mt, mid, v)),
+                     now_nanos=lambda: 42)
+        r.count(b"reqs", 1)
+        r.count(b"reqs", 2)
+        r.gauge(b"depth", 5.0)
+        r.gauge(b"depth", 7.0)
+        r.timer(b"lat", 0.1)
+        r.timer(b"lat", 0.2)
+        n = r.flush()
+        assert n == 4
+        assert (int(MetricType.COUNTER), b"reqs", 3.0) in sent
+        assert (int(MetricType.GAUGE), b"depth", 7.0) in sent
+        timers = [s for s in sent if s[0] == int(MetricType.TIMER)]
+        assert sorted(v for _, _, v in timers) == [0.1, 0.2]
+
+    def test_idle_interval_sends_nothing(self):
+        sent = []
+        r = Reporter(lambda *a: sent.append(a), now_nanos=lambda: 0)
+        r.count(b"x", 1)
+        r.flush()
+        assert r.flush() == 0  # second interval: counter reset, gauge unset
+
+    def test_timer_buffer_bounded(self):
+        r = Reporter(lambda *a: None, max_timer_buffer=4)
+        for i in range(10):
+            r.timer(b"t", i / 10)
+        assert r.dropped_timers == 6
+
+    def test_end_to_end_with_aggregator(self):
+        from m3_tpu.aggregator.engine import Aggregator
+
+        W = 10 * 10**9
+        T0 = 1_700_000_000 * 10**9 // W * W
+        agg = Aggregator(num_shards=2)
+
+        def sink(mt, mid, v, t):
+            agg.add_untimed_batch(MetricType(mt), [mid],
+                                  np.asarray([v]), np.asarray([t], np.int64))
+
+        r = Reporter(sink, now_nanos=lambda: T0 + 10**9)
+        for _ in range(5):
+            r.count(b"hits", 2)
+        r.flush()
+        out = {}
+
+        def handler(ml, f):
+            m = ml.maps.get(f.metric_type)
+            for slot, at, v in zip(f.slots, f.types, f.values):
+                if AggregationType(int(at)) == AggregationType.SUM:
+                    out[m.id_of(int(slot))] = float(v)
+
+        agg.consume(T0 + 2 * W, handler)
+        assert out.get(b"hits") == 10.0
+
+
+class TestQueryLimits:
+    def test_docs_limit_trips(self):
+        t = [0.0]
+        lim = QueryLimits(LimitsOptions(max_docs_matched=10, lookback_s=5),
+                          now=lambda: t[0])
+        lim.inc_docs(6)
+        with pytest.raises(QueryLimitExceeded):
+            lim.inc_docs(5)
+        # window rolls over -> resets
+        t[0] = 6.0
+        lim.inc_docs(6)
+
+    def test_zero_means_disabled(self):
+        lim = QueryLimits(LimitsOptions())
+        lim.inc_docs(10**9)
+        lim.inc_bytes(10**12)
+
+    def test_database_read_counts_series_and_bytes(self, tmp_path):
+        from m3_tpu.storage.database import (
+            Database, DatabaseOptions, NamespaceOptions,
+        )
+
+        BLOCK = 2 * 3600 * 10**9
+        START = (1_700_000_000 * 10**9) // BLOCK * BLOCK
+        lim = QueryLimits(LimitsOptions(max_series_read=2, lookback_s=3600))
+        db = Database(
+            DatabaseOptions(root=str(tmp_path)),
+            namespaces={"default": NamespaceOptions(
+                num_shards=1, slot_capacity=64, sample_capacity=256)},
+            limits=lim,
+        )
+        db.write_batch("default", [b"a", b"b"],
+                       np.asarray([START, START + 1], np.int64),
+                       np.asarray([1.0, 2.0]))
+        db.read("default", b"a", START, START + BLOCK)
+        db.read("default", b"b", START, START + BLOCK)
+        with pytest.raises(QueryLimitExceeded):
+            db.read("default", b"a", START, START + BLOCK)
+        db.close()

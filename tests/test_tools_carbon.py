@@ -108,7 +108,10 @@ class TestCarbon:
     def test_parse_rejects_malformed(self):
         for bad in (b"", b"# comment", b"noval 1", b"a..b 1 2",
                     b".lead 1 2", b"trail. 1 2", b"x nanb 2", b"x 1 notts",
-                    b"x nan 1700000000"):
+                    b"x nan 1700000000",
+                    # non-finite / out-of-int64-range timestamps must be
+                    # skipped, not crash the connection handler
+                    b"x 1 nan", b"x 1 inf", b"x 1 1e30", b"x 1 -5"):
             assert parse_line(bad) is None, bad
 
     def test_now_timestamp(self):
