@@ -147,7 +147,9 @@ class _R2Handler(BaseHTTPRequestHandler):
             out = self.store.set(ns, rs, expected)
         except VersionConflict as e:
             return self._json(409, {"error": str(e)})
-        except (ValueError, KeyError) as e:
+        except (ValueError, KeyError, AttributeError, TypeError) as e:
+            # malformed documents (non-dict body, wrongly-typed fields)
+            # must be a 400, not a dropped connection
             return self._json(400, {"error": f"bad ruleset: {e}"})
         return self._json(200, ruleset_to_json(out))
 

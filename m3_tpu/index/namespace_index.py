@@ -129,9 +129,15 @@ class NamespaceIndex:
 
     # -- query path --------------------------------------------------------
 
-    def query(self, q: Query, start_nanos: int, end_nanos: int) -> list[Document]:
+    def query(self, q: Query, start_nanos: int, end_nanos: int,
+              inc_docs=None) -> list[Document]:
         """Matching documents across all block segments overlapping
-        [start, end); deduped by series ID."""
+        [start, end); deduped by series ID.
+
+        `inc_docs(n)` is called as matches accumulate (per segment) so a
+        per-query docs limit can abort the match mid-way instead of
+        after the full result materializes (reference storage/limits
+        increments during matching)."""
         out: dict[bytes, Document] = {}
         lo = self._block_for(start_nanos)
         for bs in sorted(set(self.mutable) | set(self.sealed)):
@@ -148,7 +154,10 @@ class NamespaceIndex:
                     self._mutable_view[bs] = memo
                 segs.append(memo[1])
             for seg in segs:
+                before = len(out)
                 for did in execute_segment(seg, q):
                     doc = seg.doc(int(did))
                     out.setdefault(doc.id, doc)
+                if inc_docs is not None:
+                    inc_docs(len(out) - before)
         return list(out.values())

@@ -166,12 +166,31 @@ class DataFileSetReader:
         self.info = FileSetInfo.from_bytes(p("info").read_bytes())
         self._index = self._parse_index(p("index").read_bytes())
         self._ids = [e.id for e in self._index]
-        # Data segments are read on demand (seek + read per lookup, one
-        # sequential pass for read_all) — a long-lived reader (the block
-        # cache keeps up to 64 open) must not pin whole data files in
-        # memory; the reference's seek manager mmaps for the same reason.
+        # Data segments are read on demand through ONE lazily-opened
+        # persistent handle (seek + read per lookup) — a long-lived
+        # reader (the block cache keeps up to 64) must not pin whole
+        # data files in memory, and the hot read path must not pay an
+        # open/close per segment; the reference's seek manager mmaps
+        # for the same reasons.  Callers serialize reads (engine lock).
         self._data_path = p("data")
+        self._data_f = None
         self.bloom = BloomFilter.from_bytes(p("bloom").read_bytes())
+
+    def _data_file(self):
+        if self._data_f is None:
+            self._data_f = open(self._data_path, "rb")
+        return self._data_f
+
+    def close(self) -> None:
+        if self._data_f is not None:
+            self._data_f.close()
+            self._data_f = None
+
+    def __del__(self):  # belt-and-braces for transient readers
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
 
     @staticmethod
     def _parse_index(raw: bytes) -> list[IndexEntry]:
@@ -196,21 +215,21 @@ class DataFileSetReader:
         if i < 0 or self._ids[i] != sid:
             return None
         e = self._index[i]
-        with open(self._data_path, "rb") as f:
-            f.seek(e.offset)
-            seg = f.read(e.length)
+        f = self._data_file()
+        f.seek(e.offset)
+        seg = f.read(e.length)
         if digest(seg) != e.checksum:
             raise ValueError(f"segment checksum mismatch for {sid!r}")
         return seg
 
     def read_all(self) -> Iterator[tuple[bytes, bytes]]:
-        with open(self._data_path, "rb") as f:
-            for e in self._index:  # index entries are offset-ordered
-                f.seek(e.offset)
-                seg = f.read(e.length)
-                if digest(seg) != e.checksum:
-                    raise ValueError(f"segment checksum mismatch for {e.id!r}")
-                yield e.id, seg
+        f = self._data_file()
+        for e in self._index:  # index entries are offset-ordered
+            f.seek(e.offset)
+            seg = f.read(e.length)
+            if digest(seg) != e.checksum:
+                raise ValueError(f"segment checksum mismatch for {e.id!r}")
+            yield e.id, seg
 
     def __len__(self) -> int:
         return len(self._index)

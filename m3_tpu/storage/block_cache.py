@@ -44,11 +44,14 @@ class BlockCache:
                 self._readers.move_to_end(key)
                 return r
         r = DataFileSetReader(root, namespace, shard, block_start, volume)
+        evicted = []
         with self._lock:
             self._readers[key] = r
             self._readers.move_to_end(key)
             while len(self._readers) > self.max_readers:
-                self._readers.popitem(last=False)
+                evicted.append(self._readers.popitem(last=False)[1])
+        for old in evicted:  # release the persistent data handles
+            old.close()
         return r
 
     # -- decoded blocks (WiredList role) -----------------------------------
@@ -84,6 +87,7 @@ class BlockCache:
                          block_start: int) -> None:
         """Drop every volume's entries for one block (cold flush wrote a
         superseding volume; cleanup removed the files)."""
+        closing = []
         with self._lock:
             for store in (self._readers, self._series):
                 dead = [
@@ -91,12 +95,19 @@ class BlockCache:
                     if k[1] == namespace and k[2] == shard and k[3] == block_start
                 ]
                 for k in dead:
-                    del store[k]
+                    item = store.pop(k)
+                    if store is self._readers:
+                        closing.append(item)
+        for r in closing:
+            r.close()
 
     def clear(self) -> None:
         with self._lock:
+            readers = list(self._readers.values())
             self._readers.clear()
             self._series.clear()
+        for r in readers:
+            r.close()
 
     @property
     def stats(self) -> dict:

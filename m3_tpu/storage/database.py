@@ -308,10 +308,11 @@ class Namespace:
         self.index.write_batch(list(docs), ts)
         return self.write_batch([d.id for d in docs], ts, vals, now_nanos)
 
-    def query_ids(self, q: Query, start: int, end: int) -> list[Document]:
+    def query_ids(self, q: Query, start: int, end: int,
+                  inc_docs=None) -> list[Document]:
         """Index query → matching series documents (reference db.QueryIDs
         → nsIndex.Query `storage/index.go:1483`)."""
-        return self.index.query(q, start, end)
+        return self.index.query(q, start, end, inc_docs=inc_docs)
 
     def write_batch(self, ids: Sequence[bytes], ts: np.ndarray, vals: np.ndarray,
                     now_nanos: int) -> int:
@@ -442,15 +443,20 @@ class Database:
         with self._mu, self.tracer.start_span(
             Tracepoint.DB_QUERY_IDS, {"ns": namespace}
         ):
-            docs = self.namespaces[namespace].query_ids(q, start, end)
-        # windowed per-query limit (reference storage/limits: docs-matched)
-        self.limits.inc_docs(len(docs))
-        return docs
+            # windowed per-query limit, incremented DURING matching so a
+            # heavy query aborts mid-match (reference storage/limits)
+            return self.namespaces[namespace].query_ids(
+                q, start, end, inc_docs=self.limits.inc_docs
+            )
 
     def read(self, namespace: str, sid: bytes, start: int, end: int):
         if self._scope is not None:
             self._scope.counter("reads").inc()
         self.limits.inc_series(1)
+        # bytes pre-check: an already-exhausted window rejects the read
+        # BEFORE decoding; the exact size still accounts afterwards (it
+        # is unknowable until decoded).
+        self.limits.inc_bytes(0)
         with self._mu, self.tracer.start_span(Tracepoint.DB_READ):
             pts = self.namespaces[namespace].read(sid, start, end)
         # 16 bytes per (ts, value) sample — the bytes-read accounting unit
