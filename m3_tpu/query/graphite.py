@@ -1,0 +1,759 @@
+"""Graphite query engine: path expressions, function pipeline, render.
+
+Equivalent of the reference's Graphite engine (`src/query/graphite` —
+lexer/parser under `graphite/lexer`+`native`, ~100 render functions,
+and the storage adapter translating dotted paths to tags
+`graphite/storage`).  This is the working core of that surface: a
+recursive-descent parser for nested function expressions, glob path
+resolution against the inverted index via the carbon `__g{i}__` tag
+convention (metrics/carbon.py), and the most-used render functions
+evaluated over (series × step) arrays.
+
+Series model: values aligned to a fixed step grid over [from, until);
+each bucket takes the LAST datapoint falling in it (Graphite's
+consolidation default), missing buckets are NaN.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field, replace
+
+import numpy as np
+
+from m3_tpu.index.search import (
+    All, Conjunction, FieldExists, Negation, Regexp, Term,
+)
+
+NAN = float("nan")
+
+
+# ---------------------------------------------------------------------------
+# Series model
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class GraphiteSeries:
+    name: str           # display name (mutated by alias*)
+    path: str           # the real metric path
+    values: np.ndarray  # (T,) float64, NaN = missing
+    step_nanos: int
+    start_nanos: int
+
+    def with_values(self, values, name: str | None = None) -> "GraphiteSeries":
+        return replace(self, values=np.asarray(values, np.float64),
+                       name=name if name is not None else self.name)
+
+
+# ---------------------------------------------------------------------------
+# Expression parser (reference graphite/lexer + native/parser)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class PathExpr:
+    path: str
+
+
+@dataclass(frozen=True)
+class Call:
+    name: str
+    args: tuple
+    kwargs: tuple = ()
+
+
+_NUM_RE = re.compile(r"-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?")
+_IDENT_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
+_PATH_CHARS = set(
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789"
+    "_.-*?[]:$%+#"
+)
+
+
+def _scan_path(s: str, i: int) -> int:
+    """End index of a path starting at i; ',' belongs to the path only
+    inside {...} alternations (it separates args at depth 0)."""
+    depth = 0
+    j = i
+    while j < len(s):
+        c = s[j]
+        if c == "{":
+            depth += 1
+        elif c == "}":
+            if depth == 0:
+                break
+            depth -= 1
+        elif c == ",":
+            if depth == 0:
+                break
+        elif c not in _PATH_CHARS:
+            break
+        j += 1
+    return j
+
+
+class ParseError(ValueError):
+    pass
+
+
+class _Parser:
+    def __init__(self, s: str):
+        self.s = s
+        self.i = 0
+
+    def _ws(self):
+        while self.i < len(self.s) and self.s[self.i].isspace():
+            self.i += 1
+
+    def _peek(self) -> str:
+        return self.s[self.i] if self.i < len(self.s) else ""
+
+    def parse(self):
+        self._ws()
+        out = self._expr()
+        self._ws()
+        if self.i != len(self.s):
+            raise ParseError(f"trailing input at {self.i}: {self.s[self.i:]!r}")
+        return out
+
+    def _expr(self):
+        self._ws()
+        c = self._peek()
+        if c and c in "'\"":  # NB: `"" in str` is always True
+            return self._string()
+        if c.isdigit() or (c == "-" and self.i + 1 < len(self.s)
+                           and self.s[self.i + 1].isdigit()):
+            m = _NUM_RE.match(self.s, self.i)
+            # "404.count" / "1min.load" are legal paths: only a token
+            # that ends where the path-scan ends is a number literal
+            if m.end() == _scan_path(self.s, self.i):
+                self.i = m.end()
+                text = m.group()
+                return float(text) if ("." in text or "e" in text.lower()) else int(text)
+        # identifier: function call or path
+        m = _IDENT_RE.match(self.s, self.i)
+        if m:
+            j = m.end()
+            k = j
+            while k < len(self.s) and self.s[k].isspace():
+                k += 1
+            if k < len(self.s) and self.s[k] == "(":
+                name = m.group()
+                self.i = k + 1
+                args, kwargs = self._args()
+                return Call(name, tuple(args), tuple(kwargs))
+        j = _scan_path(self.s, self.i)
+        if j == self.i:
+            raise ParseError(f"unexpected input at {self.i}: {self.s[self.i:]!r}")
+        text = self.s[self.i : j]
+        self.i = j
+        if text in ("true", "false"):
+            return text == "true"
+        return PathExpr(text)
+
+    def _args(self):
+        args: list = []
+        kwargs: list = []
+        self._ws()
+        if self._peek() == ")":
+            self.i += 1
+            return args, kwargs
+        while True:
+            self._ws()
+            # keyword argument?
+            m = _IDENT_RE.match(self.s, self.i)
+            if m:
+                k = m.end()
+                while k < len(self.s) and self.s[k].isspace():
+                    k += 1
+                if k < len(self.s) and self.s[k] == "=" and (
+                    k + 1 >= len(self.s) or self.s[k + 1] != "="
+                ):
+                    self.i = k + 1
+                    kwargs.append((m.group(), self._expr()))
+                    self._ws()
+                    if self._peek() == ",":
+                        self.i += 1
+                        continue
+                    if self._peek() == ")":
+                        self.i += 1
+                        return args, kwargs
+                    raise ParseError(f"bad arg list at {self.i}")
+            args.append(self._expr())
+            self._ws()
+            if self._peek() == ",":
+                self.i += 1
+                continue
+            if self._peek() == ")":
+                self.i += 1
+                return args, kwargs
+            raise ParseError(f"bad arg list at {self.i}")
+
+    def _string(self):
+        q = self.s[self.i]
+        self.i += 1
+        j = self.s.find(q, self.i)
+        if j < 0:
+            raise ParseError("unterminated string")
+        out = self.s[self.i : j]
+        self.i = j + 1
+        return out
+
+
+def parse_target(s: str):
+    return _Parser(s).parse()
+
+
+# ---------------------------------------------------------------------------
+# Path → index query (glob translation; reference graphite/storage)
+# ---------------------------------------------------------------------------
+
+
+def _component_to_query(i: int, comp: str):
+    tag = b"__g%d__" % i
+    if comp == "*":
+        return FieldExists(tag)
+    if not re.search(r"[*?{\[]", comp):
+        return Term(tag, comp.encode())
+    return Regexp(tag, glob_component_regex(comp).encode())
+
+
+def glob_component_regex(comp: str) -> str:
+    """Graphite glob → regexp: `*` any, `?` one, `{a,b}` alternation,
+    `[0-9]` char class (reference graphite/graphite.go GlobToRegexPattern)."""
+    out = []
+    i = 0
+    while i < len(comp):
+        c = comp[i]
+        if c == "*":
+            out.append("[^.]*")
+        elif c == "?":
+            out.append("[^.]")
+        elif c == "{":
+            j = comp.find("}", i)
+            if j < 0:
+                raise ParseError(f"unbalanced {{ in {comp!r}")
+            alts = comp[i + 1 : j].split(",")
+            out.append("(?:" + "|".join(re.escape(a) for a in alts) + ")")
+            i = j
+        elif c == "[":
+            j = comp.find("]", i)
+            if j < 0:
+                raise ParseError(f"unbalanced [ in {comp!r}")
+            out.append(comp[i : j + 1])
+            i = j
+        else:
+            out.append(re.escape(c))
+        i += 1
+    return "".join(out)
+
+
+def path_to_index_query(path: str):
+    comps = path.split(".")
+    qs = [_component_to_query(i, c) for i, c in enumerate(comps)]
+    # exactly-N-components: component N must not exist
+    qs.append(Negation(FieldExists(b"__g%d__" % len(comps))))
+    return Conjunction(*qs)
+
+
+# ---------------------------------------------------------------------------
+# Storage bridge
+# ---------------------------------------------------------------------------
+
+
+MAX_RENDER_POINTS = 100_000  # per-series grid cap: one request must not OOM
+
+
+class GraphiteStorage:
+    """Fetch graphite-shaped series from a Database namespace."""
+
+    def __init__(self, db, namespace: str = "default",
+                 max_points: int = MAX_RENDER_POINTS):
+        self.db = db
+        self.namespace = namespace
+        self.max_points = max_points
+
+    def fetch(self, path: str, start: int, end: int,
+              step: int) -> list[GraphiteSeries]:
+        from m3_tpu.metrics.carbon import document_to_path
+
+        if step <= 0:
+            raise ParseError("step must be positive")
+        T = max(0, (end - start) // step)
+        if T > self.max_points:
+            # an unauthenticated /render must not drive the node to OOM
+            # (query limits never see numpy grid allocations)
+            raise ParseError(
+                f"render grid too large: {T} points > {self.max_points}; "
+                "increase step or narrow the range"
+            )
+        docs = self.db.query_ids(self.namespace, path_to_index_query(path),
+                                 start, end)
+        out = []
+        for d in sorted(docs, key=lambda d: d.id):
+            p = document_to_path(d)
+            if p is None:
+                continue
+            pts = self.db.read(self.namespace, d.id, start, end)
+            vals = np.full(T, NAN)
+            for t, v in pts:  # last point per bucket wins (consolidation)
+                b = (t - start) // step
+                if 0 <= b < T:
+                    vals[b] = v
+            out.append(GraphiteSeries(p.decode(), p.decode(), vals, step, start))
+        return out
+
+    def find(self, pattern: str) -> list[tuple[str, bool, bool]]:
+        """(name, is_leaf, expandable) children matching the pattern's
+        last component.  A node can be BOTH (metric `a.b` and branch of
+        `a.b.c`) — Graphite reports leaf=1 + expandable=1 then."""
+        comps = pattern.split(".")
+        n = len(comps)
+        qs = [_component_to_query(i, c) for i, c in enumerate(comps)]
+        docs = self.db.query_ids(self.namespace, Conjunction(*qs),
+                                 -(2**62), 2**62)
+        seen: dict[str, list] = {}
+        for d in docs:
+            tags = d.tags()
+            comp = tags.get(b"__g%d__" % (n - 1))
+            if comp is None:
+                continue
+            leaf = (b"__g%d__" % n) not in tags
+            flags = seen.setdefault(comp.decode(), [False, False])
+            flags[0] |= leaf
+            flags[1] |= not leaf
+        return sorted((k, v[0], v[1]) for k, v in seen.items())
+
+
+# ---------------------------------------------------------------------------
+# Render functions (reference src/query/graphite/native)
+# ---------------------------------------------------------------------------
+
+_FUNCS: dict = {}
+
+
+def _func(*names):
+    def deco(fn):
+        for n in names:
+            _FUNCS[n] = fn
+        return fn
+    return deco
+
+
+def _combine(series: list[GraphiteSeries], op, name: str):
+    if not series:
+        return []
+    vals = np.stack([s.values for s in series])
+    with np.errstate(all="ignore"):
+        out = op(vals)
+    paths = ",".join(s.name for s in series[:3])
+    return [series[0].with_values(out, f"{name}({paths})")]
+
+
+def _nan_agg(fn):
+    """Run a nan-aggregate with all-NaN-slice warnings silenced (the
+    result is correctly NaN; the warning is just noise)."""
+    import warnings
+
+    def run(v, *a, **kw):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            return fn(v, *a, **kw)
+    return run
+
+
+@_func("sumSeries", "sum")
+def _sum(ctx, series):
+    return _combine(series, lambda v: np.nansum(v, 0), "sumSeries")
+
+
+@_func("averageSeries", "avg")
+def _avg(ctx, series):
+    return _combine(series, _nan_agg(lambda v: np.nanmean(v, 0)), "averageSeries")
+
+
+@_func("maxSeries")
+def _max(ctx, series):
+    return _combine(series, _nan_agg(lambda v: np.nanmax(v, 0)), "maxSeries")
+
+
+@_func("minSeries")
+def _min(ctx, series):
+    return _combine(series, _nan_agg(lambda v: np.nanmin(v, 0)), "minSeries")
+
+
+@_func("diffSeries")
+def _diff(ctx, series):
+    def d(v):
+        out = v[0].copy()
+        out -= np.nansum(v[1:], 0)
+        return out
+    return _combine(series, d, "diffSeries")
+
+
+@_func("multiplySeries")
+def _mul(ctx, series):
+    return _combine(series, lambda v: np.nanprod(v, 0), "multiplySeries")
+
+
+@_func("scale")
+def _scale(ctx, series, factor):
+    return [s.with_values(s.values * factor, f"scale({s.name},{factor:g})")
+            for s in series]
+
+
+@_func("offset")
+def _offset(ctx, series, amount):
+    return [s.with_values(s.values + amount, f"offset({s.name},{amount:g})")
+            for s in series]
+
+
+@_func("absolute")
+def _absolute(ctx, series):
+    return [s.with_values(np.abs(s.values), f"absolute({s.name})")
+            for s in series]
+
+
+@_func("invert")
+def _invert(ctx, series):
+    with np.errstate(all="ignore"):
+        return [s.with_values(1.0 / s.values, f"invert({s.name})")
+                for s in series]
+
+
+@_func("derivative")
+def _derivative(ctx, series):
+    out = []
+    for s in series:
+        d = np.diff(s.values, prepend=NAN)
+        out.append(s.with_values(d, f"derivative({s.name})"))
+    return out
+
+
+@_func("nonNegativeDerivative")
+def _nnderivative(ctx, series):
+    out = []
+    for s in series:
+        d = np.diff(s.values, prepend=NAN)
+        d = np.where(d < 0, NAN, d)
+        out.append(s.with_values(d, f"nonNegativeDerivative({s.name})"))
+    return out
+
+
+@_func("perSecond")
+def _per_second(ctx, series):
+    out = []
+    for s in series:
+        d = np.diff(s.values, prepend=NAN) / (s.step_nanos / 1e9)
+        d = np.where(d < 0, NAN, d)
+        out.append(s.with_values(d, f"perSecond({s.name})"))
+    return out
+
+
+@_func("integral")
+def _integral(ctx, series):
+    out = []
+    for s in series:
+        v = np.nan_to_num(s.values)
+        out.append(s.with_values(np.cumsum(v), f"integral({s.name})"))
+    return out
+
+
+@_func("keepLastValue")
+def _keep_last(ctx, series, limit=-1):
+    out = []
+    for s in series:
+        v = s.values.copy()
+        run = 0
+        last = NAN
+        for i in range(len(v)):
+            if math.isnan(v[i]):
+                run += 1
+                if not math.isnan(last) and (limit < 0 or run <= limit):
+                    v[i] = last
+            else:
+                last = v[i]
+                run = 0
+        out.append(s.with_values(v, f"keepLastValue({s.name})"))
+    return out
+
+
+def _moving(series, window: int, fn, name):
+    out = []
+    for s in series:
+        v = s.values
+        res = np.full_like(v, NAN)
+        for i in range(len(v)):
+            lo = max(0, i - window + 1)
+            w = v[lo : i + 1]
+            w = w[~np.isnan(w)]
+            if len(w):
+                res[i] = fn(w)
+        out.append(s.with_values(res, f"{name}({s.name},{window})"))
+    return out
+
+
+@_func("movingAverage")
+def _moving_avg(ctx, series, window):
+    return _moving(series, int(window), np.mean, "movingAverage")
+
+
+@_func("movingSum")
+def _moving_sum(ctx, series, window):
+    return _moving(series, int(window), np.sum, "movingSum")
+
+
+@_func("movingMax")
+def _moving_max(ctx, series, window):
+    return _moving(series, int(window), np.max, "movingMax")
+
+
+@_func("movingMin")
+def _moving_min(ctx, series, window):
+    return _moving(series, int(window), np.min, "movingMin")
+
+
+@_func("alias")
+def _alias(ctx, series, name):
+    return [s.with_values(s.values, str(name)) for s in series]
+
+
+@_func("aliasByNode")
+def _alias_by_node(ctx, series, *nodes):
+    out = []
+    for s in series:
+        comps = s.path.split(".")
+        try:
+            parts = [comps[int(n)] for n in nodes]
+        except IndexError:
+            parts = [s.path]
+        out.append(s.with_values(s.values, ".".join(parts)))
+    return out
+
+
+@_func("timeShift")
+def _time_shift(ctx, series, shift):
+    """shift like '1h'/'-1h': refetch the shifted window per series."""
+    nanos = _duration_nanos(str(shift))
+    out = []
+    for s in series:
+        shifted = ctx.storage.fetch(
+            s.path, s.start_nanos - nanos,
+            s.start_nanos - nanos + len(s.values) * s.step_nanos,
+            s.step_nanos,
+        )
+        for sh in shifted:
+            if sh.path == s.path:
+                out.append(replace(
+                    s, values=sh.values, name=f'timeShift({s.name},"{shift}")'
+                ))
+                break
+    return out
+
+
+@_func("summarize")
+def _summarize(ctx, series, interval, func="sum"):
+    nanos = _duration_nanos(str(interval))
+    out = []
+    agg = _nan_agg({"sum": np.nansum, "avg": np.nanmean, "max": np.nanmax,
+                    "min": np.nanmin,
+                    "last": lambda w: w[~np.isnan(w)][-1] if
+                    (~np.isnan(w)).any() else NAN}[func])
+    for s in series:
+        k = max(1, nanos // s.step_nanos)
+        T = len(s.values)
+        nb = (T + k - 1) // k
+        res = np.full(nb, NAN)
+        for b in range(nb):
+            w = s.values[b * k : (b + 1) * k]
+            if (~np.isnan(w)).any():
+                res[b] = agg(w)
+        out.append(GraphiteSeries(
+            f'summarize({s.name},"{interval}","{func}")', s.path, res,
+            s.step_nanos * k, s.start_nanos,
+        ))
+    return out
+
+
+# selection / filtering ------------------------------------------------------
+
+
+def _series_stat(s: GraphiteSeries, what: str) -> float | None:
+    """None when the series has no datapoints — empty series never win
+    a lowest/below selection (and always lose highest/above)."""
+    v = s.values[~np.isnan(s.values)]
+    if not len(v):
+        return None
+    if what == "max":
+        return float(v.max())
+    if what == "avg":
+        return float(v.mean())
+    if what == "current":
+        return float(v[-1])
+    if what == "min":
+        return float(v.min())
+    raise ValueError(what)
+
+
+def _select(series, what: str, n: int, largest: bool):
+    scored = [(s, _series_stat(s, what)) for s in series]
+    scored = [(s, v) for s, v in scored if v is not None]
+    scored.sort(key=lambda sv: -sv[1] if largest else sv[1])
+    return [s for s, _ in scored[:n]]
+
+
+@_func("highestMax")
+def _highest_max(ctx, series, n=1):
+    return _select(series, "max", int(n), True)
+
+
+@_func("highestAverage")
+def _highest_avg(ctx, series, n=1):
+    return _select(series, "avg", int(n), True)
+
+
+@_func("highestCurrent")
+def _highest_cur(ctx, series, n=1):
+    return _select(series, "current", int(n), True)
+
+
+@_func("lowestAverage")
+def _lowest_avg(ctx, series, n=1):
+    return _select(series, "avg", int(n), False)
+
+
+@_func("limit")
+def _limit(ctx, series, n):
+    return series[: int(n)]
+
+
+@_func("sortByName")
+def _sort_by_name(ctx, series):
+    return sorted(series, key=lambda s: s.name)
+
+
+@_func("sortByMaxima")
+def _sort_by_maxima(ctx, series):
+    return sorted(series, key=lambda s: -_series_stat(s, "max"))
+
+
+def _filter_stat(series, what: str, pred):
+    out = []
+    for s in series:
+        v = _series_stat(s, what)
+        if v is not None and pred(v):
+            out.append(s)
+    return out
+
+
+@_func("averageAbove")
+def _avg_above(ctx, series, n):
+    return _filter_stat(series, "avg", lambda v: v > n)
+
+
+@_func("averageBelow")
+def _avg_below(ctx, series, n):
+    return _filter_stat(series, "avg", lambda v: v < n)
+
+
+@_func("maximumAbove")
+def _max_above(ctx, series, n):
+    return _filter_stat(series, "max", lambda v: v > n)
+
+
+@_func("currentAbove")
+def _cur_above(ctx, series, n):
+    return _filter_stat(series, "current", lambda v: v > n)
+
+
+@_func("groupByNode")
+def _group_by_node(ctx, series, node, func="sum"):
+    groups: dict[str, list] = {}
+    for s in series:
+        comps = s.path.split(".")
+        key = comps[int(node)] if int(node) < len(comps) else s.path
+        groups.setdefault(key, []).append(s)
+    agg = _FUNCS[{"sum": "sumSeries", "avg": "averageSeries",
+                  "max": "maxSeries", "min": "minSeries"}[func]]
+    out = []
+    for key in sorted(groups):
+        combined = agg(ctx, groups[key])
+        if combined:
+            out.append(combined[0].with_values(combined[0].values, key))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Evaluator + render entry points
+# ---------------------------------------------------------------------------
+
+
+_DUR_RE = re.compile(r"^-?(\d+)(s|min|h|d|w|y|mon)$")
+_DUR_NANOS = {"s": 10**9, "min": 60 * 10**9, "h": 3600 * 10**9,
+              "d": 86400 * 10**9, "w": 7 * 86400 * 10**9,
+              "mon": 30 * 86400 * 10**9, "y": 365 * 86400 * 10**9}
+
+
+def _duration_nanos(s: str) -> int:
+    s = s.strip()
+    m = _DUR_RE.match(s)
+    if not m:
+        raise ParseError(f"bad duration {s!r}")
+    nanos = int(m.group(1)) * _DUR_NANOS[m.group(2)]
+    # the sign matters: timeShift(x, "-1h") shifts forward, "1h" back
+    return -nanos if s.startswith("-") else nanos
+
+
+def parse_graphite_time(s: str, now_nanos: int) -> int:
+    """Epoch seconds, 'now', or relative '-1h' (reference
+    graphite/ts parsing, minimal form)."""
+    s = s.strip()
+    if s == "now" or s == "":
+        return now_nanos
+    if s.startswith("-"):
+        return now_nanos - _duration_nanos(s[1:])
+    return int(float(s) * 1e9)
+
+
+@dataclass
+class _Ctx:
+    storage: GraphiteStorage
+    start: int
+    end: int
+    step: int
+
+
+class GraphiteEngine:
+    """Parse + evaluate render targets (reference native/engine.go)."""
+
+    def __init__(self, storage: GraphiteStorage):
+        self.storage = storage
+
+    def render(self, target: str, start_nanos: int, end_nanos: int,
+               step_nanos: int) -> list[GraphiteSeries]:
+        ast = parse_target(target)
+        ctx = _Ctx(self.storage, start_nanos, end_nanos, step_nanos)
+        out = self._eval(ast, ctx)
+        if not isinstance(out, list):
+            raise ParseError(f"target does not evaluate to series: {target!r}")
+        return out
+
+    def _eval(self, node, ctx: _Ctx):
+        if isinstance(node, PathExpr):
+            return ctx.storage.fetch(node.path, ctx.start, ctx.end, ctx.step)
+        if isinstance(node, Call):
+            fn = _FUNCS.get(node.name)
+            if fn is None:
+                raise ParseError(f"unsupported function {node.name!r}")
+            args = [self._eval(a, ctx) for a in node.args]
+            kwargs = {k: self._eval(v, ctx) for k, v in node.kwargs}
+            # series-list args may come from nested calls/paths; scalars
+            # pass through
+            return fn(ctx, *args, **kwargs)
+        return node  # number / string / bool
+
+
+def supported_functions() -> list[str]:
+    return sorted(_FUNCS)

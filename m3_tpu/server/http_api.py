@@ -88,6 +88,10 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._label_values(name, q)
             if u.path == "/api/v1/series":
                 return self._series(q)
+            if u.path == "/render":
+                return self._render(q)
+            if u.path == "/metrics/find":
+                return self._find(q)
             return self._error(404, f"unknown path {u.path}")
         except QueryLimitExceeded as e:
             return self._error(429, str(e))
@@ -122,6 +126,44 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
+
+    def _render(self, q):
+        """Graphite render endpoint (reference
+        `query/api/v1/handler/graphite/render.go`): JSON list of
+        {target, datapoints: [[value|null, unix_seconds], ...]}."""
+        import math as _math
+        import time as _time
+
+        from m3_tpu.query.graphite import parse_graphite_time
+
+        now = _time.time_ns()
+        start = parse_graphite_time(q.get("from", ["-1h"])[0], now)
+        end = parse_graphite_time(q.get("until", ["now"])[0], now)
+        step = _parse_step(q.get("step", ["10s"])[0])
+        out = []
+        for target in q.get("target", []):
+            for s in self.ctx.graphite.render(target, start, end, step):
+                step_s = s.step_nanos / 1e9
+                out.append({
+                    "target": s.name,
+                    "datapoints": [
+                        [None if _math.isnan(v) else v,
+                         int(s.start_nanos / 1e9 + i * step_s)]
+                        for i, v in enumerate(s.values.tolist())
+                    ],
+                })
+        return self._json(200, out)
+
+    def _find(self, q):
+        """Graphite find endpoint (reference handler/graphite/find.go)."""
+        pattern = q["query"][0]
+        prefix = pattern.rsplit(".", 1)[0] + "." if "." in pattern else ""
+        out = [
+            {"text": name, "id": prefix + name, "leaf": 1 if leaf else 0,
+             "expandable": 1 if expandable else 0}
+            for name, leaf, expandable in self.ctx.graphite.storage.find(pattern)
+        ]
+        return self._json(200, out)
 
     def _traces(self):
         """Recent finished spans (reference x/debug's introspection
@@ -239,6 +281,9 @@ class ApiContext:
         self.registry = registry
         self.tracer = tracer
         self.engine = Engine(DatabaseStorage(db, namespace), tracer=tracer)
+        from m3_tpu.query.graphite import GraphiteEngine, GraphiteStorage
+
+        self.graphite = GraphiteEngine(GraphiteStorage(db, namespace))
 
 
 def make_server(ctx: ApiContext, host: str = "127.0.0.1", port: int = 0):

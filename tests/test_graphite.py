@@ -1,0 +1,237 @@
+"""Graphite engine: parser, glob resolution, render functions, HTTP.
+
+Reference model: `src/query/graphite` (lexer/native engine, ~100 fns)
+and the carbon `__g{i}__` tag convention shared with the ingest path.
+"""
+
+import json
+import math
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from m3_tpu.metrics.carbon import path_to_document
+from m3_tpu.query.graphite import (
+    Call, GraphiteEngine, GraphiteStorage, ParseError, PathExpr,
+    glob_component_regex, parse_graphite_time, parse_target,
+    supported_functions,
+)
+from m3_tpu.storage.database import Database, DatabaseOptions, NamespaceOptions
+
+BLOCK = 2 * 3600 * 10**9
+START = (1_700_000_000 * 10**9) // BLOCK * BLOCK
+STEP = 10 * 10**9
+NS = NamespaceOptions(num_shards=2, slot_capacity=1 << 10,
+                      sample_capacity=1 << 12)
+
+
+class TestParser:
+    def test_nested_calls(self):
+        ast = parse_target("scale(sumSeries(a.b.*, c.d), 2)")
+        assert isinstance(ast, Call) and ast.name == "scale"
+        inner = ast.args[0]
+        assert inner.name == "sumSeries"
+        assert inner.args == (PathExpr("a.b.*"), PathExpr("c.d"))
+        assert ast.args[1] == 2
+
+    def test_strings_kwargs_and_floats(self):
+        ast = parse_target('summarize(a.b, "1h", func="max")')
+        assert ast.args[1] == "1h"
+        assert dict(ast.kwargs) == {"func": "max"}
+        assert parse_target("scale(a, -0.5)").args[1] == -0.5
+
+    def test_bad_input(self):
+        for bad in ("f(", "a.b)", "f(a,)", 'alias(a, "x'):
+            with pytest.raises(ParseError):
+                parse_target(bad)
+
+    def test_glob_translation(self):
+        assert glob_component_regex("web*") == "web[^.]*"
+        assert glob_component_regex("w?b") == "w[^.]b"
+        assert glob_component_regex("{web,db}01") == "(?:web|db)01"
+        assert glob_component_regex("host[0-9]") == "host[0-9]"
+
+    def test_time_parsing(self):
+        now = 1000 * 10**9
+        assert parse_graphite_time("now", now) == now
+        assert parse_graphite_time("-1h", now) == now - 3600 * 10**9
+        assert parse_graphite_time("500", now) == 500 * 10**9
+
+    def test_leading_digit_paths(self):
+        ast = parse_target("sumSeries(404.count, 5xx.rate)")
+        assert ast.args == (PathExpr("404.count"), PathExpr("5xx.rate"))
+        # plain numbers still parse as numbers
+        assert parse_target("scale(a, 2)").args[1] == 2
+
+    def test_signed_durations(self):
+        from m3_tpu.query.graphite import _duration_nanos
+
+        assert _duration_nanos("1h") == 3600 * 10**9
+        assert _duration_nanos("-1h") == -3600 * 10**9
+
+
+def _seed_db(tmp_path):
+    db = Database(DatabaseOptions(root=str(tmp_path)),
+                  namespaces={"default": NS})
+    paths = [b"servers.web01.cpu", b"servers.web02.cpu",
+             b"servers.db01.cpu", b"servers.web01.mem"]
+    T = 30
+    for k, p in enumerate(paths):
+        docs = [path_to_document(p)] * T
+        ts = START + np.arange(T, dtype=np.int64) * STEP
+        vals = (k + 1) * np.ones(T) * np.arange(1, T + 1)
+        db.write_tagged_batch("default", docs, ts, vals)
+    return db
+
+
+class TestStorageResolution:
+    def test_glob_fetch(self, tmp_path):
+        db = _seed_db(tmp_path)
+        st = GraphiteStorage(db)
+        series = st.fetch("servers.web*.cpu", START, START + 30 * STEP, STEP)
+        assert [s.path for s in series] == [
+            "servers.web01.cpu", "servers.web02.cpu"
+        ]
+        # exactly-N-components: 'servers.*' must not match 3-part paths
+        assert st.fetch("servers.*", START, START + STEP, STEP) == []
+        db.close()
+
+    def test_brace_alternation(self, tmp_path):
+        db = _seed_db(tmp_path)
+        st = GraphiteStorage(db)
+        series = st.fetch("servers.{web01,db01}.cpu", START,
+                          START + 30 * STEP, STEP)
+        assert [s.path for s in series] == [
+            "servers.db01.cpu", "servers.web01.cpu"
+        ]
+        db.close()
+
+    def test_find(self, tmp_path):
+        db = _seed_db(tmp_path)
+        st = GraphiteStorage(db)
+        assert st.find("servers.*") == [
+            ("db01", False, True), ("web01", False, True),
+            ("web02", False, True),
+        ]
+        assert st.find("servers.web01.*") == [
+            ("cpu", True, False), ("mem", True, False)
+        ]
+        db.close()
+
+    def test_find_node_both_leaf_and_branch(self, tmp_path):
+        db = _seed_db(tmp_path)
+        # a.b is a metric AND a branch of a.b.c
+        for p in (b"a.b", b"a.b.c"):
+            docs = [path_to_document(p)]
+            db.write_tagged_batch("default", docs,
+                                  np.asarray([START], np.int64),
+                                  np.asarray([1.0]))
+        st = GraphiteStorage(db)
+        assert st.find("a.*") == [("b", True, True)]
+        db.close()
+
+    def test_render_grid_cap(self, tmp_path):
+        db = _seed_db(tmp_path)
+        st = GraphiteStorage(db, max_points=100)
+        with pytest.raises(ParseError, match="grid too large"):
+            st.fetch("servers.web01.cpu", START, START + 200 * STEP, STEP)
+        with pytest.raises(ParseError, match="positive"):
+            st.fetch("servers.web01.cpu", START, START + STEP, 0)
+        db.close()
+
+
+class TestFunctions:
+    def _engine(self, tmp_path):
+        return GraphiteEngine(GraphiteStorage(_seed_db(tmp_path)))
+
+    def test_sum_and_scale(self, tmp_path):
+        eng = self._engine(tmp_path)
+        out = eng.render("scale(sumSeries(servers.*.cpu), 0.5)",
+                         START, START + 10 * STEP, STEP)
+        assert len(out) == 1
+        # series k values: (k+1)*i for i=1.. ; cpu series k=0,1,2 → sum=6i
+        np.testing.assert_allclose(out[0].values, 3.0 * np.arange(1, 11))
+
+    def test_derivative_and_persecond(self, tmp_path):
+        eng = self._engine(tmp_path)
+        out = eng.render("perSecond(servers.web01.cpu)",
+                         START, START + 10 * STEP, STEP)
+        v = out[0].values
+        assert math.isnan(v[0])
+        np.testing.assert_allclose(v[1:], 0.1)  # +1 per 10s
+
+    def test_alias_by_node_and_group(self, tmp_path):
+        eng = self._engine(tmp_path)
+        out = eng.render("aliasByNode(servers.*.cpu, 1)",
+                         START, START + 5 * STEP, STEP)
+        assert sorted(s.name for s in out) == ["db01", "web01", "web02"]
+        grouped = eng.render('groupByNode(servers.*.*, 1, "sum")',
+                             START, START + 5 * STEP, STEP)
+        assert [s.name for s in grouped] == ["db01", "web01", "web02"]
+        # web01 group = cpu (1x) + mem (4x) = 5x
+        np.testing.assert_allclose(
+            [s for s in grouped if s.name == "web01"][0].values,
+            5.0 * np.arange(1, 6),
+        )
+
+    def test_selection(self, tmp_path):
+        eng = self._engine(tmp_path)
+        out = eng.render("highestMax(servers.*.cpu, 1)",
+                         START, START + 10 * STEP, STEP)
+        assert len(out) == 1 and out[0].path == "servers.db01.cpu"
+        out2 = eng.render("maximumAbove(servers.*.cpu, 15)",
+                          START, START + 10 * STEP, STEP)
+        assert {s.path for s in out2} == {
+            "servers.db01.cpu", "servers.web02.cpu"
+        }
+
+    def test_summarize(self, tmp_path):
+        eng = self._engine(tmp_path)
+        out = eng.render('summarize(servers.web01.cpu, "1min", "sum")',
+                         START, START + 12 * STEP, STEP)
+        s = out[0]
+        assert s.step_nanos == 6 * STEP
+        np.testing.assert_allclose(s.values[0], sum(range(1, 7)))
+
+    def test_moving_average_and_keep_last(self, tmp_path):
+        eng = self._engine(tmp_path)
+        out = eng.render("movingAverage(servers.web01.cpu, 3)",
+                         START, START + 10 * STEP, STEP)
+        v = out[0].values
+        np.testing.assert_allclose(v[4], (3 + 4 + 5) / 3)
+
+    def test_function_inventory(self):
+        fns = supported_functions()
+        assert len(fns) >= 30
+        for must in ("sumSeries", "perSecond", "aliasByNode", "summarize",
+                     "highestMax", "groupByNode", "timeShift"):
+            assert must in fns
+
+
+class TestHTTP:
+    def test_render_and_find_endpoints(self, tmp_path):
+        from m3_tpu.server.http_api import ApiContext, serve_background
+
+        db = _seed_db(tmp_path)
+        srv = serve_background(ApiContext(db))
+        port = srv.server_address[1]
+        t0 = START // 10**9
+        q = urllib.parse.urlencode({
+            "target": "sumSeries(servers.web*.cpu)",
+            "from": str(t0), "until": str(t0 + 100), "step": "10s",
+        })
+        out = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/render?{q}"
+        ))
+        assert len(out) == 1
+        dp = out[0]["datapoints"]
+        assert dp[0] == [3.0, t0]  # web01 1*1 + web02 2*1
+        find = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics/find?query=servers.*"
+        ))
+        assert {f["text"] for f in find} == {"web01", "web02", "db01"}
+        assert all(f["expandable"] for f in find)
+        srv.shutdown()
+        db.close()
