@@ -41,6 +41,21 @@ class FanoutSource:
     retention_nanos: int
 
 
+def _accumulate_block(blk: RawBlock, per_series: Dict[tuple, List[List[tuple]]]) -> None:
+    """Unpack one RawBlock's series into the shared (tags -> point-list
+    sources) accumulator both fanout shapes merge from."""
+    for i, meta in enumerate(blk.series):
+        c = int(blk.counts[i])
+        pts = list(zip(blk.ts[i, :c].tolist(), blk.values[i, :c].tolist()))
+        per_series.setdefault(meta.tags, []).append(pts)
+
+
+def _merged_block(per_series: Dict[tuple, List[List[tuple]]]) -> RawBlock:
+    keys = sorted(per_series)
+    pts_out = [merge_point_sources(per_series[k]) for k in keys]
+    return RawBlock.from_lists(pts_out, [SeriesMeta(k) for k in keys])
+
+
 class FanoutStorage:
     """Engine-facing Storage over multiple namespaces/remotes."""
 
@@ -97,17 +112,42 @@ class FanoutStorage:
         for src in chosen:  # finest → coarsest
             lo = max(start_nanos, now - src.retention_nanos)
             if lo < hi:
-                blk = src.storage.fetch_raw(name, matchers, lo, hi)
-                for i, meta in enumerate(blk.series):
-                    c = int(blk.counts[i])
-                    pts = list(
-                        zip(blk.ts[i, :c].tolist(), blk.values[i, :c].tolist())
-                    )
-                    per_series.setdefault(meta.tags, []).append(pts)
+                _accumulate_block(
+                    src.storage.fetch_raw(name, matchers, lo, hi), per_series
+                )
             hi = min(hi, lo)
             if hi <= start_nanos:
                 break
-        keys = sorted(per_series)
-        pts_out = [merge_point_sources(per_series[k]) for k in keys]
-        metas = [SeriesMeta(k) for k in keys]
-        return RawBlock.from_lists(pts_out, metas)
+        return _merged_block(per_series)
+
+
+class FederatedStorage:
+    """Cross-region union: query EVERY store and merge same-ID series.
+
+    The band-partitioned FanoutStorage above divides a window between
+    resolutions of the SAME data; federation is the other axis — each
+    store (the local fanout + remote coordinators, `query/remote`) holds
+    DIFFERENT series, with possible overlap deduplicated point-wise
+    (reference `fanout/storage.go` merging local clusters with remote
+    stores).  A store that fails is skipped (best-effort federation,
+    like the reference's partial-result handling) unless every store
+    fails."""
+
+    def __init__(self, stores: Sequence[object]):
+        if not stores:
+            raise ValueError("federation needs at least one store")
+        self.stores = list(stores)
+
+    def fetch_raw(self, name, matchers, start_nanos, end_nanos) -> RawBlock:
+        per_series: Dict[tuple, List[List[tuple]]] = {}
+        errors: List[Exception] = []
+        for st in self.stores:
+            try:
+                blk = st.fetch_raw(name, matchers, start_nanos, end_nanos)
+            except Exception as e:  # noqa: BLE001 — best-effort fan-out
+                errors.append(e)
+                continue
+            _accumulate_block(blk, per_series)
+        if errors and len(errors) == len(self.stores):
+            raise errors[0]
+        return _merged_block(per_series)

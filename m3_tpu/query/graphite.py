@@ -535,22 +535,11 @@ def _alias_by_node(ctx, series, *nodes):
 
 @_func("timeShift")
 def _time_shift(ctx, series, shift):
-    """shift like '1h'/'-1h': refetch the shifted window per series."""
-    nanos = _duration_nanos(str(shift))
-    out = []
-    for s in series:
-        shifted = ctx.storage.fetch(
-            s.path, s.start_nanos - nanos,
-            s.start_nanos - nanos + len(s.values) * s.step_nanos,
-            s.step_nanos,
-        )
-        for sh in shifted:
-            if sh.path == s.path:
-                out.append(replace(
-                    s, values=sh.values, name=f'timeShift({s.name},"{shift}")'
-                ))
-                break
-    return out
+    """Placeholder: the evaluator intercepts timeShift and evaluates
+    the INNER expression against a shifted window (so nested functions
+    like scale/sumSeries apply to the shifted data, Graphite semantics).
+    Reaching this body means a caller bypassed the evaluator."""
+    raise ParseError("timeShift must be evaluated by GraphiteEngine")
 
 
 @_func("summarize")
@@ -636,7 +625,12 @@ def _sort_by_name(ctx, series):
 
 @_func("sortByMaxima")
 def _sort_by_maxima(ctx, series):
-    return sorted(series, key=lambda s: -_series_stat(s, "max"))
+    # empty (all-NaN) series sort last instead of crashing on None
+    return sorted(
+        series,
+        key=lambda s: -(v if (v := _series_stat(s, "max")) is not None
+                        else -math.inf),
+    )
 
 
 def _filter_stat(series, what: str, pred):
@@ -744,6 +738,19 @@ class GraphiteEngine:
         if isinstance(node, PathExpr):
             return ctx.storage.fetch(node.path, ctx.start, ctx.end, ctx.step)
         if isinstance(node, Call):
+            if node.name == "timeShift":
+                if len(node.args) != 2:
+                    raise ParseError("timeShift(expr, shift) takes 2 args")
+                shift = node.args[1]
+                nanos = _duration_nanos(str(shift))
+                shifted = _Ctx(ctx.storage, ctx.start - nanos,
+                               ctx.end - nanos, ctx.step)
+                inner = self._eval(node.args[0], shifted)
+                return [
+                    replace(s, start_nanos=ctx.start,
+                            name=f'timeShift({s.name},"{shift}")')
+                    for s in inner
+                ]
             fn = _FUNCS.get(node.name)
             if fn is None:
                 raise ParseError(f"unsupported function {node.name!r}")

@@ -1,0 +1,214 @@
+"""Remote query federation: the cross-coordinator fetch protocol.
+
+Equivalent of the reference's gRPC query federation (`src/query/remote`
+— rpcpb client/server letting one coordinator query another region's
+storage, plugged into fanout as a remote store).  gRPC collapses to the
+framework's framed TCP protocol (msg/protocol.py): a QUERY_FETCH frame
+carries (name, matchers, start, end); the QUERY_RESULT frame carries
+the matched series (tags + raw points).  `RemoteStorage` implements the
+same `fetch_raw` seam as DatabaseStorage, so it drops straight into
+`FanoutSource` — cross-region federation is just another fanout source
+with a coarser typical resolution.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+
+import numpy as np
+
+from m3_tpu.msg import protocol as wire
+from m3_tpu.query.block import RawBlock, SeriesMeta
+
+QUERY_FETCH = 8
+QUERY_RESULT = 9
+
+
+# -- payload codecs ---------------------------------------------------------
+
+
+def encode_fetch(name: bytes | None, matchers, start: int, end: int) -> bytes:
+    parts = [struct.pack("<qq", start, end)]
+    parts.append(struct.pack("<H", len(name) if name is not None else 0xFFFF))
+    if name is not None:
+        parts.append(name)
+    parts.append(struct.pack("<H", len(matchers)))
+    for m in matchers:
+        op = m.op.encode()
+        parts.append(struct.pack("<BHH", len(op), len(m.name), len(m.value)))
+        parts.append(op)
+        parts.append(m.name)
+        parts.append(m.value)
+    return b"".join(parts)
+
+
+def decode_fetch(raw: bytes):
+    from m3_tpu.query.promql import LabelMatcher
+
+    start, end = struct.unpack_from("<qq", raw, 0)
+    pos = 16
+    (nlen,) = struct.unpack_from("<H", raw, pos)
+    pos += 2
+    name = None
+    if nlen != 0xFFFF:
+        name = raw[pos : pos + nlen]
+        pos += nlen
+    (nm,) = struct.unpack_from("<H", raw, pos)
+    pos += 2
+    matchers = []
+    for _ in range(nm):
+        ol, nl, vl = struct.unpack_from("<BHH", raw, pos)
+        pos += 5
+        op = raw[pos : pos + ol].decode()
+        pos += ol
+        mname = raw[pos : pos + nl]
+        pos += nl
+        value = raw[pos : pos + vl]
+        pos += vl
+        matchers.append(LabelMatcher(mname, op, value))
+    return name, tuple(matchers), start, end
+
+
+def encode_result(block: RawBlock) -> bytes:
+    parts = [struct.pack("<I", len(block.series))]
+    for i, meta in enumerate(block.series):
+        tags = list(meta.tags)
+        parts.append(struct.pack("<H", len(tags)))
+        for k, v in tags:
+            parts.append(struct.pack("<HH", len(k), len(v)))
+            parts.append(k)
+            parts.append(v)
+        n = int(block.counts[i])
+        parts.append(struct.pack("<I", n))
+        parts.append(block.ts[i, :n].astype("<i8").tobytes())
+        parts.append(block.values[i, :n].astype("<f8").tobytes())
+    return b"".join(parts)
+
+
+def decode_result(raw: bytes) -> RawBlock:
+    (ns,) = struct.unpack_from("<I", raw, 0)
+    pos = 4
+    pts, metas = [], []
+    for _ in range(ns):
+        (ntags,) = struct.unpack_from("<H", raw, pos)
+        pos += 2
+        tags = []
+        for _ in range(ntags):
+            lk, lv = struct.unpack_from("<HH", raw, pos)
+            pos += 4
+            k = raw[pos : pos + lk]
+            pos += lk
+            v = raw[pos : pos + lv]
+            pos += lv
+            tags.append((k, v))
+        (n,) = struct.unpack_from("<I", raw, pos)
+        pos += 4
+        ts = np.frombuffer(raw, "<i8", n, pos)
+        pos += 8 * n
+        vals = np.frombuffer(raw, "<f8", n, pos)
+        pos += 8 * n
+        metas.append(SeriesMeta(tuple(tags)))
+        pts.append(list(zip(ts.tolist(), vals.tolist())))
+    return RawBlock.from_lists(pts, metas)
+
+
+# -- server -----------------------------------------------------------------
+
+
+class _QueryHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        srv = self.server
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        while True:
+            try:
+                frame = wire.recv_frame(sock)
+            except (wire.ProtocolError, OSError):
+                return
+            if frame is None or frame[0] != QUERY_FETCH:
+                return
+            try:
+                name, matchers, start, end = decode_fetch(frame[1])
+                block = srv.storage.fetch_raw(name, matchers, start, end)
+                wire.send_frame(sock, QUERY_RESULT, encode_result(block))
+            except Exception as e:  # noqa: BLE001 — report, don't die
+                try:
+                    wire.send_frame(sock, wire.ERROR, str(e).encode())
+                except OSError:
+                    return
+
+
+class QueryServer(socketserver.ThreadingTCPServer):
+    """Serves fetch_raw over TCP (reference query/remote/server.go)."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, storage, host: str = "127.0.0.1", port: int = 0):
+        self.storage = storage
+        super().__init__((host, port), _QueryHandler)
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+
+def serve_query_background(storage, host: str = "127.0.0.1",
+                           port: int = 0) -> QueryServer:
+    srv = QueryServer(storage, host, port)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv
+
+
+# -- client -----------------------------------------------------------------
+
+
+class RemoteStorage:
+    """fetch_raw over the wire: a drop-in fanout source
+    (reference query/remote/client.go wrapped as a remote store)."""
+
+    def __init__(self, address, timeout_s: float = 30.0):
+        self.address = address
+        self.timeout_s = timeout_s
+        self._sock: socket.socket | None = None
+        self._lock = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection(self.address, timeout=self.timeout_s)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = s
+        return self._sock
+
+    def fetch_raw(self, name, matchers, start_nanos, end_nanos) -> RawBlock:
+        payload = encode_fetch(name, matchers, start_nanos, end_nanos)
+        with self._lock:
+            try:
+                sock = self._connect()
+                wire.send_frame(sock, QUERY_FETCH, payload)
+                frame = wire.recv_frame(sock)
+            except (OSError, wire.ProtocolError):
+                # one reconnect attempt (server restarts are routine)
+                self.close()
+                sock = self._connect()
+                wire.send_frame(sock, QUERY_FETCH, payload)
+                frame = wire.recv_frame(sock)
+        if frame is None:
+            raise ConnectionError("remote query peer closed connection")
+        ftype, body = frame
+        if ftype == wire.ERROR:
+            raise RuntimeError(f"remote query failed: {body.decode()}")
+        if ftype != QUERY_RESULT:
+            raise wire.ProtocolError(f"unexpected frame type {ftype}")
+        return decode_result(body)
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None

@@ -103,6 +103,10 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if u.path == "/api/v1/json/write":
                 return self._write_json()
+            if u.path == "/api/v1/prom/remote/write":
+                return self._prom_remote_write()
+            if u.path == "/api/v1/prom/remote/read":
+                return self._prom_remote_read()
             if u.path in ("/api/v1/query_range", "/api/v1/query"):
                 q = parse_qs(self._body().decode())
                 return self._query(u.path.endswith("query_range"), q)
@@ -176,22 +180,15 @@ class _Handler(BaseHTTPRequestHandler):
             "data": [s.to_dict() for s in tr.finished()],
         })
 
-    def _write_json(self):
-        """reference api/v1/json/write: one sample or a list of
-        {tags: {..}, timestamp (unix s or nanos), value}."""
-        payload = json.loads(self._body())
-        samples = payload if isinstance(payload, list) else [payload]
-        docs, ts, vals = [], [], []
-        for s in samples:
-            tags = {k.encode(): v.encode() for k, v in s["tags"].items()}
-            name = tags.get(b"__name__", b"")
-            sid = name + b"{" + b",".join(
-                k + b"=" + v for k, v in sorted(tags.items()) if k != b"__name__"
-            ) + b"}"
-            docs.append(Document.from_tags(sid, tags))
-            t = s["timestamp"]
-            ts.append(int(t * 1e9) if t < 1e12 else int(t))
-            vals.append(float(s["value"]))
+    @staticmethod
+    def _series_id(tags: dict) -> bytes:
+        name = tags.get(b"__name__", b"")
+        return name + b"{" + b",".join(
+            k + b"=" + v for k, v in sorted(tags.items()) if k != b"__name__"
+        ) + b"}"
+
+    def _ingest_tagged(self, docs, ts, vals) -> int:
+        """Shared downsample-then-write tail of every write handler."""
         ctx = self.ctx
         keep = np.ones(len(docs), bool)
         if ctx.downsampler is not None:
@@ -206,7 +203,77 @@ class _Handler(BaseHTTPRequestHandler):
                 np.asarray(ts, np.int64)[idx],
                 np.asarray(vals)[idx],
             )
-        return self._json(200, {"status": "success", "written": int(len(idx))})
+        return int(len(idx))
+
+    def _prom_remote_write(self):
+        """Prometheus remote write: snappy+protobuf WriteRequest
+        (reference handler/prometheus/remote/write.go)."""
+        from m3_tpu.server.prom_remote import parse_write_request
+
+        series = parse_write_request(self._body())
+        docs, ts, vals = [], [], []
+        for s in series:
+            sid = self._series_id(s.labels)
+            doc = Document.from_tags(sid, s.labels)
+            for t_nanos, v in s.samples:
+                docs.append(doc)
+                ts.append(t_nanos)
+                vals.append(v)
+        if docs:
+            self._ingest_tagged(docs, ts, vals)
+        self.send_response(204)  # Prometheus expects 2xx, no body needed
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+        return None
+
+    def _prom_remote_read(self):
+        """Prometheus remote read: snappy+protobuf ReadRequest →
+        ReadResponse (reference handler/prometheus/remote/read.go)."""
+        from m3_tpu.query.promql import LabelMatcher
+        from m3_tpu.query.storage_adapter import matchers_to_query
+        from m3_tpu.server.prom_remote import (
+            PromTimeSeries, build_read_response, parse_read_request,
+        )
+
+        ctx = self.ctx
+        _OPS = {0: "=", 1: "!=", 2: "=~", 3: "!~"}
+        results = []
+        for q in parse_read_request(self._body()):
+            matchers = tuple(
+                LabelMatcher(m.name, _OPS[m.type], m.value) for m in q.matchers
+            )
+            idx_q = matchers_to_query(None, matchers)
+            docs = ctx.db.query_ids(ctx.namespace, idx_q,
+                                    q.start_nanos, q.end_nanos)
+            series_out = []
+            for d in sorted(docs, key=lambda d: d.id):
+                pts = ctx.db.read(ctx.namespace, d.id,
+                                  q.start_nanos, q.end_nanos)
+                series_out.append(PromTimeSeries(d.tags(), list(pts)))
+            results.append(series_out)
+        body = build_read_response(results)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-protobuf")
+        self.send_header("Content-Encoding", "snappy")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+        return None
+
+    def _write_json(self):
+        """reference api/v1/json/write: one sample or a list of
+        {tags: {..}, timestamp (unix s or nanos), value}."""
+        payload = json.loads(self._body())
+        samples = payload if isinstance(payload, list) else [payload]
+        docs, ts, vals = [], [], []
+        for s in samples:
+            tags = {k.encode(): v.encode() for k, v in s["tags"].items()}
+            docs.append(Document.from_tags(self._series_id(tags), tags))
+            t = s["timestamp"]
+            ts.append(int(t * 1e9) if t < 1e12 else int(t))
+            vals.append(float(s["value"]))
+        written = self._ingest_tagged(docs, ts, vals) if docs else 0
+        return self._json(200, {"status": "success", "written": written})
 
     def _query(self, is_range: bool, q):
         query = q["query"][0]

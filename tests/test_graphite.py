@@ -202,6 +202,38 @@ class TestFunctions:
         v = out[0].values
         np.testing.assert_allclose(v[4], (3 + 4 + 5) / 3)
 
+    def test_timeshift_applies_inner_functions(self, tmp_path):
+        """timeShift(scale(x,10),'1h') must scale the SHIFTED data —
+        the evaluator shifts the whole inner expression's window."""
+        eng = self._engine(tmp_path)
+        base = eng.render("scale(servers.web01.cpu, 10)",
+                          START + 3600 * 10**9, START + 3600 * 10**9 + 5 * STEP,
+                          STEP)
+        # from one hour later, shifted back 1h -> the original window
+        shifted = eng.render('timeShift(scale(servers.web01.cpu, 10), "1h")',
+                             START + 3600 * 10**9,
+                             START + 3600 * 10**9 + 5 * STEP, STEP)
+        # original window has data (base window, 1h after START, is empty)
+        assert np.isnan(base[0].values).all()
+        np.testing.assert_allclose(shifted[0].values,
+                                   10.0 * np.arange(1, 6))
+        assert shifted[0].name.startswith("timeShift(")
+
+    def test_sort_by_maxima_with_empty_series(self, tmp_path):
+        """An all-NaN series must sort last, not crash (review fix)."""
+        db = _seed_db(tmp_path)
+        # a series with no points in the window
+        db.write_tagged_batch(
+            "default", [path_to_document(b"servers.idle.cpu")],
+            np.asarray([START + 3600 * 10**9], np.int64), np.asarray([1.0]),
+        )
+        eng = GraphiteEngine(GraphiteStorage(db))
+        out = eng.render("sortByMaxima(servers.*.cpu)",
+                         START, START + 5 * STEP, STEP)
+        assert out[-1].path == "servers.idle.cpu"
+        assert out[0].path == "servers.db01.cpu"
+        db.close()
+
     def test_function_inventory(self):
         fns = supported_functions()
         assert len(fns) >= 30

@@ -1,0 +1,146 @@
+"""Remote query federation over the frame protocol.
+
+Reference model: `src/query/remote` (gRPC query federation client/server
+plugged into fanout as a remote store).
+"""
+
+import numpy as np
+import pytest
+
+from m3_tpu.index.doc import Document
+from m3_tpu.query.block import RawBlock, SeriesMeta
+from m3_tpu.query.engine import Engine
+from m3_tpu.query.fanout import FanoutSource, FanoutStorage, FederatedStorage
+from m3_tpu.query.promql import LabelMatcher
+from m3_tpu.query.remote import (
+    RemoteStorage, decode_fetch, decode_result, encode_fetch, encode_result,
+    serve_query_background,
+)
+from m3_tpu.query.storage_adapter import DatabaseStorage
+from m3_tpu.storage.database import Database, DatabaseOptions, NamespaceOptions
+
+BLOCK = 2 * 3600 * 10**9
+START = (1_700_000_000 * 10**9) // BLOCK * BLOCK
+NS = NamespaceOptions(num_shards=2, slot_capacity=1 << 10,
+                      sample_capacity=1 << 12)
+
+
+def _seed(tmp_path, tag: bytes, n=10):
+    db = Database(DatabaseOptions(root=str(tmp_path)),
+                  namespaces={"default": NS})
+    docs = [Document.from_tags(
+        b"reqs{region=" + tag + b"}", {b"__name__": b"reqs", b"region": tag}
+    )] * n
+    ts = START + np.arange(n, dtype=np.int64) * 10**9
+    db.write_tagged_batch("default", docs, ts, np.arange(float(n)))
+    return db
+
+
+class TestCodecs:
+    def test_fetch_roundtrip(self):
+        matchers = (LabelMatcher(b"region", "=", b"us"),
+                    LabelMatcher(b"host", "=~", b"h.*"))
+        raw = encode_fetch(b"reqs", matchers, START, START + 100)
+        name, m2, s, e = decode_fetch(raw)
+        assert name == b"reqs" and (s, e) == (START, START + 100)
+        assert m2 == matchers
+        # nameless fetch
+        name, m2, _s, _e = decode_fetch(encode_fetch(None, (), 0, 1))
+        assert name is None and m2 == ()
+
+    def test_result_roundtrip(self):
+        block = RawBlock.from_lists(
+            [[(START, 1.0), (START + 1, 2.5)], []],
+            [SeriesMeta(((b"a", b"1"),)), SeriesMeta(((b"b", b"2"),))],
+        )
+        out = decode_result(encode_result(block))
+        assert out.series == block.series
+        np.testing.assert_array_equal(out.counts, block.counts)
+        assert out.ts[0, :2].tolist() == [START, START + 1]
+
+
+class TestFederation:
+    def test_remote_fetch_matches_local(self, tmp_path):
+        db = _seed(tmp_path, b"eu")
+        local = DatabaseStorage(db)
+        srv = serve_query_background(local)
+        remote = RemoteStorage(("127.0.0.1", srv.port))
+        m = (LabelMatcher(b"region", "=", b"eu"),)
+        a = local.fetch_raw(b"reqs", m, START, START + BLOCK)
+        b = remote.fetch_raw(b"reqs", m, START, START + BLOCK)
+        assert a.series == b.series
+        np.testing.assert_array_equal(a.ts[:, :10], b.ts[:, :10])
+        np.testing.assert_array_equal(a.values[:, :10], b.values[:, :10])
+        remote.close()
+        srv.shutdown()
+        db.close()
+
+    def test_remote_in_fanout_with_engine(self, tmp_path):
+        """Two 'regions': local DB + remote DB behind the wire; fanout
+        merges and PromQL aggregates across both."""
+        db_local = _seed(tmp_path / "a", b"us")
+        db_remote = _seed(tmp_path / "b", b"eu")
+        srv = serve_query_background(DatabaseStorage(db_remote))
+        remote = RemoteStorage(("127.0.0.1", srv.port))
+        fed = FederatedStorage([DatabaseStorage(db_local), remote])
+        eng = Engine(fed)
+        out = eng.execute_range("sum(reqs)", START, START + 9 * 10**9, 10**9)
+        # us + eu both contribute: sum at step k = 2k; the final step
+        # carries step 8's value via lookback (end-exclusive fetch, the
+        # engine's standard behavior for points exactly at the boundary)
+        want = 2.0 * np.arange(10)
+        want[9] = want[8]
+        np.testing.assert_allclose(out.values[0], want)
+        by_region = eng.execute_range("sum(reqs) by (region)", START,
+                                      START + 9 * 10**9, 10**9)
+        assert len(by_region.series) == 2
+        remote.close()
+        srv.shutdown()
+        db_local.close()
+        db_remote.close()
+
+    def test_federation_is_best_effort(self, tmp_path):
+        """A dead region degrades to partial results; all-dead raises."""
+        db = _seed(tmp_path, b"us")
+
+        class Dead:
+            def fetch_raw(self, *a):
+                raise ConnectionError("region down")
+
+        fed = FederatedStorage([DatabaseStorage(db), Dead()])
+        m = (LabelMatcher(b"region", "=", b"us"),)
+        out = fed.fetch_raw(b"reqs", m, START, START + BLOCK)
+        assert len(out.series) == 1
+        all_dead = FederatedStorage([Dead(), Dead()])
+        with pytest.raises(ConnectionError):
+            all_dead.fetch_raw(b"reqs", m, START, START + BLOCK)
+        db.close()
+
+    def test_remote_error_surfaces(self, tmp_path):
+        class Boom:
+            def fetch_raw(self, *a):
+                raise RuntimeError("storage exploded")
+
+        srv = serve_query_background(Boom())
+        remote = RemoteStorage(("127.0.0.1", srv.port))
+        with pytest.raises(RuntimeError, match="storage exploded"):
+            remote.fetch_raw(b"x", (), START, START + 1)
+        srv.shutdown()
+        remote.close()
+
+    def test_reconnect_after_server_restart(self, tmp_path):
+        db = _seed(tmp_path, b"eu")
+        local = DatabaseStorage(db)
+        srv = serve_query_background(local)
+        port = srv.port
+        remote = RemoteStorage(("127.0.0.1", port))
+        m = (LabelMatcher(b"region", "=", b"eu"),)
+        assert remote.fetch_raw(b"reqs", m, START, START + BLOCK).series
+        srv.shutdown()
+        srv.server_close()
+        srv2 = serve_query_background(local, port=port)
+        out = remote.fetch_raw(b"reqs", m, START, START + BLOCK)
+        assert out.series
+        srv2.shutdown()
+        remote.close()
+        db.close()
