@@ -1,0 +1,1 @@
+"""PromQL correctness comparator (reference `src/cmd/services/m3comparator`)."""

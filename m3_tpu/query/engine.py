@@ -116,7 +116,11 @@ class Engine:
 
     def _fetch(self, sel: VectorSelector, steps: np.ndarray, range_nanos: int):
         start = int(steps[0]) - range_nanos - sel.offset_nanos
-        end = int(steps[-1]) - sel.offset_nanos
+        # +1: storage reads are end-EXCLUSIVE, but a sample exactly at
+        # the final evaluation step belongs to it (Prometheus windows
+        # are (t-range, t] — found by the comparator harness, which
+        # caught the last step evaluating with the previous sample).
+        end = int(steps[-1]) - sel.offset_nanos + 1
         raw = self.storage.fetch_raw(sel.name, sel.matchers, start, end)
         eval_steps = steps - sel.offset_nanos
         return raw, eval_steps

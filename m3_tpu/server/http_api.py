@@ -243,12 +243,15 @@ class _Handler(BaseHTTPRequestHandler):
                 LabelMatcher(m.name, _OPS[m.type], m.value) for m in q.matchers
             )
             idx_q = matchers_to_query(None, matchers)
+            # prompb end timestamps are INCLUSIVE; db reads are
+            # end-exclusive (same boundary rule as Engine._fetch)
+            end = q.end_nanos + 1
             docs = ctx.db.query_ids(ctx.namespace, idx_q,
-                                    q.start_nanos, q.end_nanos)
+                                    q.start_nanos, end)
             series_out = []
             for d in sorted(docs, key=lambda d: d.id):
                 pts = ctx.db.read(ctx.namespace, d.id,
-                                  q.start_nanos, q.end_nanos)
+                                  q.start_nanos, end)
                 series_out.append(PromTimeSeries(d.tags(), list(pts)))
             results.append(series_out)
         body = build_read_response(results)

@@ -113,9 +113,10 @@ class TestEndpoints:
         )
         assert urllib.request.urlopen(req).status == 204
 
-        # remote read with an EQ matcher
+        # remote read with an EQ matcher; the end timestamp is INCLUSIVE
+        # per prompb semantics — the last sample sits exactly at end
         read_req = self._read_request(
-            START, START + 10 * 10**9,
+            START, START + 4 * 10**9,
             [PromMatcher(0, b"__name__", b"reqs"),
              PromMatcher(2, b"host", b"h[01]")],
         )

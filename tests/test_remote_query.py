@@ -85,12 +85,9 @@ class TestFederation:
         fed = FederatedStorage([DatabaseStorage(db_local), remote])
         eng = Engine(fed)
         out = eng.execute_range("sum(reqs)", START, START + 9 * 10**9, 10**9)
-        # us + eu both contribute: sum at step k = 2k; the final step
-        # carries step 8's value via lookback (end-exclusive fetch, the
-        # engine's standard behavior for points exactly at the boundary)
-        want = 2.0 * np.arange(10)
-        want[9] = want[8]
-        np.testing.assert_allclose(out.values[0], want)
+        # us + eu both contribute: sum at step k = 2k (the sample exactly
+        # at the final step is included — Prometheus (t-range, t])
+        np.testing.assert_allclose(out.values[0], 2.0 * np.arange(10))
         by_region = eng.execute_range("sum(reqs) by (region)", START,
                                       START + 9 * 10**9, 10**9)
         assert len(by_region.series) == 2
