@@ -140,6 +140,7 @@ def parse_naive(q: str) -> NaiveQuery:
     by: tuple = ()
     m = re.match(r"^(sum|avg|min|max|count)(?:\s+by\s*\(([^)]*)\))?\s*\(", q)
     inner = q
+    tail = ""
     if m:
         agg = m.group(1)
         if m.group(2):
@@ -148,13 +149,17 @@ def parse_naive(q: str) -> NaiveQuery:
         # strip the outer parens
         assert inner.startswith("(")
         depth = 0
+        closed = False
         for i, c in enumerate(inner):
             depth += c == "("
             depth -= c == ")"
             if depth == 0:
                 tail = inner[i + 1 :].strip()
                 inner = inner[1:i].strip()
+                closed = True
                 break
+        if not closed:
+            raise ValueError(f"naive parser cannot handle {q!r} (unbalanced)")
     else:
         tail = ""
         # scalar op at top level: name{...} / 2 etc

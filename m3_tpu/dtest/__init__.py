@@ -1,0 +1,1 @@
+"""Destructive-test harness (reference `src/m3em` + `src/cmd/tools/dtest`)."""

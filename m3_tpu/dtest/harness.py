@@ -1,0 +1,137 @@
+"""dtest: drive real node processes through destructive scenarios.
+
+Equivalent of the reference's m3em agent + dtest harness
+(`src/m3em/agent` — gRPC process lifecycle: setup/start/stop/heartbeat;
+`src/cmd/tools/dtest` — node add/remove/seed scenarios driving it).
+The gRPC agent collapses to direct subprocess management on one host —
+the scenarios (kill -9 mid-write, restart, verify recovery) are the
+point, not the transport.
+
+`NodeProcess` owns one `m3_tpu.server.node_main` subprocess: spawn,
+wait-healthy (polls the /health endpoint through the node.json status
+file), graceful stop (SIGTERM → commitlog flush), hard kill (SIGKILL —
+the crash case bootstrap must recover from), restart.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+
+class NodeProcess:
+    def __init__(self, config_path: str, root: str, env: dict | None = None):
+        self.config_path = str(config_path)
+        self.root = Path(root)
+        self.env = dict(os.environ, **(env or {}))
+        self.proc: subprocess.Popen | None = None
+        self.port: int | None = None
+
+    @property
+    def status_path(self) -> Path:
+        return self.root / "node.json"
+
+    # -- lifecycle (m3em operator Setup/Start/Stop/Teardown) --------------
+
+    @property
+    def log_path(self) -> Path:
+        return self.root / "node.log"
+
+    def start(self, timeout_s: float = 120.0) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            raise RuntimeError("node already running")
+        self.status_path.unlink(missing_ok=True)
+        # stderr goes to a FILE, never a pipe: a node logging >64KB
+        # would block on a full pipe buffer mid-request otherwise
+        log_f = open(self.log_path, "ab")
+        try:
+            self.proc = subprocess.Popen(
+                [sys.executable, "-m", "m3_tpu.server.node_main",
+                 self.config_path],
+                env=self.env,
+                stdout=subprocess.DEVNULL,
+                stderr=log_f,
+            )
+        finally:
+            log_f.close()  # the child holds its own descriptor
+        self.wait_healthy(timeout_s)
+
+    def wait_healthy(self, timeout_s: float) -> None:
+        """Heartbeat-until-ready (m3em agent heartbeats)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                err = ""
+                if self.log_path.exists():
+                    err = self.log_path.read_bytes()[-2000:].decode(
+                        errors="replace"
+                    )
+                raise RuntimeError(
+                    f"node died during startup (rc={self.proc.returncode}): {err}"
+                )
+            if self.status_path.exists():
+                try:
+                    status = json.loads(self.status_path.read_text())
+                except json.JSONDecodeError:
+                    time.sleep(0.05)
+                    continue
+                self.port = status["port"]
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{self.port}/health", timeout=2
+                    ) as r:
+                        if r.status == 200:
+                            return
+                except OSError:
+                    pass
+            time.sleep(0.1)
+        raise TimeoutError("node did not become healthy")
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def stop(self, timeout_s: float = 30.0) -> int:
+        """Graceful: SIGTERM → clean close (commitlog fsync)."""
+        if not self.alive():
+            return self.proc.returncode if self.proc else -1
+        self.proc.send_signal(signal.SIGTERM)
+        self.proc.wait(timeout=timeout_s)
+        return self.proc.returncode
+
+    def kill(self) -> None:
+        """The crash scenario: SIGKILL, no cleanup, no flush."""
+        if self.alive():
+            self.proc.kill()
+            self.proc.wait(timeout=30)
+
+    def restart(self, timeout_s: float = 120.0) -> None:
+        self.kill()  # no-op when already dead
+        self.start(timeout_s)
+
+    # -- client helpers ----------------------------------------------------
+
+    def write_json(self, samples: list) -> int:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self.port}/api/v1/json/write",
+            data=json.dumps(samples).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.load(r)["written"]
+
+    def query_range(self, query: str, start_s: int, end_s: int,
+                    step: str = "10s") -> list:
+        url = (f"http://127.0.0.1:{self.port}/api/v1/query_range?"
+               f"query={urllib.request.quote(query)}&start={start_s}"
+               f"&end={end_s}&step={step}")
+        with urllib.request.urlopen(url, timeout=60) as r:
+            out = json.load(r)
+        if out.get("status") != "success":
+            raise RuntimeError(out)
+        return out["data"]["result"]

@@ -313,7 +313,9 @@ class _Handler(BaseHTTPRequestHandler):
     def _fetch_docs(self, q):
         ctx = self.ctx
         start = _parse_time(q.get("start", ["0"])[0])
-        end = _parse_time(q.get("end", [str(2**31)])[0])
+        # Prometheus API bounds are inclusive; index queries are
+        # end-exclusive (same rule as Engine._fetch / remote read)
+        end = _parse_time(q.get("end", [str(2**31)])[0]) + 1
         return ctx.db.query_ids(ctx.namespace, All(), start, end)
 
     def _labels(self, q):

@@ -1,0 +1,67 @@
+"""Node entry point: `python -m m3_tpu.server.node_main <config.yaml>`.
+
+Equivalent of the reference's service mains
+(`src/cmd/services/m3dbnode/main/main.go` — parse config, server.Run,
+block on signals).  Writes a `<root>/node.json` status file (pid + HTTP
+port) once serving, so harnesses (dtest) can discover the ephemeral
+port; exits cleanly on SIGTERM, flushing the commitlog.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+from pathlib import Path
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) != 1:
+        print("usage: python -m m3_tpu.server.node_main <config.yaml>",
+              file=sys.stderr)
+        return 2
+    # force the CPU backend before any jax import captures the env: a
+    # node process must not grab the TPU tunnel for host-side serving
+    if os.environ.get("M3_NODE_PLATFORM", "cpu") == "cpu":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from m3_tpu.core.config import load_config
+    from m3_tpu.instrument import logger
+    from m3_tpu.server.assembly import run_node
+
+    log = logger("node_main")
+    cfg = load_config(argv[0])
+    asm = run_node(cfg)
+    status = {
+        "pid": os.getpid(),
+        "port": asm.port,
+        "carbon_port": asm.carbon_port,
+        "root": cfg.db.root,
+    }
+    status_path = Path(cfg.db.root) / "node.json"
+    status_path.write_text(json.dumps(status))
+    log.info("node up: %s", status)
+
+    stop = threading.Event()
+
+    def _term(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    stop.wait()
+    log.info("node shutting down")
+    asm.close()
+    status_path.unlink(missing_ok=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,3 +28,9 @@ if str(REPO_ROOT) not in sys.path:
     sys.path.insert(0, str(REPO_ROOT))
 
 DATA_DIR = pathlib.Path(__file__).resolve().parent / "data"
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-process dtest scenarios (fresh JAX per node)"
+    )

@@ -1,0 +1,75 @@
+"""dtest scenarios: real node subprocesses, kill -9, recovery.
+
+Reference model: `src/cmd/tools/dtest` scenarios over `src/m3em` agents
+(seed a node, kill it mid-stream, restart, verify bootstrap recovers).
+These are the slowest tests in the suite (each node start pays JAX
+compile in a fresh process) — kept to the two essential scenarios.
+"""
+
+import json
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from m3_tpu.dtest.harness import NodeProcess
+
+BLOCK = 2 * 3600 * 10**9
+START_S = (1_700_000_000 * 10**9) // BLOCK * BLOCK // 10**9
+
+
+def _node(tmp_path) -> NodeProcess:
+    root = tmp_path / "data"
+    cfg = tmp_path / "node.yaml"
+    cfg.write_text(f"""
+db:
+  root: {root}
+  namespaces:
+    default: {{num_shards: 2}}
+coordinator: {{listen_port: 0}}
+mediator: {{enabled: false}}
+""")
+    root.mkdir(parents=True, exist_ok=True)
+    return NodeProcess(str(cfg), str(root))
+
+
+def _samples(n, t0=START_S):
+    return [
+        {"tags": {"__name__": "dt", "host": f"h{i % 2}"},
+         "timestamp": t0 + i * 10, "value": float(i)}
+        for i in range(n)
+    ]
+
+
+@pytest.mark.slow
+class TestDtestScenarios:
+    def test_crash_recovery_via_real_process(self, tmp_path):
+        """Seed → kill -9 → restart → the data is back (WAL replay
+        through an actual process crash, not an in-process simulation)."""
+        node = _node(tmp_path)
+        node.start()
+        try:
+            assert node.write_json(_samples(40)) == 40
+            before = node.query_range("sum(dt)", START_S, START_S + 400)
+            assert before
+            node.kill()  # no flush, no graceful close
+            assert not node.alive()
+            node.start()
+            after = node.query_range("sum(dt)", START_S, START_S + 400)
+            assert after == before
+        finally:
+            node.kill()
+
+    def test_graceful_stop_then_restart(self, tmp_path):
+        node = _node(tmp_path)
+        node.start()
+        try:
+            node.write_json(_samples(10))
+            rc = node.stop()
+            assert rc == 0
+            assert not (tmp_path / "data" / "node.json").exists()
+            node.start()
+            out = node.query_range("dt", START_S, START_S + 100)
+            assert len(out) == 2  # both hosts
+        finally:
+            node.kill()
