@@ -139,15 +139,16 @@ class CoordinatorConfig:
     namespace: str = "default"
     downsample: bool = False
     carbon_listen_port: Optional[int] = None  # None = no carbon listener
+    admin_listen_port: Optional[int] = None   # None = no admin API
     tracing: bool = False
 
     def validate(self, errs: list) -> None:
         if not (0 <= self.listen_port < 65536):
             errs.append("coordinator.listen_port: out of range")
-        if self.carbon_listen_port is not None and not (
-            0 <= self.carbon_listen_port < 65536
-        ):
-            errs.append("coordinator.carbon_listen_port: out of range")
+        for f in ("carbon_listen_port", "admin_listen_port"):
+            v = getattr(self, f)
+            if v is not None and not (0 <= v < 65536):
+                errs.append(f"coordinator.{f}: out of range")
 
 
 @dataclasses.dataclass

@@ -1,0 +1,176 @@
+"""Admin API: namespace / placement / topic / runtime-option CRUD.
+
+Equivalent of the reference's coordinator admin handlers
+(`src/query/api/v1/handler/{namespace,placement...}` +
+`cluster/placementhandler` + topic handlers): cluster metadata CRUD
+over the KV control plane.  Routes:
+
+    GET/POST          /api/v1/services/m3db/namespace
+    DELETE            /api/v1/services/m3db/namespace/<name>
+    GET/DELETE        /api/v1/services/m3db/placement
+    POST              /api/v1/services/m3db/placement/init
+    POST              /api/v1/services/m3db/placement          (add instance)
+    GET/POST          /api/v1/topic
+    GET/PUT           /api/v1/runtime                          (options)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from m3_tpu.cluster.kv import KVStore
+from m3_tpu.cluster.namespace_registry import NamespaceMeta, NamespaceRegistry
+from m3_tpu.cluster.placement import (
+    Instance, PlacementService, add_instance, initial_placement,
+)
+from m3_tpu.core.runtime_options import RuntimeOptionsManager
+from m3_tpu.msg.bus import ConsumerService, ConsumptionType, Topic, TopicService
+
+
+class AdminContext:
+    def __init__(self, kv: KVStore, db=None):
+        self.kv = kv
+        self.namespaces = NamespaceRegistry(kv)
+        self.placements = PlacementService(kv)
+        self.topics = TopicService(kv)
+        self.runtime = RuntimeOptionsManager(kv)
+        if db is not None:
+            self.namespaces.attach(db)
+
+
+class _AdminHandler(BaseHTTPRequestHandler):
+    ctx: AdminContext = None
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _json(self, code: int, obj) -> None:
+        data = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _body(self) -> dict:
+        n = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(n)) if n else {}
+
+    def do_GET(self):
+        try:
+            path = self.path.split("?")[0].rstrip("/")
+            if path == "/api/v1/services/m3db/namespace":
+                return self._json(200, {
+                    "registry": {
+                        n: dataclasses.asdict(m)
+                        for n, m in self.ctx.namespaces.all().items()
+                    }
+                })
+            if path == "/api/v1/services/m3db/placement":
+                p = self.ctx.placements.get()
+                if p is None:
+                    return self._json(404, {"error": "no placement"})
+                return self._json(200, json.loads(p.to_json()))
+            if path == "/api/v1/topic":
+                names = [k.split("/", 1)[1] for k in self.ctx.kv.keys()
+                         if k.startswith("_topic/")]
+                return self._json(200, {"topics": names})
+            if path.startswith("/api/v1/topic/"):
+                t = self.ctx.topics.get(path.rsplit("/", 1)[1])
+                if t is None:
+                    return self._json(404, {"error": "no such topic"})
+                return self._json(200, json.loads(t.to_json()))
+            if path == "/api/v1/runtime":
+                return self._json(200, self.ctx.runtime.snapshot())
+            return self._json(404, {"error": f"unknown path {path}"})
+        except Exception as e:  # noqa: BLE001 — API boundary
+            return self._json(400, {"error": str(e)})
+
+    def do_POST(self):
+        try:
+            path = self.path.split("?")[0].rstrip("/")
+            body = self._body()
+            if path == "/api/v1/services/m3db/namespace":
+                meta = NamespaceMeta(**body)
+                self.ctx.namespaces.add(meta)
+                return self._json(200, dataclasses.asdict(meta))
+            if path == "/api/v1/services/m3db/placement/init":
+                instances = [
+                    Instance(i["id"], i.get("isolation_group", ""),
+                             i.get("weight", 1))
+                    for i in body["instances"]
+                ]
+                p = initial_placement(
+                    instances, body.get("num_shards", 64), body.get("rf", 3)
+                )
+                self.ctx.placements.set(p)
+                return self._json(200, json.loads(p.to_json()))
+            if path == "/api/v1/services/m3db/placement":
+                p = self.ctx.placements.get()
+                if p is None:
+                    return self._json(404, {"error": "no placement; init first"})
+                inst = Instance(body["id"], body.get("isolation_group", ""),
+                                body.get("weight", 1))
+                p2 = add_instance(p, inst)
+                self.ctx.placements.set(p2)
+                return self._json(200, json.loads(p2.to_json()))
+            if path == "/api/v1/topic":
+                t = Topic(
+                    body["name"], body.get("num_shards", 64),
+                    tuple(
+                        ConsumerService(
+                            c["name"],
+                            ConsumptionType(c.get("consumption", "shared")),
+                        )
+                        for c in body.get("consumer_services", [])
+                    ),
+                )
+                self.ctx.topics.set(t)
+                return self._json(200, json.loads(t.to_json()))
+            return self._json(404, {"error": f"unknown path {path}"})
+        except (KeyError, TypeError, ValueError) as e:
+            return self._json(400, {"error": str(e)})
+
+    def do_PUT(self):
+        try:
+            path = self.path.split("?")[0].rstrip("/")
+            if path == "/api/v1/runtime":
+                body = self._body()
+                # validate the WHOLE body before applying anything — a
+                # partial apply followed by a 400 would leave the
+                # operator believing nothing changed
+                for name, value in body.items():
+                    self.ctx.runtime.validate(name, value)
+                for name, value in body.items():
+                    self.ctx.runtime.set(name, value)
+                return self._json(200, self.ctx.runtime.snapshot())
+            return self._json(404, {"error": f"unknown path {path}"})
+        except KeyError as e:
+            return self._json(400, {"error": str(e)})
+
+    def do_DELETE(self):
+        try:
+            path = self.path.split("?")[0].rstrip("/")
+            if path.startswith("/api/v1/services/m3db/namespace/"):
+                name = path.rsplit("/", 1)[1]
+                if not self.ctx.namespaces.remove(name):
+                    return self._json(404, {"error": f"no namespace {name}"})
+                return self._json(200, {"deleted": name})
+            if path == "/api/v1/services/m3db/placement":
+                self.ctx.kv.delete(self.ctx.placements.key)
+                return self._json(200, {"deleted": "placement"})
+            return self._json(404, {"error": f"unknown path {path}"})
+        except Exception as e:  # noqa: BLE001
+            return self._json(400, {"error": str(e)})
+
+
+def serve_admin_background(ctx: AdminContext, host: str = "127.0.0.1",
+                           port: int = 0) -> ThreadingHTTPServer:
+    handler = type("BoundAdmin", (_AdminHandler,), {"ctx": ctx})
+    srv = ThreadingHTTPServer((host, port), handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv

@@ -30,6 +30,8 @@ class Assembly:
     http_server: object | None
     carbon_server: object | None = None
     tracer: object | None = None
+    admin_server: object | None = None
+    kv: object | None = None
 
     @property
     def port(self) -> int | None:
@@ -39,7 +41,14 @@ class Assembly:
     def carbon_port(self) -> int | None:
         return self.carbon_server.port if self.carbon_server else None
 
+    @property
+    def admin_port(self) -> int | None:
+        return self.admin_server.server_address[1] if self.admin_server else None
+
     def close(self) -> None:
+        if self.admin_server is not None:
+            self.admin_server.shutdown()
+            self.admin_server.server_close()
         if self.carbon_server is not None:
             self.carbon_server.shutdown()
             self.carbon_server.server_close()
@@ -171,6 +180,34 @@ def run_node(source, start_mediator: bool | None = None,
                 carbon_sink,
                 cfg.coordinator.listen_host, cfg.coordinator.carbon_listen_port,
                 instrument=scope,
+            )
+        if (serve_http and cfg.coordinator is not None
+                and cfg.coordinator.admin_listen_port is not None):
+            from m3_tpu.cluster.kv import KVStore
+            from m3_tpu.server.admin_api import (
+                AdminContext, serve_admin_background,
+            )
+
+            asm.kv = KVStore(cfg.db.root)  # file-backed control plane
+            admin_ctx = AdminContext(asm.kv, db)
+            # live-tune the query limits through runtime options
+            # (runtime_options_manager.go's role for write/query limits)
+            for opt, lim in (("max_docs_matched", limits.docs),
+                             ("max_series_read", limits.series),
+                             ("max_bytes_read", limits.bytes)):
+                def apply(value, _lim=lim):
+                    _lim.limit = int(value)
+                admin_ctx.runtime.on_change(opt, apply)
+                # replay the persisted value: the KV watch fired during
+                # AdminContext construction, BEFORE this listener existed
+                # — a restart must re-apply tuned limits, not report
+                # them while running unprotected
+                persisted = admin_ctx.runtime.get(opt)
+                if persisted:
+                    apply(persisted)
+            asm.admin_server = serve_admin_background(
+                admin_ctx, cfg.coordinator.listen_host,
+                cfg.coordinator.admin_listen_port,
             )
     except BaseException:
         asm.close()

@@ -1,0 +1,241 @@
+"""Admin API, dynamic namespaces, runtime options.
+
+Reference models: coordinator admin handlers
+(`src/query/api/v1/handler/{namespace,placement}`, topic CRUD),
+dynamic namespaces (`src/dbnode/namespace/dynamic.go`), and the
+RuntimeOptionsManager (`src/dbnode/runtime/runtime_options_manager.go`).
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from m3_tpu.cluster.kv import KVStore
+from m3_tpu.cluster.namespace_registry import NamespaceMeta, NamespaceRegistry
+from m3_tpu.core.runtime_options import RuntimeOptionsManager
+from m3_tpu.server.admin_api import AdminContext, serve_admin_background
+from m3_tpu.storage.database import Database, DatabaseOptions, NamespaceOptions
+
+BLOCK = 2 * 3600 * 10**9
+START = (1_700_000_000 * 10**9) // BLOCK * BLOCK
+
+
+def _req(base, method, path, body=None):
+    r = urllib.request.Request(
+        base + path, method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+    )
+    try:
+        with urllib.request.urlopen(r) as resp:
+            return resp.status, json.load(resp)
+    except urllib.error.HTTPError as e:
+        return e.code, json.load(e)
+
+
+class TestRuntimeOptions:
+    def test_set_get_and_listeners(self):
+        mgr = RuntimeOptionsManager(KVStore())
+        assert mgr.get("max_series_read") == 0
+        seen = []
+        mgr.on_change("max_series_read", seen.append)
+        mgr.set("max_series_read", 500)
+        assert mgr.get("max_series_read") == 500
+        assert seen == [500]
+
+    def test_unknown_option_rejected(self):
+        mgr = RuntimeOptionsManager(KVStore())
+        with pytest.raises(KeyError):
+            mgr.set("nope", 1)
+        with pytest.raises(KeyError):
+            mgr.get("nope")
+
+    def test_shared_kv_converges_two_managers(self, tmp_path):
+        """Two managers over the same persisted KV: a set through one is
+        visible to a manager constructed later (restart scenario)."""
+        kv = KVStore(str(tmp_path))
+        m1 = RuntimeOptionsManager(kv)
+        m1.set("max_docs_matched", 1234)
+        kv2 = KVStore(str(tmp_path))
+        m2 = RuntimeOptionsManager(kv2)
+        assert m2.get("max_docs_matched") == 1234
+
+    def test_malformed_kv_value_ignored(self):
+        kv = KVStore()
+        mgr = RuntimeOptionsManager(kv)
+        kv.set("runtime/max_series_read", b"not json{")
+        assert mgr.get("max_series_read") == 0  # default survives
+
+
+class TestDynamicNamespaces:
+    def test_attach_materializes_existing_and_future(self, tmp_path):
+        kv = KVStore()
+        reg = NamespaceRegistry(kv)
+        reg.add(NamespaceMeta("agg_1m", num_shards=2))
+        db = Database(DatabaseOptions(root=str(tmp_path)),
+                      namespaces={"default": NamespaceOptions(num_shards=1)})
+        reg.attach(db)
+        assert "agg_1m" in db.namespaces  # existing at attach
+        reg.add(NamespaceMeta("agg_1h", num_shards=2,
+                              retention_nanos=365 * 86400 * 10**9))
+        assert "agg_1h" in db.namespaces  # future via watch
+        assert db.namespaces["agg_1h"].opts.retention_nanos == 365 * 86400 * 10**9
+        # writes to the dynamic namespace work immediately
+        db.write_batch("agg_1h", [b"x"], np.asarray([START], np.int64),
+                       np.asarray([1.0]))
+        assert db.read("agg_1h", b"x", START, START + BLOCK)
+        db.close()
+
+    def test_duplicate_add_rejected(self):
+        reg = NamespaceRegistry(KVStore())
+        reg.add(NamespaceMeta("a"))
+        with pytest.raises(ValueError):
+            reg.add(NamespaceMeta("a"))
+
+
+class TestAdminAPI:
+    @pytest.fixture
+    def server(self, tmp_path):
+        kv = KVStore()
+        db = Database(DatabaseOptions(root=str(tmp_path)),
+                      namespaces={"default": NamespaceOptions(num_shards=1)})
+        ctx = AdminContext(kv, db)
+        srv = serve_admin_background(ctx)
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        yield base, db
+        srv.shutdown()
+        db.close()
+
+    def test_namespace_crud_reaches_database(self, server):
+        base, db = server
+        code, out = _req(base, "POST", "/api/v1/services/m3db/namespace",
+                         {"name": "agg_10s", "num_shards": 2})
+        assert code == 200
+        assert "agg_10s" in db.namespaces  # dynamic attach fired
+        code, out = _req(base, "GET", "/api/v1/services/m3db/namespace")
+        assert "agg_10s" in out["registry"]
+        code, out = _req(base, "DELETE",
+                         "/api/v1/services/m3db/namespace/agg_10s")
+        assert code == 200
+        code, out = _req(base, "GET", "/api/v1/services/m3db/namespace")
+        assert "agg_10s" not in out["registry"]
+
+    def test_placement_init_and_add(self, server):
+        base, _db = server
+        code, out = _req(base, "GET", "/api/v1/services/m3db/placement")
+        assert code == 404
+        code, out = _req(base, "POST", "/api/v1/services/m3db/placement/init", {
+            "instances": [{"id": "n1", "isolation_group": "a"},
+                          {"id": "n2", "isolation_group": "b"}],
+            "num_shards": 8, "rf": 2,
+        })
+        assert code == 200 and out["num_shards"] == 8
+        code, out = _req(base, "POST", "/api/v1/services/m3db/placement",
+                         {"id": "n3", "isolation_group": "c"})
+        assert code == 200
+        assert "n3" in out["instances"]
+
+    def test_topic_crud(self, server):
+        base, _db = server
+        code, out = _req(base, "POST", "/api/v1/topic", {
+            "name": "agg_out", "num_shards": 4,
+            "consumer_services": [{"name": "coordinator"}],
+        })
+        assert code == 200
+        code, out = _req(base, "GET", "/api/v1/topic")
+        assert out["topics"] == ["agg_out"]
+        code, out = _req(base, "GET", "/api/v1/topic/agg_out")
+        assert out["num_shards"] == 4
+
+    def test_runtime_options_over_http(self, server):
+        base, _db = server
+        code, out = _req(base, "PUT", "/api/v1/runtime",
+                         {"max_series_read": 99})
+        assert code == 200 and out["max_series_read"] == 99
+        code, out = _req(base, "GET", "/api/v1/runtime")
+        assert out["max_series_read"] == 99
+        code, out = _req(base, "PUT", "/api/v1/runtime", {"bogus": 1})
+        assert code == 400
+
+    def test_bad_namespace_body(self, server):
+        base, _db = server
+        code, out = _req(base, "POST", "/api/v1/services/m3db/namespace",
+                         {"nope": True})
+        assert code == 400
+
+    def test_runtime_put_is_atomic(self, server):
+        """A body with one bad key must apply NOTHING (review fix)."""
+        base, _db = server
+        code, out = _req(base, "PUT", "/api/v1/runtime",
+                         {"max_series_read": 77, "bogus": 1})
+        assert code == 400
+        code, out = _req(base, "GET", "/api/v1/runtime")
+        assert out["max_series_read"] == 0  # untouched
+
+    def test_runtime_type_validation(self, server):
+        base, _db = server
+        code, out = _req(base, "PUT", "/api/v1/runtime",
+                         {"max_series_read": "lots"})
+        assert code == 400
+
+
+class TestRestartReplay:
+    def test_persisted_limits_reapply_on_restart(self, tmp_path):
+        """Tuned limits must survive a node restart (review fix: the KV
+        watch fires before the limit listeners exist; run_node replays)."""
+        import urllib.error
+
+        from m3_tpu.server.assembly import run_node
+
+        cfg = f"""
+db:
+  root: {tmp_path}
+  namespaces:
+    default: {{num_shards: 1}}
+coordinator: {{listen_port: 0, admin_listen_port: 0}}
+mediator: {{enabled: false}}
+"""
+        asm = run_node(cfg)
+        base = f"http://127.0.0.1:{asm.admin_port}"
+        code, _ = _req(base, "PUT", "/api/v1/runtime", {"max_series_read": 1})
+        assert code == 200
+        asm.close()
+
+        asm2 = run_node(cfg)
+        t0 = START // 10**9
+        samples = [{"tags": {"__name__": "m", "i": str(i)},
+                    "timestamp": t0, "value": 1.0} for i in range(4)]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{asm2.port}/api/v1/json/write",
+            data=json.dumps(samples).encode())
+        urllib.request.urlopen(req)
+        q = (f"http://127.0.0.1:{asm2.port}/api/v1/query_range?"
+             f"query=m&start={t0}&end={t0 + 10}&step=10s")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(q)
+        assert ei.value.code == 429  # limit=1 is live after restart
+        asm2.close()
+
+
+class TestRegistryConcurrency:
+    def test_concurrent_adds_do_not_lose_namespaces(self):
+        import threading
+
+        reg = NamespaceRegistry(KVStore())
+        errs = []
+
+        def add(k):
+            try:
+                reg.add(NamespaceMeta(f"ns{k}"))
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=add, args=(k,)) for k in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        assert len(reg.all()) == 8
