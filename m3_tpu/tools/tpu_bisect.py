@@ -1,0 +1,257 @@
+"""TPU/CPU bit-exactness bisector for the batched M3TSZ codec.
+
+The test suite runs on the CPU backend and cannot catch TPU-only numeric
+divergence (mis-lowered 64-bit ops, the backend's X64 type rewrite).  This
+tool runs the full device codec pipeline stage-by-stage on both backends
+over a synthetic bench-shaped corpus and reports the first diverging
+stage, array, and element — the workflow that found the round-2 failure:
+the axon backend emulates float64 as an f32 pair (double-double), so any
+f64 *output* materialized on the TPU loses its low mantissa bits (~1 ulp).
+The codec itself (all-integer: int64/uint64 lower to exact u32 pairs) is
+bit-exact; outputs crossing the device boundary must therefore stay
+integer and be reinterpreted as float64 on the host.
+
+Stages checked, in pipeline order:
+
+  1. primitives  — u64 shift/div/mod/mul, clz, f64_emul kernels on random
+                   operand grids (isolates a single mis-lowered op).
+  2. encode      — ``encode_batch_device`` words/total_bits/fallback.
+  3. finalize    — host trim + EOS tail (shared host code; sanity only).
+  4. decode      — ``decode_batch_device`` ts/payload/meta/err/prec on the
+                   finalized streams.
+  5. to_values   — the int->float conversion (``f64_emul.int_div_pow10``)
+                   with the result kept as uint64 bits (the contract).
+  6. f64_output  — deliberately materializes a float64 output on the
+                   accelerator and reports whether the backend preserves
+                   it (expected DIFF on axon; documents the constraint).
+
+Usage:
+    JAX_PLATFORMS=axon,cpu python -m m3_tpu.tools.tpu_bisect [-S 512] [-T 720]
+
+Exit code 0 when stages 1-5 are bit-exact on the accelerator, 1 otherwise.
+Reference parity target: src/dbnode/encoding/m3tsz/{encoder.go,iterator.go}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import sys
+
+import numpy as np
+
+import m3_tpu  # noqa: F401  (x64 config)
+import jax
+import jax.numpy as jnp
+
+from m3_tpu.encoding import f64_emul as fe
+from m3_tpu.encoding.m3tsz_jax import (
+    decode_batch_device,
+    encode_batch_device,
+    finalize_streams,
+    pack_streams,
+)
+
+START = 1_600_000_000 * 10**9
+
+
+def _log(*a) -> None:
+    print("[tpu_bisect]", *a, file=sys.stderr, flush=True)
+
+
+def _diff_report(name: str, a: np.ndarray, b: np.ndarray) -> bool:
+    """Compare two host arrays bitwise; report and return True on diff."""
+    if a.dtype == np.float64:
+        a, b = a.view(np.uint64), b.view(np.uint64)
+    if np.array_equal(a, b):
+        _log(f"  {name}: EQUAL")
+        return False
+    d = np.argwhere(a != b) if a.shape else np.zeros((1, 0), np.int64)
+    idx = tuple(d[0])
+    av, bv = a[idx], b[idx]
+    fmt = (lambda v: f"0x{int(v):016x}") if a.dtype in (np.uint64,) else str
+    _log(
+        f"  {name}: DIFF at {idx} ({len(d)} of {a.size} elements): "
+        f"cpu={fmt(av)} dev={fmt(bv)}"
+    )
+    return True
+
+
+def _on(dev, fn, *args):
+    with jax.default_device(dev):
+        out = fn(*[jnp.asarray(x) for x in args])
+    if isinstance(out, dict):
+        return {k: np.asarray(v) for k, v in out.items()}
+    if isinstance(out, (tuple, list)):
+        return [np.asarray(x) for x in out]
+    return np.asarray(out)
+
+
+def _compare(name, cpu_out, dev_out) -> list[str]:
+    bad = []
+    if isinstance(cpu_out, dict):
+        pairs = [(k, cpu_out[k], dev_out[k]) for k in cpu_out]
+    elif isinstance(cpu_out, list):
+        pairs = [(str(i), a, b) for i, (a, b) in enumerate(zip(cpu_out, dev_out))]
+    else:
+        pairs = [("out", cpu_out, dev_out)]
+    for sub, a, b in pairs:
+        if _diff_report(f"{name}.{sub}", a, b):
+            bad.append(f"{name}.{sub}")
+    return bad
+
+
+def make_corpus(S: int, T: int, seed: int = 42):
+    """The bench corpus shape: regular 10s timestamps, 2-decimal gauges."""
+    rng = np.random.default_rng(seed)
+    ts = np.tile(START + np.arange(1, T + 1) * 10 * 10**9, (S, 1)).astype(np.int64)
+    base = rng.uniform(10, 1000, (S, 1))
+    vals = np.round(base + rng.normal(0, base * 0.05, (S, T)), 2)
+    # Mix in the codec's other regimes: float-mode series, repeats, and
+    # irregular timestamps, so every decoder branch is exercised.
+    vals[1::7] += rng.standard_normal((vals[1::7].shape))  # float (XOR) mode
+    vals[2::11, :] = vals[2::11, :1]  # constant series (repeat opcode)
+    ts[3::13, 1::2] += 10**9  # jittered timestamps (non-zero dod)
+    starts = np.full(S, START, np.int64)
+    return ts, vals, starts
+
+
+def stage_primitives(cpu, dev) -> list[str]:
+    _log("stage 1: primitives")
+    rng = np.random.default_rng(0)
+    N = 4096
+    a = rng.integers(0, 1 << 63, N, dtype=np.uint64)
+    small = rng.integers(0, 1 << 52, N, dtype=np.uint64)
+    d = np.asarray([10 ** (i % 7) for i in range(N)], np.uint64)
+    sh = (a % 64).astype(np.uint64)
+    k = (np.arange(N) % 7).astype(np.int64)
+    ii = rng.integers(-(1 << 53), 1 << 53, N, dtype=np.int64)
+
+    cases = [
+        ("u64_shl", jax.jit(lambda a, s: a << s), (a, sh)),
+        ("u64_shr", jax.jit(lambda a, s: a >> s), (a, sh)),
+        ("u64_div", jax.jit(lambda a, d: a // d), (small, d)),
+        ("u64_mod", jax.jit(lambda a, d: a % d), (small, d)),
+        ("u64_mul", jax.jit(lambda a, d: a * d), (small, d)),
+        ("i64_clz", jax.jit(lambda a: jax.lax.clz(a.astype(jnp.int64))), (a,)),
+        ("uint_to_f64_bits", jax.jit(fe.uint_to_f64_bits), (a,)),
+        ("mul_pow10", jax.jit(fe.mul_pow10),
+         (small | np.uint64(1 << 62), (k % 7).astype(np.int32))),
+        ("int_div_pow10", jax.jit(fe.int_div_pow10), (ii, k)),
+        ("u64_scatter_add",
+         jax.jit(lambda v, i: jnp.zeros(64, jnp.uint64).at[i].add(v)),
+         (a, (a % 64).astype(np.int32))),
+    ]
+    bad = []
+    for name, f, args in cases:
+        bad += _compare(name, _on(cpu, f, *args), _on(dev, f, *args))
+    return bad
+
+
+def stage_codec(cpu, dev, S: int, T: int) -> list[str]:
+    ts, vals, starts = make_corpus(S, T)
+    vb = vals.view(np.uint64)
+    valid = np.ones((S, T), bool)
+    ow = T * 40 // 64 + 8
+
+    _log(f"stage 2: encode_batch_device (S={S}, T={T})")
+    enc = functools.partial(encode_batch_device, unit=1, out_words=ow)
+    ec = _on(cpu, enc, ts, vb, starts, valid)
+    ed = _on(dev, enc, ts, vb, starts, valid)
+    bad = _compare("encode", ec, ed)
+    if bad:
+        return bad  # downstream comparisons would just cascade
+
+    _log("stage 3: finalize_streams (host)")
+    streams = finalize_streams(ec["words"], ec["total_bits"])
+    words, nbits = pack_streams(streams)
+    _log(f"  {len(streams)} streams, max {max(map(len, streams))} bytes")
+
+    _log("stage 4: decode_batch_device")
+    dec = functools.partial(decode_batch_device, max_points=T + 1)
+    dc = _on(cpu, dec, words, nbits)
+    dd = _on(dev, dec, words, nbits)
+    names = ["ts", "payload", "meta", "err", "prec"]
+    for n, a, b in zip(names, dc, dd):
+        if _diff_report(f"decode.{n}", a, b):
+            bad.append(f"decode.{n}")
+    if bad:
+        return bad
+
+    _log("stage 5: int->float bits (int_div_pow10, uint64 output)")
+
+    @jax.jit
+    def to_bits(payload, meta):
+        isf = (meta & 8) != 0
+        mult = (meta & 7).astype(jnp.int64)
+        ibits = fe.int_div_pow10(payload.astype(jnp.int64), mult)
+        return jnp.where(isf, payload, ibits)
+
+    bc = _on(cpu, to_bits, dc[1], dc[2])
+    bd = _on(dev, to_bits, dd[1], dd[2])
+    if _diff_report("to_values.bits", bc, bd):
+        bad.append("to_values.bits")
+    # Cross-check against the corpus itself — only for series the device
+    # codec owns: encoder-fallback rows (e.g. streams overflowing
+    # out_words) carry garbage words by contract (the host scalar codec
+    # re-encodes them) and still must match bit-for-bit ACROSS backends
+    # (checked above), just not against the corpus.
+    ok_rows = ~(ec["fallback"] | dc[3] | dc[4])
+    _log(f"  corpus check on {int(ok_rows.sum())}/{S} device-path series")
+    want = vals.view(np.uint64)[ok_rows]
+    got = bc[ok_rows, :T]
+    if not np.array_equal(got, want):
+        _diff_report("to_values.vs_corpus", got, want)
+        bad.append("to_values.vs_corpus")
+    return bad
+
+
+def stage_f64_output(cpu, dev) -> None:
+    """Document (not gate): does the accelerator preserve f64 outputs?"""
+    _log("stage 6: f64 output materialization (informational)")
+    v = np.asarray([802.18, 3.141592653589793, 1.0000000000000002], np.float64)
+    f = jax.jit(lambda x: x + jnp.float64(0.0))
+    try:
+        a, b = _on(cpu, f, v), _on(dev, f, v)
+        if _diff_report("f64_roundtrip", a, b):
+            _log(
+                "  NOTE: accelerator does NOT preserve float64 outputs "
+                "(X64 rewrite emulates f64 as an f32 pair). Device code "
+                "must return integer bit patterns, never f64."
+            )
+    except Exception as e:  # pragma: no cover - backend specific
+        _log(f"  f64 roundtrip raised: {type(e).__name__}: {e}")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("-S", type=int, default=512, help="series count")
+    p.add_argument("-T", type=int, default=720, help="points per series")
+    args = p.parse_args(argv)
+
+    devs = jax.devices()
+    accel = [d for d in devs if d.platform != "cpu"]
+    try:
+        cpu = jax.devices("cpu")[0]
+    except RuntimeError:
+        _log("no cpu backend registered; set JAX_PLATFORMS=<accel>,cpu")
+        return 2
+    if not accel:
+        _log("no accelerator attached; nothing to bisect (cpu-only run)")
+        return 0
+    dev = accel[0]
+    _log(f"comparing {cpu} vs {dev} ({dev.device_kind})")
+
+    bad = stage_primitives(cpu, dev)
+    bad += stage_codec(cpu, dev, args.S, args.T)
+    stage_f64_output(cpu, dev)
+
+    if bad:
+        _log(f"FAIL: diverging stages: {bad}")
+        return 1
+    _log("OK: codec pipeline is bit-exact on the accelerator")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
