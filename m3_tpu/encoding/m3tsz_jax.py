@@ -634,8 +634,8 @@ def _peek(words, cursor, n):
 # axis); the original decoder issued ~24 of them per scan step and was
 # gather-bound (round-2: 0.96M datapoints/s on a v5e).  The decoder now
 # carries a 32-word (2048-bit) window of each lane's stream in the scan
-# carry.  All field reads are register-level selects/shifts against an
-# 8-word buffer extracted from that window once per step; the only memory
+# carry.  All field reads are register-level selects/shifts against a
+# 9-word buffer extracted from that window once per step; the only memory
 # access is a 16-word block refill, executed under a *scalar* `lax.cond`
 # only on steps where some lane's window runs low (~every 1024/avg-bits
 # steps on typical corpora).  Worst case (adversarial drift) is one
