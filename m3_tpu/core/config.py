@@ -109,6 +109,19 @@ class DBConfig:
         default_factory=lambda: {"default": NamespaceConfig()}
     )
     limits: LimitsConfig = dataclasses.field(default_factory=LimitsConfig)
+    # Cross-process data plane (server/rpc.py).  rpc_listen_port None
+    # disables the RPC listener (single-node deployments); 0 binds an
+    # ephemeral port (published via the node.json status file).  The
+    # bind host defaults to loopback; multi-host deployments must set
+    # rpc_listen_host (e.g. "0.0.0.0") or peer dials get ECONNREFUSED.
+    # peers lists other replicas' RPC endpoints as "host:port"; when
+    # bootstrap_peers is true the node's bootstrap chain ends with a
+    # wire peers-bootstrap pass against them (reference
+    # bootstrapper/peers/source.go).
+    rpc_listen_host: str = "127.0.0.1"
+    rpc_listen_port: Optional[int] = None
+    peers: list = dataclasses.field(default_factory=list)
+    bootstrap_peers: bool = False
 
     def validate(self, errs: list) -> None:
         if not self.namespaces:
@@ -116,6 +129,15 @@ class DBConfig:
         for name, ns in self.namespaces.items():
             ns.validate(f"db.namespaces.{name}", errs)
         self.limits.validate(errs)
+        if self.rpc_listen_port is not None and not (
+                0 <= self.rpc_listen_port < 65536):
+            errs.append("db.rpc_listen_port: out of range")
+        for p in self.peers:
+            host, _, port = p.rpartition(":") if isinstance(p, str) else ("", "", "")
+            if not host or not port.isdigit() or not (0 < int(port) < 65536):
+                errs.append(f"db.peers: expected 'host:port', got {p!r}")
+        if self.bootstrap_peers and not self.peers:
+            errs.append("db.bootstrap_peers requires db.peers")
 
 
 @dataclasses.dataclass

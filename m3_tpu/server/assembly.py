@@ -32,10 +32,16 @@ class Assembly:
     tracer: object | None = None
     admin_server: object | None = None
     kv: object | None = None
+    rpc_server: object | None = None
+    peer_handles: list = dataclasses.field(default_factory=list)
 
     @property
     def port(self) -> int | None:
         return self.http_server.server_address[1] if self.http_server else None
+
+    @property
+    def rpc_port(self) -> int | None:
+        return self.rpc_server.port if self.rpc_server else None
 
     @property
     def carbon_port(self) -> int | None:
@@ -46,6 +52,11 @@ class Assembly:
         return self.admin_server.server_address[1] if self.admin_server else None
 
     def close(self) -> None:
+        for h in self.peer_handles:
+            h.close()
+        if self.rpc_server is not None:
+            self.rpc_server.shutdown()
+            self.rpc_server.server_close()
         if self.admin_server is not None:
             self.admin_server.shutdown()
             self.admin_server.server_close()
@@ -126,6 +137,31 @@ def run_node(source, start_mediator: bool | None = None,
     asm = Assembly(cfg, registry, db, None, None, None, tracer)
     try:
         db.bootstrap()
+
+        # Wire peers bootstrap: after local fs+commitlog recovery, pull
+        # any (shard, block) filesets a replica peer has that this node
+        # lacks, over the socket RPC (the bootstrap chain's final
+        # `peers` stage — bootstrapper/peers/source.go).  Unreachable
+        # peers are skipped; repair converges them later.
+        if cfg.db.peers:
+            from m3_tpu.server.rpc import RemoteDatabase
+
+            asm.peer_handles = [
+                RemoteDatabase((h, int(p)))
+                for h, _, p in (a.rpartition(":") for a in cfg.db.peers)
+            ]
+            if cfg.db.bootstrap_peers:
+                from m3_tpu.storage.repair import peers_bootstrap
+
+                for ns_name in cfg.db.namespaces:
+                    peers_bootstrap(db, asm.peer_handles, ns_name)
+
+        if cfg.db.rpc_listen_port is not None:
+            from m3_tpu.server.rpc import serve_rpc_background
+
+            asm.rpc_server = serve_rpc_background(
+                db, host=cfg.db.rpc_listen_host, port=cfg.db.rpc_listen_port
+            )
 
         if cfg.mediator.enabled if start_mediator is None else start_mediator:
             asm.mediator = Mediator(

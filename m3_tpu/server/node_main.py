@@ -43,6 +43,7 @@ def main(argv=None) -> int:
         "pid": os.getpid(),
         "port": asm.port,
         "carbon_port": asm.carbon_port,
+        "rpc_port": asm.rpc_port,
         "root": cfg.db.root,
     }
     status_path = Path(cfg.db.root) / "node.json"

@@ -470,6 +470,66 @@ class Database:
                 stats[name] = ns.tick(now_nanos)
             return stats
 
+    # ---- block-level replication surface -------------------------------
+    # The handle interface repair and peers bootstrap run against; the
+    # socket RPC (server/rpc.py) exports exactly these four methods so a
+    # replica works the same whether it is this object or a remote node
+    # (reference FetchBlocksMetadataRawV2 `node/service.go:1529` + the
+    # peer block streaming in `client/peer.go`).
+
+    def list_block_filesets(self, namespace: str, shard: int):
+        """[(block_start, latest volume)] flushed for the shard."""
+        from m3_tpu.persist.fs import list_filesets
+
+        return sorted(list_filesets(self.opts.root, namespace, shard))
+
+    def block_metadata(self, namespace: str, shard: int, block_start: int):
+        """Per-series stream checksums for one flushed block, or None
+        when no fileset exists for it.
+
+        Served from the fileset's index entries alone (the writer stores
+        adler32-of-segment per entry), never touching the data file —
+        the metadata-only property of the reference's
+        FetchBlocksMetadataRawV2."""
+        from m3_tpu.persist.fs import DataFileSetReader, list_filesets
+
+        filesets = dict(list_filesets(self.opts.root, namespace, shard))
+        if block_start not in filesets:
+            return None
+        r = DataFileSetReader(
+            self.opts.root, namespace, shard, block_start, filesets[block_start]
+        )
+        return {e.id: e.checksum for e in r._index}
+
+    def read_block(self, namespace: str, shard: int, block_start: int):
+        """All (series id, encoded stream) pairs of one flushed block;
+        [] when the block has no fileset."""
+        from m3_tpu.persist.fs import DataFileSetReader, list_filesets
+
+        filesets = dict(list_filesets(self.opts.root, namespace, shard))
+        if block_start not in filesets:
+            return []
+        r = DataFileSetReader(
+            self.opts.root, namespace, shard, block_start, filesets[block_start]
+        )
+        return list(r.read_all())
+
+    def write_block(self, namespace: str, shard: int, block_start: int,
+                    series) -> None:
+        """Persist a full block's series as the next fileset volume and
+        mark it flushed (repair rewrite / peers-bootstrap load)."""
+        from m3_tpu.persist.fs import DataFileSetWriter, list_filesets
+
+        with self._mu:
+            ns = self.namespaces[namespace]
+            filesets = dict(list_filesets(self.opts.root, namespace, shard))
+            vol = filesets.get(block_start, -1) + 1
+            DataFileSetWriter(
+                self.opts.root, namespace, shard, block_start,
+                ns.opts.block_size_nanos, volume=vol,
+            ).write_all(sorted(series))
+            ns.shards[shard].flushed_blocks.add(block_start)
+
     def snapshot(self) -> dict:
         """Capture every namespace's un-flushed buffers as snapshot
         filesets (reference mediator.go:318 runFileSystemProcesses →

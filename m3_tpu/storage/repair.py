@@ -11,36 +11,25 @@ Reference parity:
   add/replace) streams whole blocks from replica peers and persists
   them locally.
 
-Here replicas are per-instance `Database` handles (the same in-process
-topology the reference's integration tests use); metadata compare is a
-dict diff over per-series adler32 digests — the digest the reference
-filesets already carry (`src/dbnode/digest/digest.go:24-37`).  The
-device-side analogue (checksum compare across the replica mesh axis as
-a ppermute collective) lives in `m3_tpu/parallel/replication.py`.
+Replicas are *handles* exposing the block-level replication surface
+(``list_block_filesets`` / ``block_metadata`` / ``read_block`` /
+``write_block``): either local ``Database`` objects or
+``server.rpc.RemoteDatabase`` connections to other node processes —
+repair and peers bootstrap stream blocks over the wire exactly like the
+reference's peer block streaming (`client/peer.go`,
+`stream_blocks_*`), never by reading a peer's filesystem.  Metadata
+compare is a dict diff over per-series adler32 digests — the digest the
+reference filesets already carry (`src/dbnode/digest/digest.go:24-37`).
+The device-side analogue (checksum compare across the replica mesh axis
+as a ppermute collective) lives in ``m3_tpu/parallel/replication.py``.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+from typing import Dict, List
 
 from m3_tpu.encoding.m3tsz import decode_series, encode_series
 from m3_tpu.persist.digest import digest as checksum
-from m3_tpu.persist.fs import DataFileSetReader, DataFileSetWriter, list_filesets
-
-
-def block_metadata(
-    db, namespace: str, shard: int, block_start: int
-) -> Dict[bytes, int] | None:
-    """Per-series stream checksums for one flushed block, or None when
-    the replica has no fileset for it (reference
-    FetchBlocksMetadataRawV2, the metadata half of repair)."""
-    filesets = dict(list_filesets(db.opts.root, namespace, shard))
-    if block_start not in filesets:
-        return None
-    r = DataFileSetReader(
-        db.opts.root, namespace, shard, block_start, filesets[block_start]
-    )
-    return {sid: checksum(seg) for sid, seg in r.read_all()}
 
 
 class RepairReport(dict):
@@ -50,21 +39,32 @@ class RepairReport(dict):
 
 
 def repair_shard_block(
-    dbs: List[object], namespace: str, shard: int, block_start: int
+    dbs: List[object], namespace: str, shard: int, block_start: int,
 ) -> RepairReport:
-    """Compare one (shard, block) across replicas; merge + rewrite where
-    they diverge (repair.go:115-246 + the load at :348).
+    """Compare one (shard, block) across replica handles; merge + rewrite
+    where they diverge (repair.go:115-246 + the load at :348).
 
     Divergent replicas get a new fileset volume holding the union of all
     replicas' points (last-writer-wins per timestamp is unnecessary: the
     merge is per-timestamp first-seen, matching the session's read
     de-dup).  Returns counts; a second call reports convergence.
+    Unreachable replicas are skipped (counted as blocks_missing) like
+    the reference's per-host metadata fetch failures; a REACHABLE
+    replica that merely lacks the fileset gets the merged block written
+    so repair alone converges a blockless replica (the old behavior —
+    peers bootstrap is only the startup fast path).
     """
-    metas = [block_metadata(db, namespace, shard, block_start) for db in dbs]
-    present = [m for m in metas if m is not None]
+    metas = []   # dict | None (reachable, no fileset) | DOWN
+    DOWN = object()
+    for db in dbs:
+        try:
+            metas.append(db.block_metadata(namespace, shard, block_start))
+        except ConnectionError:
+            metas.append(DOWN)
+    present = [m for m in metas if m is not None and m is not DOWN]
     report = RepairReport(
         replicas=len(dbs),
-        blocks_missing=sum(1 for m in metas if m is None),
+        blocks_missing=sum(1 for m in metas if m is None or m is DOWN),
         series_checked=len(set().union(*present)) if present else 0,
         series_diff=0,
         repaired_replicas=0,
@@ -80,27 +80,37 @@ def repair_shard_block(
     divergent = [
         sid
         for sid in all_sids
-        if len({m.get(sid) for m in metas if m is not None}) > 1
+        if len({m.get(sid) for m in present}) > 1
     ]
     report["series_diff"] = len(divergent)
-    if not divergent and report["blocks_missing"] == 0:
+    # Stream + merge only when something repairable exists: a divergent
+    # series, or a REACHABLE replica missing the block.  DOWN replicas
+    # keep blocks_missing non-zero (convergence honestly unknown) but
+    # cannot be written, so they must not trigger the expensive merge.
+    reachable_missing = any(m is None for m in metas)
+    if not divergent and not reachable_missing:
         return report
 
     # Merge pass: union every replica's points for the whole block
     # (streaming just the divergent series would also work; whole-block
     # union keeps the rewrite one volume bump, like the cold-flush merge).
+    # A replica dying between metadata and streaming is demoted to DOWN.
     merged: Dict[bytes, Dict[int, float]] = {}
-    for db, meta in zip(dbs, metas):
-        if meta is None:
+    for i, (db, meta) in enumerate(zip(dbs, metas)):
+        if meta is None or meta is DOWN:
             continue
-        filesets = dict(list_filesets(db.opts.root, namespace, shard))
-        r = DataFileSetReader(
-            db.opts.root, namespace, shard, block_start, filesets[block_start]
-        )
-        for sid, seg in r.read_all():
+        try:
+            block = db.read_block(namespace, shard, block_start)
+        except ConnectionError:
+            metas[i] = DOWN
+            report["blocks_missing"] += 1
+            continue
+        for sid, seg in block:
             tgt = merged.setdefault(sid, {})
             for d in decode_series(seg):
                 tgt.setdefault(d.timestamp, d.value)
+    if not any(m is not None and m is not DOWN for m in metas):
+        return report
 
     series = [
         (sid, encode_series(sorted(pts.items()), start=block_start))
@@ -108,23 +118,34 @@ def repair_shard_block(
     ]
     merged_ck = {sid: checksum(seg) for sid, seg in series}
     for db, meta in zip(dbs, metas):
+        if meta is DOWN:
+            continue  # unreachable: next sweep, after it rejoins
         if meta == merged_ck:
             continue  # already converged replica: no rewrite
-        filesets = dict(list_filesets(db.opts.root, namespace, shard))
-        vol = filesets.get(block_start, -1) + 1
-        ns = db.namespaces[namespace]
-        DataFileSetWriter(
-            db.opts.root, namespace, shard, block_start,
-            ns.opts.block_size_nanos, volume=vol,
-        ).write_all(series)
-        ns.shards[shard].flushed_blocks.add(block_start)
-        report["repaired_replicas"] += 1
+        try:
+            db.write_block(namespace, shard, block_start, series)
+            report["repaired_replicas"] += 1
+        except ConnectionError:
+            continue
     return report
 
 
-def repair_namespace(dbs: List[object], namespace: str) -> RepairReport:
-    """Repair every flushed (shard, block) seen on any replica."""
-    num_shards = dbs[0].namespaces[namespace].opts.num_shards
+def repair_namespace(dbs: List[object], namespace: str,
+                     num_shards: int | None = None) -> RepairReport:
+    """Repair every flushed (shard, block) seen on any reachable replica.
+
+    ``num_shards`` must be given when every handle is remote; otherwise
+    it is read off the first local Database in ``dbs``."""
+    if num_shards is None:
+        num_shards = next(
+            (db.namespaces[namespace].opts.num_shards
+             for db in dbs if hasattr(db, "namespaces")), None,
+        )
+        if num_shards is None:
+            raise ValueError(
+                "repair_namespace: num_shards is required when every "
+                "replica handle is remote"
+            )
     total = RepairReport(
         replicas=len(dbs), blocks_missing=0, series_checked=0,
         series_diff=0, repaired_replicas=0,
@@ -132,9 +153,12 @@ def repair_namespace(dbs: List[object], namespace: str) -> RepairReport:
     for shard in range(num_shards):
         blocks = set()
         for db in dbs:
-            blocks.update(
-                bs for bs, _ in list_filesets(db.opts.root, namespace, shard)
-            )
+            try:
+                blocks.update(
+                    bs for bs, _ in db.list_block_filesets(namespace, shard)
+                )
+            except ConnectionError:
+                continue
         for bs in sorted(blocks):
             rep = repair_shard_block(dbs, namespace, shard, bs)
             for k in ("blocks_missing", "series_checked", "series_diff",
@@ -144,35 +168,43 @@ def repair_namespace(dbs: List[object], namespace: str) -> RepairReport:
 
 
 def peers_bootstrap(
-    db, peers: List[object], namespace: str
+    db, peers: List[object], namespace: str, num_shards: int | None = None,
 ) -> Dict[str, int]:
     """Fill every (shard, block) fileset missing locally from a replica
     peer (bootstrapper/peers/source.go: stream blocks from peers and
     persist, used on node add/replace and after data loss).
 
-    Copies the peer's encoded streams verbatim — bit-identical blocks,
-    so a follow-up repair pass reports convergence immediately.
+    ``db`` is the local ``Database``; ``peers`` are replica handles
+    (local or ``RemoteDatabase``).  Streams the peer's encoded segments
+    verbatim — bit-identical blocks, so a follow-up repair pass reports
+    convergence immediately.  Unreachable peers are skipped.
     """
     ns = db.namespaces[namespace]
+    shards = num_shards if num_shards is not None else ns.opts.num_shards
     copied_blocks = copied_series = 0
-    for shard in range(ns.opts.num_shards):
-        local = dict(list_filesets(db.opts.root, namespace, shard))
+    for shard in range(shards):
+        local = dict(db.list_block_filesets(namespace, shard))
         for peer in peers:
             if peer is None or peer is db:
                 continue
-            for bs, vol in list_filesets(peer.opts.root, namespace, shard):
+            try:
+                peer_blocks = peer.list_block_filesets(namespace, shard)
+            except ConnectionError:
+                continue
+            for bs, _vol in peer_blocks:
                 if bs in local:
                     continue
-                r = DataFileSetReader(
-                    peer.opts.root, namespace, shard, bs, vol
-                )
-                series = list(r.read_all())
-                DataFileSetWriter(
-                    db.opts.root, namespace, shard, bs,
-                    ns.opts.block_size_nanos, volume=0,
-                ).write_all(series)
-                ns.shards[shard].flushed_blocks.add(bs)
+                try:
+                    series = peer.read_block(namespace, shard, bs)
+                except ConnectionError:
+                    continue
+                db.write_block(namespace, shard, bs, series)
                 local[bs] = 0
                 copied_blocks += 1
                 copied_series += len(series)
     return {"blocks": copied_blocks, "series": copied_series}
+
+
+def block_metadata(db, namespace: str, shard: int, block_start: int):
+    """Back-compat shim over the handle method (old free-function API)."""
+    return db.block_metadata(namespace, shard, block_start)

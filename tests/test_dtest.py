@@ -7,15 +7,19 @@ compile in a fresh process) — kept to the two essential scenarios.
 """
 
 import json
+import socket
 import urllib.request
 from pathlib import Path
 
+import numpy as np
 import pytest
 
 from m3_tpu.dtest.harness import NodeProcess
 
 BLOCK = 2 * 3600 * 10**9
 START_S = (1_700_000_000 * 10**9) // BLOCK * BLOCK // 10**9
+SEC = 10**9
+T0 = START_S * SEC
 
 
 def _node(tmp_path) -> NodeProcess:
@@ -39,6 +43,125 @@ def _samples(n, t0=START_S):
          "timestamp": t0 + i * 10, "value": float(i)}
         for i in range(n)
     ]
+
+
+def _free_ports(n: int) -> list:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _cluster_nodes(tmp_path, n=3):
+    """n node processes wired as an RF=n replica set: each serves the
+    socket RPC and peers-bootstraps from the others on startup."""
+    ports = _free_ports(n)
+    nodes = []
+    for k in range(n):
+        root = tmp_path / f"n{k}" / "data"
+        cfg = tmp_path / f"n{k}" / "node.yaml"
+        peers = [f"127.0.0.1:{p}" for i, p in enumerate(ports) if i != k]
+        cfg.parent.mkdir(parents=True, exist_ok=True)
+        cfg.write_text(f"""
+db:
+  root: {root}
+  rpc_listen_port: {ports[k]}
+  peers: [{", ".join(repr(p) for p in peers)}]
+  bootstrap_peers: true
+  namespaces:
+    default: {{num_shards: 2}}
+coordinator: {{listen_port: 0}}
+mediator: {{enabled: false}}
+""")
+        root.mkdir(parents=True, exist_ok=True)
+        nodes.append(NodeProcess(str(cfg), str(root)))
+    return nodes, ports
+
+
+@pytest.mark.slow
+class TestQuorumCluster:
+    def test_majority_write_kill_rejoin_via_wire_bootstrap(self, tmp_path):
+        """The reference's write_quorum_test family as a real 3-process
+        scenario: write at Majority, SIGKILL one replica, keep writing
+        at Majority, read back at Majority, then the killed node rejoins
+        and backfills over the socket RPC (wire peers bootstrap), after
+        which repair reports convergence."""
+        from m3_tpu.client.session import ConsistencyLevel, ReplicatedSession
+        from m3_tpu.cluster.placement import Instance, initial_placement
+        from m3_tpu.server.rpc import RemoteDatabase
+        from m3_tpu.storage.repair import repair_namespace
+
+        nodes, ports = _cluster_nodes(tmp_path)
+        remotes = {}
+        try:
+            for nd in nodes:
+                nd.start()
+            remotes = {
+                f"i{k}": RemoteDatabase(("127.0.0.1", ports[k]))
+                for k in range(3)
+            }
+            placement = initial_placement(
+                [Instance(f"i{k}") for k in range(3)], num_shards=2, rf=3
+            )
+            session = ReplicatedSession(
+                placement, dict(remotes),
+                write_level=ConsistencyLevel.MAJORITY,
+                read_level=ConsistencyLevel.MAJORITY,
+            )
+
+            ids = [b"qd-%d" % i for i in range(6)]
+            ts1 = np.full(len(ids), T0 + SEC, np.int64)
+            session.write_batch("default", ids, ts1,
+                                np.arange(len(ids), dtype=np.float64),
+                                now_nanos=T0 + SEC)
+
+            nodes[2].kill()  # SIGKILL: no flush, no graceful close
+            assert not nodes[2].alive()
+
+            # Majority writes still succeed with 2/3 replicas up.
+            ts2 = np.full(len(ids), T0 + 2 * SEC, np.int64)
+            session.write_batch("default", ids, ts2,
+                                np.arange(len(ids), dtype=np.float64) + 100,
+                                now_nanos=T0 + 2 * SEC)
+
+            # Majority reads return both rounds of writes.
+            for i, sid in enumerate(ids):
+                pts = session.fetch("default", sid, T0, T0 + BLOCK)
+                assert pts == [(T0 + SEC, float(i)),
+                               (T0 + 2 * SEC, float(i) + 100)]
+
+            # Flush the live replicas so their blocks exist as filesets.
+            for k in (0, 1):
+                remotes[f"i{k}"].tick(T0 + 2 * BLOCK)
+
+            # The killed node rejoins: local WAL replay + wire peers
+            # bootstrap from the live replicas pulls the flushed blocks.
+            nodes[2].start()
+            r2 = remotes["i2"]
+            for i, sid in enumerate(ids):
+                pts = r2.read("default", sid, T0, T0 + BLOCK)
+                assert pts == [(T0 + SEC, float(i)),
+                               (T0 + 2 * SEC, float(i) + 100)], (sid, pts)
+
+            # Anti-entropy over the wire handles reports convergence
+            # once the rejoined node also flushes its merged state.
+            r2.tick(T0 + 2 * BLOCK)
+            rep = repair_namespace(list(remotes.values()), "default",
+                                   num_shards=2)
+            if not rep.converged:
+                rep = repair_namespace(list(remotes.values()), "default",
+                                       num_shards=2)
+            assert rep.converged, rep
+        finally:
+            for r in remotes.values():
+                r.close()
+            for nd in nodes:
+                nd.kill()
 
 
 @pytest.mark.slow
