@@ -251,7 +251,8 @@ def _run_stage(S: int, T: int) -> float:
         the low mantissa bits (~1 ulp) — exactly the BENCH_r02 validation
         failure.  All codec math is integer (f64_emul); the host reinterprets
         the returned bits as float64 losslessly."""
-        ts, payload, meta, err, prec = decode_batch_device(words, nbits, max_points)
+        ts, payload, meta, err, prec, _ann = decode_batch_device(
+            words, nbits, max_points)
         isf = (meta & 8) != 0
         mult = (meta & 7).astype(jnp.int64)
         # TPU's emulated f64 divide is not correctly rounded; the exact

@@ -37,6 +37,7 @@ class Instance:
     isolation_group: str = ""
     weight: int = 1
     shards: dict = field(default_factory=dict)  # shard id -> ShardAssignment
+    shard_set_id: int = 0  # mirrored placements: same set id => same shards
 
     def owned(self) -> list[int]:
         return sorted(self.shards)
@@ -53,6 +54,7 @@ class Placement:
     num_shards: int
     replica_factor: int
     version: int = 0
+    is_mirrored: bool = False
 
     # -- queries -----------------------------------------------------------
 
@@ -81,10 +83,12 @@ class Placement:
             "num_shards": self.num_shards,
             "replica_factor": self.replica_factor,
             "version": self.version,
+            "is_mirrored": self.is_mirrored,
             "instances": {
                 iid: {
                     "isolation_group": inst.isolation_group,
                     "weight": inst.weight,
+                    "shard_set_id": inst.shard_set_id,
                     "shards": {
                         str(s): [a.state.value, a.source_id]
                         for s, a in inst.shards.items()
@@ -104,8 +108,10 @@ class Placement:
                 for s, v in idata["shards"].items()
             }
             insts[iid] = Instance(iid, idata["isolation_group"],
-                                  idata["weight"], shards)
-        return cls(insts, d["num_shards"], d["replica_factor"], d["version"])
+                                  idata["weight"], shards,
+                                  idata.get("shard_set_id", 0))
+        return cls(insts, d["num_shards"], d["replica_factor"], d["version"],
+                   d.get("is_mirrored", False))
 
 
 def _least_loaded(instances: list[Instance], shard: int,
@@ -144,9 +150,11 @@ def add_instance(p: Placement, new: Instance) -> Placement:
     """reference algo/sharded.go AddInstance: steal shards from the most
     loaded instances; stolen shards go Initializing on the new instance
     with the donor as source (donor keeps serving until cutover)."""
-    insts = {iid: Instance(i.id, i.isolation_group, i.weight, dict(i.shards))
+    insts = {iid: Instance(i.id, i.isolation_group, i.weight, dict(i.shards),
+                           i.shard_set_id)
              for iid, i in p.instances.items()}
-    newcomer = Instance(new.id, new.isolation_group, new.weight, {})
+    newcomer = Instance(new.id, new.isolation_group, new.weight, {},
+                        new.shard_set_id)
     insts[new.id] = newcomer
     target = p.num_shards * p.replica_factor // len(insts)
     while len(newcomer.shards) < target:
@@ -168,7 +176,8 @@ def add_instance(p: Placement, new: Instance) -> Placement:
 def remove_instance(p: Placement, instance_id: str) -> Placement:
     """reference algo/sharded.go RemoveInstance: the leaver's shards go
     Initializing on the least-loaded survivors."""
-    insts = {iid: Instance(i.id, i.isolation_group, i.weight, dict(i.shards))
+    insts = {iid: Instance(i.id, i.isolation_group, i.weight, dict(i.shards),
+                           i.shard_set_id)
              for iid, i in p.instances.items()}
     leaver = insts[instance_id]
     for s in list(leaver.shards):
@@ -186,10 +195,12 @@ def remove_instance(p: Placement, instance_id: str) -> Placement:
 def replace_instance(p: Placement, leaving_id: str, new: Instance) -> Placement:
     """reference algo/sharded.go ReplaceInstances: the replacement takes
     exactly the leaver's shards."""
-    insts = {iid: Instance(i.id, i.isolation_group, i.weight, dict(i.shards))
+    insts = {iid: Instance(i.id, i.isolation_group, i.weight, dict(i.shards),
+                           i.shard_set_id)
              for iid, i in p.instances.items()}
     leaver = insts[leaving_id]
-    newcomer = Instance(new.id, new.isolation_group, new.weight, {})
+    newcomer = Instance(new.id, new.isolation_group, new.weight, {},
+                        new.shard_set_id)
     insts[new.id] = newcomer
     for s, a in list(leaver.shards.items()):
         leaver.shards[s] = ShardAssignment(s, ShardState.LEAVING)
@@ -201,7 +212,8 @@ def mark_available(p: Placement, instance_id: str, shard: int) -> Placement:
     """Cutover: Initializing→Available on the target, and the matching
     Leaving shard disappears from its source (reference
     MarkShardsAvailable)."""
-    insts = {iid: Instance(i.id, i.isolation_group, i.weight, dict(i.shards))
+    insts = {iid: Instance(i.id, i.isolation_group, i.weight, dict(i.shards),
+                           i.shard_set_id)
              for iid, i in p.instances.items()}
     inst = insts[instance_id]
     a = inst.shards.get(shard)

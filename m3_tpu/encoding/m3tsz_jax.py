@@ -468,7 +468,7 @@ def _encode_step(carry, xs, unit: int, default_unit_is_32bit: bool):
 
 @functools.partial(jax.jit, static_argnames=("unit", "out_words"))
 def encode_batch_device(timestamps, value_bits, start, valid, unit: int = 1,
-                        out_words: int = 0):
+                        out_words: int = 0, prefix_bits=None):
     """Encode (S, T) series on device.
 
     Args:
@@ -479,6 +479,10 @@ def encode_batch_device(timestamps, value_bits, start, valid, unit: int = 1,
       unit: static time unit (wire byte value).
       out_words: static output width in 64-bit words per series
         (0 -> T * 16 bits / 64 + 4).
+      prefix_bits: optional (S,) int32 — bits reserved after the start
+        word for a host-composed prefix (the first datapoint's
+        annotation marker+varint+bytes, spliced in by ``encode_batch``);
+        all emitted fields shift right by this amount.
 
     Returns dict with packed words (S, W) uint64 (starting with the 64-bit
     start time), total_bits (S,), fallback (S,) bool.
@@ -520,8 +524,11 @@ def encode_batch_device(timestamps, value_bits, start, valid, unit: int = 1,
     w0, w1, w2, w3 = (w.T for w in (w0, w1, w2, w3))
     lens = lens.T.astype(jnp.int64)
 
-    # bit offsets: 64 bits for the start word, then cumulative lengths
-    offsets = jnp.cumsum(lens, axis=1) - lens + 64
+    # bit offsets: 64 bits for the start word (+ any host prefix), then
+    # cumulative lengths
+    base = 64 if prefix_bits is None else (
+        64 + prefix_bits.astype(jnp.int64)[:, None])
+    offsets = jnp.cumsum(lens, axis=1) - lens + base
     total_bits = offsets[:, -1] + lens[:, -1]
 
     out = jnp.zeros((S, out_words), U64)
@@ -582,12 +589,37 @@ def pack_streams(streams: list[bytes], pad_words: int = 0):
     return words, nbits
 
 
+def _annotation_prefix(ann: bytes):
+    """The first-datapoint annotation wire prefix (marker + varint +
+    bytes) as (uint64 big-endian words, bit length) — composed with the
+    scalar OStream so the bit layout is definitionally identical to the
+    scalar encoder's (_write_annotation)."""
+    from m3_tpu.encoding.bitstream import OStream
+    from m3_tpu.encoding.m3tsz import _put_varint
+    from m3_tpu.encoding.scheme import ANNOTATION_MARKER, write_special_marker
+
+    os_ = OStream()
+    write_special_marker(os_, ANNOTATION_MARKER)
+    os_.write_bytes(_put_varint(len(ann) - 1))
+    os_.write_bytes(ann)
+    raw, _ = os_.raw_bytes()
+    padded = raw + b"\x00" * (-len(raw) % 8)
+    return np.frombuffer(padded, dtype=">u8").astype(np.uint64), os_.bit_length
+
+
 def encode_batch(timestamps, values, start, counts=None, unit: Unit = Unit.SECOND,
-                 out_words: int = 0):
+                 out_words: int = 0, annotations=None):
     """Host-facing batched encode.
 
     Returns (streams: list[bytes], fallback: np.ndarray[bool]); fallback
     series contain b"" and must be encoded with the scalar codec.
+
+    ``annotations`` (optional list[bytes|None], len S) attaches an
+    annotation to each series' FIRST datapoint — the proto-schema /
+    tag-payload shape (`timestamp_encoder.go:99-116` writes it before
+    the first time-unit marker).  The device scan shifts its output by
+    the prefix width and the host splices the marker+varint+bytes in;
+    mid-stream annotation CHANGES stay on the scalar path.
     """
     timestamps = np.asarray(timestamps, dtype=np.int64)
     values = np.asarray(values, dtype=np.float64)
@@ -596,11 +628,29 @@ def encode_batch(timestamps, values, start, counts=None, unit: Unit = Unit.SECON
         counts = np.full(S, T, dtype=np.int64)
     valid = np.arange(T)[None, :] < np.asarray(counts)[:, None]
     vb = values.view(np.uint64)
+
+    prefix_bits = None
+    prefix_words: dict[int, np.ndarray] = {}
+    if annotations is not None:
+        pb = np.zeros(S, np.int32)
+        for i, ann in enumerate(annotations):
+            if ann:
+                prefix_words[i], pb[i] = _annotation_prefix(ann)
+        prefix_bits = jnp.asarray(pb) if prefix_words else None
+
     res = encode_batch_device(
         jnp.asarray(timestamps), jnp.asarray(vb), jnp.asarray(start, dtype=jnp.int64),
-        jnp.asarray(valid), unit=int(unit), out_words=out_words)
+        jnp.asarray(valid), unit=int(unit), out_words=out_words,
+        prefix_bits=prefix_bits)
     fallback = np.asarray(res["fallback"])
-    streams = finalize_streams(np.asarray(res["words"]), np.asarray(res["total_bits"]))
+    words_out = np.asarray(res["words"])
+    if prefix_words:
+        # Splice each prefix in after the start word (bit 64 is a word
+        # boundary, so this is a plain OR into untouched zero bits).
+        words_out = words_out.copy()
+        for i, pw in prefix_words.items():
+            words_out[i, 1:1 + len(pw)] |= pw
+    streams = finalize_streams(words_out, np.asarray(res["total_bits"]))
     counts_arr = np.asarray(counts)
     # An empty series encodes to b"" (the reference encoder's Stream() returns
     # no segment when nothing was written), not a bare start-word stream.
@@ -696,9 +746,9 @@ def _decode_step(carry, _, words3, nbits, default_unit: int):
     carry); ``nbits`` the per-series stream bit lengths.  All bit reads
     come from the carried window via ``_buf9``/``_rd``.
     """
-    (cursor, done, err, prec, first, prev_time, prev_delta,
-     unit_idx, prev_fbits, prev_xor, int_val, sig, mult, is_float,
-     window, blk) = carry
+    (cursor, done, err, prec, need_start, first_val, saw_ann, prev_time,
+     prev_delta, unit_idx, prev_fbits, prev_xor, int_val, sig, mult,
+     is_float, window, blk) = carry
     active = (~done) & (~err)
 
     unit_tbl = jnp.asarray(_UNIT_NANOS, I64)
@@ -713,14 +763,15 @@ def _decode_step(carry, _, words3, nbits, default_unit: int):
     words = None  # all reads go through the window
 
     # ---- first: 64-bit start timestamp ----
-    rd_first = jnp.where(active & first, _c(64, I32), _c(0, I32))
+    rd_first = jnp.where(active & need_start, _c(64, I32), _c(0, I32))
     nt = _sign_extend(_peek(words, cursor, rd_first), _c(64, I32))
     cur = cursor + rd_first
     d_ns = jnp.asarray(int(Unit(default_unit).nanos()), I64)
     aligned = (lax.rem(nt, d_ns)) == _c(0, I64)
     unit0 = jnp.where(aligned, _c(default_unit, I32), _c(0, I32))
-    unit_eff = jnp.where(first, unit0, unit_idx)
-    base_time = jnp.where(first, nt, prev_time)
+    unit_eff = jnp.where(need_start, unit0, unit_idx)
+    base_time = jnp.where(need_start, nt, prev_time)
+    first = first_val  # value-mode branch key (first value still pending)
 
     # ---- marker peek (11 bits) ----
     can_peek = (cur + _c(11, I32)) <= nbits
@@ -730,9 +781,30 @@ def _decode_step(carry, _, words3, nbits, default_unit: int):
     eos = active & is_marker & (mval == _c(0, I32))
     ann = active & is_marker & (mval == _c(1, I32))
     is_tu = active & is_marker & (mval == _c(2, I32))
-    err = err | ann  # annotations take the host path
     done = done | eos
     proceed = active & ~eos & ~ann
+
+    # ---- annotation skip (timestamp_encoder.go:99-116) ----
+    # marker + zigzag-LEB128 varint of (len-1) + len bytes.  The step
+    # consumes the marker and varint from the window (<= 43 bits) and
+    # jumps the cursor over the payload; the refill below reloads the
+    # window for any lane whose cursor left it.  The annotation slot
+    # emits no datapoint — callers size max_points accordingly.
+    acur = cur + _c(11, I32)
+    ux = jnp.zeros_like(peek11)
+    more = ann
+    abits = jnp.zeros_like(cur)
+    for k in range(4):
+        rd = jnp.where(more, _c(8, I32), _c(0, I32))
+        byte = _peek(words, acur + abits, rd)
+        ux = ux | _shl(byte & _c(0x7F), _c(7 * k))
+        abits = abits + rd
+        more = more & ((byte & _c(0x80)) != _c(0))
+    err = err | more  # varint > 4 bytes: host path
+    ann_len = (ux >> _c(1)).astype(I32) + _c(1, I32)  # zigzag, stored len-1
+    ann_end = acur + abits + ann_len * _c(8, I32)
+    err = err | (ann & (ann_end > nbits))
+    saw_ann = saw_ann | (ann & ~err)
 
     cur = cur + jnp.where(is_tu, _c(11, I32), _c(0, I32))
     rd_tu = jnp.where(is_tu, _c(8, I32), _c(0, I32))
@@ -909,37 +981,63 @@ def _decode_step(carry, _, words3, nbits, default_unit: int):
                 jnp.where(out_isf, _c(1, I32), _c(0, I32)) << 3 |
                 jnp.clip(n_mult, 0, 7)).astype(jnp.uint8)
 
+    # ---- cursor update ----
+    # Normal datapoint steps advance to `cur`; annotation steps jump the
+    # cursor past the payload (consuming this scan slot without a
+    # datapoint); the start word still counts as consumed for them.
+    ann_ok = ann & ~err
+    new_cursor = jnp.where(ann_ok, ann_end,
+                           jnp.where(proceed, cur, cursor))
+
     # ---- window refill ----
     # Lanes whose cursor crossed into the window's second 16-word block
-    # shift down and pull the next block.  The gather is guarded by a
-    # scalar predicate: on typical corpora only ~1 step in 15-100 pays it.
-    new_cursor = jnp.where(proceed, cur, cursor)
-    need = proceed & ((new_cursor - blk * _c(_BLK_WORDS * 64, I32))
-                      >= _c(_BLK_WORDS * 64, I32))
+    # shift down and pull the next block; annotation jumps may leave the
+    # window entirely and reload both halves.  All gathers sit behind a
+    # scalar predicate: on typical corpora only ~1 step in 15-100 pays.
+    new_rel = new_cursor - blk * _c(_BLK_WORDS * 64, I32)
+    advanced = proceed | ann_ok
+    need_shift = advanced & (new_rel >= _c(_BLK_WORDS * 64, I32)) & (
+        new_rel < _c(2 * _BLK_WORDS * 64, I32))
+    need_jump = advanced & (new_rel >= _c(2 * _BLK_WORDS * 64, I32))
 
     def _refill(ops):
         win, bk = ops
         NB = words3.shape[1] - 1
-        # The window spans blocks [bk, bk+1]; after shifting down by one
-        # block the new upper half is block bk+2 (zeros past the stream).
+        # Shift path: window [bk, bk+1] -> [bk+1, bk+2].
         bnext = jnp.clip(bk + _c(2, I32), 0, NB)
         nxt = jnp.take_along_axis(
             words3, bnext[:, None, None].astype(jnp.int32), axis=1)[:, 0]
         shifted = jnp.concatenate([win[:, _BLK_WORDS:], nxt], axis=1)
-        win = jnp.where(need[:, None], shifted, win)
-        bk = jnp.where(need, bk + _c(1, I32), bk)
+        # Jump path (annotation skip): reload [tb, tb+1] from scratch.
+        tb = new_cursor // _c(_BLK_WORDS * 64, I32)
+        lo = jnp.take_along_axis(
+            words3, jnp.clip(tb, 0, NB)[:, None, None].astype(jnp.int32),
+            axis=1)[:, 0]
+        hi = jnp.take_along_axis(
+            words3, jnp.clip(tb + 1, 0, NB)[:, None, None].astype(jnp.int32),
+            axis=1)[:, 0]
+        reload = jnp.concatenate([lo, hi], axis=1)
+        win = jnp.where(need_jump[:, None], reload,
+                        jnp.where(need_shift[:, None], shifted, win))
+        bk = jnp.where(need_jump, tb,
+                       jnp.where(need_shift, bk + _c(1, I32), bk))
         return win, bk
 
-    window, blk = lax.cond(jnp.any(need), _refill, lambda ops: ops,
-                           (window, blk))
+    window, blk = lax.cond(jnp.any(need_shift | need_jump), _refill,
+                           lambda ops: ops, (window, blk))
 
+    consumed = proceed | ann_ok
     new_carry = (
         new_cursor,
         done, err, prec,
-        first & ~proceed,
-        jnp.where(proceed, new_time, prev_time),
+        need_start & ~consumed,
+        first_val & ~proceed,
+        saw_ann,
+        jnp.where(proceed, new_time,
+                  jnp.where(ann_ok & need_start, nt, prev_time)),
         jnp.where(proceed, pd, prev_delta),
-        jnp.where(proceed, new_unit, unit_idx),
+        jnp.where(proceed, new_unit,
+                  jnp.where(ann_ok & need_start, unit0, unit_idx)),
         jnp.where(proceed, n_prev_fbits, prev_fbits),
         jnp.where(proceed, n_prev_xor, prev_xor),
         jnp.where(proceed, n_int_val, int_val),
@@ -956,8 +1054,12 @@ def decode_batch_device(words, nbits, max_points: int, default_unit: int = 1):
     """Decode (S, W+1) padded word arrays in parallel.
 
     Returns (ts (S, max_points) int64, payload (S, max_points) uint64,
-    meta (S, max_points) uint8, err (S,), prec (S,)).
+    meta (S, max_points) uint8, err (S,), prec (S,), ann (S,)).
     meta: bit4 = valid, bit3 = is_float, bits0-2 = multiplier.
+    ``ann`` marks series whose stream carried annotation markers: their
+    datapoints are decoded (each annotation consumes one scan slot) but
+    the annotation bytes are skipped — callers needing them re-read via
+    the scalar iterator.
     """
     S, Wp = words.shape
     # Pad the stream out to whole refill blocks plus one zero block so the
@@ -970,6 +1072,7 @@ def decode_batch_device(words, nbits, max_points: int, default_unit: int = 1):
     carry0 = (
         jnp.zeros(S, I32), jnp.zeros(S, jnp.bool_), jnp.zeros(S, jnp.bool_),
         jnp.zeros(S, jnp.bool_), jnp.ones(S, jnp.bool_),
+        jnp.ones(S, jnp.bool_), jnp.zeros(S, jnp.bool_),
         jnp.zeros(S, I64), jnp.zeros(S, I64), jnp.zeros(S, I32),
         jnp.zeros(S, U64), jnp.zeros(S, U64), jnp.zeros(S, I64),
         jnp.zeros(S, I32), jnp.zeros(S, I32), jnp.zeros(S, jnp.bool_),
@@ -988,19 +1091,28 @@ def decode_batch_device(words, nbits, max_points: int, default_unit: int = 1):
     done = done | eos_tail
     err = carry[2] | (~done)  # not done after max_points -> error
     prec = carry[3]
-    return ts.T, payload.T, meta.T, err, prec
+    ann = carry[6]  # series whose stream carried annotation markers
+    return ts.T, payload.T, meta.T, err, prec, ann
 
 
 def decode_batch(streams: list[bytes], max_points: int,
-                 default_unit: Unit = Unit.SECOND):
+                 default_unit: Unit = Unit.SECOND,
+                 annotations_fallback: bool = True):
     """Host-facing batched decode.
 
     Returns (timestamps (S, P) int64, values (S, P) float64,
-    counts (S,), fallback (S,) bool).  Fallback series (annotations,
-    >2^53 magnitudes, errors) must use the scalar ReaderIterator.
+    counts (S,), fallback (S,) bool).  Fallback series (>2^53
+    magnitudes, errors) must use the scalar ReaderIterator.
+
+    Annotated streams decode on device (timestamps/values come back
+    correct; each annotation consumes one max_points slot) but their
+    annotation BYTES are skipped, so by default they still flag
+    fallback for callers that need the bytes (tag payloads, proto
+    schemas); pass annotations_fallback=False when only the numeric
+    series matters.
     """
     words, nbits = pack_streams(streams)
-    ts, payload, meta, err, prec = decode_batch_device(
+    ts, payload, meta, err, prec, ann = decode_batch_device(
         jnp.asarray(words), jnp.asarray(nbits), max_points=max_points,
         default_unit=int(default_unit))
     ts = np.asarray(ts)
@@ -1013,5 +1125,20 @@ def decode_batch(streams: list[bytes], max_points: int,
     ivals = payload.astype(np.int64).astype(np.float64) / np.power(10.0, mult)
     values = np.where(isf, fvals, ivals)
     counts = valid.sum(axis=1)
+    ann_np = np.asarray(ann)
+    if ann_np.any():
+        # Annotation slots leave holes in annotated rows; compact each
+        # row's valid datapoints to a prefix (the contract counts rely on).
+        ts = ts.copy()
+        values = values.copy()
+        for i in np.nonzero(ann_np)[0]:
+            m = valid[i]
+            k = int(m.sum())
+            ts[i, :k] = ts[i, m]
+            values[i, :k] = values[i, m]
+            ts[i, k:] = 0
+            values[i, k:] = 0.0
     fallback = np.asarray(err) | np.asarray(prec)
+    if annotations_fallback:
+        fallback = fallback | ann_np
     return ts, values, counts, fallback

@@ -1,11 +1,17 @@
-"""Namespace index: time-blocked segments over the segment library.
+"""Namespace index: time-blocked, multi-segment, compacting.
 
-Equivalent of `src/dbnode/storage/index` (`nsIndex`, `index.go:97`): an
-active mutable segment per index block start receiving tagged writes
-(`WriteBatch` `index.go:624`), sealed to an immutable segment at flush
-(the reference compacts mutable → FST via the segment builder), and
-`Query` (`index.go:1483`) executing a boolean query across every block
-segment overlapping the query range, unioning series IDs.
+Equivalent of `src/dbnode/storage/index` (`nsIndex`, `index.go:97`) plus
+the segment-builder compaction tier
+(`src/m3ninx/index/segment/builder/multi_segments_builder.go`): an
+active mutable segment per index block start receives tagged writes
+(`WriteBatch` `index.go:624`); sealing appends an immutable segment to
+the block's segment LIST (cheap — no proportional-to-history merge on
+the write path); a background compaction pass merges a block's segments
+tiered-smallest-first down to a bounded count, dropping tombstoned
+series, so sustained series churn neither grows the per-query segment
+fan-out nor resurrects deleted series.  `Query` (`index.go:1483`)
+executes a boolean query across every live segment of every overlapping
+block, de-duplicating by series ID and filtering tombstones.
 """
 
 from __future__ import annotations
@@ -16,7 +22,28 @@ import numpy as np
 
 from m3_tpu.index.doc import Document
 from m3_tpu.index.search import Query, execute_segment
-from m3_tpu.index.segment import MutableSegment, SealedSegment
+from m3_tpu.index.segment import MutableSegment, SealedSegment, merge_segments
+
+# Compaction targets: a block holding more than MAX_SEGMENTS sealed
+# segments gets merged down to at most TARGET_SEGMENTS (batching several
+# seals per merge amortizes the rebuild, like the reference's
+# size-tiered compaction plans).
+MAX_SEGMENTS = 4
+TARGET_SEGMENTS = 2
+
+
+def _merge_excluding(segments: list[SealedSegment],
+                     tombstones: set[bytes]) -> SealedSegment:
+    """merge_segments with tombstone filtering: deleted series do not
+    survive compaction (the reference drops them when the builder
+    rewrites postings)."""
+    m = MutableSegment()
+    for seg in segments:
+        for did in range(len(seg)):
+            d = seg.doc(did)
+            if d.id not in tombstones:
+                m.insert(d)
+    return m.seal()
 
 
 class NamespaceIndex:
@@ -26,7 +53,8 @@ class NamespaceIndex:
         self.root = root
         self.namespace = namespace
         self.mutable: dict[int, MutableSegment] = {}
-        self.sealed: dict[int, SealedSegment] = {}
+        self.sealed: dict[int, list[SealedSegment]] = {}
+        self.tombstones: dict[int, set[bytes]] = {}
         # block_start -> (generation, sealed view) memo so read-heavy
         # workloads don't rebuild term tables per query.
         self._mutable_view: dict[int, tuple[int, SealedSegment]] = {}
@@ -41,48 +69,125 @@ class NamespaceIndex:
     def write_batch(self, docs: list[Document], ts_nanos: np.ndarray) -> None:
         """Index each tagged series in the block its timestamp falls in
         (reference forward-index semantics simplified: one insert per
-        (doc, block))."""
+        (doc, block)).  A re-created series clears any tombstone."""
         for doc, t in zip(docs, ts_nanos):
             bs = self._block_for(int(t))
             seg = self.mutable.get(bs)
             if seg is None:
                 seg = self.mutable[bs] = MutableSegment()
             seg.insert(doc)
+            ts = self.tombstones.get(bs)
+            if ts:
+                ts.discard(doc.id)
+
+    def delete_series(self, block_start: int, ids) -> None:
+        """Tombstone series within a block (series expiry/churn): they
+        stop matching queries immediately and are physically dropped by
+        the next compaction (the reference deletes at segment rewrite)."""
+        self.tombstones.setdefault(block_start, set()).update(ids)
 
     # -- seal/persist ------------------------------------------------------
 
-    def _seg_path(self, block_start: int) -> Path:
+    def _seg_path(self, block_start: int, n: int) -> Path:
         return (
-            Path(self.root) / "index" / self.namespace / f"segment-{block_start}.db"
+            Path(self.root) / "index" / self.namespace
+            / f"segment-{block_start}-{n}.db"
         )
 
+    def _persist_block(self, block_start: int) -> None:
+        """Rewrite the block's segment files to match memory: new files
+        first, then drop strays (crash between the two leaves extra
+        segments, which are self-contained and merely re-compacted)."""
+        if self.root is None:
+            return
+        d = Path(self.root) / "index" / self.namespace
+        d.mkdir(parents=True, exist_ok=True)
+        keep = set()
+        for n, seg in enumerate(self.sealed.get(block_start, [])):
+            p = self._seg_path(block_start, n)
+            p.write_bytes(seg.to_bytes())
+            keep.add(p.name)
+        for f in d.glob(f"segment-{block_start}-*.db"):
+            if f.name not in keep:
+                f.unlink()
+        legacy = d / f"segment-{block_start}.db"
+        legacy.unlink(missing_ok=True)
+
     def seal_block(self, block_start: int) -> SealedSegment | None:
-        """Mutable -> sealed (+ persisted when rooted); reference index
-        flush writes the FST fileset (`storage/index.go` flush +
-        `m3ninx/index/segment/fst/writer.go`)."""
+        """Mutable -> sealed: APPENDS to the block's segment list (O(new
+        docs), the write path never pays a history-sized merge); the
+        reference's equivalent is rotating the active segment into the
+        flushed set, with compaction left to the background pass."""
         m = self.mutable.pop(block_start, None)
         self._mutable_view.pop(block_start, None)
         if m is None or len(m) == 0:
             return None
         sealed = m.seal()
-        if block_start in self.sealed:
-            from m3_tpu.index.segment import merge_segments
-
-            sealed = merge_segments([self.sealed[block_start], sealed])
-        self.sealed[block_start] = sealed
-        if self.root is not None:
-            p = self._seg_path(block_start)
-            p.parent.mkdir(parents=True, exist_ok=True)
-            p.write_bytes(sealed.to_bytes())
+        self.sealed.setdefault(block_start, []).append(sealed)
+        self._persist_block(block_start)
         return sealed
+
+    def compact_block(self, block_start: int,
+                      max_segments: int = MAX_SEGMENTS,
+                      target_segments: int = TARGET_SEGMENTS) -> int:
+        """Tiered compaction: while over ``max_segments``, merge the
+        smallest segments together until at most ``target_segments``
+        remain, dropping tombstones.  Returns merges performed."""
+        segs = self.sealed.get(block_start)
+        tombs = self.tombstones.get(block_start, set())
+        if not segs:
+            return 0
+        if len(segs) <= max_segments and not tombs:
+            return 0
+        merges = 0
+        if len(segs) > max_segments:
+            segs.sort(key=len)
+            take = len(segs) - target_segments + 1
+            merged = _merge_excluding(segs[:take], tombs)
+            segs[:take] = [merged] if len(merged) else []
+            merges += 1
+        if tombs:
+            # Drop tombstones from any remaining segment that holds one.
+            out = []
+            for seg in segs:
+                if any(seg.doc(d).id in tombs for d in range(len(seg))):
+                    rewritten = _merge_excluding([seg], tombs)
+                    if len(rewritten):
+                        out.append(rewritten)
+                    merges += 1
+                else:
+                    out.append(seg)
+            segs[:] = out
+        if not segs:
+            self.sealed.pop(block_start, None)
+        # Tombstones may only be retired once no mutable segment can
+        # still hold a deleted doc: the mutable side is filtered at
+        # query time and physically dropped when it seals and the NEXT
+        # compaction rewrites it — popping early would resurrect those.
+        if block_start not in self.mutable:
+            self.tombstones.pop(block_start, None)
+        self._persist_block(block_start)
+        return merges
+
+    def compact(self) -> int:
+        """Background pass over every block (mediator tick hook)."""
+        return sum(
+            self.compact_block(bs) for bs in sorted(self.sealed)
+        )
+
+    @property
+    def segment_counts(self) -> dict[int, int]:
+        return {bs: len(segs) for bs, segs in self.sealed.items()}
 
     def _load_sealed(self) -> None:
         d = Path(self.root) / "index" / self.namespace
         if not d.exists():
             return
-        for f in d.glob("segment-*.db"):
-            bs = int(f.stem.split("-")[1])
-            self.sealed[bs] = SealedSegment.from_bytes(f.read_bytes())
+        for f in sorted(d.glob("segment-*.db")):
+            parts = f.stem.split("-")
+            bs = int(parts[1])
+            seg = SealedSegment.from_bytes(f.read_bytes())
+            self.sealed.setdefault(bs, []).append(seg)
 
     def snapshot_mutable(self, snap_root: str) -> int:
         """Persist a sealed VIEW of every mutable segment under
@@ -103,13 +208,11 @@ class NamespaceIndex:
         return written
 
     def restore_snapshot(self, snap_root: str) -> int:
-        """Install snapshot index segments as sealed segments (merging
-        with any already-sealed block).  Restored segments are re-persisted
+        """Install snapshot index segments as sealed segments (appended
+        to any already-sealed block).  Restored segments are re-persisted
         under the MAIN root immediately: the covering snapshot (and the
         WAL that carried the tags) may be cleaned up before this block
         ever seals again, so the main index dir must be durable now."""
-        from m3_tpu.index.segment import merge_segments
-
         d = Path(snap_root) / "index" / self.namespace
         if not d.exists():
             return 0
@@ -117,13 +220,8 @@ class NamespaceIndex:
         for f in d.glob("segment-*.db"):
             bs = int(f.stem.split("-")[1])
             seg = SealedSegment.from_bytes(f.read_bytes())
-            if bs in self.sealed:
-                seg = merge_segments([self.sealed[bs], seg])
-            self.sealed[bs] = seg
-            if self.root is not None:
-                p = self._seg_path(bs)
-                p.parent.mkdir(parents=True, exist_ok=True)
-                p.write_bytes(seg.to_bytes())
+            self.sealed.setdefault(bs, []).append(seg)
+            self._persist_block(bs)
             n += 1
         return n
 
@@ -131,21 +229,20 @@ class NamespaceIndex:
 
     def query(self, q: Query, start_nanos: int, end_nanos: int,
               inc_docs=None) -> list[Document]:
-        """Matching documents across all block segments overlapping
-        [start, end); deduped by series ID.
+        """Matching documents across all live segments of blocks
+        overlapping [start, end); deduped by series ID, tombstones
+        filtered.
 
         `inc_docs(n)` is called as matches accumulate (per segment) so a
         per-query docs limit can abort the match mid-way instead of
         after the full result materializes (reference storage/limits
         increments during matching)."""
         out: dict[bytes, Document] = {}
-        lo = self._block_for(start_nanos)
         for bs in sorted(set(self.mutable) | set(self.sealed)):
             if bs + self.block_size <= start_nanos or bs >= end_nanos:
                 continue
-            segs = []
-            if bs in self.sealed:
-                segs.append(self.sealed[bs])
+            tombs = self.tombstones.get(bs, ())
+            segs = list(self.sealed.get(bs, ()))
             if bs in self.mutable:
                 m = self.mutable[bs]
                 memo = self._mutable_view.get(bs)
@@ -157,7 +254,8 @@ class NamespaceIndex:
                 before = len(out)
                 for did in execute_segment(seg, q):
                     doc = seg.doc(int(did))
-                    out.setdefault(doc.id, doc)
+                    if doc.id not in tombs:
+                        out.setdefault(doc.id, doc)
                 if inc_docs is not None:
                     inc_docs(len(out) - before)
         return list(out.values())

@@ -58,7 +58,7 @@ def decode_to_step_series(words, nbits, max_points: int):
     codec upholds — only the decoded payload integers are, and those
     stay exact.
     """
-    ts, payload, meta, err, prec = _raw(codec.decode_batch_device)(
+    ts, payload, meta, err, prec, _ann = _raw(codec.decode_batch_device)(
         words, nbits, max_points
     )
     valid = (meta & 16) != 0

@@ -569,6 +569,23 @@ def _summarize(ctx, series, interval, func="sum"):
 # selection / filtering ------------------------------------------------------
 
 
+# Canonical aggregation-name aliases for the per-series stat used by
+# selection/sorting/filter builtins; one map so every function accepts
+# the same spellings (and unknown names fail loudly everywhere).
+_STAT_ALIASES = {
+    "average": "avg", "avg": "avg", "max": "max", "min": "min",
+    "current": "current", "last": "current", "sum": "sum",
+    "total": "sum", "median": "median", "stddev": "stddev",
+}
+
+
+def _stat_name(func) -> str:
+    what = _STAT_ALIASES.get(str(func))
+    if what is None:
+        raise ParseError(f"unknown aggregation func {func!r}")
+    return what
+
+
 def _series_stat(s: GraphiteSeries, what: str) -> float | None:
     """None when the series has no datapoints — empty series never win
     a lowest/below selection (and always lose highest/above)."""
@@ -583,6 +600,12 @@ def _series_stat(s: GraphiteSeries, what: str) -> float | None:
         return float(v[-1])
     if what == "min":
         return float(v.min())
+    if what == "sum":
+        return float(v.sum())
+    if what == "median":
+        return float(np.median(v))
+    if what == "stddev":
+        return float(v.std())
     raise ValueError(what)
 
 
@@ -676,6 +699,774 @@ def _group_by_node(ctx, series, node, func="sum"):
         combined = agg(ctx, groups[key])
         if combined:
             out.append(combined[0].with_values(combined[0].values, key))
+    return out
+
+
+# Breadth tier: the most-used remainder of the reference's ~107 builtins
+# (`src/query/graphite/native/builtin_functions.go`), implemented over
+# the same GraphiteSeries model.  Purely presentational builtins
+# (dashed, legendValue, cactiStyle, secondYAxis) and the holt-winters /
+# random-walk families are intentionally out of scope.
+# ---------------------------------------------------------------------------
+
+
+def _percentile(values: np.ndarray, n: float, interpolate: bool = False):
+    """Graphite's _getPercentile: rank = (n/100)*(count+1) over sorted
+    non-null values, optionally linearly interpolated."""
+    pts = np.sort(values[~np.isnan(values)])
+    if not len(pts):
+        return None
+    frac_rank = (n / 100.0) * (len(pts) + 1)
+    rank = int(frac_rank)
+    rank_frac = frac_rank - rank
+    if not interpolate:
+        rank += int(math.ceil(rank_frac))
+    if rank == 0:
+        out = float(pts[0])
+    elif rank - 1 >= len(pts):
+        out = float(pts[-1])
+    else:
+        out = float(pts[rank - 1])
+    if interpolate and 0 < rank < len(pts):
+        out += rank_frac * (float(pts[rank]) - float(pts[rank - 1]))
+    return out
+
+
+_AGG_OPS = {
+    "sum": lambda v: np.nansum(v, 0),
+    "total": lambda v: np.nansum(v, 0),
+    "avg": _nan_agg(lambda v: np.nanmean(v, 0)),
+    "average": _nan_agg(lambda v: np.nanmean(v, 0)),
+    "max": _nan_agg(lambda v: np.nanmax(v, 0)),
+    "min": _nan_agg(lambda v: np.nanmin(v, 0)),
+    "median": _nan_agg(lambda v: np.nanmedian(v, 0)),
+    "range": _nan_agg(lambda v: np.nanmax(v, 0) - np.nanmin(v, 0)),
+    "rangeOf": _nan_agg(lambda v: np.nanmax(v, 0) - np.nanmin(v, 0)),
+    "stddev": _nan_agg(lambda v: np.nanstd(v, 0)),
+    "count": lambda v: np.sum(~np.isnan(v), 0).astype(np.float64),
+    "last": _nan_agg(lambda v: _last_non_nan(v)),
+    "multiply": lambda v: np.nanprod(v, 0),
+    "diff": lambda v: v[0] - np.nansum(v[1:], 0),
+}
+
+
+def _last_non_nan(v: np.ndarray) -> np.ndarray:
+    out = np.full(v.shape[1], NAN)
+    for row in v:
+        out = np.where(np.isnan(row), out, row)
+    return out
+
+
+@_func("aggregate")
+def _aggregate(ctx, series, func):
+    op = _AGG_OPS.get(str(func).removesuffix("Series"))
+    if op is None:
+        raise ParseError(f"aggregate: unknown func {func!r}")
+    return _combine(series, op, f"aggregate:{func}")
+
+
+@_func("group")
+def _group(ctx, *series_lists):
+    out = []
+    for sl in series_lists:
+        out.extend(sl)
+    return out
+
+
+@_func("aliasByMetric")
+def _alias_by_metric(ctx, series):
+    return [s.with_values(s.values, s.path.split(".")[-1]) for s in series]
+
+
+@_func("aliasSub")
+def _alias_sub(ctx, series, search, rep):
+    rx = re.compile(str(search))
+    return [s.with_values(s.values, rx.sub(str(rep), s.name)) for s in series]
+
+
+@_func("aliasByTags")
+def _alias_by_tags(ctx, series, *tags):
+    """Graphite-on-tags naming: M3 maps path component i to tag __gi__
+    (reference graphite storage adapter); 'name' is the full path."""
+    out = []
+    for s in series:
+        comps = s.path.split(".")
+        parts = []
+        for t in tags:
+            t = str(t)
+            if t == "name":
+                parts.append(s.path)
+            elif t.startswith("__g") and t.endswith("__"):
+                i = int(t[3:-2])
+                parts.append(comps[i] if i < len(comps) else "")
+            elif t.isdigit():
+                i = int(t)
+                parts.append(comps[i] if i < len(comps) else "")
+            else:
+                parts.append("")
+        out.append(s.with_values(s.values, ".".join(p for p in parts if p)))
+    return out
+
+
+@_func("asPercent")
+def _as_percent(ctx, series, total=None):
+    if not series:
+        return []
+    with np.errstate(all="ignore"):
+        if total is None:
+            denom = np.nansum(np.stack([s.values for s in series]), 0)
+            return [s.with_values(100.0 * s.values / denom,
+                                  f"asPercent({s.name})") for s in series]
+        if isinstance(total, (int, float)):
+            return [s.with_values(100.0 * s.values / float(total),
+                                  f"asPercent({s.name},{total:g})")
+                    for s in series]
+        if len(total) == 1:
+            d = total[0].values
+            return [s.with_values(100.0 * s.values / d,
+                                  f"asPercent({s.name},{total[0].name})")
+                    for s in series]
+        if len(total) == len(series):
+            return [s.with_values(100.0 * s.values / t.values,
+                                  f"asPercent({s.name},{t.name})")
+                    for s, t in zip(series, total)]
+    raise ParseError("asPercent: total must be scalar, 1 series, or match")
+
+
+@_func("changed")
+def _changed(ctx, series):
+    out = []
+    for s in series:
+        v = s.values
+        prev = np.concatenate([[NAN], v[:-1]])
+        ch = ((~np.isnan(v)) & (~np.isnan(prev)) & (v != prev)).astype(np.float64)
+        out.append(s.with_values(ch, f"changed({s.name})"))
+    return out
+
+
+@_func("consolidateBy", "cumulative")
+def _consolidate_by(ctx, series, func="sum"):
+    # Consolidation is a render-resolution hint; data passes through.
+    return [s.with_values(s.values, f'consolidateBy({s.name},"{func}")')
+            for s in series]
+
+
+def _grid(ctx):
+    n = max(1, (ctx.end - ctx.start) // ctx.step)
+    return n
+
+
+@_func("constantLine")
+def _constant_line(ctx, value):
+    n = _grid(ctx)
+    return [GraphiteSeries(f"{float(value):g}", f"{float(value):g}",
+                           np.full(n, float(value)), ctx.step, ctx.start)]
+
+
+@_func("threshold")
+def _threshold(ctx, value, label=None):
+    (line,) = _constant_line(ctx, value)
+    return [line.with_values(line.values,
+                             str(label) if label is not None else line.name)]
+
+
+@_func("identity")
+def _identity(ctx, name="identity"):
+    n = _grid(ctx)
+    secs = (ctx.start + np.arange(n) * ctx.step) / 1e9
+    return [GraphiteSeries(str(name), str(name), secs.astype(np.float64),
+                           ctx.step, ctx.start)]
+
+
+@_func("timeFunction", "time")
+def _time_function(ctx, name="time", step=None):
+    return _identity(ctx, name)
+
+
+@_func("countSeries")
+def _count_series(ctx, *series_lists):
+    series = [s for sl in series_lists for s in sl]
+    if not series:
+        return []
+    n = len(series[0].values)
+    return [series[0].with_values(np.full(n, float(len(series))),
+                                  "countSeries()")]
+
+
+@_func("currentBelow")
+def _cur_below(ctx, series, n):
+    return _filter_stat(series, "current", lambda v: v < n)
+
+
+@_func("maximumBelow")
+def _max_below(ctx, series, n):
+    return _filter_stat(series, "max", lambda v: v < n)
+
+
+@_func("minimumAbove")
+def _min_above(ctx, series, n):
+    return _filter_stat(series, "min", lambda v: v > n)
+
+
+@_func("minimumBelow")
+def _min_below(ctx, series, n):
+    return _filter_stat(series, "min", lambda v: v < n)
+
+
+@_func("lowestCurrent")
+def _lowest_cur(ctx, series, n=1):
+    return _select(series, "current", int(n), False)
+
+
+@_func("highest")
+def _highest(ctx, series, n=1, func="average"):
+    return _select(series, _stat_name(func), int(n), True)
+
+
+@_func("lowest")
+def _lowest(ctx, series, n=1, func="average"):
+    return _select(series, _stat_name(func), int(n), False)
+
+
+@_func("delay")
+def _delay(ctx, series, steps):
+    k = int(steps)
+    out = []
+    for s in series:
+        v = np.full_like(s.values, NAN)
+        if k >= 0:
+            if k < len(v):
+                v[k:] = s.values[: len(v) - k]
+        else:
+            if -k < len(v):
+                v[:k] = s.values[-k:]
+        out.append(s.with_values(v, f"delay({s.name},{k})"))
+    return out
+
+
+@_func("divideSeries")
+def _divide_series(ctx, dividends, divisor):
+    if len(divisor) != 1:
+        raise ParseError("divideSeries: divisor must be exactly one series")
+    d = divisor[0].values
+    with np.errstate(all="ignore"):
+        return [
+            s.with_values(np.where(d == 0, NAN, s.values / d),
+                          f"divideSeries({s.name},{divisor[0].name})")
+            for s in dividends
+        ]
+
+
+@_func("divideSeriesLists")
+def _divide_series_lists(ctx, dividends, divisors):
+    if len(dividends) != len(divisors):
+        raise ParseError("divideSeriesLists: length mismatch")
+    with np.errstate(all="ignore"):
+        return [
+            s.with_values(np.where(t.values == 0, NAN, s.values / t.values),
+                          f"divideSeries({s.name},{t.name})")
+            for s, t in zip(dividends, divisors)
+        ]
+
+
+@_func("exclude")
+def _exclude(ctx, series, pattern):
+    rx = re.compile(str(pattern))
+    return [s for s in series if not rx.search(s.name)]
+
+
+@_func("grep")
+def _grep(ctx, series, pattern):
+    rx = re.compile(str(pattern))
+    return [s for s in series if rx.search(s.name)]
+
+
+@_func("fallbackSeries")
+def _fallback_series(ctx, series, fallback):
+    return series if series else fallback
+
+
+@_func("filterSeries")
+def _filter_series(ctx, series, func, op, threshold):
+    what = _stat_name(func)
+    ops = {
+        "=": lambda v: v == threshold, "!=": lambda v: v != threshold,
+        ">": lambda v: v > threshold, ">=": lambda v: v >= threshold,
+        "<": lambda v: v < threshold, "<=": lambda v: v <= threshold,
+    }
+    pred = ops.get(str(op))
+    if pred is None:
+        raise ParseError(f"filterSeries: unknown op {op!r}")
+    return _filter_stat(series, what, pred)
+
+
+@_func("hitcount")
+def _hitcount(ctx, series, interval, aligned=False):
+    nanos = _duration_nanos(str(interval))
+    out = []
+    for s in series:
+        k = max(1, nanos // s.step_nanos)
+        T = len(s.values)
+        nb = (T + k - 1) // k
+        res = np.full(nb, NAN)
+        secs = s.step_nanos / 1e9
+        for b in range(nb):
+            w = s.values[b * k: (b + 1) * k]
+            if (~np.isnan(w)).any():
+                res[b] = np.nansum(w) * secs
+        out.append(GraphiteSeries(
+            f'hitcount({s.name},"{interval}")', s.path, res,
+            s.step_nanos * k, s.start_nanos,
+        ))
+    return out
+
+
+@_func("integralByInterval")
+def _integral_by_interval(ctx, series, interval):
+    nanos = _duration_nanos(str(interval))
+    out = []
+    for s in series:
+        k = max(1, nanos // s.step_nanos)
+        v = np.nan_to_num(s.values)
+        res = np.empty_like(v)
+        for b in range(0, len(v), k):
+            res[b: b + k] = np.cumsum(v[b: b + k])
+        out.append(s.with_values(res, f"integralByInterval({s.name})"))
+    return out
+
+
+@_func("interpolate")
+def _interpolate(ctx, series, limit=-1):
+    """Fill interior NaN gaps linearly; a gap is filled only when its
+    ENTIRE run length is <= limit (graphite-web leaves longer gaps
+    untouched rather than partially filling them)."""
+    out = []
+    for s in series:
+        v = s.values.copy()
+        idx = np.arange(len(v))
+        good = ~np.isnan(v)
+        if good.sum() >= 2:
+            filled = np.interp(idx, idx[good], v[good])
+            first, last = idx[good][0], idx[good][-1]
+            i = 0
+            while i < len(v):
+                if np.isnan(v[i]):
+                    j = i
+                    while j < len(v) and np.isnan(v[j]):
+                        j += 1
+                    interior = first < i and j - 1 < last
+                    if interior and (limit < 0 or (j - i) <= limit):
+                        v[i:j] = filled[i:j]
+                    i = j
+                else:
+                    i += 1
+        out.append(s.with_values(v, f"interpolate({s.name})"))
+    return out
+
+
+@_func("isNonNull")
+def _is_non_null(ctx, series):
+    return [s.with_values((~np.isnan(s.values)).astype(np.float64),
+                          f"isNonNull({s.name})") for s in series]
+
+
+@_func("logarithm", "log")
+def _logarithm(ctx, series, base=10):
+    with np.errstate(all="ignore"):
+        return [
+            s.with_values(
+                np.where(s.values > 0,
+                         np.log(s.values) / math.log(float(base)), NAN),
+                f"log({s.name},{float(base):g})")
+            for s in series
+        ]
+
+
+@_func("mostDeviant")
+def _most_deviant(ctx, series, n):
+    def sigma(s):
+        v = s.values[~np.isnan(s.values)]
+        return float(v.std()) if len(v) else -math.inf
+    return sorted(series, key=sigma, reverse=True)[: int(n)]
+
+
+@_func("movingMedian")
+def _moving_median(ctx, series, window):
+    return _moving(series, int(window), np.median, "movingMedian")
+
+
+@_func("movingWindow")
+def _moving_window(ctx, series, window, func="average"):
+    fn = {"average": np.mean, "avg": np.mean, "sum": np.sum,
+          "max": np.max, "min": np.min, "median": np.median,
+          "stddev": np.std}.get(str(func))
+    if fn is None:
+        raise ParseError(f"movingWindow: unknown func {func!r}")
+    return _moving(series, int(window), fn, f"movingWindow:{func}")
+
+
+@_func("exponentialMovingAverage")
+def _ema(ctx, series, window):
+    """graphite-web semantics: the EMA seeds with the simple average of
+    the first ``window`` points (emitted at that index; earlier points
+    are null), then decays with alpha = 2/(window+1)."""
+    n = int(window)
+    alpha = 2.0 / (n + 1)
+    out = []
+    for s in series:
+        v = s.values
+        res = np.full_like(v, NAN)
+        if len(v) >= n:
+            seed_w = v[:n]
+            seed = float(np.nanmean(seed_w)) if (~np.isnan(seed_w)).any() else NAN
+            ema = seed
+            res[n - 1] = ema
+            for i in range(n, len(v)):
+                x = v[i]
+                if not math.isnan(x) and not math.isnan(ema):
+                    ema = alpha * x + (1 - alpha) * ema
+                elif not math.isnan(x):
+                    ema = x
+                res[i] = ema
+        out.append(s.with_values(res, f"exponentialMovingAverage({s.name},{n})"))
+    return out
+
+
+@_func("stdev")
+def _stdev_moving(ctx, series, points, window_tolerance=0.1):
+    return _moving(series, int(points), np.std, "stdev")
+
+
+@_func("stddevSeries")
+def _stddev_series(ctx, series):
+    return _combine(series, _nan_agg(lambda v: np.nanstd(v, 0)), "stddevSeries")
+
+
+@_func("rangeOfSeries")
+def _range_of_series(ctx, series):
+    return _combine(
+        series,
+        _nan_agg(lambda v: np.nanmax(v, 0) - np.nanmin(v, 0)),
+        "rangeOfSeries",
+    )
+
+
+@_func("nPercentile")
+def _n_percentile(ctx, series, n):
+    out = []
+    for s in series:
+        p = _percentile(s.values, float(n))
+        if p is None:
+            continue
+        out.append(s.with_values(np.full_like(s.values, p),
+                                 f"nPercentile({s.name},{float(n):g})"))
+    return out
+
+
+@_func("percentileOfSeries")
+def _percentile_of_series(ctx, series, n, interpolate=False):
+    if not series:
+        return []
+    vals = np.stack([s.values for s in series])
+    T = vals.shape[1]
+    res = np.full(T, NAN)
+    for t in range(T):
+        p = _percentile(vals[:, t], float(n), bool(interpolate))
+        if p is not None:
+            res[t] = p
+    return [series[0].with_values(res, f"percentileOfSeries({series[0].name},{float(n):g})")]
+
+
+@_func("pow")
+def _pow(ctx, series, factor):
+    with np.errstate(all="ignore"):
+        return [s.with_values(np.power(s.values, float(factor)),
+                              f"pow({s.name},{float(factor):g})")
+                for s in series]
+
+
+@_func("powSeries")
+def _pow_series(ctx, *series_lists):
+    series = [s for sl in series_lists for s in sl]
+    if not series:
+        return []
+    with np.errstate(all="ignore"):
+        acc = series[0].values.copy()
+        for s in series[1:]:
+            acc = np.power(acc, s.values)
+    return [series[0].with_values(acc, "powSeries()")]
+
+
+@_func("offsetToZero")
+def _offset_to_zero(ctx, series):
+    out = []
+    for s in series:
+        v = s.values[~np.isnan(s.values)]
+        base = float(v.min()) if len(v) else 0.0
+        out.append(s.with_values(s.values - base, f"offsetToZero({s.name})"))
+    return out
+
+
+def _remove_by(series, pred, name):
+    out = []
+    for s in series:
+        v = s.values.copy()
+        v[pred(s, v)] = NAN
+        out.append(s.with_values(v, f"{name}({s.name})"))
+    return out
+
+
+@_func("removeAboveValue")
+def _remove_above_value(ctx, series, n):
+    return _remove_by(series, lambda s, v: v > n, "removeAboveValue")
+
+
+@_func("removeBelowValue")
+def _remove_below_value(ctx, series, n):
+    return _remove_by(series, lambda s, v: v < n, "removeBelowValue")
+
+
+@_func("removeAbovePercentile")
+def _remove_above_pct(ctx, series, n):
+    def pred(s, v):
+        p = _percentile(v, float(n))
+        return v > p if p is not None else np.zeros(len(v), bool)
+    return _remove_by(series, pred, "removeAbovePercentile")
+
+
+@_func("removeBelowPercentile")
+def _remove_below_pct(ctx, series, n):
+    def pred(s, v):
+        p = _percentile(v, float(n))
+        return v < p if p is not None else np.zeros(len(v), bool)
+    return _remove_by(series, pred, "removeBelowPercentile")
+
+
+@_func("removeEmptySeries")
+def _remove_empty(ctx, series, xFilesFactor=0):
+    out = []
+    for s in series:
+        frac = float((~np.isnan(s.values)).mean()) if len(s.values) else 0.0
+        if frac > 0 and frac >= float(xFilesFactor):
+            out.append(s)
+    return out
+
+
+@_func("round")
+def _round(ctx, series, precision=0):
+    p = int(precision)
+    return [s.with_values(np.round(s.values, p),
+                          f"round({s.name},{p})") for s in series]
+
+
+@_func("scaleToSeconds")
+def _scale_to_seconds(ctx, series, seconds):
+    return [
+        s.with_values(s.values * (float(seconds) / (s.step_nanos / 1e9)),
+                      f"scaleToSeconds({s.name},{float(seconds):g})")
+        for s in series
+    ]
+
+
+@_func("smartSummarize")
+def _smart_summarize(ctx, series, interval, func="sum"):
+    # summarize with buckets aligned to the interval epoch boundary:
+    # the leading partial bucket is trimmed so every bucket starts on a
+    # multiple of the interval.
+    nanos = _duration_nanos(str(interval))
+    out = []
+    for s in series:
+        off = s.start_nanos % nanos
+        lead = 0 if off == 0 else int((nanos - off) // s.step_nanos)
+        trimmed = replace(s, values=s.values[lead:],
+                          start_nanos=s.start_nanos + lead * s.step_nanos)
+        summ = _summarize(ctx, [trimmed], interval, func)
+        if summ:
+            out.append(replace(
+                summ[0],
+                name=f'smartSummarize({s.name},"{interval}","{func}")'))
+    return out
+
+
+@_func("sortBy")
+def _sort_by(ctx, series, func="average", reverse=False):
+    what = _stat_name(func)
+    scored = [(s, _series_stat(s, what)) for s in series]
+    scored = [(s, v if v is not None else -math.inf) for s, v in scored]
+    scored.sort(key=lambda sv: sv[1], reverse=bool(reverse))
+    return [s for s, _ in scored]
+
+
+@_func("sortByMinima")
+def _sort_by_minima(ctx, series):
+    return sorted(
+        series,
+        key=lambda s: (v if (v := _series_stat(s, "min")) is not None
+                       else math.inf),
+    )
+
+
+@_func("sortByTotal")
+def _sort_by_total(ctx, series):
+    def total(s):
+        v = s.values[~np.isnan(s.values)]
+        return float(v.sum()) if len(v) else -math.inf
+    return sorted(series, key=total, reverse=True)
+
+
+@_func("squareRoot")
+def _square_root(ctx, series):
+    with np.errstate(all="ignore"):
+        return [s.with_values(np.where(s.values >= 0, np.sqrt(s.values), NAN),
+                              f"squareRoot({s.name})") for s in series]
+
+
+@_func("substr")
+def _substr(ctx, series, start=0, stop=0):
+    out = []
+    for s in series:
+        comps = s.name.split(".")
+        sl = comps[int(start): int(stop)] if int(stop) != 0 else comps[int(start):]
+        out.append(s.with_values(s.values, ".".join(sl)))
+    return out
+
+
+def _sustained(series, duration, pred, name):
+    """Keep values only inside runs satisfying ``pred`` for at least
+    ``duration`` (shared body of sustainedAbove/Below)."""
+    nanos = _duration_nanos(str(duration))
+    out = []
+    for s in series:
+        k = max(1, int(nanos // s.step_nanos))
+        v = s.values
+        ok = pred(v)
+        res = np.full_like(v, NAN)
+        run = 0
+        for i in range(len(v)):
+            run = run + 1 if ok[i] else 0
+            if run >= k:
+                res[i - run + 1: i + 1] = v[i - run + 1: i + 1]
+        out.append(s.with_values(res, f"{name}({s.name})"))
+    return out
+
+
+@_func("sustainedAbove")
+def _sustained_above(ctx, series, value, duration):
+    return _sustained(series, duration, lambda v: v >= value,
+                      "sustainedAbove")
+
+
+@_func("sustainedBelow")
+def _sustained_below(ctx, series, value, duration):
+    return _sustained(series, duration, lambda v: v <= value,
+                      "sustainedBelow")
+
+
+@_func("transformNull")
+def _transform_null(ctx, series, default=0):
+    return [
+        s.with_values(np.where(np.isnan(s.values), float(default), s.values),
+                      f"transformNull({s.name},{float(default):g})")
+        for s in series
+    ]
+
+
+@_func("groupByNodes")
+def _group_by_nodes(ctx, series, func, *nodes):
+    groups: dict[str, list] = {}
+    for s in series:
+        comps = s.path.split(".")
+        key = ".".join(
+            comps[int(n)] if int(n) < len(comps) else "" for n in nodes
+        )
+        groups.setdefault(key, []).append(s)
+    op = _AGG_OPS.get(str(func).removesuffix("Series"))
+    if op is None:
+        raise ParseError(f"groupByNodes: unknown func {func!r}")
+    out = []
+    for key in sorted(groups):
+        combined = _combine(groups[key], op, key)
+        if combined:
+            out.append(combined[0].with_values(combined[0].values, key))
+    return out
+
+
+def _with_wildcards(series, positions):
+    groups: dict[str, list] = {}
+    for s in series:
+        comps = s.path.split(".")
+        key = ".".join(
+            c for i, c in enumerate(comps) if i not in positions
+        )
+        groups.setdefault(key, []).append(s)
+    return groups
+
+
+@_func("aggregateWithWildcards")
+def _aggregate_with_wildcards(ctx, series, func, *positions):
+    op = _AGG_OPS.get(str(func).removesuffix("Series"))
+    if op is None:
+        raise ParseError(f"aggregateWithWildcards: unknown func {func!r}")
+    pos = {int(p) for p in positions}
+    out = []
+    for key in sorted(groups := _with_wildcards(series, pos)):
+        combined = _combine(groups[key], op, key)
+        if combined:
+            out.append(combined[0].with_values(combined[0].values, key))
+    return out
+
+
+@_func("sumSeriesWithWildcards")
+def _sum_with_wildcards(ctx, series, *positions):
+    return _aggregate_with_wildcards(ctx, series, "sum", *positions)
+
+
+@_func("averageSeriesWithWildcards")
+def _avg_with_wildcards(ctx, series, *positions):
+    return _aggregate_with_wildcards(ctx, series, "average", *positions)
+
+
+@_func("multiplySeriesWithWildcards")
+def _mul_with_wildcards(ctx, series, *positions):
+    return _aggregate_with_wildcards(ctx, series, "multiply", *positions)
+
+
+@_func("weightedAverage")
+def _weighted_average(ctx, avg_series, weight_series, *nodes):
+    def key_of(s):
+        comps = s.path.split(".")
+        return ".".join(
+            comps[int(n)] if int(n) < len(comps) else "" for n in nodes
+        )
+    weights = {key_of(s): s for s in weight_series}
+    num = None
+    den = None
+    for s in avg_series:
+        w = weights.get(key_of(s))
+        if w is None:
+            continue
+        prod = np.where(np.isnan(s.values) | np.isnan(w.values), 0.0,
+                        s.values * w.values)
+        wv = np.where(np.isnan(s.values) | np.isnan(w.values), 0.0, w.values)
+        num = prod if num is None else num + prod
+        den = wv if den is None else den + wv
+    if num is None:
+        return []
+    with np.errstate(all="ignore"):
+        res = np.where(den == 0, NAN, num / den)
+    return [avg_series[0].with_values(res, "weightedAverage()")]
+
+
+@_func("aggregateLine")
+def _aggregate_line(ctx, series, func="average"):
+    what = _stat_name(func)
+    out = []
+    for s in series:
+        stat = _series_stat(s, what)
+        if stat is None:
+            continue
+        out.append(s.with_values(np.full_like(s.values, stat),
+                                 f"aggregateLine({s.name},{stat:g})"))
     return out
 
 

@@ -100,21 +100,59 @@ class _AdminHandler(BaseHTTPRequestHandler):
             if path == "/api/v1/services/m3db/placement/init":
                 instances = [
                     Instance(i["id"], i.get("isolation_group", ""),
-                             i.get("weight", 1))
+                             i.get("weight", 1),
+                             shard_set_id=i.get("shard_set_id", 0))
                     for i in body["instances"]
                 ]
-                p = initial_placement(
-                    instances, body.get("num_shards", 64), body.get("rf", 3)
-                )
+                if body.get("mirrored", False):
+                    # Aggregator-style HA placement (algo/mirrored.go):
+                    # shard sets of RF instances sharing identical shards.
+                    from m3_tpu.cluster.placement_mirrored import (
+                        mirrored_initial_placement,
+                    )
+
+                    p = mirrored_initial_placement(
+                        instances, body.get("num_shards", 64),
+                        body.get("rf", 3),
+                    )
+                else:
+                    p = initial_placement(
+                        instances, body.get("num_shards", 64),
+                        body.get("rf", 3),
+                    )
                 self.ctx.placements.set(p)
                 return self._json(200, json.loads(p.to_json()))
             if path == "/api/v1/services/m3db/placement":
                 p = self.ctx.placements.get()
                 if p is None:
                     return self._json(404, {"error": "no placement; init first"})
-                inst = Instance(body["id"], body.get("isolation_group", ""),
-                                body.get("weight", 1))
-                p2 = add_instance(p, inst)
+                if p.is_mirrored:
+                    # Mirrored placements grow by whole shard sets of RF
+                    # instances (algo/mirrored.go AddInstances); a solo
+                    # add would break the mirror invariant.
+                    insts = body.get("instances")
+                    if not insts:
+                        return self._json(400, {
+                            "error": "mirrored placement: POST "
+                            "{'instances': [RF members sharing a new "
+                            "shard_set_id]}"})
+                    from m3_tpu.cluster.placement_mirrored import (
+                        mirrored_add_group,
+                    )
+
+                    group = [
+                        Instance(i["id"], i.get("isolation_group", ""),
+                                 i.get("weight", 1),
+                                 shard_set_id=i["shard_set_id"])
+                        for i in insts
+                    ]
+                    p2 = mirrored_add_group(p, group)
+                else:
+                    inst = Instance(body["id"],
+                                    body.get("isolation_group", ""),
+                                    body.get("weight", 1),
+                                    shard_set_id=body.get("shard_set_id", 0))
+                    p2 = add_instance(p, inst)
                 self.ctx.placements.set(p2)
                 return self._json(200, json.loads(p2.to_json()))
             if path == "/api/v1/topic":

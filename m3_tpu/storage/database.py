@@ -347,6 +347,9 @@ class Namespace:
         for bs in sorted(sealed_blocks):
             if self.index.seal_block(bs) is not None:
                 stats["index_sealed"] += 1
+        # Background segment compaction: bound per-block segment counts
+        # under churn (reference multi_segments_builder compaction).
+        stats["index_compactions"] = self.index.compact()
         return stats
 
 

@@ -171,7 +171,7 @@ def stage_codec(cpu, dev, S: int, T: int) -> list[str]:
     dec = functools.partial(decode_batch_device, max_points=T + 1)
     dc = _on(cpu, dec, words, nbits)
     dd = _on(dev, dec, words, nbits)
-    names = ["ts", "payload", "meta", "err", "prec"]
+    names = ["ts", "payload", "meta", "err", "prec", "ann"]
     for n, a, b in zip(names, dc, dd):
         if _diff_report(f"decode.{n}", a, b):
             bad.append(f"decode.{n}")

@@ -267,3 +267,212 @@ class TestHTTP:
         assert all(f["expandable"] for f in find)
         srv.shutdown()
         db.close()
+
+
+class TestFunctionParityTable:
+    """Checked-in parity table vs the reference's builtin registry
+    (`src/query/graphite/native/builtin_functions.go` registers ~107
+    functions).  Presentational and synthetic-data builtins are
+    deliberately out of scope; everything else must resolve."""
+
+    # The reference registry, partitioned by our support policy.
+    OUT_OF_SCOPE = {
+        # presentational / render hints
+        "cactiStyle", "dashed", "legendValue",
+        # synthetic data generators
+        "randomWalkFunction",
+        # holt-winters family (post-MVP forecasting tier)
+        "holtWintersAberration", "holtWintersConfidenceBands",
+        "holtWintersForecast",
+        # template re-evaluation
+        "applyByNode",
+        # window re-fetch variants
+        "timeSlice", "useSeriesAbove",
+    }
+    REFERENCE_REGISTRY = {
+        "absolute", "aggregate", "aggregateLine", "aggregateWithWildcards",
+        "alias", "aliasByMetric", "aliasByNode", "aliasSub", "applyByNode",
+        "asPercent", "averageAbove", "averageBelow", "averageSeries",
+        "averageSeriesWithWildcards", "cactiStyle", "changed",
+        "consolidateBy", "constantLine", "countSeries", "cumulative",
+        "currentAbove", "currentBelow", "dashed", "delay", "derivative",
+        "diffSeries", "divideSeries", "divideSeriesLists", "exclude",
+        "exponentialMovingAverage", "fallbackSeries", "filterSeries",
+        "grep", "group", "groupByNode", "groupByNodes", "highest",
+        "highestAverage", "highestCurrent", "highestMax", "hitcount",
+        "holtWintersAberration", "holtWintersConfidenceBands",
+        "holtWintersForecast", "identity", "integral", "integralByInterval",
+        "interpolate", "invert", "isNonNull", "keepLastValue",
+        "legendValue", "limit", "logarithm", "lowest", "lowestAverage",
+        "lowestCurrent", "maxSeries", "maximumAbove", "minSeries",
+        "minimumAbove", "mostDeviant", "movingAverage", "movingMax",
+        "movingMedian", "movingMin", "movingSum", "movingWindow",
+        "multiplySeries", "multiplySeriesWithWildcards", "nPercentile",
+        "nonNegativeDerivative", "offset", "offsetToZero", "perSecond",
+        "percentileOfSeries", "pow", "powSeries", "randomWalkFunction",
+        "rangeOfSeries", "removeAbovePercentile", "removeAboveValue",
+        "removeBelowPercentile", "removeBelowValue", "removeEmptySeries",
+        "scale", "scaleToSeconds", "smartSummarize", "sortBy",
+        "sortByMaxima", "sortByMinima", "sortByName", "sortByTotal",
+        "squareRoot", "stddevSeries", "stdev", "substr", "sumSeries",
+        "sumSeriesWithWildcards", "summarize", "sustainedAbove",
+        "sustainedBelow", "threshold", "timeFunction", "timeShift",
+        "timeSlice", "transformNull", "useSeriesAbove", "weightedAverage",
+        "aliasByTags", "minimumBelow", "maximumBelow", "round",
+    }
+
+    def test_in_scope_functions_all_supported(self):
+        from m3_tpu.query.graphite import supported_functions
+
+        # timeShift is evaluator-intercepted but still registered.
+        supported = set(supported_functions())
+        in_scope = self.REFERENCE_REGISTRY - self.OUT_OF_SCOPE
+        missing = sorted(in_scope - supported)
+        assert not missing, f"unsupported in-scope builtins: {missing}"
+        assert len(supported) >= 70, len(supported)
+
+
+class TestBreadthTierFunctions:
+    """Behavior spot-checks of the round-3 breadth additions."""
+
+    def _series(self, name, vals, step=10 * 10**9, start=0):
+        from m3_tpu.query.graphite import GraphiteSeries
+        import numpy as np
+
+        return GraphiteSeries(name, name, np.asarray(vals, np.float64),
+                              step, start)
+
+    def _ctx(self):
+        from m3_tpu.query.graphite import _Ctx
+
+        return _Ctx(None, 0, 80 * 10**9, 10 * 10**9)
+
+    def test_as_percent_of_total(self):
+        from m3_tpu.query.graphite import _FUNCS
+        import numpy as np
+
+        a = self._series("a.x", [1, 1, 3])
+        b = self._series("b.x", [3, 1, 1])
+        out = _FUNCS["asPercent"](self._ctx(), [a, b])
+        np.testing.assert_allclose(out[0].values, [25.0, 50.0, 75.0])
+        np.testing.assert_allclose(out[1].values, [75.0, 50.0, 25.0])
+
+    def test_divide_series(self):
+        from m3_tpu.query.graphite import _FUNCS
+        import numpy as np
+
+        a = self._series("a", [4, 9, 0])
+        d = self._series("d", [2, 3, 0])
+        (out,) = _FUNCS["divideSeries"](self._ctx(), [a], [d])
+        np.testing.assert_allclose(out.values[:2], [2.0, 3.0])
+        assert np.isnan(out.values[2])  # x/0 -> null, graphite-style
+
+    def test_moving_median_and_window(self):
+        from m3_tpu.query.graphite import _FUNCS
+        import numpy as np
+
+        s = self._series("m", [1, 9, 5, 3, 7])
+        (out,) = _FUNCS["movingMedian"](self._ctx(), [s], 3)
+        np.testing.assert_allclose(out.values[2:], [5.0, 5.0, 5.0])
+        (out2,) = _FUNCS["movingWindow"](self._ctx(), [s], 3, "median")
+        np.testing.assert_allclose(out2.values[2:], out.values[2:])
+
+    def test_group_by_nodes(self):
+        from m3_tpu.query.graphite import _FUNCS
+        import numpy as np
+
+        series = [
+            self._series("svc.a.east.req", [1, 2]),
+            self._series("svc.a.west.req", [10, 20]),
+            self._series("svc.b.east.req", [100, 200]),
+        ]
+        out = _FUNCS["groupByNodes"](self._ctx(), series, "sum", 1)
+        got = {s.name: s.values.tolist() for s in out}
+        assert got == {"a": [11.0, 22.0], "b": [100.0, 200.0]}
+
+    def test_alias_by_tags_path_components(self):
+        from m3_tpu.query.graphite import _FUNCS
+
+        s = self._series("svc.api.host1", [1])
+        (out,) = _FUNCS["aliasByTags"](self._ctx(), [s], "__g1__", "__g2__")
+        assert out.name == "api.host1"
+
+    def test_transform_null_and_is_non_null(self):
+        from m3_tpu.query.graphite import _FUNCS, NAN
+        import numpy as np
+
+        s = self._series("m", [1, NAN, 3])
+        (out,) = _FUNCS["transformNull"](self._ctx(), [s], -1)
+        np.testing.assert_allclose(out.values, [1, -1, 3])
+        (nn,) = _FUNCS["isNonNull"](self._ctx(), [s])
+        np.testing.assert_allclose(nn.values, [1, 0, 1])
+
+    def test_remove_above_percentile(self):
+        from m3_tpu.query.graphite import _FUNCS
+        import numpy as np
+
+        s = self._series("m", list(range(1, 11)))
+        (out,) = _FUNCS["removeAbovePercentile"](self._ctx(), [s], 50)
+        assert np.isnan(out.values[-1])
+        assert out.values[0] == 1.0
+
+    def test_weighted_average(self):
+        from m3_tpu.query.graphite import _FUNCS
+        import numpy as np
+
+        avg = [self._series("lat.a.avg", [10, 20]),
+               self._series("lat.b.avg", [30, 40])]
+        w = [self._series("lat.a.count", [1, 1]),
+             self._series("lat.b.count", [3, 1])]
+        (out,) = _FUNCS["weightedAverage"](self._ctx(), avg, w, 1)
+        np.testing.assert_allclose(out.values, [(10 + 90) / 4.0, 30.0])
+
+    def test_sum_series_with_wildcards(self):
+        from m3_tpu.query.graphite import _FUNCS
+        import numpy as np
+
+        series = [
+            self._series("svc.h1.req", [1, 2]),
+            self._series("svc.h2.req", [10, 20]),
+        ]
+        out = _FUNCS["sumSeriesWithWildcards"](self._ctx(), series, 1)
+        assert len(out) == 1
+        assert out[0].name == "svc.req"
+        np.testing.assert_allclose(out[0].values, [11.0, 22.0])
+
+    def test_ema_sma_seed_and_decay(self):
+        """graphite-web EMA: null until the window fills, seeds with the
+        SMA of the first window, then decays with alpha=2/(n+1)."""
+        from m3_tpu.query.graphite import _FUNCS
+        import numpy as np
+
+        s = self._series("m", [10, 20, 30, 40])
+        (out,) = _FUNCS["exponentialMovingAverage"](self._ctx(), [s], 3)
+        assert np.isnan(out.values[:2]).all()
+        np.testing.assert_allclose(out.values[2], 20.0)  # avg(10,20,30)
+        np.testing.assert_allclose(out.values[3], 0.5 * 40 + 0.5 * 20.0)
+
+    def test_highest_rejects_unknown_func(self):
+        from m3_tpu.query.graphite import _FUNCS, ParseError
+        import pytest
+
+        s = self._series("m", [1, 2])
+        with pytest.raises(ParseError, match="unknown aggregation"):
+            _FUNCS["highest"](self._ctx(), [s], 1, "bogus")
+        # sum is a real aggregation and must select by sum, not average
+        a = self._series("a", [10, 0, 0])   # sum 10, avg 3.33
+        b = self._series("b", [4, 4, 0])    # sum 8, avg 2.67
+        c = self._series("c", [0, 0, 9])    # sum 9, avg 3
+        out = _FUNCS["highest"](self._ctx(), [a, b, c], 2, "sum")
+        assert [s.name for s in out] == ["a", "c"]
+
+    def test_interpolate_gap_length_limit(self):
+        from m3_tpu.query.graphite import _FUNCS, NAN
+        import numpy as np
+
+        s = self._series("m", [1, NAN, NAN, NAN, NAN, 6, NAN, 8])
+        (out,) = _FUNCS["interpolate"](self._ctx(), [s], 2)
+        # the 4-long gap exceeds limit=2: left fully null
+        assert np.isnan(out.values[1:5]).all()
+        # the 1-long gap fills linearly
+        np.testing.assert_allclose(out.values[6], 7.0)

@@ -121,7 +121,9 @@ def test_roundtrip_batched():
     assert np.allclose(vals2[:, :150], vals, rtol=0, atol=0, equal_nan=True)
 
 
-def test_decode_annotation_stream_flags_fallback():
+def test_decode_annotation_stream_default_flags_fallback():
+    """By default annotated streams still flag fallback (the annotation
+    BYTES are skipped on device and callers may need them)."""
     from m3_tpu.core.xtime import Unit
     from m3_tpu.encoding.m3tsz import Datapoint, Encoder
 
@@ -130,6 +132,70 @@ def test_decode_annotation_stream_flags_fallback():
     enc.encode(Datapoint(START + 2 * 10**9, 2.0, Unit.SECOND))
     _, _, _, fb = decode_batch([enc.stream()], max_points=10)
     assert fb.all()
+
+
+def test_decode_annotation_stream_rides_device_path():
+    """With annotations_fallback=False, annotated streams decode on
+    device: values/timestamps exact, each annotation eats one slot."""
+    from m3_tpu.core.xtime import Unit
+    from m3_tpu.encoding.m3tsz import Datapoint, Encoder
+
+    enc = Encoder(START)
+    enc.encode(Datapoint(START + 10**9, 1.5, Unit.SECOND, b"schema-v1"))
+    enc.encode(Datapoint(START + 2 * 10**9, 2.5, Unit.SECOND))
+    # mid-stream annotation CHANGE plus more points
+    enc.encode(Datapoint(START + 3 * 10**9, 3.5, Unit.SECOND, b"schema-v2"))
+    enc.encode(Datapoint(START + 4 * 10**9, 4.5, Unit.SECOND))
+    ts, vals, counts, fb = decode_batch(
+        [enc.stream()], max_points=12, annotations_fallback=False)
+    assert not fb.any()
+    n = int(counts[0])
+    assert n == 4
+    assert ts[0][:n].tolist() == [START + (k + 1) * 10**9 for k in range(4)]
+    assert vals[0][:n].tolist() == [1.5, 2.5, 3.5, 4.5]
+
+
+def test_decode_large_annotation_window_jump():
+    """An annotation bigger than the decoder's 2048-bit window forces
+    the full window-reload path; the stream must still decode."""
+    from m3_tpu.core.xtime import Unit
+    from m3_tpu.encoding.m3tsz import Datapoint, Encoder
+
+    big = bytes(range(256)) * 3  # 768 bytes = 6144 bits >> window
+    enc = Encoder(START)
+    enc.encode(Datapoint(START + 10**9, 7.25, Unit.SECOND, big))
+    for k in range(2, 40):
+        enc.encode(Datapoint(START + k * 10**9, float(k), Unit.SECOND))
+    ts, vals, counts, fb = decode_batch(
+        [enc.stream()], max_points=50, annotations_fallback=False)
+    assert not fb.any()
+    assert int(counts[0]) == 39
+    assert vals[0][0] == 7.25 and vals[0][38] == 39.0
+
+
+def test_encode_first_datapoint_annotation_bit_exact():
+    """encode_batch(annotations=...) must produce byte-identical streams
+    to the scalar encoder writing the same first-dp annotation."""
+    from m3_tpu.core.xtime import Unit
+    from m3_tpu.encoding.m3tsz import Datapoint, Encoder
+
+    T = 30
+    ts = np.tile(START + np.arange(1, T + 1) * 10**9, (3, 1)).astype(np.int64)
+    vals = np.round(np.arange(3)[:, None] + np.arange(T)[None, :] * 0.5, 1)
+    anns = [b"proto-schema-A", None, b"x" * 100]
+    streams, fb = encode_batch(ts, vals, np.full(3, START, np.int64),
+                               out_words=200, annotations=anns)
+    assert not fb.any()
+    for i in range(3):
+        enc = Encoder(START)
+        for k in range(T):
+            enc.encode(Datapoint(int(ts[i, k]), float(vals[i, k]),
+                                 Unit.SECOND, anns[i] or b""))
+        assert streams[i] == enc.stream(), f"series {i} not bit-exact"
+    # and the scalar decoder returns the annotation from the batched bytes
+    from m3_tpu.encoding.m3tsz import decode_series as _ds
+    pts = _ds(streams[0])
+    assert pts[0].annotation == b"proto-schema-A"
 
 
 def test_saturated_int64_values_flag_fallback():

@@ -1,0 +1,268 @@
+"""Mirrored placement algorithm (reference algo/mirrored.go).
+
+Scenario coverage mirrors the reference's add/remove/replace tests:
+groups of RF instances share identical shard sets through every
+transition, replacements stream from the surviving mirror, and the
+aggregator client fans each shard's traffic to the whole mirror set.
+"""
+
+import pytest
+
+from m3_tpu.cluster.placement import Instance, ShardState
+from m3_tpu.cluster.placement_mirrored import (
+    mirrored_add_group,
+    mirrored_initial_placement,
+    mirrored_mark_available,
+    mirrored_remove_group,
+    mirrored_replace_instance,
+    validate_mirrored,
+)
+
+
+def _insts(groups: dict[int, list[str]], iso=None):
+    out = []
+    for ssid, ids in groups.items():
+        for k, iid in enumerate(ids):
+            out.append(Instance(iid, isolation_group=(iso or {}).get(iid, f"g{k}"),
+                                shard_set_id=ssid))
+    return out
+
+
+class TestMirroredInitial:
+    def test_shards_land_on_whole_groups(self):
+        p = mirrored_initial_placement(
+            _insts({1: ["a1", "a2"], 2: ["b1", "b2"], 3: ["c1", "c2"]}),
+            num_shards=12, rf=2,
+        )
+        validate_mirrored(p)
+        assert p.is_mirrored
+        # mirror invariant: both members of a set own identical shards
+        assert p.instances["a1"].owned() == p.instances["a2"].owned()
+        assert p.instances["b1"].owned() == p.instances["b2"].owned()
+        # balanced: 12 shards over 3 groups -> 4 each
+        assert len(p.instances["a1"].shards) == 4
+        # every shard owned by exactly one group (RF members)
+        for s in range(12):
+            owners = p.instances_for_shard(s)
+            assert len(owners) == 2
+            assert len({i.shard_set_id for i in owners}) == 1
+
+    def test_wrong_group_size_rejected(self):
+        with pytest.raises(ValueError, match="want RF"):
+            mirrored_initial_placement(
+                _insts({1: ["a1", "a2", "a3"], 2: ["b1", "b2"]}),
+                num_shards=4, rf=2,
+            )
+
+
+class TestMirroredAddRemove:
+    def test_add_group_steals_group_wise(self):
+        p = mirrored_initial_placement(
+            _insts({1: ["a1", "a2"], 2: ["b1", "b2"]}), num_shards=12, rf=2
+        )
+        p2 = mirrored_add_group(
+            p, [Instance("c1", "g0", shard_set_id=3),
+                Instance("c2", "g1", shard_set_id=3)]
+        )
+        c1, c2 = p2.instances["c1"], p2.instances["c2"]
+        assert c1.owned() == c2.owned() and c1.owned()
+        # every stolen shard initializes from the member-paired donor
+        for s, a in c1.shards.items():
+            assert a.state == ShardState.INITIALIZING
+            donor = p2.instances[a.source_id]
+            assert donor.shards[s].state == ShardState.LEAVING
+            assert donor.shard_set_id == p2.instances[c2.shards[s].source_id].shard_set_id
+        # cutover all moves -> valid mirrored placement again
+        for inst in ("c1", "c2"):
+            for s, a in list(p2.instances[inst].shards.items()):
+                if a.state == ShardState.INITIALIZING:
+                    p2 = mirrored_mark_available(p2, inst, s)
+        validate_mirrored(p2)
+        assert len(p2.instances["c1"].shards) == 4
+
+    def test_remove_group_redistributes(self):
+        p = mirrored_initial_placement(
+            _insts({1: ["a1", "a2"], 2: ["b1", "b2"], 3: ["c1", "c2"]}),
+            num_shards=6, rf=2,
+        )
+        p2 = mirrored_remove_group(p, 3)
+        for iid in ("c1", "c2"):
+            for s, a in p2.instances[iid].shards.items():
+                assert a.state == ShardState.LEAVING
+        # takers initialize group-wise
+        moved = [s for s in p.instances["c1"].shards]
+        for s in moved:
+            takers = [
+                i for i in p2.instances.values()
+                if s in i.shards
+                and i.shards[s].state == ShardState.INITIALIZING
+            ]
+            assert len(takers) == 2
+            assert len({i.shard_set_id for i in takers}) == 1
+        # cutover and the leavers vanish from ownership
+        for s in moved:
+            for i in list(p2.instances.values()):
+                if (s in i.shards
+                        and i.shards[s].state == ShardState.INITIALIZING):
+                    p2 = mirrored_mark_available(p2, i.id, s)
+        for s in range(6):
+            owners = [i for i in p2.instances_for_shard(s)
+                      if i.shards[s].state != ShardState.LEAVING]
+            assert len(owners) == 2
+
+    def test_remove_last_group_rejected(self):
+        p = mirrored_initial_placement(
+            _insts({1: ["a1", "a2"]}), num_shards=4, rf=2
+        )
+        with pytest.raises(ValueError, match="last shard set"):
+            mirrored_remove_group(p, 1)
+
+
+class TestMirroredReplace:
+    def test_replacement_streams_from_surviving_mirror(self):
+        p = mirrored_initial_placement(
+            _insts({1: ["a1", "a2"], 2: ["b1", "b2"]}), num_shards=8, rf=2
+        )
+        p2 = mirrored_replace_instance(p, "a2", Instance("a3", "g1"))
+        a3 = p2.instances["a3"]
+        assert a3.shard_set_id == 1
+        assert a3.owned() == p.instances["a2"].owned()
+        for s, a in a3.shards.items():
+            assert a.state == ShardState.INITIALIZING
+            # the stream source is the SURVIVING mirror, not the leaver
+            assert a.source_id == "a1"
+        for s, a in p2.instances["a3"].shards.items():
+            p2 = mirrored_mark_available(p2, "a3", s)
+        assert "a2" not in {
+            i.id for s in range(8) for i in p2.instances_for_shard(s)
+        }
+        validate_mirrored(p2)
+
+
+class TestMirroredRoundtripAndClient:
+    def test_json_roundtrip_preserves_shard_sets(self):
+        from m3_tpu.cluster.placement import Placement
+
+        p = mirrored_initial_placement(
+            _insts({1: ["a1", "a2"], 2: ["b1", "b2"]}), num_shards=4, rf=2
+        )
+        p2 = Placement.from_json(p.to_json())
+        assert p2.is_mirrored
+        assert {i.shard_set_id for i in p2.instances.values()} == {1, 2}
+        validate_mirrored(p2)
+
+    def test_aggregator_client_fans_to_mirror_set(self):
+        """The client's per-shard fan-out hits exactly the mirror set of
+        the owning group (the HA property leader election rides on)."""
+        from m3_tpu.client.aggregator_client import AggregatorClient
+
+        p = mirrored_initial_placement(
+            _insts({1: ["a1", "a2"], 2: ["b1", "b2"]}), num_shards=4, rf=2
+        )
+        sent: dict[str, list] = {}
+
+        class _FakeQueue:
+            def __init__(self, iid):
+                self.iid = iid
+
+            def enqueue(self, mt, mid, value, t):
+                sent.setdefault(self.iid, []).append(mid)
+
+        client = AggregatorClient(p, resolve=lambda iid: ("127.0.0.1", 1))
+        client.queues = {}
+        client._queue_for = lambda iid: client.queues.setdefault(
+            iid, _FakeQueue(iid)
+        )
+        n = client.write_untimed(0, b"metric-x", 1.0, 0)
+        assert n == 2
+        owners = {iid for iid in sent}
+        ssids = {p.instances[iid].shard_set_id for iid in owners}
+        assert len(owners) == 2 and len(ssids) == 1
+
+
+class TestMirroredAdminApi:
+    def test_init_mirrored_via_admin(self, tmp_path):
+        import json
+        import urllib.request
+
+        from m3_tpu.cluster.kv import KVStore
+        from m3_tpu.server.admin_api import AdminContext, serve_admin_background
+
+        kv = KVStore(str(tmp_path))
+        srv = serve_admin_background(AdminContext(kv, None))
+        try:
+            body = {
+                "mirrored": True, "num_shards": 8, "rf": 2,
+                "instances": [
+                    {"id": "a1", "shard_set_id": 1, "isolation_group": "z1"},
+                    {"id": "a2", "shard_set_id": 1, "isolation_group": "z2"},
+                    {"id": "b1", "shard_set_id": 2, "isolation_group": "z1"},
+                    {"id": "b2", "shard_set_id": 2, "isolation_group": "z2"},
+                ],
+            }
+            port = srv.server_address[1]
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/v1/services/m3db/placement/init",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as r:
+                out = json.load(r)
+            assert out["is_mirrored"]
+            assert out["instances"]["a1"]["shard_set_id"] == 1
+            assert (sorted(out["instances"]["a1"]["shards"])
+                    == sorted(out["instances"]["a2"]["shards"]))
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+
+class TestMirroredAdminAdd:
+    def test_admin_add_on_mirrored_requires_group(self, tmp_path):
+        import json
+        import urllib.request
+        from urllib.error import HTTPError
+
+        from m3_tpu.cluster.kv import KVStore
+        from m3_tpu.server.admin_api import AdminContext, serve_admin_background
+
+        kv = KVStore(str(tmp_path))
+        srv = serve_admin_background(AdminContext(kv, None))
+        try:
+            port = srv.server_address[1]
+
+            def post(path, body):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}{path}",
+                    data=json.dumps(body).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    return json.load(r)
+
+            post("/api/v1/services/m3db/placement/init", {
+                "mirrored": True, "num_shards": 8, "rf": 2,
+                "instances": [
+                    {"id": "a1", "shard_set_id": 1},
+                    {"id": "a2", "shard_set_id": 1},
+                ],
+            })
+            # solo add must be rejected on a mirrored placement
+            try:
+                post("/api/v1/services/m3db/placement", {"id": "x"})
+                raise AssertionError("expected 400")
+            except HTTPError as e:
+                assert e.code == 400
+            # whole-group add goes through the mirrored algorithm
+            out = post("/api/v1/services/m3db/placement", {
+                "instances": [
+                    {"id": "b1", "shard_set_id": 2},
+                    {"id": "b2", "shard_set_id": 2},
+                ],
+            })
+            assert out["is_mirrored"]
+            assert (sorted(out["instances"]["b1"]["shards"])
+                    == sorted(out["instances"]["b2"]["shards"]))
+        finally:
+            srv.shutdown()
+            srv.server_close()
