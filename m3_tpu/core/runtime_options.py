@@ -24,7 +24,7 @@ DEFAULT_OPTIONS: Dict[str, Any] = {
     "max_series_read": 0,
     "max_bytes_read": 0,
     "bootstrap_consistency": "majority",
-    "block_cache_max_series_blocks": 8192,
+    "block_cache_max_bytes": 64 << 20,
     "mediator_tick_interval_s": 10.0,
 }
 

@@ -55,6 +55,9 @@ class NamespaceIndex:
         self.mutable: dict[int, MutableSegment] = {}
         self.sealed: dict[int, list[SealedSegment]] = {}
         self.tombstones: dict[int, set[bytes]] = {}
+        # per-block memo of the tombstone set already applied to the
+        # SEALED segments (compaction cost control, see compact_block)
+        self._tombs_applied: dict[int, frozenset] = {}
         # block_start -> (generation, sealed view) memo so read-heavy
         # workloads don't rebuild term tables per query.
         self._mutable_view: dict[int, tuple[int, SealedSegment]] = {}
@@ -85,6 +88,7 @@ class NamespaceIndex:
         stop matching queries immediately and are physically dropped by
         the next compaction (the reference deletes at segment rewrite)."""
         self.tombstones.setdefault(block_start, set()).update(ids)
+        self._tombs_applied.pop(block_start, None)
 
     # -- seal/persist ------------------------------------------------------
 
@@ -124,6 +128,9 @@ class NamespaceIndex:
             return None
         sealed = m.seal()
         self.sealed.setdefault(block_start, []).append(sealed)
+        # the fresh segment may carry tombstoned docs from the mutable
+        # side: force the next compaction to re-apply the tombstone set
+        self._tombs_applied.pop(block_start, None)
         self._persist_block(block_start)
         return sealed
 
@@ -138,6 +145,14 @@ class NamespaceIndex:
         if not segs:
             return 0
         if len(segs) <= max_segments and not tombs:
+            return 0
+        # Skip the per-doc tombstone scan when this exact tombstone set
+        # was already applied to the sealed segments (it lingers only
+        # because a mutable segment keeps it alive — see below): without
+        # the memo every mediator tick would rescan every doc.
+        tombs_f = frozenset(tombs)
+        if (len(segs) <= max_segments
+                and self._tombs_applied.get(block_start) == tombs_f):
             return 0
         merges = 0
         if len(segs) > max_segments:
@@ -166,7 +181,11 @@ class NamespaceIndex:
         # compaction rewrites it — popping early would resurrect those.
         if block_start not in self.mutable:
             self.tombstones.pop(block_start, None)
-        self._persist_block(block_start)
+            self._tombs_applied.pop(block_start, None)
+        else:
+            self._tombs_applied[block_start] = tombs_f
+        if merges:
+            self._persist_block(block_start)
         return merges
 
     def compact(self) -> int:

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import os
 import struct
+import threading
 from bisect import bisect_right
 from dataclasses import dataclass
 from pathlib import Path
@@ -144,9 +145,12 @@ class DataFileSetWriter:
 
 
 class DataFileSetReader:
-    """mmap-free reader with the reference's lookup ladder: bloom filter →
+    """Reader with the reference's lookup ladder: bloom filter →
     summaries → binary-searched index → data segment + checksum verify
-    (persist/fs/read.go, index_lookup.go, seek.go)."""
+    (persist/fs/read.go, index_lookup.go, seek.go).  Data segments come
+    from an mmap of the data file (`persist/fs/mmap_util.go` role):
+    page-cache backed, no per-read seek state, so concurrent reads on a
+    shared reader are safe without a lock."""
 
     def __init__(self, root, namespace: str, shard: int, block_start: int, volume: int):
         self.root = root
@@ -166,22 +170,40 @@ class DataFileSetReader:
         self.info = FileSetInfo.from_bytes(p("info").read_bytes())
         self._index = self._parse_index(p("index").read_bytes())
         self._ids = [e.id for e in self._index]
-        # Data segments are read on demand through ONE lazily-opened
-        # persistent handle (seek + read per lookup) — a long-lived
-        # reader (the block cache keeps up to 64) must not pin whole
-        # data files in memory, and the hot read path must not pay an
-        # open/close per segment; the reference's seek manager mmaps
-        # for the same reasons.  Callers serialize reads (engine lock).
+        # Data segments are served from a lazily-created mmap of the
+        # data file: the page cache owns residency (a long-lived reader
+        # pins address space, not RSS), lookups are stateless slices
+        # (thread-safe), and the hot path pays no open/seek per segment
+        # — the properties the reference gets from mmap'd seekers.
         self._data_path = p("data")
         self._data_f = None
+        self._data_mm = None
+        self._data_init = threading.Lock()
         self.bloom = BloomFilter.from_bytes(p("bloom").read_bytes())
 
-    def _data_file(self):
-        if self._data_f is None:
-            self._data_f = open(self._data_path, "rb")
-        return self._data_f
+    def _data(self):
+        if self._data_mm is None:
+            import mmap as _mmap
+
+            # Initialization is the only mutation; reads thereafter are
+            # lock-free slices.  Without the lock a first-read race
+            # leaks the loser's fd + mmap.
+            with self._data_init:
+                if self._data_mm is None:
+                    self._data_f = open(self._data_path, "rb")
+                    try:
+                        self._data_mm = _mmap.mmap(
+                            self._data_f.fileno(), 0,
+                            access=_mmap.ACCESS_READ,
+                        )
+                    except ValueError:  # zero-length file (empty fileset)
+                        self._data_mm = b""
+        return self._data_mm
 
     def close(self) -> None:
+        if self._data_mm is not None and not isinstance(self._data_mm, bytes):
+            self._data_mm.close()
+        self._data_mm = None
         if self._data_f is not None:
             self._data_f.close()
             self._data_f = None
@@ -215,18 +237,15 @@ class DataFileSetReader:
         if i < 0 or self._ids[i] != sid:
             return None
         e = self._index[i]
-        f = self._data_file()
-        f.seek(e.offset)
-        seg = f.read(e.length)
+        seg = bytes(self._data()[e.offset : e.offset + e.length])
         if digest(seg) != e.checksum:
             raise ValueError(f"segment checksum mismatch for {sid!r}")
         return seg
 
     def read_all(self) -> Iterator[tuple[bytes, bytes]]:
-        f = self._data_file()
+        mm = self._data()
         for e in self._index:  # index entries are offset-ordered
-            f.seek(e.offset)
-            seg = f.read(e.length)
+            seg = bytes(mm[e.offset : e.offset + e.length])
             if digest(seg) != e.checksum:
                 raise ValueError(f"segment checksum mismatch for {e.id!r}")
             yield e.id, seg

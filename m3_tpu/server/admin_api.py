@@ -30,6 +30,27 @@ from m3_tpu.core.runtime_options import RuntimeOptionsManager
 from m3_tpu.msg.bus import ConsumerService, ConsumptionType, Topic, TopicService
 
 
+# Retention -> recommended block size ladder (reference
+# handler/database/create.go recommendedBlockSizesByRetentionAsc).
+_BLOCK_LADDER_HOURS = (
+    (12, 0.5), (24, 1), (7 * 24, 2), (30 * 24, 12), (365 * 24, 24),
+)
+
+
+def _recommended_block_size(retention_nanos: int) -> int:
+    hours = retention_nanos / 3600e9
+    for upto, block in _BLOCK_LADDER_HOURS:
+        if hours <= upto:
+            return int(block * 3600 * 10**9)
+    return 24 * 3600 * 10**9
+
+
+def _parse_dur_nanos(s) -> int:
+    from m3_tpu.core.config import parse_duration
+
+    return parse_duration(str(s))
+
+
 class AdminContext:
     def __init__(self, kv: KVStore, db=None):
         self.kv = kv
@@ -155,6 +176,33 @@ class _AdminHandler(BaseHTTPRequestHandler):
                     p2 = add_instance(p, inst)
                 self.ctx.placements.set(p2)
                 return self._json(200, json.loads(p2.to_json()))
+            if path == "/api/v1/database/create":
+                # One-call bring-up (reference handler/database/create.go):
+                # namespace with a retention-recommended block size, plus a
+                # single-node placement when none exists ("local" type).
+                name = body.get("namespaceName")
+                if not name:
+                    return self._json(400, {"error": "namespaceName required"})
+                retention = _parse_dur_nanos(body.get("retentionTime", "48h"))
+                block = _recommended_block_size(retention)
+                meta = NamespaceMeta(
+                    name=name, retention_nanos=retention,
+                    block_size_nanos=block,
+                    num_shards=int(body.get("numShards", 4)),
+                )
+                self.ctx.namespaces.add(meta)
+                placement_out = None
+                if (body.get("type", "local") == "local"
+                        and self.ctx.placements.get() is None):
+                    host = body.get("hostID", "m3db_local")
+                    p = initial_placement(
+                        [Instance(host)], num_shards=meta.num_shards, rf=1)
+                    self.ctx.placements.set(p)
+                    placement_out = json.loads(p.to_json())
+                return self._json(200, {
+                    "namespace": dataclasses.asdict(meta),
+                    "placement": placement_out,
+                })
             if path == "/api/v1/topic":
                 t = Topic(
                     body["name"], body.get("num_shards", 64),
@@ -169,8 +217,12 @@ class _AdminHandler(BaseHTTPRequestHandler):
                 self.ctx.topics.set(t)
                 return self._json(200, json.loads(t.to_json()))
             return self._json(404, {"error": f"unknown path {path}"})
-        except (KeyError, TypeError, ValueError) as e:
-            return self._json(400, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 — every failure must come
+            # back as an HTTP error, never a dropped connection (config
+            # parse errors, registry conflicts, placement validation...)
+            code = 400 if isinstance(
+                e, (KeyError, TypeError, ValueError)) else 500
+            return self._json(code, {"error": f"{type(e).__name__}: {e}"})
 
     def do_PUT(self):
         try:

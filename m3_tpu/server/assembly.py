@@ -241,6 +241,15 @@ def run_node(source, start_mediator: bool | None = None,
                 persisted = admin_ctx.runtime.get(opt)
                 if persisted:
                     apply(persisted)
+
+            def apply_cache_budget(value):
+                db.block_cache.max_bytes = int(value)
+
+            admin_ctx.runtime.on_change("block_cache_max_bytes",
+                                        apply_cache_budget)
+            persisted = admin_ctx.runtime.get("block_cache_max_bytes")
+            if persisted:
+                apply_cache_budget(persisted)
             asm.admin_server = serve_admin_background(
                 admin_ctx, cfg.coordinator.listen_host,
                 cfg.coordinator.admin_listen_port,

@@ -103,6 +103,8 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if u.path == "/api/v1/json/write":
                 return self._write_json()
+            if u.path in ("/api/v1/influxdb/write", "/write"):
+                return self._influx_write(parse_qs(u.query))
             if u.path == "/api/v1/prom/remote/write":
                 return self._prom_remote_write()
             if u.path == "/api/v1/prom/remote/read":
@@ -277,6 +279,24 @@ class _Handler(BaseHTTPRequestHandler):
             vals.append(float(s["value"]))
         written = self._ingest_tagged(docs, ts, vals) if docs else 0
         return self._json(200, {"status": "success", "written": written})
+
+    def _influx_write(self, q):
+        """InfluxDB line-protocol write endpoint (reference
+        `api/v1/handler/influxdb/write.go`); 204 on success like
+        InfluxDB itself."""
+        import time as _time
+
+        from m3_tpu.server.influx import parse_lines, points_to_writes
+
+        precision = q.get("precision", ["ns"])[0]
+        points = parse_lines(self._body().decode(), precision,
+                             now_nanos=int(_time.time() * 1e9))
+        docs, ts, vals = points_to_writes(points)
+        written = self._ingest_tagged(docs, ts, vals) if docs else 0
+        self.send_response(204)
+        self.send_header("X-Written", str(written))
+        self.send_header("Content-Length", "0")
+        self.end_headers()
 
     def _query(self, is_range: bool, q):
         query = q["query"][0]

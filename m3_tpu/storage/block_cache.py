@@ -21,14 +21,37 @@ from m3_tpu.encoding.m3tsz import decode_series
 from m3_tpu.persist.fs import DataFileSetReader
 
 
+_POINT_BYTES = 16  # (int64 ts, float64 value)
+_ENTRY_OVERHEAD = 120  # key tuple + list object bookkeeping, approximate
+
+
+def _entry_bytes(pts) -> int:
+    return _ENTRY_OVERHEAD + (_POINT_BYTES * len(pts) if pts else 0)
+
+
 class BlockCache:
-    def __init__(self, max_readers: int = 64, max_series_blocks: int = 8192,
+    """Seek-manager + wired-list tier.
+
+    * readers: open-fileset LRU capped by count (each pins an mmap and
+      a parsed index — the seek manager's open-seeker pool).
+    * decoded series-blocks: LRU bounded by a BYTE budget, the
+      reference WiredList's capacity model (`storage/block` wires
+      decompressed blocks up to a byte limit, evicting LRU), with
+      single-flight decode so concurrent readers of one cold
+      series-block pay one disk fetch (retriever.go request
+      coalescing).
+    """
+
+    def __init__(self, max_readers: int = 64,
+                 max_bytes: int = 64 << 20,
                  instrument=None):
         self._readers: OrderedDict[tuple, DataFileSetReader] = OrderedDict()
         self._series: OrderedDict[tuple, list] = OrderedDict()
+        self._series_bytes = 0
         self.max_readers = max_readers
-        self.max_series_blocks = max_series_blocks
+        self.max_bytes = max_bytes
         self._lock = threading.Lock()
+        self._inflight: dict[tuple, threading.Event] = {}
         self._scope = (
             instrument.scope("block_cache") if instrument is not None else None
         )
@@ -44,14 +67,17 @@ class BlockCache:
                 self._readers.move_to_end(key)
                 return r
         r = DataFileSetReader(root, namespace, shard, block_start, volume)
-        evicted = []
         with self._lock:
             self._readers[key] = r
             self._readers.move_to_end(key)
             while len(self._readers) > self.max_readers:
-                evicted.append(self._readers.popitem(last=False)[1])
-        for old in evicted:  # release the persistent data handles
-            old.close()
+                # Drop the pool's reference only: a concurrent borrower
+                # may still be mid-read on the evicted reader, and
+                # closing its mmap under it would poison that read.
+                # The reader's __del__ closes the handles once the last
+                # borrower releases it (refcount close-deferral — the
+                # role of the seek manager's borrow counts).
+                self._readers.popitem(last=False)
         return r
 
     # -- decoded blocks (WiredList role) -----------------------------------
@@ -61,25 +87,44 @@ class BlockCache:
         """Decoded [(ts, value)] for one series-block, or None when the
         fileset has no entry for `sid`."""
         key = (str(root), namespace, shard, block_start, volume, sid)
-        with self._lock:
-            if key in self._series:
-                self._series.move_to_end(key)
-                if self._scope is not None:
-                    self._scope.counter("hits").inc()
-                return self._series[key]
+        while True:
+            with self._lock:
+                if key in self._series:
+                    self._series.move_to_end(key)
+                    if self._scope is not None:
+                        self._scope.counter("hits").inc()
+                    return self._series[key]
+                ev = self._inflight.get(key)
+                if ev is None:
+                    # this thread owns the fetch (single-flight)
+                    self._inflight[key] = threading.Event()
+                    break
+            # another thread is decoding the same series-block: wait and
+            # re-check the cache instead of duplicating the disk read
+            ev.wait()
         if self._scope is not None:
             self._scope.counter("misses").inc()
-        seg = self.reader(root, namespace, shard, block_start, volume).read(sid)
-        pts = (
-            [(d.timestamp, d.value) for d in decode_series(seg)]
-            if seg else None
-        )
-        with self._lock:
-            self._series[key] = pts
-            self._series.move_to_end(key)
-            while len(self._series) > self.max_series_blocks:
-                self._series.popitem(last=False)
-        return pts
+        try:
+            seg = self.reader(root, namespace, shard, block_start,
+                              volume).read(sid)
+            pts = (
+                [(d.timestamp, d.value) for d in decode_series(seg)]
+                if seg else None
+            )
+            with self._lock:
+                self._series[key] = pts
+                self._series.move_to_end(key)
+                self._series_bytes += _entry_bytes(pts)
+                while (self._series_bytes > self.max_bytes
+                       and len(self._series) > 1):
+                    _, old = self._series.popitem(last=False)
+                    self._series_bytes -= _entry_bytes(old)
+                    if self._scope is not None:
+                        self._scope.counter("evictions").inc()
+            return pts
+        finally:
+            with self._lock:
+                self._inflight.pop(key).set()
 
     # -- invalidation ------------------------------------------------------
 
@@ -87,7 +132,6 @@ class BlockCache:
                          block_start: int) -> None:
         """Drop every volume's entries for one block (cold flush wrote a
         superseding volume; cleanup removed the files)."""
-        closing = []
         with self._lock:
             for store in (self._readers, self._series):
                 dead = [
@@ -96,18 +140,16 @@ class BlockCache:
                 ]
                 for k in dead:
                     item = store.pop(k)
-                    if store is self._readers:
-                        closing.append(item)
-        for r in closing:
-            r.close()
+                    if store is not self._readers:
+                        self._series_bytes -= _entry_bytes(item)
+                    # evicted readers close via refcount (__del__), not
+                    # here — a borrower may still be reading
 
     def clear(self) -> None:
         with self._lock:
-            readers = list(self._readers.values())
-            self._readers.clear()
+            self._readers.clear()  # refcount close-deferral as above
             self._series.clear()
-        for r in readers:
-            r.close()
+            self._series_bytes = 0
 
     @property
     def stats(self) -> dict:
@@ -115,4 +157,5 @@ class BlockCache:
             return {
                 "readers": len(self._readers),
                 "series_blocks": len(self._series),
+                "series_bytes": self._series_bytes,
             }

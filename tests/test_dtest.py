@@ -196,3 +196,76 @@ class TestDtestScenarios:
             assert len(out) == 2  # both hosts
         finally:
             node.kill()
+
+
+@pytest.mark.slow
+class TestAgentLifecycle:
+    """m3em-agent scenario: the dtest driver manages a node purely
+    through the agent's HTTP surface (reference m3em operator verbs)."""
+
+    def test_setup_start_crash_restart_teardown(self, tmp_path):
+        from m3_tpu.dtest.agent import AgentClient, serve_agent_background
+
+        srv = serve_agent_background(str(tmp_path / "agent"))
+        client = AgentClient(srv.server_address)
+        try:
+            cfg = """
+db:
+  root: {root}
+  namespaces:
+    default: {{num_shards: 2}}
+coordinator: {{listen_port: 0}}
+mediator: {{enabled: false}}
+"""
+            out = client.setup("n1", cfg.format(root=tmp_path / "agent" / "n1" / "data"))
+            assert out["name"] == "n1"
+            st = client.start("n1")
+            assert st["alive"] and st["port"]
+            port = st["port"]
+
+            # write through the node's own HTTP API
+            import urllib.request
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/v1/json/write",
+                data=json.dumps([{"tags": {"__name__": "am"},
+                                  "timestamp": START_S + 10,
+                                  "value": 5.0}]).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert json.load(r)["written"] == 1
+
+            # crash + heartbeat shows it dead; logs are retrievable
+            client.kill("n1")
+            assert not client.status()["nodes"]["n1"]["alive"]
+            assert isinstance(client.logs("n1"), bytes)
+
+            # restart through the agent: WAL recovery inside the node
+            st2 = client.start("n1")
+            assert st2["alive"]
+            url = (f"http://127.0.0.1:{st2['port']}/api/v1/query_range?"
+                   f"query=am&start={START_S}&end={START_S + 100}&step=10s")
+            with urllib.request.urlopen(url, timeout=60) as r:
+                out = json.load(r)
+            assert out["data"]["result"], out
+
+            client.teardown("n1")
+            assert "n1" not in client.status()["nodes"]
+            assert not (tmp_path / "agent" / "n1").exists()
+        finally:
+            srv.agent.close()
+            srv.shutdown()
+            srv.server_close()
+
+
+class TestAgentNameSafety:
+    def test_path_escaping_names_rejected(self, tmp_path):
+        from m3_tpu.dtest.agent import Agent
+
+        a = Agent(str(tmp_path / "w"))
+        for bad in ("../x", "a/b", "..", "", "x" * 65, "a\x00b"):
+            with pytest.raises(ValueError):
+                a.setup(bad, "db: {}")
+            with pytest.raises((ValueError, KeyError)):
+                a.teardown(bad)
+        a.close()

@@ -1,0 +1,157 @@
+"""InfluxDB line-protocol ingest + database-create admin endpoint.
+
+Reference parity: `src/query/api/v1/handler/influxdb/write.go` (field
+promotion to __name__, value typing) and
+`handler/database/create.go` (retention-recommended block sizes,
+local placement bring-up).
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from m3_tpu.server.influx import (
+    LineProtocolError,
+    parse_lines,
+    points_to_writes,
+)
+
+NS = 10**9
+
+
+class TestLineProtocol:
+    def test_basic_line(self):
+        pts = parse_lines("cpu,host=h1,dc=east usage=0.5,sys=1i 1600000000000000000")
+        assert len(pts) == 1
+        p = pts[0]
+        assert p.measurement == b"cpu"
+        assert p.tags == ((b"dc", b"east"), (b"host", b"h1"))
+        assert p.fields == ((b"usage", 0.5), (b"sys", 1.0))
+        assert p.timestamp_nanos == 1600000000000000000
+
+    def test_precision_and_default_now(self):
+        pts = parse_lines("m v=1 1600000000", precision="s")
+        assert pts[0].timestamp_nanos == 1600000000 * NS
+        pts = parse_lines("m v=1", now_nanos=42)
+        assert pts[0].timestamp_nanos == 42
+
+    def test_escapes_and_quotes(self):
+        pts = parse_lines(
+            'disk\\ usage,path=/var\\,log used=9,note="a b, c=d",ok=true 5')
+        p = pts[0]
+        assert p.measurement == b"disk usage"
+        assert p.tags == ((b"path", b"/var,log"),)
+        # string field skipped; bool -> 1.0
+        assert p.fields == ((b"used", 9.0), (b"ok", 1.0))
+
+    def test_bad_lines_raise(self):
+        with pytest.raises(LineProtocolError):
+            parse_lines("novalue")
+        with pytest.raises(LineProtocolError):
+            parse_lines("m,tagnoeq v=1 5")
+        with pytest.raises(LineProtocolError):
+            parse_lines('m v="unterminated 5')
+        with pytest.raises(LineProtocolError):
+            parse_lines("m v=abc 5")
+        with pytest.raises(LineProtocolError):
+            parse_lines("m v=1 notanum")
+
+    def test_field_name_promotion(self):
+        docs, ts, vals = points_to_writes(
+            parse_lines("cpu,host=h usage=1,value=2 7"))
+        names = sorted(d.tags()[b"__name__"] for d in docs)
+        # 'value' keeps the bare measurement name (influx convention);
+        # other fields promote to measurement_field
+        assert names == [b"cpu", b"cpu_usage"]
+        assert ts == [7, 7] and sorted(vals) == [1.0, 2.0]
+
+    def test_escaped_equals_in_field_key(self):
+        pts = parse_lines("m a\\=b=2,c=3 5")
+        assert pts[0].fields == ((b"a=b", 2.0), (b"c", 3.0))
+
+    def test_comments_and_blank_lines(self):
+        pts = parse_lines("# a comment\n\nm v=3 9\n")
+        assert len(pts) == 1 and pts[0].fields == ((b"v", 3.0),)
+
+
+class TestInfluxHttpWrite:
+    def test_write_then_query(self, tmp_path):
+        from m3_tpu.query.engine import Engine
+        from m3_tpu.query.storage_adapter import DatabaseStorage
+        from m3_tpu.server.http_api import ApiContext, serve_background
+        from m3_tpu.storage.database import (
+            Database, DatabaseOptions, NamespaceOptions,
+        )
+
+        db = Database(DatabaseOptions(root=str(tmp_path)),
+                      {"default": NamespaceOptions(num_shards=2)})
+        srv = serve_background(ApiContext(db), "127.0.0.1", 0)
+        try:
+            port = srv.server_address[1]
+            t0 = 1_600_000_000
+            body = "\n".join(
+                f"reqs,host=h{k % 2} count={k}i {t0 + k * 10}"
+                for k in range(12)
+            )
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/v1/influxdb/write?precision=s",
+                data=body.encode(),
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert r.status == 204
+                assert int(r.headers["X-Written"]) == 12
+            url = (f"http://127.0.0.1:{port}/api/v1/query_range?"
+                   f"query=reqs_count&start={t0}&end={t0 + 120}&step=10s")
+            with urllib.request.urlopen(url, timeout=30) as r:
+                out = json.load(r)
+            assert out["status"] == "success"
+            assert len(out["data"]["result"]) == 2  # one series per host
+        finally:
+            srv.shutdown()
+            srv.server_close()
+            db.close()
+
+
+class TestDatabaseCreate:
+    def test_create_namespace_and_local_placement(self, tmp_path):
+        from m3_tpu.cluster.kv import KVStore
+        from m3_tpu.server.admin_api import (
+            AdminContext, serve_admin_background,
+        )
+
+        kv = KVStore(str(tmp_path))
+        srv = serve_admin_background(AdminContext(kv, None))
+        try:
+            port = srv.server_address[1]
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/v1/database/create",
+                data=json.dumps({
+                    "type": "local",
+                    "namespaceName": "metrics_10s_48h",
+                    "retentionTime": "48h",
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as r:
+                out = json.load(r)
+            # 48h retention -> 2h recommended block size (ladder)
+            assert out["namespace"]["block_size_nanos"] == 2 * 3600 * NS
+            assert out["placement"]["replica_factor"] == 1
+            # a second create must NOT clobber the placement
+            req2 = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/v1/database/create",
+                data=json.dumps({
+                    "namespaceName": "agg_1m_720h",
+                    "retentionTime": "720h",
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req2, timeout=10) as r:
+                out2 = json.load(r)
+            assert out2["namespace"]["block_size_nanos"] == 12 * 3600 * NS
+            assert out2["placement"] is None
+        finally:
+            srv.shutdown()
+            srv.server_close()

@@ -177,13 +177,55 @@ class TestBlockCache:
         assert len(after) == len(before) + 1
         db.close()
 
-    def test_bounded(self, tmp_path):
-        c = BlockCache(max_readers=2, max_series_blocks=3)
+    def test_byte_budget_bounded(self, tmp_path):
+        """WiredList model: the decoded-block cache is bounded by BYTES,
+        evicting least-recently-used series-blocks."""
+        from m3_tpu.storage.block_cache import _entry_bytes
+
+        c = BlockCache(max_readers=2, max_bytes=2000)
+        with c._lock:
+            pass  # lock exists and is not held by the public path below
+        # simulate inserts through the accounting path
         for i in range(10):
-            c._series[("k", i)] = []
-            while len(c._series) > c.max_series_blocks:
-                c._series.popitem(last=False)
-        assert len(c._series) <= 3
+            pts = [(k, float(k)) for k in range(20)]  # 120 + 320 bytes
+            with c._lock:
+                c._series[("k", i)] = pts
+                c._series_bytes += _entry_bytes(pts)
+                while c._series_bytes > c.max_bytes and len(c._series) > 1:
+                    _, old = c._series.popitem(last=False)
+                    c._series_bytes -= _entry_bytes(old)
+        assert c._series_bytes <= c.max_bytes
+        assert 0 < len(c._series) < 10
+        assert c.stats["series_bytes"] == c._series_bytes
+
+    def test_single_flight_coalesces(self, tmp_path):
+        """Concurrent cold reads of one series-block pay one decode."""
+        import threading
+
+        calls = {"n": 0}
+        gate = threading.Event()
+
+        class _FakeReader:
+            def read(self, sid):
+                calls["n"] += 1
+                gate.wait(2)
+                return None
+
+        c = BlockCache()
+        c.reader = lambda *a, **k: _FakeReader()
+        out = []
+
+        def go():
+            out.append(c.read_series("r", "ns", 0, 0, 0, b"x"))
+
+        ts = [threading.Thread(target=go) for _ in range(6)]
+        for t in ts:
+            t.start()
+        gate.set()
+        for t in ts:
+            t.join()
+        assert calls["n"] == 1
+        assert out == [None] * 6
 
 
 class TestTracing:
