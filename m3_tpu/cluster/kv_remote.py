@@ -1,0 +1,268 @@
+"""External coordination binding: the KV control plane as a service.
+
+Equivalent of the reference's etcd layer (`src/cluster/kv` over
+`src/cluster/client/etcd/client.go`): placements, namespaces, topics,
+rules and elections live in a store that SURVIVES the nodes — every
+node process dials it instead of owning a file-backed copy.  etcd
+itself collapses to the framework's own framed-TCP service around the
+existing ``KVStore`` (versioned values, CAS, watches):
+
+* ``KVServer`` — hosts one authoritative ``KVStore`` (file-backed for
+  durability) behind the msg/protocol framing.
+* ``RemoteKVStore`` — implements the exact ``KVStore`` method surface
+  (get/set/set_if_not_exists/check_and_set/delete/keys/watch) over a
+  connection, so ``PlacementService``, ``NamespaceRegistry``,
+  ``TopicService``, ``RuntimeOptionsManager`` and ``LeaderElection``
+  work unchanged against the remote plane — CAS conflicts raise the
+  same ValueError/KeyError the local store raises.
+* watches poll on a short interval over a dedicated connection (the
+  reference's etcd watch channels; polling keeps the protocol
+  request/response only).
+
+Cross-process leader election follows for free: ``LeaderElection``'s
+TTL-lease CAS runs against the shared remote store, so aggregator
+leader/follower pairs in different processes elect exactly one emitter.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+from typing import Callable, Tuple
+
+from m3_tpu.cluster.kv import KVStore, VersionedValue
+from m3_tpu.msg.protocol import ProtocolError, recv_frame, send_frame
+
+KV_REQ = 24
+KV_OK = 25
+KV_ERR = 26
+
+M_GET = 1
+M_SET = 2
+M_SET_NX = 3
+M_CAS = 4
+M_DELETE = 5
+M_KEYS = 6
+
+
+def _pack(b: bytes) -> bytes:
+    return struct.pack("<I", len(b)) + b
+
+
+def _unpack(raw: bytes, pos: int):
+    (n,) = struct.unpack_from("<I", raw, pos)
+    return raw[pos + 4 : pos + 4 + n], pos + 4 + n
+
+
+class _KVHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        srv: KVServer = self.server
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        while True:
+            try:
+                frame = recv_frame(sock)
+            except (ProtocolError, OSError):
+                return
+            if frame is None or frame[0] != KV_REQ:
+                return
+            payload = frame[1]
+            try:
+                if not payload:
+                    raise ProtocolError("empty kv request")
+                resp = self._dispatch(srv.store, payload[0], payload[1:])
+                send_frame(sock, KV_OK, resp)
+            except Exception as e:  # typed error frame, conn survives
+                try:
+                    send_frame(
+                        sock, KV_ERR,
+                        f"{type(e).__name__}\x00{e}".encode()[:4096])
+                except OSError:
+                    return
+
+    def _dispatch(self, store: KVStore, method: int, raw: bytes) -> bytes:
+        if method == M_GET:
+            key, _ = _unpack(raw, 0)
+            v = store.get(key.decode())
+            if v is None:
+                return b"\x00"
+            return b"\x01" + struct.pack("<q", v.version) + v.data
+        if method == M_SET:
+            key, pos = _unpack(raw, 0)
+            data, _ = _unpack(raw, pos)
+            return struct.pack("<q", store.set(key.decode(), data))
+        if method == M_SET_NX:
+            key, pos = _unpack(raw, 0)
+            data, _ = _unpack(raw, pos)
+            return struct.pack("<q", store.set_if_not_exists(key.decode(), data))
+        if method == M_CAS:
+            key, pos = _unpack(raw, 0)
+            (expect,) = struct.unpack_from("<q", raw, pos)
+            data, _ = _unpack(raw, pos + 8)
+            return struct.pack(
+                "<q", store.check_and_set(key.decode(), expect, data))
+        if method == M_DELETE:
+            key, _ = _unpack(raw, 0)
+            return b"\x01" if store.delete(key.decode()) else b"\x00"
+        if method == M_KEYS:
+            keys = store.keys()
+            return struct.pack("<I", len(keys)) + b"".join(
+                _pack(k.encode()) for k in keys)
+        raise ProtocolError(f"unknown kv method {method}")
+
+
+class KVServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, store: KVStore | None = None, root: str | None = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.store = store if store is not None else KVStore(root)
+        super().__init__((host, port), _KVHandler)
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+
+def serve_kv_background(root: str | None = None, host: str = "127.0.0.1",
+                        port: int = 0, store: KVStore | None = None) -> KVServer:
+    srv = KVServer(store=store, root=root, host=host, port=port)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+class RemoteKVStore:
+    """KVStore-shaped client over one connection (+ one watch poller).
+
+    Errors raised by the authoritative store come back typed: CAS
+    conflicts re-raise as ValueError, set_if_not_exists duplicates as
+    KeyError — identical to the local store so callers (elections,
+    placement CAS loops) are transport-agnostic."""
+
+    _RERAISE = {"ValueError": ValueError, "KeyError": KeyError}
+
+    def __init__(self, address: Tuple[str, int], timeout_s: float = 30.0,
+                 watch_poll_s: float = 2.0):
+        # watch_poll_s: control-plane objects change rarely; every
+        # watched key costs one round-trip per tick, so the default
+        # favors low idle load (tests pass a small value).
+        self.address = tuple(address)
+        self.timeout_s = timeout_s
+        self._sock: socket.socket | None = None
+        self._mu = threading.Lock()       # connection
+        self._wmu = threading.Lock()      # watcher registry
+        self._watch_poll_s = watch_poll_s
+        self._watchers: dict[str, list[Callable]] = {}
+        self._watch_seen: dict[str, int] = {}
+        self._watch_thread: threading.Thread | None = None
+        self._closed = threading.Event()
+
+    def _call(self, method: int, body: bytes) -> bytes:
+        if self._closed.is_set():
+            raise ConnectionError(f"kv {self.address}: store closed")
+        with self._mu:
+            try:
+                if self._sock is None:
+                    self._sock = socket.create_connection(
+                        self.address, timeout=self.timeout_s)
+                    self._sock.setsockopt(
+                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                send_frame(self._sock, KV_REQ, bytes([method]) + body)
+                frame = recv_frame(self._sock)
+            except (OSError, ProtocolError) as e:
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+                raise ConnectionError(f"kv {self.address}: {e}") from e
+        if frame is None:
+            raise ConnectionError(f"kv {self.address}: closed")
+        ftype, payload = frame
+        if ftype == KV_ERR:
+            tname, _, msg = payload.decode(errors="replace").partition("\x00")
+            raise self._RERAISE.get(tname, RuntimeError)(msg)
+        return payload
+
+    # -- KVStore surface --
+
+    def get(self, key: str) -> VersionedValue | None:
+        raw = self._call(M_GET, _pack(key.encode()))
+        if raw[0] == 0:
+            return None
+        (version,) = struct.unpack_from("<q", raw, 1)
+        return VersionedValue(version, raw[9:])
+
+    def set(self, key: str, data: bytes) -> int:
+        raw = self._call(M_SET, _pack(key.encode()) + _pack(data))
+        return struct.unpack("<q", raw)[0]
+
+    def set_if_not_exists(self, key: str, data: bytes) -> int:
+        raw = self._call(M_SET_NX, _pack(key.encode()) + _pack(data))
+        return struct.unpack("<q", raw)[0]
+
+    def check_and_set(self, key: str, expect_version: int, data: bytes) -> int:
+        raw = self._call(
+            M_CAS,
+            _pack(key.encode()) + struct.pack("<q", expect_version) + _pack(data),
+        )
+        return struct.unpack("<q", raw)[0]
+
+    def delete(self, key: str) -> bool:
+        return self._call(M_DELETE, _pack(key.encode())) == b"\x01"
+
+    def keys(self) -> list:
+        raw = self._call(M_KEYS, b"")
+        (n,) = struct.unpack_from("<I", raw, 0)
+        pos = 4
+        out = []
+        for _ in range(n):
+            k, pos = _unpack(raw, pos)
+            out.append(k.decode())
+        return out
+
+    def watch(self, key: str, fn: Callable[[VersionedValue], None]) -> None:
+        """Fire on every observed version change (etcd watch channel
+        role, implemented as a version poll)."""
+        with self._wmu:
+            self._watchers.setdefault(key, []).append(fn)
+        cur = self.get(key)
+        if cur is not None:
+            self._watch_seen[key] = cur.version
+            fn(cur)
+        if self._watch_thread is None:
+            self._watch_thread = threading.Thread(
+                target=self._watch_loop, daemon=True)
+            self._watch_thread.start()
+
+    def _watch_loop(self) -> None:
+        while not self._closed.wait(self._watch_poll_s):
+            with self._wmu:
+                keys = list(self._watchers)
+            for key in keys:
+                try:
+                    cur = self.get(key)
+                except (ConnectionError, RuntimeError):
+                    continue
+                if cur is None:
+                    continue
+                if cur.version != self._watch_seen.get(key):
+                    self._watch_seen[key] = cur.version
+                    with self._wmu:
+                        fns = list(self._watchers.get(key, ()))
+                    for fn in fns:
+                        fn(cur)
+
+    def close(self) -> None:
+        self._closed.set()
+        with self._mu:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None

@@ -122,6 +122,11 @@ class DBConfig:
     rpc_listen_port: Optional[int] = None
     peers: list = dataclasses.field(default_factory=list)
     bootstrap_peers: bool = False
+    # External control plane (cluster/kv_remote.py): "host:port" of a
+    # KV service shared by the cluster; None keeps the control plane
+    # file-backed inside this node (single-node deployments).  The
+    # reference's etcd endpoint role (client/etcd/client.go).
+    kv_endpoint: Optional[str] = None
 
     def validate(self, errs: list) -> None:
         if not self.namespaces:
@@ -136,6 +141,11 @@ class DBConfig:
             host, _, port = p.rpartition(":") if isinstance(p, str) else ("", "", "")
             if not host or not port.isdigit() or not (0 < int(port) < 65536):
                 errs.append(f"db.peers: expected 'host:port', got {p!r}")
+        if self.kv_endpoint is not None:
+            host, _, port = self.kv_endpoint.rpartition(":")
+            if not host or not port.isdigit() or not (0 < int(port) < 65536):
+                errs.append(
+                    f"db.kv_endpoint: expected 'host:port', got {self.kv_endpoint!r}")
         if self.bootstrap_peers and not self.peers:
             errs.append("db.bootstrap_peers requires db.peers")
 

@@ -71,7 +71,8 @@ class Agent:
             return {"name": name, "root": str(root)}
 
     def _node(self, name: str) -> NodeProcess:
-        node = self.nodes.get(name)
+        with self._mu:
+            node = self.nodes.get(name)
         if node is None:
             raise KeyError(f"unknown node {name!r}; setup first")
         return node
@@ -101,8 +102,10 @@ class Agent:
     def status(self) -> dict:
         """The heartbeat payload (m3em agent heartbeats carry process
         liveness the same way)."""
+        with self._mu:
+            snapshot = list(self.nodes.items())
         out = {}
-        for name, node in self.nodes.items():
+        for name, node in snapshot:
             st = {"alive": node.alive(), "port": node.port}
             if node.status_path.exists():
                 try:
@@ -120,7 +123,9 @@ class Agent:
         return data[-tail:]
 
     def close(self) -> None:
-        for node in list(self.nodes.values()):
+        with self._mu:
+            nodes = list(self.nodes.values())
+        for node in nodes:
             node.kill()
 
 
@@ -158,10 +163,12 @@ class _AgentHandler(BaseHTTPRequestHandler):
             return self._json(400, {"error": str(e)})
 
     def do_POST(self):
-        n = int(self.headers.get("Content-Length", 0))
-        body = json.loads(self.rfile.read(n)) if n else {}
         path = self.path.rstrip("/")
         try:
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n)) if n else {}
+            if not isinstance(body, dict):
+                raise ValueError("request body must be a JSON object")
             if path == "/setup":
                 return self._json(200, self.agent.setup(
                     body["name"], body["config_yaml"]))
@@ -175,10 +182,10 @@ class _AgentHandler(BaseHTTPRequestHandler):
             if path == "/teardown":
                 return self._json(200, self.agent.teardown(body["name"]))
             return self._json(404, {"error": f"unknown path {path}"})
-        except (KeyError, ValueError) as e:
+        except (KeyError, ValueError, TypeError, json.JSONDecodeError) as e:
             return self._json(400, {"error": str(e)})
-        except (RuntimeError, TimeoutError) as e:
-            return self._json(500, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 — never drop the socket
+            return self._json(500, {"error": f"{type(e).__name__}: {e}"})
 
 
 def serve_agent_background(workdir: str, host: str = "127.0.0.1",

@@ -1,0 +1,190 @@
+"""Protobuf descriptor bridge for the proto value codec.
+
+Equivalent of the reference's descriptor-driven proto encoding
+(`src/dbnode/encoding/proto/encoder.go` parses real protobuf schemas;
+the schema travels as a FileDescriptorSet annotation and nested
+messages compress field-by-field).  The columnar codec in
+``proto_codec.py`` stays the compression engine; this module maps real
+protobuf message descriptors onto its (name, kind) schema:
+
+* scalar fields map directly (ints/enums/bool -> INT, float/double ->
+  FLOAT, string/bytes -> BYTES so they ride the byte-field LRU);
+* NESTED message fields flatten to dotted column names
+  (``outer.inner.value``), arbitrarily deep — the columnar model's
+  answer to the reference's recursive custom marshal;
+* the schema annotation is a serialized FileDescriptorSet plus the
+  fully-qualified message name (``pack_schema_annotation``), the same
+  payload shape the reference stores, so it can ride the codec
+  annotation path (commitlog annotations / M3TSZ first-datapoint
+  annotations).
+
+Out of scope (explicit errors, host fallback): repeated fields, maps,
+and ``oneof`` groups — the reference's custom marshal handles these
+through proto reflection; this framework keeps the device-friendly
+dense-column contract.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from m3_tpu.encoding.proto_codec import FieldKind, Schema
+
+class UnsupportedFieldError(ValueError):
+    pass
+
+
+def _kind_for(field) -> FieldKind:
+    from google.protobuf import descriptor as _d
+
+    FD = _d.FieldDescriptor
+    if field.is_repeated:
+        raise UnsupportedFieldError(
+            f"repeated/map field {field.full_name!r} is host-fallback scope"
+        )
+    t = field.type
+    if t in (FD.TYPE_INT32, FD.TYPE_INT64, FD.TYPE_UINT32, FD.TYPE_UINT64,
+             FD.TYPE_SINT32, FD.TYPE_SINT64, FD.TYPE_FIXED32,
+             FD.TYPE_FIXED64, FD.TYPE_SFIXED32, FD.TYPE_SFIXED64,
+             FD.TYPE_ENUM):
+        return FieldKind.INT
+    if t in (FD.TYPE_FLOAT, FD.TYPE_DOUBLE):
+        return FieldKind.FLOAT
+    if t in (FD.TYPE_STRING, FD.TYPE_BYTES):
+        return FieldKind.BYTES
+    if t == FD.TYPE_BOOL:
+        return FieldKind.BOOL
+    raise UnsupportedFieldError(
+        f"field {field.full_name!r} type {t} unsupported"
+    )
+
+
+def schema_from_descriptor(desc, prefix: str = "",
+                           _depth: int = 0) -> Schema:
+    """Flatten a protobuf message Descriptor into the columnar schema.
+
+    Nested message fields recurse with dotted names; field order is the
+    declaration order at every level (deterministic wire order)."""
+    from google.protobuf import descriptor as _d
+
+    if _depth > 16:
+        raise UnsupportedFieldError("message nesting too deep")
+    fields: list[tuple[str, FieldKind]] = []
+    for field in desc.fields:
+        name = prefix + field.name
+        if field.type == _d.FieldDescriptor.TYPE_MESSAGE:
+            if field.is_repeated:
+                raise UnsupportedFieldError(
+                    f"repeated message field {field.full_name!r}"
+                )
+            sub = schema_from_descriptor(field.message_type, name + ".",
+                                         _depth + 1)
+            fields.extend(sub.fields)
+        else:
+            fields.append((name, _kind_for(field)))
+    return Schema(tuple(fields))
+
+
+def message_to_columns(msg) -> dict:
+    """Flatten one parsed protobuf message to {dotted name: value}
+    (schema order supplies defaults for unset scalar fields)."""
+    from google.protobuf import descriptor as _d
+
+    out: dict = {}
+
+    def walk(m, prefix: str):
+        for field in m.DESCRIPTOR.fields:
+            name = prefix + field.name
+            if field.type == _d.FieldDescriptor.TYPE_MESSAGE:
+                walk(getattr(m, field.name), name + ".")
+            else:
+                v = getattr(m, field.name)
+                if field.type == _d.FieldDescriptor.TYPE_STRING:
+                    v = v.encode()
+                elif field.type in (_d.FieldDescriptor.TYPE_FLOAT,
+                                    _d.FieldDescriptor.TYPE_DOUBLE):
+                    v = float(v)
+                out[name] = v
+
+    walk(msg, "")
+    return out
+
+
+def columns_to_message(msg, columns: dict):
+    """Fill a protobuf message instance from flattened columns; returns
+    the message (strings decode back from bytes)."""
+    from google.protobuf import descriptor as _d
+
+    def walk(m, prefix: str):
+        for field in m.DESCRIPTOR.fields:
+            name = prefix + field.name
+            if field.type == _d.FieldDescriptor.TYPE_MESSAGE:
+                walk(getattr(m, field.name), name + ".")
+                continue
+            v = columns.get(name)
+            if v is None:
+                continue
+            if field.type == _d.FieldDescriptor.TYPE_STRING:
+                v = v.decode() if isinstance(v, bytes) else v
+            elif field.type == _d.FieldDescriptor.TYPE_BOOL:
+                v = bool(v)
+            elif field.type in (_d.FieldDescriptor.TYPE_FLOAT,
+                                _d.FieldDescriptor.TYPE_DOUBLE):
+                v = float(v)
+            else:
+                v = int(v)
+            setattr(m, field.name, v)
+
+    walk(msg, "")
+    return msg
+
+
+# ---------------------------------------------------------------------------
+# Schema annotation: serialized FileDescriptorSet + message name — the
+# wire form the reference stores so decoders can rebuild the schema.
+# ---------------------------------------------------------------------------
+
+_SCHEMA_MAGIC = b"m3ps"
+
+
+def pack_schema_annotation(file_descriptor_set_bytes: bytes,
+                           message_name: str) -> bytes:
+    name = message_name.encode()
+    return (_SCHEMA_MAGIC + struct.pack("<H", len(name)) + name
+            + file_descriptor_set_bytes)
+
+
+def unpack_schema_annotation(raw: bytes):
+    """(FileDescriptorSet bytes, message name) or None when `raw` is
+    not a schema annotation."""
+    if not raw.startswith(_SCHEMA_MAGIC):
+        return None
+    (n,) = struct.unpack_from("<H", raw, 4)
+    name = raw[6 : 6 + n].decode()
+    return raw[6 + n :], name
+
+
+def descriptor_from_annotation(raw: bytes):
+    """Rebuild the message Descriptor from a schema annotation through a
+    fresh descriptor pool (the decode-side path: a node that has never
+    seen this schema learns it from the stream)."""
+    from google.protobuf import descriptor_pb2, descriptor_pool
+
+    unpacked = unpack_schema_annotation(raw)
+    if unpacked is None:
+        raise ValueError("not a schema annotation")
+    fds_bytes, message_name = unpacked
+    fds = descriptor_pb2.FileDescriptorSet()
+    fds.MergeFromString(fds_bytes)
+    pool = descriptor_pool.DescriptorPool()
+    for f in fds.file:
+        pool.Add(f)
+    return pool.FindMessageTypeByName(message_name)
+
+
+def message_class_for(desc):
+    """A concrete message class for a Descriptor (decode-side
+    materialization)."""
+    from google.protobuf import message_factory
+
+    return message_factory.GetMessageClass(desc)

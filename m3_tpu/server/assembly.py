@@ -68,6 +68,10 @@ class Assembly:
             self.http_server.server_close()
         if self.mediator is not None:
             self.mediator.close()
+        # the KV client closes only after every server that used it is
+        # down — a racing admin request must not reconnect a closed store
+        if self.kv is not None and hasattr(self.kv, "close"):
+            self.kv.close()
         self.db.close()
 
 
@@ -224,32 +228,39 @@ def run_node(source, start_mediator: bool | None = None,
                 AdminContext, serve_admin_background,
             )
 
-            asm.kv = KVStore(cfg.db.root)  # file-backed control plane
+            if cfg.db.kv_endpoint:
+                # shared external control plane (etcd role) — survives
+                # this node and is visible to every replica
+                from m3_tpu.cluster.kv_remote import RemoteKVStore
+
+                h, _, p = cfg.db.kv_endpoint.rpartition(":")
+                asm.kv = RemoteKVStore((h, int(p)))
+            else:
+                asm.kv = KVStore(cfg.db.root)  # file-backed control plane
             admin_ctx = AdminContext(asm.kv, db)
-            # live-tune the query limits through runtime options
-            # (runtime_options_manager.go's role for write/query limits)
-            for opt, lim in (("max_docs_matched", limits.docs),
-                             ("max_series_read", limits.series),
-                             ("max_bytes_read", limits.bytes)):
+            # live-tune query limits + cache budget through runtime
+            # options (runtime_options_manager.go's role)
+            def _limit_applier(lim):
                 def apply(value, _lim=lim):
                     _lim.limit = int(value)
+                return apply
+
+            appliers = [
+                ("max_docs_matched", _limit_applier(limits.docs)),
+                ("max_series_read", _limit_applier(limits.series)),
+                ("max_bytes_read", _limit_applier(limits.bytes)),
+                ("block_cache_max_bytes",
+                 lambda v: setattr(db.block_cache, "max_bytes", int(v))),
+            ]
+            for opt, apply in appliers:
                 admin_ctx.runtime.on_change(opt, apply)
                 # replay the persisted value: the KV watch fired during
                 # AdminContext construction, BEFORE this listener existed
-                # — a restart must re-apply tuned limits, not report
-                # them while running unprotected
+                # — a restart must re-apply tuned values, not report
+                # them while running untuned
                 persisted = admin_ctx.runtime.get(opt)
                 if persisted:
                     apply(persisted)
-
-            def apply_cache_budget(value):
-                db.block_cache.max_bytes = int(value)
-
-            admin_ctx.runtime.on_change("block_cache_max_bytes",
-                                        apply_cache_budget)
-            persisted = admin_ctx.runtime.get("block_cache_max_bytes")
-            if persisted:
-                apply_cache_budget(persisted)
             asm.admin_server = serve_admin_background(
                 admin_ctx, cfg.coordinator.listen_host,
                 cfg.coordinator.admin_listen_port,

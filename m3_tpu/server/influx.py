@@ -17,7 +17,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-PRECISION_NANOS = {"s": 10**9, "ms": 10**6, "us": 10**3, "u": 10**3, "ns": 1}
+PRECISION_NANOS = {"h": 3600 * 10**9, "m": 60 * 10**9, "s": 10**9,
+                   "ms": 10**6, "us": 10**3, "u": 10**3, "ns": 1, "n": 1}
 
 
 class LineProtocolError(ValueError):
@@ -218,7 +219,9 @@ def points_to_writes(points: list[InfluxPoint]):
     for p in points:
         for fname, fval in p.fields:
             name = p.measurement + b"_" + fname if fname != b"value" else p.measurement
-            tags = {b"__name__": name, **dict(p.tags)}
+            # promoted name wins over any literal __name__ point tag so
+            # the document's name and its series id always agree
+            tags = {**dict(p.tags), b"__name__": name}
             sid = name + b"{" + b",".join(
                 k + b"=" + v for k, v in sorted(p.tags)) + b"}"
             docs.append(Document.from_tags(sid, tags))

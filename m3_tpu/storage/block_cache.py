@@ -21,7 +21,10 @@ from m3_tpu.encoding.m3tsz import decode_series
 from m3_tpu.persist.fs import DataFileSetReader
 
 
-_POINT_BYTES = 16  # (int64 ts, float64 value)
+# Python-object cost of one cached (ts, value) tuple: the tuple header
+# (~56 B) + an int and a float object (~60 B) + the list slot (8 B).
+# Budgeting raw payload (16 B) would admit ~6x the configured memory.
+_POINT_BYTES = 124
 _ENTRY_OVERHEAD = 120  # key tuple + list object bookkeeping, approximate
 
 
