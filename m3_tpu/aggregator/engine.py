@@ -80,22 +80,52 @@ class MetricMap:
     arena's device-side last_at column through MetricList.expire).
     """
 
-    def __init__(self, capacity: int):
+    def __init__(self, capacity: int, use_native: bool | None = None):
         self.capacity = capacity
         self._slots: Dict[tuple, int] = {}
         self._ids: List[bytes | None] = []
         self._free: List[int] = []
         self.agg_mask = np.zeros(capacity, np.uint64)
+        # Native batch resolver (native/idmap.cc): the per-sample dict
+        # probe is the engine's host bottleneck at 1M-series scale
+        # (reference map.go:149 is a sharded concurrent map for the
+        # same reason).  The Python path remains as oracle + fallback.
+        self._native = None
+        if use_native is not False:
+            try:
+                from m3_tpu.native.idmap import NativeIdMap, available
+
+                if available():
+                    self._native = NativeIdMap(capacity)
+                    self._native_ids: List[bytes | None] = [None] * capacity
+            except Exception:  # pragma: no cover - toolchain-less host
+                self._native = None
 
     def __len__(self) -> int:
-        return len(self._slots)
+        return (len(self._native) if self._native is not None
+                else len(self._slots))
 
     def id_of(self, slot: int) -> bytes | None:
+        if self._native is not None:
+            return (self._native_ids[slot]
+                    if slot < len(self._native_ids) else None)
         return self._ids[slot] if slot < len(self._ids) else None
 
     def resolve(self, ids: Sequence[bytes], agg_id: AggregationID, mt: MetricType) -> np.ndarray:
         """Find-or-create slots for a batch of IDs."""
         mask = self._mask_for(agg_id, mt)
+        if self._native is not None:
+            try:
+                slots, new_pos = self._native.resolve(ids, mask)
+            except RuntimeError as e:
+                raise RuntimeError(
+                    f"metric map capacity {self.capacity} exhausted"
+                ) from e
+            for i in new_pos:
+                s = int(slots[i])
+                self._native_ids[s] = ids[i]
+                self.agg_mask[s] = np.uint64(mask)
+            return slots
         slots = np.empty(len(ids), np.int32)
         get = self._slots.get
         missing: List[int] = []
@@ -139,6 +169,14 @@ class MetricMap:
         return s
 
     def release(self, slot: int) -> None:
+        if self._native is not None:
+            mid = self._native_ids[slot] if slot < len(self._native_ids) else None
+            if mid is None:
+                return
+            self._native.release(mid, int(self.agg_mask[slot]))
+            self._native_ids[slot] = None
+            self.agg_mask[slot] = 0
+            return
         mid = self._ids[slot]
         if mid is None:
             return

@@ -1,0 +1,47 @@
+"""Shared build-on-demand loader for the native/ C++ modules.
+
+One implementation of the compile-if-stale + dlopen + cache pattern
+(previously copy-pasted per module): callers get a loaded CDLL or None
+— never an exception — so a toolchain-less or stale-artifact host
+degrades to the Python paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+
+_ROOT = Path(__file__).resolve().parent.parent.parent
+_cache: dict[str, object] = {}
+
+
+def load_native(src_name: str, so_name: str, extra_flags: tuple = ()):
+    """CDLL for native/<src_name> built into native/build/<so_name>,
+    or None when the toolchain/artifact is unusable.  Results (including
+    failures) are cached per so_name."""
+    if so_name in _cache:
+        return _cache[so_name]
+    _cache[so_name] = None
+    src = _ROOT / "native" / src_name
+    so = _ROOT / "native" / "build" / so_name
+    try:
+        stale = not so.exists() or so.stat().st_mtime < src.stat().st_mtime
+    except OSError:
+        stale = True
+    if stale:
+        so.parent.mkdir(parents=True, exist_ok=True)
+        try:
+            subprocess.run(
+                ["g++", "-O2", *extra_flags, "-shared", "-fPIC",
+                 "-o", str(so), str(src)],
+                check=True, capture_output=True, timeout=120,
+            )
+        except (subprocess.SubprocessError, FileNotFoundError, OSError):
+            return None
+    try:
+        lib = ctypes.CDLL(str(so))
+    except OSError:
+        return None
+    _cache[so_name] = lib
+    return lib

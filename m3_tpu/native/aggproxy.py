@@ -9,29 +9,13 @@ native/build/, ``available()`` gates callers.
 from __future__ import annotations
 
 import ctypes
-import subprocess
-from pathlib import Path
 
 import numpy as np
 
-_ROOT = Path(__file__).resolve().parent.parent.parent
-_SRC = _ROOT / "native" / "agg_bench.cc"
-_SO = _ROOT / "native" / "build" / "libaggbench.so"
+from m3_tpu.native._build import load_native
 
 _lib = None
 _tried = False
-
-
-def _build() -> bool:
-    _SO.parent.mkdir(parents=True, exist_ok=True)
-    try:
-        subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-o", str(_SO), str(_SRC)],
-            check=True, capture_output=True, timeout=120,
-        )
-        return True
-    except Exception:
-        return False
 
 
 def _load():
@@ -39,10 +23,9 @@ def _load():
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
-        if not _build():
-            return None
-    lib = ctypes.CDLL(str(_SO))
+    lib = load_native("agg_bench.cc", "libaggbench.so")
+    if lib is None:
+        return None
     u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
     i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
     f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
