@@ -98,7 +98,14 @@ class MetricMap:
                 if available():
                     self._native = NativeIdMap(capacity)
                     self._native_ids: List[bytes | None] = [None] * capacity
-            except Exception:  # pragma: no cover - toolchain-less host
+                elif use_native is True:
+                    raise RuntimeError("native idmap unavailable")
+            except Exception:
+                # Opportunistic mode (None) degrades silently to the
+                # Python path; an EXPLICIT use_native=True must not —
+                # silent 5x-slower fallback would corrupt perf numbers.
+                if use_native is True:
+                    raise
                 self._native = None
 
     def __len__(self) -> int:
@@ -135,13 +142,23 @@ class MetricMap:
                 missing.append(i)
             else:
                 slots[i] = s
-        for i in missing:
-            mid = ids[i]
-            s = self._slots.get((mid, mask))
-            if s is None:
-                s = self._allocate(mid, mask)
-                self.agg_mask[s] = np.uint64(mask)
-            slots[i] = s
+        allocated: List[int] = []
+        try:
+            for i in missing:
+                mid = ids[i]
+                s = self._slots.get((mid, mask))
+                if s is None:
+                    s = self._allocate(mid, mask)
+                    self.agg_mask[s] = np.uint64(mask)
+                    allocated.append(s)
+                slots[i] = s
+        except RuntimeError:
+            # All-or-nothing like the native resolver: roll this batch's
+            # allocations back so both paths leave identical state after
+            # a capacity-exhausted resolve.
+            for s in allocated:
+                self.release(s)
+            raise
         return slots
 
     def _mask_for(self, agg_id: AggregationID, mt: MetricType) -> int:

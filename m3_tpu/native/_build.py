@@ -9,6 +9,7 @@ degrades to the Python paths.
 from __future__ import annotations
 
 import ctypes
+import os
 import subprocess
 from pathlib import Path
 
@@ -31,13 +32,20 @@ def load_native(src_name: str, so_name: str, extra_flags: tuple = ()):
         stale = True
     if stale:
         so.parent.mkdir(parents=True, exist_ok=True)
+        # Compile to a unique temp path and rename into place: multiple
+        # processes sharing the checkout (the dtest harness) may build
+        # concurrently, and dlopen of a half-written .so would cache a
+        # permanent failure for that process.
+        tmp = so.with_suffix(f".tmp{os.getpid()}")
         try:
             subprocess.run(
                 ["g++", "-O2", *extra_flags, "-shared", "-fPIC",
-                 "-o", str(so), str(src)],
+                 "-o", str(tmp), str(src)],
                 check=True, capture_output=True, timeout=120,
             )
+            os.replace(tmp, so)
         except (subprocess.SubprocessError, FileNotFoundError, OSError):
+            tmp.unlink(missing_ok=True)
             return None
     try:
         lib = ctypes.CDLL(str(so))
