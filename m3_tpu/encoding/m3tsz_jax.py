@@ -853,10 +853,24 @@ def _decode_step(carry, _, words3, nbits, default_unit: int):
     pd = jnp.where(full64, _c(0, I64), pd)
 
     # ---- value ----
+    # Small-field chunk: every flag/sig/mult/sign read in the value
+    # section starts within 16 bits of the section origin on whichever
+    # path a lane takes (64-bit payload reads only precede reads that
+    # are inactive on that lane), so ONE 64-bit window read serves all
+    # thirteen of them as in-register shifts instead of full buffer
+    # funnels.  Inactive lanes may compute off >= 64: the guarded
+    # shifts return 0, matching a zero-width _peek.
+    v0 = cur
+    W = _peek(words, v0, _c(64, I32))
+
+    def rdw(cur_abs, n):
+        off = (cur_abs - v0).astype(U64)
+        return _shr(_shl(W, off), _c(64) - _c(n, I32).astype(U64))
+
     # first value
     f_active = proceed & first
     rd = jnp.where(f_active, _c(1, I32), _c(0, I32))
-    mode_bit = _peek(words, cur, rd)
+    mode_bit = rdw(cur, rd)
     cur = cur + rd
     f_is_float = f_active & (mode_bit == _c(1))
     rd = jnp.where(f_is_float, _c(64, I32), _c(0, I32))
@@ -866,16 +880,16 @@ def _decode_step(carry, _, words3, nbits, default_unit: int):
     # next-value branch bits
     n_active = proceed & ~first
     rd = jnp.where(n_active, _c(1, I32), _c(0, I32))
-    nb1 = _peek(words, cur, rd)
+    nb1 = rdw(cur, rd)
     cur = cur + rd
     upd = n_active & (nb1 == _c(0))  # opcodeUpdate = 0
     rd = jnp.where(upd, _c(1, I32), _c(0, I32))
-    nb2 = _peek(words, cur, rd)
+    nb2 = rdw(cur, rd)
     cur = cur + rd
     repeat = upd & (nb2 == _c(1))
     upd2 = upd & (nb2 == _c(0))
     rd = jnp.where(upd2, _c(1, I32), _c(0, I32))
-    nb3 = _peek(words, cur, rd)
+    nb3 = rdw(cur, rd)
     cur = cur + rd
     to_float = upd2 & (nb3 == _c(1))
     rd = jnp.where(to_float, _c(64, I32), _c(0, I32))
@@ -886,24 +900,24 @@ def _decode_step(carry, _, words3, nbits, default_unit: int):
     # readIntSigMult for first-int or next-int-update
     sig_rd_active = (f_active & ~f_is_float) | to_int_upd
     rd = jnp.where(sig_rd_active, _c(1, I32), _c(0, I32))
-    sb1 = _peek(words, cur, rd)
+    sb1 = rdw(cur, rd)
     cur = cur + rd
     sig_upd = sig_rd_active & (sb1 == _c(1))
     rd = jnp.where(sig_upd, _c(1, I32), _c(0, I32))
-    sb2 = _peek(words, cur, rd)
+    sb2 = rdw(cur, rd)
     cur = cur + rd
     sig_nonzero = sig_upd & (sb2 == _c(1))
     rd = jnp.where(sig_nonzero, _c(6, I32), _c(0, I32))
-    sigbits = _peek(words, cur, rd)
+    sigbits = rdw(cur, rd)
     cur = cur + rd
     new_sig = jnp.where(sig_upd & ~sig_nonzero, _c(0, I32),
                jnp.where(sig_nonzero, sigbits.astype(I32) + _c(1, I32), sig))
     rd = jnp.where(sig_rd_active, _c(1, I32), _c(0, I32))
-    mb1 = _peek(words, cur, rd)
+    mb1 = rdw(cur, rd)
     cur = cur + rd
     mult_upd = sig_rd_active & (mb1 == _c(1))
     rd = jnp.where(mult_upd, _c(3, I32), _c(0, I32))
-    multbits = _peek(words, cur, rd)
+    multbits = rdw(cur, rd)
     cur = cur + rd
     new_mult = jnp.where(mult_upd, multbits.astype(I32), mult)
     err = err | (mult_upd & (new_mult > _c(6, I32)))
@@ -913,7 +927,7 @@ def _decode_step(carry, _, words3, nbits, default_unit: int):
     diff_active = sig_rd_active | int_noupd
     eff_sig = jnp.where(int_noupd, sig, new_sig)
     rd = jnp.where(diff_active, _c(1, I32), _c(0, I32))
-    sign_bit = _peek(words, cur, rd)
+    sign_bit = rdw(cur, rd)
     cur = cur + rd
     rd = jnp.where(diff_active, eff_sig, _c(0, I32))
     diff_bits = _peek(words, cur, rd)
@@ -926,12 +940,12 @@ def _decode_step(carry, _, words3, nbits, default_unit: int):
     # XOR float next (n_active & ~upd & is_float)
     xor_active = n_active & (nb1 == _c(1)) & is_float
     rd = jnp.where(xor_active, _c(1, I32), _c(0, I32))
-    xb1 = _peek(words, cur, rd)
+    xb1 = rdw(cur, rd)
     cur = cur + rd
     xor_zero = xor_active & (xb1 == _c(0))
     xor_nz = xor_active & (xb1 == _c(1))
     rd = jnp.where(xor_nz, _c(1, I32), _c(0, I32))
-    xb2 = _peek(words, cur, rd)
+    xb2 = rdw(cur, rd)
     cur = cur + rd
     contained = xor_nz & (xb2 == _c(0))
     uncont = xor_nz & (xb2 == _c(1))
@@ -944,7 +958,7 @@ def _decode_step(carry, _, words3, nbits, default_unit: int):
     cbits = _peek(words, cur, rd)
     cur = cur + rd
     rd = jnp.where(uncont, _c(12, I32), _c(0, I32))
-    packed = _peek(words, cur, rd)
+    packed = rdw(cur, rd)
     cur = cur + rd
     u_lead = ((packed >> _c(6)) & _c(0x3F)).astype(I32)
     u_meaningful = (packed & _c(0x3F)).astype(I32) + _c(1, I32)
