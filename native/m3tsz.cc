@@ -17,6 +17,7 @@
 #include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -130,6 +131,55 @@ struct IStream {
   bool can(int n) const { return bitpos + n <= nbits; }
 };
 
+// Word-at-a-time bit reader for the batched path: one unaligned 9-byte
+// load per peek instead of IStream's byte loop.  Requires the caller to
+// guarantee >= 16 readable bytes past the stream end (the batch entry
+// points document this; the ctypes binding pads the concatenated buffer).
+struct FastIStream {
+  static_assert(__BYTE_ORDER__ == __ORDER_LITTLE_ENDIAN__,
+                "FastIStream's load+bswap word reads assume a little-endian "
+                "host; use IStream on big-endian builds");
+  const uint8_t* data;
+  int64_t nbits;
+  int64_t bitpos = 0;
+  bool eof = false;
+
+  uint64_t peek(int n) {
+    int64_t byte = bitpos >> 3;
+    int off = (int)(bitpos & 7);
+    uint64_t hi;
+    std::memcpy(&hi, data + byte, 8);
+    hi = __builtin_bswap64(hi);
+    unsigned __int128 w = ((unsigned __int128)hi << 8) | data[byte + 8];
+    uint64_t out = (uint64_t)(w >> (72 - off - n));
+    if (n < 64) out &= (1ULL << n) - 1;
+    return out;
+  }
+  uint64_t read(int n) {
+    if (n == 0) return 0;
+    if (bitpos + n > nbits) { eof = true; return 0; }
+    uint64_t v = peek(n);
+    bitpos += n;
+    return v;
+  }
+  bool can(int n) const { return bitpos + n <= nbits; }
+};
+
+// Run fn(lo, hi) over [0, B) split across up to nthreads OS threads.
+template <typename Fn>
+void parallel_for(long B, int nthreads, Fn fn) {
+  if (nthreads <= 1 || B <= 1) { fn(0, B); return; }
+  if (nthreads > B) nthreads = (int)B;
+  std::vector<std::thread> pool;
+  long chunk = (B + nthreads - 1) / nthreads;
+  for (int t = 0; t < nthreads; t++) {
+    long lo = t * chunk, hi = lo + chunk < B ? lo + chunk : B;
+    if (lo >= hi) break;
+    pool.emplace_back([=] { fn(lo, hi); });
+  }
+  for (auto& th : pool) th.join();
+}
+
 inline int num_sig(uint64_t v) { return v ? 64 - __builtin_clzll(v) : 0; }
 
 inline void lead_trail(uint64_t v, int* lead, int* trail) {
@@ -207,10 +257,12 @@ struct FloatXOR {
     }
     prev_xor = x; prev_bits = bits;
   }
-  void read_full(IStream& is) {
+  template <typename IS>
+  void read_full(IS& is) {
     prev_bits = is.read(64); prev_xor = prev_bits;
   }
-  void read_next(IStream& is) {
+  template <typename IS>
+  void read_next(IS& is) {
     uint64_t cb = is.read(1);
     if (cb == kOpcodeZeroValueXor) { prev_xor = 0; return; }
     cb = (cb << 1) | is.read(1);
@@ -439,14 +491,17 @@ long m3tsz_encode(const int64_t* ts, const double* vals, long n,
   return total;
 }
 
+}  // extern "C"
+
 // Decode a stream; returns count, -1 on small buffer, -2 unsupported
 // (annotation/time-unit markers), -3 corrupt.  Trace pointers may be null.
+template <typename IS>
 static long decode_impl(const uint8_t* data, long nbytes, int default_unit,
                         int64_t* out_ts, double* out_vals, uint8_t* out_isf,
                         uint8_t* out_sig, uint8_t* out_mult,
                         double* out_intval, long cap) {
   if (nbytes == 0) return 0;
-  IStream is{data, (int64_t)nbytes * 8};
+  IS is{data, (int64_t)nbytes * 8};
   Scheme scheme;
 
   int64_t prev_time = 0, prev_delta = 0;
@@ -576,24 +631,63 @@ static long decode_impl(const uint8_t* data, long nbytes, int default_unit,
 
 extern "C" long m3tsz_decode(const uint8_t* data, long nbytes, int default_unit,
                              int64_t* out_ts, double* out_vals, long cap) {
-  return decode_impl(data, nbytes, default_unit, out_ts, out_vals,
-                     nullptr, nullptr, nullptr, nullptr, cap);
+  return decode_impl<IStream>(data, nbytes, default_unit, out_ts, out_vals,
+                              nullptr, nullptr, nullptr, nullptr, cap);
 }
 
+// Debug trace: per-element (is_float, sig, mult, int_val) for parity
+// triage against the Python oracle.  Not part of the public surface.
 extern "C" long m3tsz_decode_trace(const uint8_t* data, long nbytes,
                                    int default_unit, int64_t* out_ts,
                                    double* out_vals, uint8_t* out_isf,
                                    uint8_t* out_sig, uint8_t* out_mult,
                                    double* out_intval, long cap) {
-  return decode_impl(data, nbytes, default_unit, out_ts, out_vals,
-                     out_isf, out_sig, out_mult, out_intval, cap);
+  return decode_impl<IStream>(data, nbytes, default_unit, out_ts, out_vals,
+                              out_isf, out_sig, out_mult, out_intval, cap);
 }
 
-// Debug trace: per-element (is_float, sig, mult, int_val) for parity
-// triage against the Python oracle.  Not part of the public surface.
-long m3tsz_decode_trace(const uint8_t* data, long nbytes, int default_unit,
-                        int64_t* out_ts, double* out_vals, uint8_t* out_isf,
-                        uint8_t* out_sig, uint8_t* out_mult,
-                        double* out_intval, long cap);
+// Batched decode: B streams concatenated in `data` at
+// [offsets[i], offsets[i+1]) byte ranges.  The buffer MUST stay readable
+// for >= 16 bytes past offsets[B] (FastIStream loads 9 bytes at a time);
+// the Python binding pads.  Series i's datapoints land in
+// out_ts/out_vals[i*max_points ...]; counts[i] gets the datapoint count
+// or the negative status (-1 cap, -2 unsupported, -3 corrupt).  Returns
+// the number of series with negative status.  `nthreads` <= 1 runs
+// inline; more splits series ranges across OS threads (the batch is
+// embarrassingly parallel).
+extern "C" long m3tsz_decode_batch(const uint8_t* data, const int64_t* offsets,
+                                   long B, int default_unit, int64_t* out_ts,
+                                   double* out_vals, long max_points,
+                                   int64_t* counts, int nthreads) {
+  parallel_for(B, nthreads, [=](long lo, long hi) {
+    for (long i = lo; i < hi; i++) {
+      counts[i] = decode_impl<FastIStream>(
+          data + offsets[i], offsets[i + 1] - offsets[i], default_unit,
+          out_ts + i * max_points, out_vals + i * max_points, nullptr,
+          nullptr, nullptr, nullptr, max_points);
+    }
+  });
+  long bad = 0;
+  for (long i = 0; i < B; i++) bad += counts[i] < 0;
+  return bad;
+}
 
-}  // extern "C"
+// Batched encode: series i is ts/vals[i*T .. i*T+ns[i]) started at
+// starts[i]; its stream is written at out[i*stride] and lens[i] gets the
+// byte length or negative status (-1 stride too small, -2 unsupported —
+// callers fall back per series).  Returns the number of negative lens.
+extern "C" long m3tsz_encode_batch(const int64_t* ts, const double* vals,
+                                   const int64_t* ns, long B, long T,
+                                   const int64_t* starts, int unit,
+                                   uint8_t* out, long stride, int64_t* lens,
+                                   int nthreads) {
+  parallel_for(B, nthreads, [=](long lo, long hi) {
+    for (long i = lo; i < hi; i++) {
+      lens[i] = m3tsz_encode(ts + i * T, vals + i * T, ns[i], starts[i], unit,
+                             out + i * stride, stride);
+    }
+  });
+  long bad = 0;
+  for (long i = 0; i < B; i++) bad += lens[i] < 0;
+  return bad;
+}

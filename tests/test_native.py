@@ -100,3 +100,66 @@ def test_roundtrip_fuzz():
         # both decoders must agree on that snapped value.)
         py_vals = np.array([d.value for d in decode_series(got)])
         np.testing.assert_array_equal(dvals, py_vals)
+
+
+def test_batch_roundtrip_matches_single():
+    """Batched encode/decode agree with the single-series entry points
+    (and therefore with the Python oracle) across mixed value shapes."""
+    rng = np.random.default_rng(13)
+    S, T = 64, 97
+    ts = np.tile(START + np.arange(1, T + 1) * 10 * 10**9, (S, 1)).astype(np.int64)
+    vals = np.empty((S, T))
+    vals[0::3] = rng.integers(-(10**6), 10**6, ((S + 2) // 3, T)).astype(float)
+    vals[1::3] = np.round(rng.normal(0, 100, ((S + 1) // 3, T)), 2)
+    vals[2::3] = rng.normal(0, 1e9, (S // 3, T))
+    starts = np.full(S, START, np.int64)
+    counts = rng.integers(1, T + 1, S)
+
+    streams, fb = native.encode_batch(ts, vals, starts, counts=counts)
+    assert not fb.any()
+    for i in (0, 1, 2, 31, S - 1):
+        n = int(counts[i])
+        assert streams[i] == native.encode_series(ts[i, :n], vals[i, :n], START)
+
+    dts, dvals, dcounts, dfb = native.decode_batch(streams, T + 1)
+    assert not dfb.any()
+    np.testing.assert_array_equal(dcounts, counts)
+    for i in range(S):
+        n = int(counts[i])
+        sts, svals = native.decode_series(streams[i], max_points=T + 1)
+        np.testing.assert_array_equal(dts[i, :n], sts)
+        np.testing.assert_array_equal(dvals[i, :n], svals)
+
+
+def test_batch_flags_bad_streams_and_continues():
+    """A rejected or truncated stream flags fallback without poisoning
+    its neighbours."""
+    ts = START + np.arange(1, 9) * 10**10
+    good = native.encode_series(ts, np.arange(8.0), START)
+
+    from m3_tpu.encoding.m3tsz import Encoder
+    enc = Encoder(START)
+    enc.encode(Datapoint(START + 10**10, 1.0, annotation=b"s1"))
+    annotated = enc.stream()
+
+    streams = [good, annotated, good[:5], good]
+    dts, dvals, counts, fb = native.decode_batch(streams, 16)
+    assert list(fb) == [False, True, True, False]
+    assert counts[0] == 8 and counts[3] == 8
+    np.testing.assert_array_equal(dts[0, :8], ts)
+    np.testing.assert_array_equal(dts[3, :8], ts)
+
+
+def test_batch_threaded_matches_inline():
+    rng = np.random.default_rng(5)
+    S, T = 40, 50
+    ts = np.tile(START + np.arange(1, T + 1) * 10**10, (S, 1)).astype(np.int64)
+    vals = np.round(rng.normal(0, 50, (S, T)), 1)
+    starts = np.full(S, START, np.int64)
+    s1, _ = native.encode_batch(ts, vals, starts, nthreads=1)
+    s4, _ = native.encode_batch(ts, vals, starts, nthreads=4)
+    assert s1 == s4
+    out1 = native.decode_batch(s1, T + 1, nthreads=1)
+    out4 = native.decode_batch(s1, T + 1, nthreads=4)
+    for a, b in zip(out1, out4):
+        np.testing.assert_array_equal(a, b)
