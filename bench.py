@@ -1,26 +1,32 @@
-"""Headline benchmark: batched M3TSZ decode on the attached accelerator.
+"""Headline benchmark: batched M3TSZ decode + aggregator north stars.
 
 BASELINE config #2 — "Batched M3TSZ decode: 100K series × 720-pt blocks
-(2h @10s) — parallel ReaderIterator".  The reference baseline is the one
-authoritative in-repo number: 69,272 ns per ~720-pt block decode ≈ 10.4M
-datapoints/s/core (`src/dbnode/encoding/m3tsz/decoder_benchmark_test.go:34`,
-see BASELINE.md).
+(2h @10s) — parallel ReaderIterator"; configs #3/#4 — the 1M-slot
+rollup and 10M-sample timer quantile aggregator benches.  The decode
+baseline is the one authoritative in-repo number: 69,272 ns per ~720-pt
+block decode ≈ 10.4M datapoints/s/core
+(`src/dbnode/encoding/m3tsz/decoder_benchmark_test.go:34`, BASELINE.md).
 
 Prints exactly ONE JSON line on stdout:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
-On any failure the line still appears, with an "error" field and the best
-result achieved before the failure (value 0 if none).  All diagnostics go
-to stderr.  Robustness measures (the round-1 run died in TPU backend init
-with no output at all):
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
-* The TPU backend is probed in a SUBPROCESS with a timeout first — a
-  hanging/failing PJRT init can't take down the benchmark; after retries
-  we fall back to the virtual CPU backend and still emit a number.
-* Sizes are staged (1K → 10K → 100K series); each completed stage's
-  result is also mirrored to stderr, so even a hard process death
-  (segfault/OOM in a later stage) leaves the largest completed stage's
-  numbers in the driver's captured output tail.  Stdout itself carries
-  exactly one JSON line, printed at the end.
+Architecture (round 4, after three rounds of environment-inflicted
+losses — r01 died in backend init, r02 produced lossy f64 TPU bytes,
+r03 lost the relay at minute 0 and never re-probed):
+
+* The PARENT process never initializes a JAX backend, so no PJRT hang
+  can take it down.  It benches the native (C++, threaded) batch decode
+  first — a guaranteed number within ~30s on any machine — then drives
+  everything else through budget-enforced CHILD processes that stream
+  incremental `RESULT {...}` JSON lines; a child dying or hanging
+  forfeits only its not-yet-reported stages.
+* The TPU relay is probed with a cheap TCP connect before any
+  subprocess budget is spent, and RE-probed after the CPU stages until
+  ~90s of deadline remain — a transient relay outage at minute 0 no
+  longer forfeits the round's TPU evidence.
+* The bit-exactness verdict is ALWAYS emitted (`validation` +
+  `validation_detail` fields), even when timing is cut short; every
+  aggregator block records the C/N/NT sizes it actually ran.
 * A global wall-clock deadline (M3_BENCH_DEADLINE_SEC, default 780s)
   gates every stage so the driver's timeout is never hit silently.
 """
@@ -30,6 +36,7 @@ from __future__ import annotations
 import functools
 import json
 import os
+import socket
 import subprocess
 import sys
 import time
@@ -39,7 +46,7 @@ import numpy as np
 GO_BASELINE_DPS = 720 / 69_272e-9  # ≈ 10.39M datapoints/s/core
 START = 1_600_000_000 * 10**9
 T_POINTS = 720
-ENC_CHUNK = 8192
+RELAY_PORT = int(os.environ.get("M3_AXON_RELAY_PORT", "8113"))
 
 _DEADLINE = time.monotonic() + float(os.environ.get("M3_BENCH_DEADLINE_SEC", "780"))
 
@@ -52,30 +59,21 @@ def _left() -> float:
     return _DEADLINE - time.monotonic()
 
 
-def _probe_tpu(timeout: float) -> str:
-    """Initialize the pinned backend in a subprocess so a hang can't kill us.
-
-    Returns "ok" | "cpu" (clean init but no accelerator — deterministic,
-    don't retry) | "timeout" (likely a persistent hang) | "fail"
-    (possibly transient init error — worth retrying).
-    """
-    code = "import jax; d = jax.devices(); print(d[0].platform, len(d))"
+def _relay_open(timeout: float = 3.0) -> bool:
+    """Cheap pre-check: is anything listening on the axon relay port?
+    A closed port means backend init would hang (the plugin retries
+    forever), so don't spend subprocess-probe budget on it."""
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return False
+    s = socket.socket()
+    s.settimeout(timeout)
     try:
-        p = subprocess.run(
-            [sys.executable, "-c", code],
-            timeout=timeout,
-            capture_output=True,
-            text=True,
-        )
-        _log("probe rc", p.returncode, (p.stdout or p.stderr).strip()[-200:])
-        if p.returncode != 0:
-            return "fail"
-        # A multi-platform pin (e.g. "axon,cpu") can exit 0 after silently
-        # falling back to CPU — require a real accelerator platform.
-        return "cpu" if p.stdout.startswith("cpu") else "ok"
-    except subprocess.TimeoutExpired:
-        _log(f"probe timed out after {timeout:.0f}s")
-        return "timeout"
+        s.connect(("127.0.0.1", RELAY_PORT))
+        return True
+    except OSError:
+        return False
+    finally:
+        s.close()
 
 
 def _make_corpus(S: int, T: int, seed: int = 42):
@@ -89,18 +87,142 @@ def _make_corpus(S: int, T: int, seed: int = 42):
     return ts, vals, starts
 
 
-def _run_agg_bench(kind: str, C: int = 1_000_000, N: int = 2_000_000,
-                   NT: int = 10_000_000) -> dict:
-    """BASELINE configs #3/#4: 1M-slot counter/gauge rollup and timer
-    p50/95/99 quantiles, device arenas vs the single-core C++ Go-proxy
-    (native/agg_bench.cc — deliberately generous to the baseline: dense
-    arrays instead of the reference's map+locks).
+def _encode_corpus(S: int, T: int):
+    """Encode the corpus with the native batch encoder (fast, no JAX).
+    Returns (streams, ts, vals) — encoding is corpus prep, never timed."""
+    from m3_tpu import native
 
-    Returns {"samples_per_sec": N, "vs_go_proxy": r, ...} for the kind.
-    Batches are device-resident; the timed region is ingest + window
-    drain, matching the Go proxy's ingest + flush.  ``C``/``N``/``NT``
-    shrink on the CPU fallback backend.
-    """
+    ts, vals, starts = _make_corpus(S, T)
+    out = native.encode_batch(ts, vals, starts)
+    if out is None:
+        return None, ts, vals
+    streams, fb = out
+    if fb.any():
+        return None, ts, vals
+    return streams, ts, vals
+
+
+# ---------------------------------------------------------------------------
+# Parent stage: native (C++) batched decode — no JAX, guaranteed number
+# ---------------------------------------------------------------------------
+
+
+def bench_native_decode(S: int, T: int) -> dict:
+    from m3_tpu import native
+
+    if not native.available():
+        return {"error": "native toolchain unavailable"}
+    streams, ts, vals = _encode_corpus(S, T)
+    if streams is None:
+        return {"error": "native encode unavailable/fell back"}
+    nthreads = os.cpu_count() or 1
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        dts, dvals, counts, fb = native.decode_batch(streams, T + 1)
+        best = min(best, time.perf_counter() - t0)
+        if _left() < 30:
+            break
+    ok = (not fb.any() and (counts == T).all()
+          and np.array_equal(dts[:, :T], ts)
+          and np.array_equal(dvals[:, :T].view(np.uint64), vals.view(np.uint64)))
+    return {
+        "dps": round(S * T / best),
+        "S": S, "T": T, "threads": nthreads,
+        "validation": "ok" if ok else "mismatch",
+    }
+
+
+# ---------------------------------------------------------------------------
+# Child stages (run under an initialized JAX backend)
+# ---------------------------------------------------------------------------
+
+
+def _emit(kind: str, payload: dict) -> None:
+    """Child -> parent incremental result line (parent merges in order)."""
+    print("RESULT " + json.dumps({kind: payload}), flush=True)
+
+
+def _run_decode_stage(S: int, T: int, platform: str) -> dict:
+    """Device decode: packed streams -> (ts, float64 value BITS); returns
+    stage dict with dps + bit-exactness verdict."""
+    import jax
+    import jax.numpy as jnp
+
+    from m3_tpu.encoding import f64_emul as fe
+    from m3_tpu.encoding.m3tsz_jax import (
+        decode_batch_device, encode_batch, pack_streams)
+
+    @functools.partial(jax.jit, static_argnames=("max_points",))
+    def _decode_to_values(words, nbits, max_points: int):
+        # The result stays uint64 on device: the TPU backend emulates
+        # f64 as an f32 pair (double-double), so materializing a float64
+        # output loses the low mantissa bits (~1 ulp) — the BENCH_r02
+        # validation failure.  All codec math is integer (f64_emul); the
+        # host reinterprets the returned bits as float64 losslessly.
+        ts, payload, meta, err, prec, _ann = decode_batch_device(
+            words, nbits, max_points)
+        isf = (meta & 8) != 0
+        mult = (meta & 7).astype(jnp.int64)
+        ibits = fe.int_div_pow10(payload.astype(jnp.int64), mult)
+        vbits = jnp.where(isf, payload, ibits)
+        return ts, vbits, meta, err | prec
+
+    streams, ts, vals = _encode_corpus(S, T)
+    if streams is None:
+        # native encoder unavailable: encode on device (slower prep)
+        starts = np.full(S, START, np.int64)
+        streams = []
+        for lo in range(0, S, 8192):
+            hi = min(lo + 8192, S)
+            chunk, fb = encode_batch(ts[lo:hi], vals[lo:hi], starts[lo:hi],
+                                     out_words=T * 40 // 64 + 8)
+            assert not fb.any(), "encoder fell back on synthetic gauge corpus"
+            streams.extend(chunk)
+    _log(f"stage S={S}: encoded, {_left():.0f}s left")
+
+    words_np, nbits_np = pack_streams(streams)
+    words = jnp.asarray(words_np)
+    nbits = jnp.asarray(nbits_np)
+
+    run = lambda: jax.block_until_ready(
+        _decode_to_values(words, nbits, max_points=T + 1))
+    out = run()  # compile
+    _log(f"stage S={S}: compiled+ran, {_left():.0f}s left")
+
+    # Bit-exactness: decoded timestamps and value BIT PATTERNS must match
+    # the corpus exactly (immune to any host<->device f64 conversion).
+    dec_ts = np.asarray(out[0][:, :T])
+    dec_bits = np.asarray(out[1][:, :T])
+    errs = np.asarray(out[3])
+    if errs.any():
+        verdict = f"decode-error on {int(errs.sum())}/{S} series"
+    elif not np.array_equal(dec_ts, ts):
+        verdict = "timestamp mismatch vs corpus"
+    elif not np.array_equal(dec_bits, vals.view(np.uint64)):
+        bad = int((dec_bits != vals.view(np.uint64)).any(axis=1).sum())
+        verdict = f"value-bits mismatch on {bad}/{S} series"
+    else:
+        verdict = "ok"
+
+    best = float("inf")
+    for _ in range(5):
+        if _left() < 20 and best < float("inf"):
+            break
+        t0 = time.perf_counter()
+        run()
+        best = min(best, time.perf_counter() - t0)
+    return {"dps": round(S * T / best), "S": S, "T": T,
+            "platform": platform, "validation": verdict}
+
+
+def _run_agg_bench(kind: str, C: int, N: int, NT: int, platform: str) -> dict:
+    """BASELINE configs #3/#4: C-slot counter/gauge rollup and timer
+    quantiles over NT samples, device arenas vs the single-core C++
+    Go-proxy (native/agg_bench.cc — deliberately generous to the
+    baseline: dense arrays instead of the reference's map+locks).
+    Validation is recorded, not asserted, so a cut-short run still
+    reports its verdict."""
     import jax
     import jax.numpy as jnp
 
@@ -150,24 +272,25 @@ def _run_agg_bench(kind: str, C: int = 1_000_000, N: int = 2_000_000,
         checks = drain(cstate, gstate)
         jax.block_until_ready(checks)
         dev_s = time.perf_counter() - t0
-        # Validation: counts must equal exactly (reps+1 ingests of N
-        # samples x 2 metric types, integer lanes are exact on device).
+        # Counts must equal exactly: (reps+1) ingests of N samples x 2
+        # metric types; integer lanes are exact on device.
         total_counts = float(checks[2]) + float(checks[3])
-        assert total_counts == 2.0 * (reps + 1) * N, total_counts
+        count_ok = total_counts == 2.0 * (reps + 1) * N
         dev_rate = reps * 2 * N / dev_s
 
-        proxy = {}
+        out = {"samples_per_sec": round(dev_rate), "C": C, "N": N,
+               "platform": platform,
+               "validation": "ok" if count_ok else
+               f"ingest count mismatch: {total_counts}"}
         if aggproxy.available():
             tc = aggproxy.counter_rollup_ns(ids, cvals, C)
             tg = aggproxy.gauge_rollup_ns(ids, gvals, times, C)
             proxy_rate = 2 * N / (tc + tg)
-            proxy = {
-                "go_proxy_samples_per_sec": round(proxy_rate),
-                "vs_go_proxy": round(dev_rate / proxy_rate, 3),
-            }
-        return {"samples_per_sec": round(dev_rate), **proxy}
+            out.update(go_proxy_samples_per_sec=round(proxy_rate),
+                       vs_go_proxy=round(dev_rate / proxy_rate, 3))
+        return out
 
-    # kind == "timer": 10M samples over 1M timer IDs, p50/95/99.
+    # kind == "timer": NT samples over C timer IDs, p50/95/99.
     B = min(2_000_000, NT)
     ids = rng.integers(0, C, NT, np.uint32)
     vals = np.round(rng.gamma(2.0, 50.0, NT), 3)
@@ -193,7 +316,7 @@ def _run_agg_bench(kind: str, C: int = 1_000_000, N: int = 2_000_000,
     def tstep(ts, win, slots, values, times):
         return arena.raw(arena.timer_ingest)(ts, win, slots, values, times, C)
 
-    @functools.partial(jax.jit, static_argnames=())
+    @jax.jit
     def tdrain(ts):
         lanes, cnt = arena.raw(arena.timer_consume)(ts, jnp.int32(0), C, qs)
         return lanes[:, 8:], cnt
@@ -209,17 +332,18 @@ def _run_agg_bench(kind: str, C: int = 1_000_000, N: int = 2_000_000,
     qlanes, cnt = tdrain(tstate)
     jax.block_until_ready((qlanes, cnt))
     dev_s = time.perf_counter() - t0
-    assert int(jnp.sum(cnt)) == NT, int(jnp.sum(cnt))
+    count_ok = int(jnp.sum(cnt)) == NT
     dev_rate = NT / dev_s
 
-    out = {"samples_per_sec": round(dev_rate)}
+    out = {"samples_per_sec": round(dev_rate), "C": C, "NT": NT,
+           "platform": platform,
+           "validation": "ok" if count_ok else
+           f"sample count mismatch: {int(jnp.sum(cnt))} != {NT}"}
     if aggproxy.available():
         tt, host_out = aggproxy.timer_quantiles(ids, vals, C, qs)
         proxy_rate = NT / tt
-        out.update(
-            go_proxy_samples_per_sec=round(proxy_rate),
-            vs_go_proxy=round(dev_rate / proxy_rate, 3),
-        )
+        out.update(go_proxy_samples_per_sec=round(proxy_rate),
+                   vs_go_proxy=round(dev_rate / proxy_rate, 3))
         # Cross-validate device quantiles against the host proxy on a
         # sample of slots (both are exact rank statistics).
         dq = np.asarray(qlanes)
@@ -230,234 +354,259 @@ def _run_agg_bench(kind: str, C: int = 1_000_000, N: int = 2_000_000,
     return out
 
 
-def _run_stage(S: int, T: int) -> float:
-    """Encode S×T corpus, decode it on device, return datapoints/s."""
+def child_main(platform: str) -> None:
+    """Run decode stages + aggregator benches under one JAX backend,
+    streaming RESULT lines.  ``platform``: "tpu" or "cpu"."""
+    if platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
-    import jax.numpy as jnp
 
-    from m3_tpu.encoding.m3tsz_jax import (
-        decode_batch_device, encode_batch, pack_streams)
-    from m3_tpu.encoding import f64_emul as fe
+    if platform == "cpu":
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
 
-    @functools.partial(jax.jit, static_argnames=("max_points",))
-    def _decode_to_values(words, nbits, max_points: int):
-        """Full device decode: packed streams -> (ts, float64 value BITS).
+    import m3_tpu  # noqa: F401  (x64 config)
 
-        Includes the int-mode payload -> float conversion (payload / 10^mult)
-        so the timed region covers everything the Go ReaderIterator does.
+    dev = jax.devices()[0]
+    kind = dev.device_kind
+    _emit("backend", {"platform": dev.platform, "kind": kind})
+    _log("child backend up:", dev.platform, kind)
 
-        The result stays uint64 on device: the TPU backend emulates f64 as
-        an f32 pair (double-double), so materializing a float64 output loses
-        the low mantissa bits (~1 ulp) — exactly the BENCH_r02 validation
-        failure.  All codec math is integer (f64_emul); the host reinterprets
-        the returned bits as float64 losslessly."""
-        ts, payload, meta, err, prec, _ann = decode_batch_device(
-            words, nbits, max_points)
-        isf = (meta & 8) != 0
-        mult = (meta & 7).astype(jnp.int64)
-        # TPU's emulated f64 divide is not correctly rounded; the exact
-        # integer-emulated division (f64_emul.int_div_pow10) matches the
-        # reference's IEEE `float64(v) / multiplier` bit-for-bit.
-        ibits = fe.int_div_pow10(payload.astype(jnp.int64), mult)
-        vbits = jnp.where(isf, payload, ibits)
-        return ts, vbits, meta, err | prec
+    is_tpu = platform == "tpu"
+    # Validation-first: a small decode stage whose verdict survives even
+    # if the big stage or the deadline kills us.
+    stages = [2_000, 100_000] if is_tpu else [2_000, 10_000]
+    agg_sizes = (dict(C=1_000_000, N=2_000_000, NT=10_000_000) if is_tpu
+                 else dict(C=65_536, N=131_072, NT=524_288))
 
-    ts, vals, starts = _make_corpus(S, T)
-    streams = []
-    for lo in range(0, S, ENC_CHUNK):
-        hi = min(lo + ENC_CHUNK, S)
-        chunk, fb = encode_batch(
-            ts[lo:hi], vals[lo:hi], starts[lo:hi], out_words=T * 40 // 64 + 8
-        )
-        assert not fb.any(), "encoder fell back on synthetic gauge corpus"
-        streams.extend(chunk)
-    _log(f"stage S={S}: encoded, {_left():.0f}s left")
+    agg_done = False
 
-    words_np, nbits_np = pack_streams(streams)
-    words = jnp.asarray(words_np)
-    nbits = jnp.asarray(nbits_np)
+    def run_aggs():
+        nonlocal agg_done
+        agg_done = True
+        for akind in ("rollup", "timer"):
+            if _left() < 120:
+                _emit("error", {"msg": f"skipped agg {akind}: "
+                                       f"{_left():.0f}s left"})
+                break
+            try:
+                res = _run_agg_bench(akind, platform=platform, **agg_sizes)
+                _emit(f"agg_{akind}", res)
+                _log("agg", akind, json.dumps(res))
+            except Exception as e:
+                _emit("error", {"msg": f"agg {akind}: {type(e).__name__}: {e}"})
 
-    # max_points includes the end-of-stream slot.
-    run = lambda: jax.block_until_ready(
-        _decode_to_values(words, nbits, max_points=T + 1)
-    )
-    out = run()  # compile
-    _log(f"stage S={S}: compiled+ran, {_left():.0f}s left")
-    # Sanity: decoded values must match the corpus bit-exactly (compare the
-    # raw bit patterns — equivalent to float equality for these finite
-    # values, and immune to any host<->device f64 conversion).
-    dec_ts = np.asarray(out[0][:, :T])
-    dec_bits = np.asarray(out[1][:, :T])
-    errs = np.asarray(out[3])
-    assert not errs.any(), f"{int(errs.sum())} series failed to decode"
-    assert np.array_equal(dec_ts, ts) and np.array_equal(
-        dec_bits, vals.view(np.uint64)
-    ), "decoded output mismatch vs corpus"
-
-    best = float("inf")
-    for _ in range(5):
-        if _left() < 30 and best < float("inf"):
+    for i, S in enumerate(stages):
+        need = 60 + S // 1_500
+        if _left() < need:
+            _emit("error", {"msg": f"skipped S={S}: {_left():.0f}s < {need}s"})
             break
-        t0 = time.perf_counter()
-        run()
-        best = min(best, time.perf_counter() - t0)
-    return S * T / best
+        try:
+            res = _run_decode_stage(S, T_POINTS, platform)
+            _emit("decode", res)
+            _log("decode", json.dumps(res))
+            if res["validation"] != "ok" and is_tpu:
+                # A numerically-diverging TPU decode must not be timed
+                # at full size as if it were correct — record and stop.
+                break
+        except Exception as e:
+            _emit("error", {"msg": f"stage S={S}: {type(e).__name__}: {e}"})
+            break
+        if i == 0:
+            # North stars run right after the first validated decode
+            # stage so the big decode stage can't starve them.
+            run_aggs()
+    if not agg_done:
+        run_aggs()
+
+
+# ---------------------------------------------------------------------------
+# Parent orchestration
+# ---------------------------------------------------------------------------
+
+
+def _run_child(platform: str, budget: float) -> dict:
+    """Run `bench.py --child <platform>` with a hard timeout, merging its
+    RESULT lines as they arrive.  Returns {kind: payload} of everything
+    the child reported before finishing/dying/timing out."""
+    merged: dict = {}
+    deadline = time.monotonic() + budget
+    env = dict(os.environ)
+    env["M3_BENCH_DEADLINE_SEC"] = str(max(30, int(budget - 10)))
+    p = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child", platform],
+        stdout=subprocess.PIPE, stderr=sys.stderr, env=env)
+    try:
+        import selectors
+        sel = selectors.DefaultSelector()
+        sel.register(p.stdout, selectors.EVENT_READ)
+        buf = ""
+        while True:
+            tleft = deadline - time.monotonic()
+            if tleft <= 0:
+                _log(f"{platform} child out of budget; killing")
+                p.kill()
+                break
+            if not sel.select(timeout=min(tleft, 5)):
+                if p.poll() is not None:
+                    break
+                continue
+            chunk = p.stdout.read1(65536).decode(errors="replace")
+            if not chunk:
+                break
+            buf += chunk
+            while "\n" in buf:
+                line, buf = buf.split("\n", 1)
+                if line.startswith("RESULT "):
+                    try:
+                        d = json.loads(line[len("RESULT "):])
+                    except json.JSONDecodeError:
+                        continue
+                    for k, v in d.items():
+                        if k == "decode":
+                            merged.setdefault("decode", []).append(v)
+                        elif k == "error":
+                            merged.setdefault("errors", []).append(v["msg"])
+                        else:
+                            merged[k] = v
+    finally:
+        try:
+            p.kill()
+        except OSError:
+            pass
+        p.wait()
+    return merged
 
 
 def main() -> None:
+    if len(sys.argv) > 2 and sys.argv[1] == "--child":
+        child_main(sys.argv[2])
+        return
+
     result = {
         "metric": "m3tsz_batched_decode_datapoints_per_sec",
         "value": 0,
         "unit": "datapoints/s",
         "vs_baseline": 0.0,
+        "validation": "not-run",
     }
     errors: list[str] = []
+    detail: dict = {}
+    decode_block: dict = {}
+    agg_block: dict = {}
 
-    # ---- choose a platform without letting a PJRT hang kill the run ----
-    use_tpu = False
-    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
-        # Unset JAX_PLATFORMS still auto-selects the accelerator plugin,
-        # so it needs the same guarded probe as an explicit pin.
-        timeouts = 0
-        for attempt in range(3):
-            # Always reserve ≥300s so the CPU fallback can still complete.
-            budget = min(240.0, _left() - 300.0)
-            if budget < 30:
-                errors.append("no time left for TPU probe")
-                break
-            status = _probe_tpu(budget)
-            if status == "ok":
-                use_tpu = True
-                break
-            errors.append(f"tpu backend probe attempt {attempt + 1}: {status}")
-            if status == "cpu":
-                break  # deterministic: no accelerator on this machine
-            if status == "timeout":
-                timeouts += 1
-                if timeouts >= 2:
-                    break  # a second full-budget hang won't resolve itself
-            time.sleep(10)
-
-    import jax
-
-    if not use_tpu:
-        _log("falling back to virtual CPU backend")
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        try:
-            jax.config.update("jax_platforms", "cpu")
-        except Exception as e:  # pragma: no cover
-            errors.append(f"cpu fallback config: {e}")
-
-    import m3_tpu  # noqa: F401  (x64 config)
-
-    try:
-        dev = jax.devices()[0]
-        kind = dev.device_kind
-        _log("backend up:", dev.platform, kind)
-    except Exception as e:
-        errors.append(f"backend init: {e}")
-        result["error"] = "; ".join(errors)[-800:]
-        print(json.dumps(result))
-        return
-
-    # ---- staged sizes: always keep the largest completed stage ----
-    if len(sys.argv) > 1:
-        stages = [int(sys.argv[1])]
-    elif use_tpu:
-        stages = [1_000, 10_000, 100_000]
-    else:
-        stages = [1_000, 10_000]
-    T = int(sys.argv[2]) if len(sys.argv) > 2 else T_POINTS
-
-    def run_agg_benches():
-        """BASELINE configs #3/#4 — the north-star numbers.  Full
-        1M-slot / 10M-sample configs on the accelerator; a reduced smoke
-        (same code path) on the CPU fallback so the line always carries
-        aggregator numbers."""
-        agg_attempted[0] = True
-        agg = {}
-        agg_sizes = (dict(C=1_000_000, N=2_000_000, NT=10_000_000) if use_tpu
-                     else dict(C=65_536, N=131_072, NT=524_288))
-        for akind in ("rollup", "timer"):
-            if _left() < 150:
-                errors.append(f"skipped agg {akind}: {_left():.0f}s left")
-                break
-            try:
-                agg[akind] = _run_agg_bench(akind, **agg_sizes)
-                if not use_tpu:
-                    agg[akind]["note"] = "cpu-fallback smoke sizes"
-                _log("agg", akind, json.dumps(agg[akind]))
-            except Exception as e:
-                errors.append(f"agg {akind}: {type(e).__name__}: {e}")
-        if agg:
-            result["aggregator"] = dict(
-                agg, note="vs_go_proxy baseline = native/agg_bench.cc, a "
-                "single-core dense-array C++ upper bound on the Go engine's "
-                "ingest+flush hot loop (no map/lock costs)")
-            _log("partial-result", json.dumps(result))
-
-    agg_attempted = [False]
-    validation_failed = False
-    for i, S in enumerate(stages):
-        # A 100K-series stage needs encode + compile headroom.
-        need = 60 + S // 1_000
-        if _left() < need:
-            errors.append(f"skipped S={S}: {_left():.0f}s left < {need}s")
-            break
-        try:
-            dps = _run_stage(S, T)
+    def compose_and_log(tag: str) -> None:
+        """Fold current state into `result` and mirror to stderr (the
+        driver's output tail keeps it even if we die later)."""
+        # Headline: TPU decode if present, else native-CPU, else JAX-CPU.
+        tpu = decode_block.get("tpu")
+        nat = decode_block.get("cpu_native")
+        cj = decode_block.get("cpu_jax")
+        if tpu:
             result.update(
-                value=round(dps),
-                unit=f"datapoints/s ({S}x{T} blocks, {kind})",
-                vs_baseline=round(dps / GO_BASELINE_DPS, 3),
-            )
-            # Mirror to stderr: survives in the driver's output tail even
-            # if a later stage dies hard (stdout line never printed).
-            _log("partial-result", json.dumps(result))
-        except AssertionError as e:
-            errors.append(f"stage S={S}: validation: {e}")
-            validation_failed = True
-            break
-        except Exception as e:
-            errors.append(f"stage S={S}: {type(e).__name__}: {e}")
-            break
-        if i == 0:
-            # The aggregator north star (configs #3/#4) runs right after
-            # the first validated decode stage: the big decode stages
-            # must not be able to starve it of deadline.
-            run_agg_benches()
-    if not agg_attempted[0]:
-        run_agg_benches()
+                value=tpu["dps"],
+                unit=f"datapoints/s ({tpu['S']}x{tpu['T']} blocks, tpu)",
+                vs_baseline=round(tpu["dps"] / GO_BASELINE_DPS, 3))
+        elif nat and "dps" in nat:
+            result.update(
+                value=nat["dps"],
+                unit=(f"datapoints/s ({nat['S']}x{nat['T']} blocks, "
+                      f"cpu-native x{nat['threads']}thr)"),
+                vs_baseline=round(nat["dps"] / GO_BASELINE_DPS, 3))
+        elif cj:
+            result.update(
+                value=cj["dps"],
+                unit=f"datapoints/s ({cj['S']}x{cj['T']} blocks, cpu-jax)",
+                vs_baseline=round(cj["dps"] / GO_BASELINE_DPS, 3))
+        verdicts = [v for v in detail.values() if isinstance(v, str)]
+        if verdicts:
+            result["validation"] = (
+                "ok" if all(v == "ok" for v in verdicts) else "failed")
+        result["validation_detail"] = detail
+        result["decode"] = decode_block
+        if agg_block:
+            result["aggregator"] = dict(
+                agg_block,
+                note="vs_go_proxy baseline = native/agg_bench.cc, a "
+                     "single-core dense-array C++ upper bound on the Go "
+                     "engine's ingest+flush hot loop (no map/lock costs)")
+        if errors:
+            result["note"] = "; ".join(errors)[-600:]
+        _log(f"partial-result [{tag}]", json.dumps(result))
 
-    if use_tpu and validation_failed and result["value"] == 0 and _left() > 120:
-        # The decode runs bit-exact on CPU (validated in tests); a TPU
-        # numeric divergence must not leave the round with NO number.
-        # Re-run on the virtual CPU backend in a subprocess and surface
-        # the TPU validation failure in the note.
-        _log("TPU validation failed - falling back to CPU subprocess")
-        env = dict(os.environ, JAX_PLATFORMS="cpu",
-                   M3_BENCH_DEADLINE_SEC=str(int(max(60, _left() - 30))))
-        try:
-            p = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "2000"],
-                env=env, capture_output=True, text=True,
-                timeout=max(90, _left() - 10),
-            )
-            line = (p.stdout or "").strip().splitlines()
-            sub = json.loads(line[-1]) if line else {}
-            if sub.get("value"):
-                if "aggregator" in result:
-                    # Keep the full-size TPU aggregator numbers over the
-                    # subprocess's CPU smoke-size re-run.
-                    sub.pop("aggregator", None)
-                result.update(sub)
-        except Exception as e:  # pragma: no cover
-            errors.append(f"cpu fallback: {type(e).__name__}: {e}")
+    # ---- stage 1: native CPU decode (no JAX -> cannot hang) ----
+    try:
+        nat = bench_native_decode(10_000, T_POINTS)
+        decode_block["cpu_native"] = nat
+        if "validation" in nat:
+            detail["cpu_native_decode_bits"] = nat["validation"]
+    except Exception as e:
+        errors.append(f"native decode: {type(e).__name__}: {e}")
+    compose_and_log("native")
 
-    if errors and result["value"] == 0:
+    def merge_child(res: dict, platform: str) -> bool:
+        """Merge a child's reported stages; True if it delivered a
+        timed decode stage."""
+        got = False
+        for st in res.get("decode", []):
+            key = platform if platform == "tpu" else "cpu_jax"
+            # Keep the largest stage's number; keep the strictest verdict.
+            old = decode_block.get(key)
+            if old is None or st["S"] >= old["S"]:
+                decode_block[key] = st
+            detail[f"{key}_decode_bits_S{st['S']}"] = st["validation"]
+            got = True
+        for akind in ("rollup", "timer"):
+            st = res.get(f"agg_{akind}")
+            if st is not None:
+                # Full-size accelerator numbers win over CPU smoke.
+                old = agg_block.get(akind)
+                if old is None or st.get("platform") == "tpu":
+                    agg_block[akind] = st
+                detail[f"{akind}_{st.get('platform', '?')}"] = st["validation"]
+        for msg in res.get("errors", []):
+            errors.append(f"{platform}: {msg}")
+        return got
+
+    # ---- stage 2: TPU first attempt (only if the relay answers) ----
+    tpu_ok = False
+    if _relay_open():
+        budget = _left() - 240  # reserve the cpu-jax fallback window
+        if budget > 120:
+            _log(f"relay up; TPU child budget {budget:.0f}s")
+            res = _run_child("tpu", budget)
+            tpu_ok = merge_child(res, "tpu")
+            compose_and_log("tpu-1")
+    else:
+        errors.append("tpu relay probe: connection refused at t=0")
+        _log("relay down at t=0; running CPU stages first, will re-probe")
+
+    # ---- stage 3: CPU-JAX stages (decode smoke + agg smoke) ----
+    need_cpu_jax = (not tpu_ok or "rollup" not in agg_block
+                    or "timer" not in agg_block)
+    if need_cpu_jax and _left() > 150:
+        res = _run_child("cpu", min(_left() - 90, 300))
+        merge_child(res, "cpu")
+        compose_and_log("cpu-jax")
+
+    # ---- stage 4: TPU re-probe loop with the remaining budget ----
+    # (pointless under an explicit CPU pin: _relay_open is always False)
+    while (not tpu_ok and _left() > 120
+           and os.environ.get("JAX_PLATFORMS", "") != "cpu"):
+        if _relay_open():
+            _log(f"relay now up; TPU child budget {_left() - 45:.0f}s")
+            res = _run_child("tpu", _left() - 45)
+            tpu_ok = merge_child(res, "tpu")
+            compose_and_log("tpu-retry")
+            if tpu_ok:
+                break
+        time.sleep(min(15, max(1, _left() - 120)))
+
+    compose_and_log("final")
+    if result["value"] == 0 and errors:
         result["error"] = "; ".join(errors)[-800:]
-    elif errors:
-        result["note"] = "; ".join(errors)[-400:]
     print(json.dumps(result))
 
 
