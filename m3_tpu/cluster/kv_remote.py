@@ -227,17 +227,62 @@ class RemoteKVStore:
 
     def watch(self, key: str, fn: Callable[[VersionedValue], None]) -> None:
         """Fire on every observed version change (etcd watch channel
-        role, implemented as a version poll)."""
+        role, implemented as a version poll).
+
+        ``_watch_seen`` updates and the initial-fire decision happen
+        under ``_wmu`` so registration and the poll loop agree on the
+        last-seen version: without it a poll tick racing a registration
+        could double-fire or swallow one version change.  Callbacks fire
+        outside the lock (they may re-enter the store)."""
+        cur = self.get(key)
+        fire: list = []
         with self._wmu:
             self._watchers.setdefault(key, []).append(fn)
-        cur = self.get(key)
-        if cur is not None:
-            self._watch_seen[key] = cur.version
-            fn(cur)
-        if self._watch_thread is None:
-            self._watch_thread = threading.Thread(
-                target=self._watch_loop, daemon=True)
+            if cur is not None:
+                seen = self._watch_seen.get(key)
+                if seen is None:
+                    self._watch_seen[key] = cur.version
+                    fire = [fn]
+                elif cur.version > seen:
+                    # Version moved past what the loop last delivered:
+                    # every watcher (not just the new one) must see it,
+                    # or the poll loop would skip this change.  The
+                    # ordered compare (not !=) means a registration that
+                    # read an OLDER version than the loop already
+                    # delivered cannot regress _watch_seen and re-fire
+                    # stale values at existing watchers.
+                    self._watch_seen[key] = cur.version
+                    fire = list(self._watchers[key])
+                else:
+                    if cur.version < seen:
+                        # Our pre-lock read lost a race with the poll
+                        # loop; re-read so the initial fire isn't stale
+                        # (versions are monotonic per key).
+                        try:
+                            cur = self.get(key) or cur
+                        except (ConnectionError, RuntimeError):
+                            pass
+                    fire = [fn]  # initial fire for the new watcher only
+            start = self._watch_thread is None
+            if start:
+                self._watch_thread = threading.Thread(
+                    target=self._watch_loop, daemon=True)
+        for f in fire:
+            self._fire(f, cur)
+        if start:
             self._watch_thread.start()
+
+    @staticmethod
+    def _fire(fn, cur) -> None:
+        """Deliver one watch callback; a raising callback must never
+        kill the shared poll thread or starve its sibling watchers."""
+        try:
+            fn(cur)
+        except Exception:  # noqa: BLE001 — isolate watcher faults
+            import logging
+
+            logging.getLogger("m3_tpu.cluster.kv_remote").exception(
+                "kv watch callback raised")
 
     def _watch_loop(self) -> None:
         while not self._closed.wait(self._watch_poll_s):
@@ -250,12 +295,13 @@ class RemoteKVStore:
                     continue
                 if cur is None:
                     continue
-                if cur.version != self._watch_seen.get(key):
-                    self._watch_seen[key] = cur.version
-                    with self._wmu:
-                        fns = list(self._watchers.get(key, ()))
-                    for fn in fns:
-                        fn(cur)
+                with self._wmu:
+                    changed = cur.version != self._watch_seen.get(key)
+                    if changed:
+                        self._watch_seen[key] = cur.version
+                    fns = list(self._watchers.get(key, ())) if changed else []
+                for fn in fns:
+                    self._fire(fn, cur)
 
     def close(self) -> None:
         self._closed.set()

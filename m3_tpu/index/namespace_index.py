@@ -127,11 +127,22 @@ class NamespaceIndex:
         if m is None or len(m) == 0:
             return None
         sealed = m.seal()
-        self.sealed.setdefault(block_start, []).append(sealed)
+        segs = self.sealed.setdefault(block_start, [])
+        segs.append(sealed)
         # the fresh segment may carry tombstoned docs from the mutable
         # side: force the next compaction to re-apply the tombstone set
         self._tombs_applied.pop(block_start, None)
-        self._persist_block(block_start)
+        # Persist ONLY the appended segment: sealed segments are
+        # immutable and position-named, so earlier files are already
+        # correct on disk — a full _persist_block here would rewrite the
+        # whole block history per seal (O(total history) I/O, quadratic
+        # under churn).  Full rewrites happen only in compact_block,
+        # where the list structure actually changes.
+        if self.root is not None:
+            d = Path(self.root) / "index" / self.namespace
+            d.mkdir(parents=True, exist_ok=True)
+            self._seg_path(block_start, len(segs) - 1).write_bytes(
+                sealed.to_bytes())
         return sealed
 
     def compact_block(self, block_start: int,

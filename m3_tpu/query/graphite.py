@@ -480,7 +480,10 @@ def _keep_last(ctx, series, limit=-1):
     return out
 
 
-def _moving(series, window: int, fn, name):
+def _moving(series, window: int, fn, name, min_fraction: float = 0.0):
+    """Trailing-window aggregate over non-null points.  ``min_fraction``
+    (stdev's windowTolerance) nulls windows whose valid fraction falls
+    below it; 0 keeps any non-empty window."""
     out = []
     for s in series:
         v = s.values
@@ -489,7 +492,8 @@ def _moving(series, window: int, fn, name):
             lo = max(0, i - window + 1)
             w = v[lo : i + 1]
             w = w[~np.isnan(w)]
-            if len(w):
+            if len(w) and (not min_fraction
+                           or len(w) / window >= min_fraction):
                 res[i] = fn(w)
         out.append(s.with_values(res, f"{name}({s.name},{window})"))
     return out
@@ -846,7 +850,11 @@ def _changed(ctx, series):
 
 @_func("consolidateBy", "cumulative")
 def _consolidate_by(ctx, series, func="sum"):
-    # Consolidation is a render-resolution hint; data passes through.
+    # graphite-web's consolidationFunc only changes how the RENDERER
+    # reduces points when maxDataPoints forces downsampling; this
+    # engine always returns full-resolution data (no maxDataPoints
+    # reduction exists), so pass-through is exact — there is no code
+    # path where the chosen func could alter returned values.
     return [s.with_values(s.values, f'consolidateBy({s.name},"{func}")')
             for s in series]
 
@@ -1002,21 +1010,38 @@ def _filter_series(ctx, series, func, op, threshold):
 
 @_func("hitcount")
 def _hitcount(ctx, series, interval, aligned=False):
-    nanos = _duration_nanos(str(interval))
+    """Per-bucket hit totals (value x step-seconds summed per interval).
+
+    graphite-web's ``alignToFrom`` (default False) aligns bucket
+    boundaries to epoch multiples of the interval; True aligns them to
+    the series start.  Both alignments are honored here — the first
+    bucket of an unaligned series covers only the partial interval up
+    to the next epoch boundary."""
+    nanos = max(_duration_nanos(str(interval)), 1)
     out = []
     for s in series:
-        k = max(1, nanos // s.step_nanos)
         T = len(s.values)
-        nb = (T + k - 1) // k
+        # A bucket can't be finer than the data's step (old-code clamp):
+        # an interval below the step would otherwise time-stretch the
+        # output and scatter values across mostly-NaN buckets.
+        eff = max(nanos, s.step_nanos)
+        base = (s.start_nanos if aligned
+                else (s.start_nanos // eff) * eff)
+        t = s.start_nanos + np.arange(T, dtype=np.int64) * s.step_nanos
+        bidx = (t - base) // eff
+        nb = int(bidx[-1]) + 1 if T else 0
         res = np.full(nb, NAN)
         secs = s.step_nanos / 1e9
+        # bidx is non-decreasing: bucket b is the slice between edges.
+        edges = np.searchsorted(bidx, np.arange(nb + 1))
         for b in range(nb):
-            w = s.values[b * k: (b + 1) * k]
-            if (~np.isnan(w)).any():
+            w = s.values[edges[b]:edges[b + 1]]
+            if w.size and (~np.isnan(w)).any():
                 res[b] = np.nansum(w) * secs
+        suffix = ",true" if aligned else ""
         out.append(GraphiteSeries(
-            f'hitcount({s.name},"{interval}")', s.path, res,
-            s.step_nanos * k, s.start_nanos,
+            f'hitcount({s.name},"{interval}"{suffix})', s.path, res,
+            eff, base,
         ))
     return out
 
@@ -1134,7 +1159,12 @@ def _ema(ctx, series, window):
 
 @_func("stdev")
 def _stdev_moving(ctx, series, points, window_tolerance=0.1):
-    return _moving(series, int(points), np.std, "stdev")
+    """Trailing-window population stddev over non-null points; a window
+    whose valid fraction falls below ``windowTolerance`` yields null
+    (graphite-web functions.py stdev: validPoints/points >=
+    windowTolerance gates the calculation)."""
+    return _moving(series, int(points), np.std, "stdev",
+                   min_fraction=float(window_tolerance))
 
 
 @_func("stddevSeries")

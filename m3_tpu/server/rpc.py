@@ -49,6 +49,16 @@ RPC_REQ = 16
 RPC_OK = 17
 RPC_ERR = 18
 
+
+class RemoteError(RuntimeError):
+    """Application-level failure reported by the remote node (RPC_ERR
+    frame): the transport is healthy but the call failed there — e.g. a
+    segment checksum error on a corrupt replica.  Kept a RuntimeError
+    subclass so pre-existing broad handlers still match; sweeps like
+    repair catch it per replica and demote the handle instead of
+    aborting (reference: per-host fetch failures in
+    src/dbnode/storage/repair.go:115-246 fail only that host)."""
+
 # methods
 M_WRITE_BATCH = 1
 M_WRITE_TAGGED = 2
@@ -369,7 +379,7 @@ class RemoteDatabase:
                 raise ConnectionError(f"rpc {self.address}: connection closed")
         ftype, payload = frame
         if ftype == RPC_ERR:
-            raise RuntimeError(payload.decode(errors="replace"))
+            raise RemoteError(payload.decode(errors="replace"))
         if ftype != RPC_OK:
             self._drop()
             raise ConnectionError(f"rpc {self.address}: bad frame {ftype}")

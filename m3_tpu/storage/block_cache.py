@@ -77,9 +77,11 @@ class BlockCache:
                 # Drop the pool's reference only: a concurrent borrower
                 # may still be mid-read on the evicted reader, and
                 # closing its mmap under it would poison that read.
-                # The reader's __del__ closes the handles once the last
-                # borrower releases it (refcount close-deferral — the
-                # role of the seek manager's borrow counts).
+                # DataFileSetReader.close()/__del__ (persist/fs.py)
+                # release the fd+mmap when the last borrower's reference
+                # dies — immediate under CPython refcounting, the only
+                # runtime this framework targets (the role of the seek
+                # manager's borrow counts).
                 self._readers.popitem(last=False)
         return r
 

@@ -30,6 +30,15 @@ from typing import Dict, List
 
 from m3_tpu.encoding.m3tsz import decode_series, encode_series
 from m3_tpu.persist.digest import digest as checksum
+from m3_tpu.server.rpc import RemoteError
+
+# A replica is skipped/demoted on transport failure (ConnectionError)
+# AND on application-level failure it reports (RemoteError: RPC_ERR
+# frames, e.g. a segment checksum ValueError while reading a corrupt
+# block) — one bad replica must never abort the anti-entropy sweep,
+# matching the reference's per-host fetch failure handling
+# (src/dbnode/storage/repair.go:115-246).
+_REPLICA_FAILURE = (ConnectionError, RemoteError)
 
 
 class RepairReport(dict):
@@ -59,7 +68,7 @@ def repair_shard_block(
     for db in dbs:
         try:
             metas.append(db.block_metadata(namespace, shard, block_start))
-        except ConnectionError:
+        except _REPLICA_FAILURE:
             metas.append(DOWN)
     present = [m for m in metas if m is not None and m is not DOWN]
     report = RepairReport(
@@ -101,7 +110,7 @@ def repair_shard_block(
             continue
         try:
             block = db.read_block(namespace, shard, block_start)
-        except ConnectionError:
+        except _REPLICA_FAILURE:
             metas[i] = DOWN
             report["blocks_missing"] += 1
             continue
@@ -125,7 +134,7 @@ def repair_shard_block(
         try:
             db.write_block(namespace, shard, block_start, series)
             report["repaired_replicas"] += 1
-        except ConnectionError:
+        except _REPLICA_FAILURE:
             continue
     return report
 
@@ -157,7 +166,7 @@ def repair_namespace(dbs: List[object], namespace: str,
                 blocks.update(
                     bs for bs, _ in db.list_block_filesets(namespace, shard)
                 )
-            except ConnectionError:
+            except _REPLICA_FAILURE:
                 continue
         for bs in sorted(blocks):
             rep = repair_shard_block(dbs, namespace, shard, bs)
@@ -189,14 +198,14 @@ def peers_bootstrap(
                 continue
             try:
                 peer_blocks = peer.list_block_filesets(namespace, shard)
-            except ConnectionError:
+            except _REPLICA_FAILURE:
                 continue
             for bs, _vol in peer_blocks:
                 if bs in local:
                     continue
                 try:
                     series = peer.read_block(namespace, shard, bs)
-                except ConnectionError:
+                except _REPLICA_FAILURE:
                     continue
                 db.write_block(namespace, shard, bs, series)
                 local[bs] = 0

@@ -476,3 +476,53 @@ class TestBreadthTierFunctions:
         assert np.isnan(out.values[1:5]).all()
         # the 1-long gap fills linearly
         np.testing.assert_allclose(out.values[6], 7.0)
+
+
+class TestAdvisedSemantics:
+    """Round-4 ADVICE fixes: hitcount alignment, stdev window tolerance."""
+
+    def _series(self, name, vals, step=10 * 10**9, start=0):
+        from m3_tpu.query.graphite import GraphiteSeries
+
+        return GraphiteSeries(name, name, np.asarray(vals, np.float64),
+                              step, start)
+
+    def _ctx(self):
+        from m3_tpu.query.graphite import _Ctx
+
+        return _Ctx(None, 0, 80 * 10**9, 10 * 10**9)
+
+    def test_hitcount_epoch_aligned_default(self):
+        from m3_tpu.query.graphite import _FUNCS
+
+        # Series starts 30s past the minute; default alignment buckets
+        # on epoch minute boundaries, so the first bucket holds only the
+        # 3 pre-boundary points (30/40/50s).
+        s = self._series("h", [1.0] * 9, start=30 * 10**9)
+        (out,) = _FUNCS["hitcount"](self._ctx(), [s], "1min")
+        assert out.start_nanos == 0
+        np.testing.assert_allclose(out.values, [30.0, 60.0])
+
+    def test_hitcount_align_to_from(self):
+        from m3_tpu.query.graphite import _FUNCS
+
+        s = self._series("h", [1.0] * 9, start=30 * 10**9)
+        (out,) = _FUNCS["hitcount"](self._ctx(), [s], "1min", True)
+        assert out.start_nanos == 30 * 10**9
+        np.testing.assert_allclose(out.values, [60.0, 30.0])
+        assert ",true)" in out.name
+
+    def test_stdev_window_tolerance(self):
+        from m3_tpu.query.graphite import _FUNCS
+
+        vals = [2.0, 4.0, float("nan"), float("nan"), float("nan")]
+        s = self._series("sd", vals)
+        # tolerance 0.5 over a 4-point window: indices with <2 valid
+        # points in their trailing window go null.
+        (out,) = _FUNCS["stdev"](self._ctx(), [s], 4, 0.5)
+        np.testing.assert_allclose(out.values[1], 1.0)  # std([2,4])
+        assert np.isnan(out.values[0])   # 1/4 valid < 0.5
+        assert np.isnan(out.values[4])   # window [4,nan,nan,nan]: 1/4
+        # default tolerance 0.1 keeps single-valid windows
+        (out2,) = _FUNCS["stdev"](self._ctx(), [s], 4)
+        assert out2.values[0] == 0.0

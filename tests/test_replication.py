@@ -228,3 +228,68 @@ class TestDeviceCollectives:
         assert np.asarray(got).tolist() == [2, 1, 0, 1]
         ok1, _ = quorum_ack(topo, acks, required=1)
         assert np.asarray(ok1).tolist() == [True, True, False, True]
+
+
+class TestBadReplicaDoesNotAbortSweep:
+    """One replica surfacing application-level RPC failures (RemoteError,
+    e.g. a checksum error on a corrupt replica) must be demoted like an
+    unreachable one — never abort the anti-entropy sweep (reference:
+    per-host fetch failures, storage/repair.go:115-246)."""
+
+    class _SickReplica:
+        """Handle whose block reads fail at the application level."""
+
+        def __init__(self, inner, fail_on="read_block"):
+            self._inner = inner
+            self._fail_on = fail_on
+
+        def __getattr__(self, name):
+            from m3_tpu.server.rpc import RemoteError
+
+            if name == self._fail_on:
+                def boom(*a, **k):
+                    raise RemoteError("segment checksum mismatch")
+                return boom
+            return getattr(self._inner, name)
+
+    def _flushed_cluster(self, tmp_path):
+        p, dbs = _cluster(tmp_path)
+        s = ReplicatedSession(p, dbs, write_level=ConsistencyLevel.ALL)
+        ids = _write_corpus(s)
+        for db in dbs.values():
+            db.tick(T0 + BLOCK + NamespaceOptions().buffer_past_nanos + SEC)
+        return p, dbs, ids
+
+    def test_remote_error_on_metadata_demotes_not_aborts(self, tmp_path):
+        p, dbs, _ = self._flushed_cluster(tmp_path)
+        handles = list(dbs.values())
+        handles[1] = self._SickReplica(handles[1], fail_on="block_metadata")
+        rep = repair_namespace(handles, "default")
+        # The sick replica counts as missing per block; the healthy two
+        # still complete the sweep.
+        assert rep["blocks_missing"] > 0
+        assert rep["series_checked"] > 0
+
+    def test_remote_error_on_read_demotes_not_aborts(self, tmp_path):
+        p, dbs, _ = self._flushed_cluster(tmp_path)
+        handles = list(dbs.values())
+        handles[2] = self._SickReplica(handles[2], fail_on="read_block")
+        # Force a merge pass by wiping a healthy replica's block.
+        victim = handles[0]
+        shutil.rmtree(f"{victim.opts.root}/data/default/0", ignore_errors=True)
+        victim.namespaces["default"].shards[0].flushed_blocks.clear()
+        rep = repair_shard_block(handles, "default", 0, T0)
+        assert rep["blocks_missing"] >= 1  # sick replica demoted mid-sweep
+        # The wiped healthy replica got the merged block back.
+        assert block_metadata(victim, "default", 0, T0) is not None
+
+    def test_peers_bootstrap_skips_sick_peer(self, tmp_path):
+        p, dbs, _ = self._flushed_cluster(tmp_path)
+        victim = dbs["i1"]
+        shutil.rmtree(f"{victim.opts.root}/data/default/0", ignore_errors=True)
+        victim.namespaces["default"].shards[0].flushed_blocks.clear()
+        peers = [self._SickReplica(db) if name == "i2" else db
+                 for name, db in dbs.items()]
+        stats = peers_bootstrap(victim, peers, "default")
+        assert stats["blocks"] >= 1  # healthy peer i0 supplied the block
+        assert block_metadata(victim, "default", 0, T0) is not None
