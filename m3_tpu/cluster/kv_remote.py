@@ -235,42 +235,50 @@ class RemoteKVStore:
         could double-fire or swallow one version change.  Callbacks fire
         outside the lock (they may re-enter the store)."""
         cur = self.get(key)
-        fire: list = []
         with self._wmu:
             self._watchers.setdefault(key, []).append(fn)
-            if cur is not None:
-                seen = self._watch_seen.get(key)
-                if seen is None:
-                    self._watch_seen[key] = cur.version
-                    fire = [fn]
-                elif cur.version > seen:
-                    # Version moved past what the loop last delivered:
-                    # every watcher (not just the new one) must see it,
-                    # or the poll loop would skip this change.  The
-                    # ordered compare (not !=) means a registration that
-                    # read an OLDER version than the loop already
-                    # delivered cannot regress _watch_seen and re-fire
-                    # stale values at existing watchers.
-                    self._watch_seen[key] = cur.version
-                    fire = list(self._watchers[key])
-                else:
-                    if cur.version < seen:
-                        # Our pre-lock read lost a race with the poll
-                        # loop; re-read so the initial fire isn't stale
-                        # (versions are monotonic per key).
-                        try:
-                            cur = self.get(key) or cur
-                        except (ConnectionError, RuntimeError):
-                            pass
-                    fire = [fn]  # initial fire for the new watcher only
             start = self._watch_thread is None
             if start:
                 self._watch_thread = threading.Thread(
                     target=self._watch_loop, daemon=True)
+            fire = self._decide_locked(key, fn, cur)
+        if fire is None:
+            # Pre-lock read lost a race with the poll loop (cur older
+            # than what it delivered).  Re-read OUTSIDE the lock —
+            # network I/O under _wmu would stall every watcher — then
+            # reconcile; if the re-read fails too, deliver what we have
+            # rather than nothing.
+            try:
+                cur = self.get(key) or cur
+            except (ConnectionError, RuntimeError):
+                pass
+            with self._wmu:
+                fire = self._decide_locked(key, fn, cur)
+            if fire is None:
+                fire = [fn]
         for f in fire:
             self._fire(f, cur)
         if start:
             self._watch_thread.start()
+
+    def _decide_locked(self, key, fn, cur):
+        """Under _wmu: advance _watch_seen for ``cur`` and return the
+        callbacks to fire.  When ``cur`` moved past the loop's last
+        delivery — including the key-creation case where watchers
+        registered while the key was absent — EVERY watcher fires, or
+        the poll loop (which compares against the now-advanced seen)
+        would swallow that change for the others.  Returns None when
+        ``cur`` is older than seen: the caller re-reads outside the
+        lock (versions are monotonic per key)."""
+        if cur is None:
+            return []
+        seen = self._watch_seen.get(key)
+        if seen is None or cur.version > seen:
+            self._watch_seen[key] = cur.version
+            return list(self._watchers[key])
+        if cur.version == seen:
+            return [fn]  # initial fire for the new watcher only
+        return None
 
     @staticmethod
     def _fire(fn, cur) -> None:

@@ -10,9 +10,12 @@ Two forms of every transform:
 * scalar — Datapoint -> Datapoint, bit-faithful to the reference, used by
   the host-side oracle and tests;
 * batched — ``jnp`` arrays of shape (..., T) of values + timestamps, with a
-  carried ``prev`` lane for binary transforms, used by the aggregator
-  Consume path on device.  NaN marks "empty datapoint" exactly as the
-  reference uses an empty datapoint sentinel.
+  carried ``prev`` lane for binary transforms, for device-resident
+  multi-window consume paths.  NaN marks "empty datapoint" exactly as the
+  reference uses an empty datapoint sentinel.  (The host MetricList
+  consume path applies the scalar semantics row-wise — one aggregate per
+  (slot, type) per window — in ``aggregator/engine.py _apply_tails``;
+  these batched forms are its oracle-tested device counterpart.)
 """
 
 from __future__ import annotations

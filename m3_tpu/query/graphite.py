@@ -1008,25 +1008,42 @@ def _filter_series(ctx, series, func, op, threshold):
     return _filter_stat(series, what, pred)
 
 
-@_func("hitcount")
-def _hitcount(ctx, series, interval, aligned=False):
-    """Per-bucket hit totals (value x step-seconds summed per interval).
+_MINUTE_NANOS = 60 * 10**9
+_HOUR_NANOS = 3600 * 10**9
+_DAY_NANOS = 86400 * 10**9
 
-    graphite-web's ``alignToFrom`` (default False) aligns bucket
-    boundaries to epoch multiples of the interval; True aligns them to
-    the series start.  Both alignments are honored here — the first
-    bucket of an unaligned series covers only the partial interval up
-    to the next epoch boundary."""
-    nanos = max(_duration_nanos(str(interval)), 1)
+
+@_func("hitcount")
+def _hitcount(ctx, series, interval, align_to_interval=False):
+    """Per-bucket hit totals (value x step-seconds summed per interval),
+    graphite-web functions.py hitcount semantics:
+
+    * default — buckets are anchored at the series END
+      (``newStart = end - bucket_count*interval``), so any partial
+      bucket is the FIRST one;
+    * ``alignToInterval=True`` — the start truncates to the interval's
+      leading calendar unit (day/hour/minute) and buckets run forward
+      from there.  (graphite-web re-fetches from the truncated start;
+      without a re-fetch the pre-start remainder of that first bucket
+      is simply empty here.)"""
+    nanos = max(_duration_nanos(str(interval)),
+                1)
     out = []
     for s in series:
         T = len(s.values)
-        # A bucket can't be finer than the data's step (old-code clamp):
-        # an interval below the step would otherwise time-stretch the
-        # output and scatter values across mostly-NaN buckets.
+        # A bucket can't be finer than the data's step: an interval
+        # below the step would time-stretch the output.
         eff = max(nanos, s.step_nanos)
-        base = (s.start_nanos if aligned
-                else (s.start_nanos // eff) * eff)
+        end = s.start_nanos + T * s.step_nanos
+        if align_to_interval:
+            unit = (_DAY_NANOS if eff >= _DAY_NANOS
+                    else _HOUR_NANOS if eff >= _HOUR_NANOS
+                    else _MINUTE_NANOS if eff >= _MINUTE_NANOS
+                    else 10**9)
+            base = (s.start_nanos // unit) * unit
+        else:
+            nb0 = max(0, -(-(end - s.start_nanos) // eff))
+            base = end - nb0 * eff
         t = s.start_nanos + np.arange(T, dtype=np.int64) * s.step_nanos
         bidx = (t - base) // eff
         nb = int(bidx[-1]) + 1 if T else 0
@@ -1038,7 +1055,7 @@ def _hitcount(ctx, series, interval, aligned=False):
             w = s.values[edges[b]:edges[b + 1]]
             if w.size and (~np.isnan(w)).any():
                 res[b] = np.nansum(w) * secs
-        suffix = ",true" if aligned else ""
+        suffix = ",true" if align_to_interval else ""
         out.append(GraphiteSeries(
             f'hitcount({s.name},"{interval}"{suffix})', s.path, res,
             eff, base,

@@ -492,24 +492,27 @@ class TestAdvisedSemantics:
 
         return _Ctx(None, 0, 80 * 10**9, 10 * 10**9)
 
-    def test_hitcount_epoch_aligned_default(self):
+    def test_hitcount_end_anchored_default(self):
         from m3_tpu.query.graphite import _FUNCS
 
-        # Series starts 30s past the minute; default alignment buckets
-        # on epoch minute boundaries, so the first bucket holds only the
-        # 3 pre-boundary points (30/40/50s).
-        s = self._series("h", [1.0] * 9, start=30 * 10**9)
+        # graphite-web anchors buckets at the series END: 8 points
+        # @10s from t=30 end at t=110; two 60s buckets run back from
+        # 110, so the FIRST bucket is the partial one (t=[-10,50): the
+        # 2 points at 30/40), the second holds the 6 at 50..100.
+        s = self._series("h", [1.0] * 8, start=30 * 10**9)
         (out,) = _FUNCS["hitcount"](self._ctx(), [s], "1min")
-        assert out.start_nanos == 0
-        np.testing.assert_allclose(out.values, [30.0, 60.0])
+        assert out.start_nanos == -10 * 10**9
+        np.testing.assert_allclose(out.values, [20.0, 60.0])
 
-    def test_hitcount_align_to_from(self):
+    def test_hitcount_align_to_interval(self):
         from m3_tpu.query.graphite import _FUNCS
 
-        s = self._series("h", [1.0] * 9, start=30 * 10**9)
+        # alignToInterval=True truncates the start to the calendar
+        # minute: buckets [0,60) and [60,120) hold 3 and 5 points.
+        s = self._series("h", [1.0] * 8, start=30 * 10**9)
         (out,) = _FUNCS["hitcount"](self._ctx(), [s], "1min", True)
-        assert out.start_nanos == 30 * 10**9
-        np.testing.assert_allclose(out.values, [60.0, 30.0])
+        assert out.start_nanos == 0
+        np.testing.assert_allclose(out.values, [30.0, 50.0])
         assert ",true)" in out.name
 
     def test_stdev_window_tolerance(self):
