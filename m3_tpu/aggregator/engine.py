@@ -267,6 +267,7 @@ class MetricList:
         # reference's too-early/too-late errors (entry.go).
         self.consumed_until: int | None = None
         self.drops = 0
+        self.timed_rejects = {"too_early": 0, "too_far_future": 0}
         # Rollup pipeline TAILS: (metric type, slot) -> transformation
         # tuple, applied to that slot's window aggregates at consume
         # with per-(slot, aggregation type, op) previous-value state
@@ -338,6 +339,23 @@ class MetricList:
                 raise ValueError(f"unsupported pipeline op {op!r} in tail")
         return tuple(tail)
 
+    def _route_windows(self, times: np.ndarray):
+        """Window-ring routing for a batch of timestamps.  Returns
+        (windows int32 with the drop sentinel W for out-of-range,
+        too_early mask, too_future mask)."""
+        r = self.resolution
+        W = self.opts.num_windows
+        aligned = (times // r) * r
+        if self.consumed_until is None:
+            self.consumed_until = int(aligned.min())
+        base = self.consumed_until
+        offset = (aligned - base) // r
+        too_early = offset < 0
+        too_future = offset >= W
+        in_range = ~(too_early | too_future)
+        windows = np.where(in_range, (aligned // r) % W, W).astype(np.int32)
+        return windows, too_early, too_future
+
     def add_batch_slots(
         self,
         mt: MetricType,
@@ -346,19 +364,67 @@ class MetricList:
         times: np.ndarray,
     ) -> None:
         """Pure device path: slots already resolved (the hot loop)."""
-        r = self.resolution
-        W = self.opts.num_windows
-        aligned = (times // r) * r
-        if self.consumed_until is None:
-            self.consumed_until = int(aligned.min())
-        base = self.consumed_until
-        offset = (aligned - base) // r
-        in_range = (offset >= 0) & (offset < W)
-        self.drops += int((~in_range).sum())
-        windows = np.where(in_range, (aligned // r) % W, W).astype(np.int32)
+        windows, too_early, too_future = self._route_windows(times)
+        self.drops += int(too_early.sum()) + int(too_future.sum())
         self._arena(mt).ingest(
             jnp.asarray(windows), jnp.asarray(slots), jnp.asarray(values), jnp.asarray(times)
         )
+
+    def seed_windows(self, now_nanos: int) -> None:
+        """Anchor an un-seeded window ring to the caller's clock: the
+        ring becomes [now-(W-1)r, now+r) — (W-1) windows of bufferPast,
+        one of bufferFuture, the reference's now±buffer validation for
+        timed writes (entry.go addTimed).  No-op once seeded."""
+        if self.consumed_until is None:
+            r = self.resolution
+            W = self.opts.num_windows
+            self.consumed_until = (now_nanos // r) * r - (W - 1) * r
+
+    def timed_check(self, times: np.ndarray):
+        """Non-mutating window validation: (too_early, too_future)
+        masks for a timed batch.  An un-seeded list accepts anything
+        (ingest will seed from the batch)."""
+        if self.consumed_until is None:
+            z = np.zeros(len(times), bool)
+            return z, z
+        r = self.resolution
+        W = self.opts.num_windows
+        offset = ((times // r) * r - self.consumed_until) // r
+        return offset < 0, offset >= W
+
+    def add_timed_batch(
+        self,
+        mt: MetricType,
+        ids: Sequence[bytes],
+        values: np.ndarray,
+        times: np.ndarray,
+        agg_id: AggregationID = AggregationID.DEFAULT,
+        now_nanos: int | None = None,
+    ) -> np.ndarray:
+        """Timed ingestion (reference aggregator.go:77 AddTimed →
+        shard.AddTimed → entry.go addTimed): each sample lands in the
+        window its OWN timestamp selects, and out-of-range samples are
+        REJECTED back to the caller — errTooFarInThePast /
+        errTooFarInTheFuture in the reference — instead of the untimed
+        path's fire-and-forget drop counter.  Returns the accepted
+        mask; per-reason counts accumulate in ``timed_rejects``.
+
+        ``now_nanos`` anchors a FRESH list's window ring to the clock
+        (see seed_windows) — without it the first batch's minimum
+        timestamp seeds the ring, so one bogus ancient timestamp would
+        anchor it in the past and reject everything after it as
+        too-far-future.  Servers pass their wall clock."""
+        if now_nanos is not None:
+            self.seed_windows(now_nanos)
+        slots = self.maps[mt].resolve(ids, agg_id, mt)
+        windows, too_early, too_future = self._route_windows(times)
+        self.timed_rejects["too_early"] += int(too_early.sum())
+        self.timed_rejects["too_far_future"] += int(too_future.sum())
+        self._arena(mt).ingest(
+            jnp.asarray(windows), jnp.asarray(slots), jnp.asarray(values),
+            jnp.asarray(times)
+        )
+        return ~(too_early | too_future)
 
     def open_windows(self, now_nanos: int) -> List[int]:
         """Closed windows that can actually hold data.
@@ -550,6 +616,19 @@ class MetricList:
         return fm
 
 
+@dataclasses.dataclass
+class PassthroughBatch:
+    """Pre-aggregated samples bypassing the arenas entirely (reference
+    aggregator.go:86,422 AddPassthrough → passWriter.Write): already
+    carrying their storage policy, they go straight to the output
+    handler."""
+
+    policy: StoragePolicy
+    ids: list
+    values: np.ndarray
+    times: np.ndarray
+
+
 class AggregatorShard:
     """One aggregator shard: a MetricList per storage policy
     (reference shard.go:171 AddUntimed + list registry)."""
@@ -562,6 +641,40 @@ class AggregatorShard:
     def add_batch(self, mt, ids, values, times, agg_id=AggregationID.DEFAULT):
         for ml in self.lists.values():
             ml.add_batch(mt, ids, values, times, agg_id)
+
+    def add_timed_batch(self, mt, ids, values, times,
+                        agg_id=AggregationID.DEFAULT,
+                        now_nanos: int | None = None) -> np.ndarray:
+        """All-or-nothing across storage policies: a sample out of range
+        for ANY list is ingested into NONE (pre-checked without
+        mutation), so the returned reject mask is trustworthy — a
+        rejected sample never silently contributes to some policies'
+        aggregates, and a caller retrying it cannot double-count."""
+        lists = list(self.lists.values())
+        if now_nanos is not None:
+            for ml in lists:
+                ml.seed_windows(now_nanos)
+        accepted = np.ones(len(ids), bool)
+        for ml in lists:
+            early, future = ml.timed_check(times)
+            accepted &= ~(early | future)
+        sel = np.nonzero(accepted)[0]
+        if sel.size:
+            ids_sel = [ids[i] for i in sel]
+            for ml in lists:
+                acc = ml.add_timed_batch(mt, ids_sel, values[sel],
+                                         times[sel], agg_id)
+                # The pre-check guaranteed acceptance per list; a fresh
+                # un-seeded list seeds from this filtered batch.
+                accepted[sel] &= acc
+        if not accepted.all():
+            # Count cross-policy rejects on every list that did not see
+            # them in its own add (pre-checked ones never reached it).
+            for ml in lists:
+                early, future = ml.timed_check(times[~accepted])
+                ml.timed_rejects["too_early"] += int(early.sum())
+                ml.timed_rejects["too_far_future"] += int(future.sum())
+        return accepted
 
     def consume(self, target_nanos: int, flush_handler=None):
         out = []
@@ -578,9 +691,14 @@ class Aggregator:
     mesh (m3_tpu.parallel) so each device owns capacity/D slots.
     """
 
-    def __init__(self, num_shards: int = 1, opts: AggregatorOptions | None = None):
+    def __init__(self, num_shards: int = 1, opts: AggregatorOptions | None = None,
+                 passthrough_handler=None):
         self.opts = opts or AggregatorOptions()
         self.shards = [AggregatorShard(i, self.opts) for i in range(num_shards)]
+        # Passthrough output (reference passWriter): pre-aggregated
+        # samples skip the arenas and go straight here.
+        self.passthrough_handler = passthrough_handler
+        self.passthrough_samples = 0
 
     def shard_index(self, mid: bytes) -> int:
         # murmur3(id) % numShards, matching the reference router
@@ -602,6 +720,45 @@ class Aggregator:
             self.shards[sid].add_batch(
                 mt, [ids[i] for i in idxs], values[sel], times[sel], agg_id
             )
+
+    def add_timed_batch(self, mt, ids, values, times,
+                        agg_id=AggregationID.DEFAULT,
+                        now_nanos: int | None = None) -> np.ndarray:
+        """Timed ingestion with per-sample accept/reject (reference
+        aggregator.go:77 AddTimed; see MetricList.add_timed_batch)."""
+        values = np.asarray(values, np.float64)
+        times = np.asarray(times, np.int64)
+        if len(self.shards) == 1:
+            return self.shards[0].add_timed_batch(
+                mt, ids, values, times, agg_id, now_nanos=now_nanos)
+        accepted = np.ones(len(ids), bool)
+        by_shard: Dict[int, List[int]] = {}
+        for i, mid in enumerate(ids):
+            by_shard.setdefault(self.shard_index(mid), []).append(i)
+        for sid, idxs in by_shard.items():
+            sel = np.asarray(idxs)
+            acc = self.shards[sid].add_timed_batch(
+                mt, [ids[i] for i in idxs], values[sel], times[sel], agg_id,
+                now_nanos=now_nanos)
+            accepted[sel] = acc
+        return accepted
+
+    def add_passthrough_batch(self, ids, values, times,
+                              policy: StoragePolicy) -> None:
+        """Pre-aggregated metrics go straight to the output handler with
+        their storage policy (reference aggregator.go:86,422
+        AddPassthrough → passWriter.Write) — no arenas, no windows.
+        Raises when no handler is configured: silently eating
+        passthrough traffic would be data loss."""
+        if self.passthrough_handler is None:
+            raise RuntimeError(
+                "no passthrough handler configured on this aggregator")
+        batch = PassthroughBatch(
+            policy=policy, ids=list(ids),
+            values=np.asarray(values, np.float64),
+            times=np.asarray(times, np.int64))
+        self.passthrough_samples += len(batch.ids)
+        self.passthrough_handler(batch)
 
     def consume(self, target_nanos: int, flush_handler=None):
         out = []

@@ -31,9 +31,11 @@ class InstanceQueue:
     (reference client/queue.go).  Connection errors park the buffer for
     the next flush (bounded by max_queue_size, drop-oldest)."""
 
-    def __init__(self, address: Tuple[str, int], max_queue_size: int = 1 << 16):
+    def __init__(self, address: Tuple[str, int], max_queue_size: int = 1 << 16,
+                 frame_type: int = wire.METRIC_BATCH):
         self.address = address
         self.max_queue_size = max_queue_size
+        self.frame_type = frame_type
         self._mts: list[int] = []
         self._ids: list[bytes] = []
         self._values: list[float] = []
@@ -77,7 +79,7 @@ class InstanceQueue:
         payload = wire.encode_metric_batch(batch)
         try:
             sock = self._connect()
-            wire.send_frame(sock, wire.METRIC_BATCH, payload)
+            wire.send_frame(sock, self.frame_type, payload)
         except OSError:
             # park the batch back for the next flush (retry)
             with self._lock:
@@ -93,6 +95,24 @@ class InstanceQueue:
             return 0
         self.sent += len(batch.ids)
         return len(batch.ids)
+
+    def send_raw(self, ftype: int, payload: bytes) -> bool:
+        """Send one pre-encoded frame immediately (passthrough traffic
+        is not queued: it is already aggregated and latency-sensitive).
+        Socket I/O happens OUTSIDE the queue lock, like flush(), so a
+        slow/down instance cannot stall the flush thread behind a
+        blocking connect.  Returns False on a connection error."""
+        try:
+            sock = self._connect()
+            wire.send_frame(sock, ftype, payload)
+            return True
+        except OSError:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+            return False
 
     def close(self) -> None:
         self.flush()
@@ -122,25 +142,33 @@ class AggregatorClient:
             self._thread = threading.Thread(target=self._loop, daemon=True)
             self._thread.start()
 
-    def _queue_for(self, instance_id: str) -> InstanceQueue:
-        q = self.queues.get(instance_id)
+    def _queue_for(self, instance_id: str,
+                   frame_type: int = wire.METRIC_BATCH) -> InstanceQueue:
+        key = (instance_id, frame_type)
+        q = self.queues.get(key)
         if q is None:
-            q = self.queues[instance_id] = InstanceQueue(
-                self.resolve(instance_id)
+            q = self.queues[key] = InstanceQueue(
+                self.resolve(instance_id), frame_type=frame_type
             )
         return q
 
-    def write_untimed(self, mt: int, mid: bytes, value: float, t: int) -> int:
-        """Enqueue to every available owner; returns owners reached."""
+    def _enqueue_routed(self, frame_type: int, mt: int, mid: bytes,
+                        value: float, t: int) -> int:
+        """Enqueue to every available owner of the sample's shard;
+        returns owners reached (shared by the untimed/timed paths)."""
         shard = shard_for(mid, self.placement.num_shards)
         n = 0
         for inst in self.placement.instances_for_shard(shard):
             a = inst.shards[shard]
             if a.state == ShardState.LEAVING:
                 continue
-            self._queue_for(inst.id).enqueue(mt, mid, value, t)
+            self._queue_for(inst.id, frame_type).enqueue(mt, mid, value, t)
             n += 1
         return n
+
+    def write_untimed(self, mt: int, mid: bytes, value: float, t: int) -> int:
+        """Enqueue to every available owner; returns owners reached."""
+        return self._enqueue_routed(wire.METRIC_BATCH, mt, mid, value, t)
 
     def write_batch(self, mts, ids, values, times) -> int:
         n = 0
@@ -149,6 +177,43 @@ class AggregatorClient:
                 int(mts[i]), mid, float(values[i]), int(times[i])
             )
         return n
+
+    def write_timed(self, mt: int, mid: bytes, value: float, t: int) -> int:
+        """Timed samples ride their own queues and frame type so the
+        server routes them through AddTimed's strict window validation
+        (reference aggregator.go:77; client m3msg_client.go timed
+        path)."""
+        return self._enqueue_routed(wire.TIMED_BATCH, mt, mid, value, t)
+
+    def write_timed_batch(self, mts, ids, values, times) -> int:
+        n = 0
+        for i, mid in enumerate(ids):
+            n += self.write_timed(
+                int(mts[i]), mid, float(values[i]), int(times[i])
+            )
+        return n
+
+    def write_passthrough(self, ids, values, times, policy) -> int:
+        """Pre-aggregated samples: shard-route and send IMMEDIATELY as
+        PASSTHROUGH_BATCH frames (reference aggregator.go:86; these skip
+        the aggregation queues entirely).  Returns frames delivered."""
+        by_inst: Dict[str, list] = {}
+        for i, mid in enumerate(ids):
+            shard = shard_for(mid, self.placement.num_shards)
+            for inst in self.placement.instances_for_shard(shard):
+                if inst.shards[shard].state == ShardState.LEAVING:
+                    continue
+                by_inst.setdefault(inst.id, []).append(i)
+        sent = 0
+        for inst_id, idxs in by_inst.items():
+            payload = wire.encode_passthrough_batch(
+                str(policy), [ids[i] for i in idxs],
+                [float(values[i]) for i in idxs],
+                [int(times[i]) for i in idxs])
+            if self._queue_for(inst_id, wire.PASSTHROUGH_BATCH).send_raw(
+                    wire.PASSTHROUGH_BATCH, payload):
+                sent += 1
+        return sent
 
     def flush(self) -> int:
         return sum(q.flush() for q in self.queues.values())

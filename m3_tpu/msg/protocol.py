@@ -40,6 +40,10 @@ BUS_DELIVER = 4
 BUS_ACK = 5
 OK = 6
 ERROR = 7
+# (8/9 are the query federation frames in query/remote.py;
+#  16-18 dbnode RPC in server/rpc.py; 24-26 the KV control plane.)
+TIMED_BATCH = 11        # MetricBatch payload; samples land by own time
+PASSTHROUGH_BATCH = 12  # pre-aggregated, carries a storage policy
 
 
 class ProtocolError(ConnectionError):
@@ -133,6 +137,41 @@ def decode_metric_batch(raw: bytes) -> MetricBatch:
     if pos != len(raw):
         raise ProtocolError("metric batch trailing bytes")
     return MetricBatch(mts, ids, values, times, agg_id)
+
+
+def encode_passthrough_batch(policy: str, ids, values, times) -> bytes:
+    """PASSTHROUGH_BATCH payload: storage policy string + parallel
+    (id, time, value) entries (reference aggregator.go:86 AddPassthrough
+    carries metric + policy)."""
+    p = policy.encode()
+    parts = [struct.pack("<HI", len(p), len(ids)), p]
+    for i, sid in enumerate(ids):
+        parts.append(struct.pack("<H", len(sid)))
+        parts.append(sid)
+        parts.append(struct.pack("<qd", int(times[i]), float(values[i])))
+    return b"".join(parts)
+
+
+def decode_passthrough_batch(raw: bytes):
+    lp, n = struct.unpack_from("<HI", raw, 0)
+    pos = 6
+    policy = raw[pos:pos + lp].decode()
+    pos += lp
+    ids = []
+    values = np.empty(n, np.float64)
+    times = np.empty(n, np.int64)
+    for i in range(n):
+        (idlen,) = struct.unpack_from("<H", raw, pos)
+        pos += 2
+        ids.append(raw[pos:pos + idlen])
+        pos += idlen
+        t, v = struct.unpack_from("<qd", raw, pos)
+        pos += 16
+        times[i] = t
+        values[i] = v
+    if pos != len(raw):
+        raise ProtocolError("passthrough batch trailing bytes")
+    return policy, ids, values, times
 
 
 # -- bus transport payloads -------------------------------------------------

@@ -17,30 +17,49 @@ from __future__ import annotations
 import socket
 import socketserver
 import threading
+import time
 
 import numpy as np
 
+from m3_tpu.metrics.policy import StoragePolicy
 from m3_tpu.metrics.types import MetricType
 from m3_tpu.msg import protocol as wire
 
 
-def aggregator_sink(aggregator, lock: threading.Lock | None = None):
+def aggregator_sink(aggregator, lock: threading.Lock | None = None,
+                    clock=time.time_ns):
     """Standard sink: group a wire batch by metric type (the engine
     ingests one type per call, like the reference's per-union dispatch
-    in AddUntimed) and feed the aggregator under `lock`."""
+    in AddUntimed) and feed the aggregator under `lock`.
+
+    The returned sink handles all three ingest classes (reference
+    aggregator.go AddUntimed :263 / AddTimed :77 / AddPassthrough :86)
+    via its ``kind`` argument — the frame type dispatches in the
+    handler."""
     lock = lock or threading.Lock()
 
-    def sink(batch: "wire.MetricBatch") -> None:
-        mts = np.asarray(batch.metric_types)
+    def sink(batch, kind: int = wire.METRIC_BATCH) -> None:
         with lock:
+            if kind == wire.PASSTHROUGH_BATCH:
+                policy, ids, values, times = batch
+                aggregator.add_passthrough_batch(
+                    ids, values, times, StoragePolicy.parse(policy))
+                return
+            mts = np.asarray(batch.metric_types)
             for mt in np.unique(mts):
                 sel = np.nonzero(mts == mt)[0]
-                aggregator.add_untimed_batch(
-                    MetricType(int(mt)),
-                    [batch.ids[i] for i in sel],
-                    batch.values[sel],
-                    batch.times[sel],
-                )
+                ids = [batch.ids[i] for i in sel]
+                if kind == wire.TIMED_BATCH:
+                    # The server clock anchors fresh window rings
+                    # (entry.go addTimed validates against now±buffer).
+                    aggregator.add_timed_batch(
+                        MetricType(int(mt)), ids,
+                        batch.values[sel], batch.times[sel],
+                        now_nanos=clock())
+                else:
+                    aggregator.add_untimed_batch(
+                        MetricType(int(mt)), ids,
+                        batch.values[sel], batch.times[sel])
 
     return sink
 
@@ -60,19 +79,37 @@ class _IngestHandler(socketserver.BaseRequestHandler):
             if frame is None:
                 break
             ftype, payload = frame
-            if ftype != wire.METRIC_BATCH:
+            if ftype not in (wire.METRIC_BATCH, wire.TIMED_BATCH,
+                             wire.PASSTHROUGH_BATCH):
                 if srv.scope is not None:
                     srv.scope.counter("unknown_frames").inc()
                 break
             try:
-                batch = wire.decode_metric_batch(payload)
+                if ftype == wire.PASSTHROUGH_BATCH:
+                    batch = wire.decode_passthrough_batch(payload)
+                    n = len(batch[1])
+                else:
+                    batch = wire.decode_metric_batch(payload)
+                    n = len(batch.ids)
             except (wire.ProtocolError, Exception):  # noqa: BLE001
                 if srv.scope is not None:
                     srv.scope.counter("decode_errors").inc()
                 break
-            srv.sink(batch)
+            try:
+                if ftype == wire.METRIC_BATCH:
+                    srv.sink(batch)  # one-arg call: custom sinks keep working
+                else:
+                    srv.sink(batch, ftype)
+            except Exception:  # noqa: BLE001 — a sink fault (e.g. no
+                # passthrough handler configured, or a one-arg custom
+                # sink receiving a timed frame) must close THIS
+                # connection with a counter, not kill the handler
+                # thread with an unrecorded traceback.
+                if srv.scope is not None:
+                    srv.scope.counter("sink_errors").inc()
+                break
             if srv.scope is not None:
-                srv.scope.counter("samples").inc(len(batch.ids))
+                srv.scope.counter("samples").inc(n)
 
 
 class IngestServer(socketserver.ThreadingTCPServer):

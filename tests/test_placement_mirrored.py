@@ -170,7 +170,7 @@ class TestMirroredRoundtripAndClient:
 
         client = AggregatorClient(p, resolve=lambda iid: ("127.0.0.1", 1))
         client.queues = {}
-        client._queue_for = lambda iid: client.queues.setdefault(
+        client._queue_for = lambda iid, ftype=None: client.queues.setdefault(
             iid, _FakeQueue(iid)
         )
         n = client.write_untimed(0, b"metric-x", 1.0, 0)

@@ -153,7 +153,7 @@ class TestIngestPath:
         owners = [i.id for i in placement.instances_for_shard(shard)]
         n = client.write_untimed(int(MetricType.COUNTER), mid, 1.0, T0 + 1)
         assert n == len(owners) == 1
-        assert set(client.queues) == set(owners)
+        assert {k[0] for k in client.queues} == set(owners)
         client.close()
         for srv in servers.values():
             srv.shutdown()
@@ -227,4 +227,135 @@ class TestBusTransport:
         assert bus.acked >= 1
         prod.close()
         cons.close()
+        srv.shutdown()
+
+
+class TestTimedAndPassthroughWire:
+    """The two new ingest classes over the real socket path (reference
+    rawtcp carries untimed/timed/forwarded/passthrough unions)."""
+
+    def _server(self, **agg_kwargs):
+        from m3_tpu import instrument
+        from m3_tpu.aggregator.engine import AggregatorOptions
+        from m3_tpu.metrics.policy import StoragePolicy
+
+        agg = Aggregator(
+            num_shards=4,
+            opts=AggregatorOptions(
+                capacity=256, num_windows=4, timer_sample_capacity=1 << 12,
+                storage_policies=(StoragePolicy.parse("10s:2d"),)),
+            **agg_kwargs)
+        reg = instrument.new_registry()
+        # synthetic server clock near the corpus epoch: the sink anchors
+        # fresh timed window rings to it (wall time would reject T0)
+        srv = serve_ingest_background(
+            aggregator_sink(agg, clock=lambda: T0 + WINDOW + 1),
+            instrument=reg.scope(""))
+        return agg, srv, reg
+
+    def _wait_samples(self, reg, n, timeout=120.0):
+        """The samples counter increments only after the sink call has
+        fully ingested the frame — waiting on engine internals instead
+        races the server thread."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if reg.snapshot().get("ingest_tcp.samples", 0) >= n:
+                return
+            time.sleep(0.05)
+        raise AssertionError(f"server never processed {n} samples")
+
+    def test_timed_batch_over_socket(self):
+        agg, srv, reg = self._server()
+        insts = [Instance("i0", isolation_group="g0")]
+        placement = initial_placement(insts, num_shards=4, rf=1)
+        client = AggregatorClient(placement, lambda iid: ("127.0.0.1", srv.port))
+        R = 10 * 10**9
+        client.write_timed(int(MetricType.COUNTER), b"timed.c", 3.0, T0 + R + 1)
+        client.write_timed(int(MetricType.COUNTER), b"timed.c", 4.0, T0 + 1)
+        client.flush()
+        self._wait_samples(reg, 2)
+        out = agg.consume(T0 + 3 * R)
+        by_ts = {}
+        from m3_tpu.metrics.aggregation import AggregationType
+        for fm in out:
+            for t, v in zip(fm.types, fm.values):
+                if int(t) == int(AggregationType.SUM):
+                    by_ts[fm.timestamp_nanos] = float(v)
+        # each sample landed in its own timestamp's window
+        assert by_ts.get(T0 + R) == 4.0
+        assert by_ts.get(T0 + 2 * R) == 3.0
+        client.close()
+        srv.shutdown()
+
+    def test_passthrough_over_socket(self):
+        got = []
+        agg, srv, _reg = self._server(passthrough_handler=got.append)
+        insts = [Instance("i0", isolation_group="g0")]
+        placement = initial_placement(insts, num_shards=4, rf=1)
+        client = AggregatorClient(placement, lambda iid: ("127.0.0.1", srv.port))
+        from m3_tpu.metrics.policy import StoragePolicy
+
+        sp = StoragePolicy.parse("1m:40d")
+        n = client.write_passthrough(
+            [b"pre.agg.a", b"pre.agg.b"], [1.5, 2.5], [T0, T0], sp)
+        assert n == 1
+        deadline = time.monotonic() + 5
+        while not got and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert len(got) == 1
+        pb = got[0]
+        assert pb.policy == sp
+        assert sorted(pb.ids) == [b"pre.agg.a", b"pre.agg.b"]
+        assert list(pb.values) == [1.5, 2.5]
+        # passthrough never touched the arenas
+        assert agg.consume(10**30) == []
+        client.close()
+        srv.shutdown()
+
+
+class TestTimedClockAnchor:
+    def test_bogus_ancient_timestamp_cannot_anchor_ring(self):
+        """With a clock-anchored ring (now_nanos), one ancient timestamp
+        in the first timed batch is rejected too-early instead of
+        seeding the ring in the past and poisoning all later writes."""
+        from m3_tpu.aggregator.engine import AggregatorOptions
+        from m3_tpu.metrics.policy import StoragePolicy
+
+        agg = Aggregator(num_shards=1, opts=AggregatorOptions(
+            capacity=64, num_windows=4, timer_sample_capacity=1 << 10,
+            storage_policies=(StoragePolicy.parse("10s:2d"),)))
+        now = T0 + 10**9
+        acc = agg.add_timed_batch(
+            MetricType.COUNTER, [b"old", b"cur"], np.asarray([1.0, 2.0]),
+            np.asarray([0, now], np.int64), now_nanos=now)
+        assert list(acc) == [False, True]
+        # and the current-time sample keeps landing
+        acc2 = agg.add_timed_batch(
+            MetricType.COUNTER, [b"cur"], np.asarray([3.0]),
+            np.asarray([now + 1], np.int64), now_nanos=now)
+        assert acc2.all()
+
+    def test_sink_error_counted_not_fatal(self):
+        """A PASSTHROUGH frame hitting a server with no passthrough
+        handler closes that connection with a sink_errors counter —
+        the handler thread must not die with a raw traceback."""
+        from m3_tpu import instrument
+        from m3_tpu.msg.protocol import encode_passthrough_batch
+
+        agg = Aggregator(num_shards=1)  # no passthrough handler
+        reg = instrument.new_registry()
+        srv = serve_ingest_background(aggregator_sink(agg),
+                                      instrument=reg.scope(""))
+        s = socket.create_connection(("127.0.0.1", srv.port))
+        payload = encode_passthrough_batch("1m:40d", [b"x"], [1.0], [T0])
+        wire.send_frame(s, wire.PASSTHROUGH_BATCH, payload)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if reg.snapshot().get("ingest_tcp.sink_errors", 0) >= 1:
+                break
+            time.sleep(0.05)
+        assert reg.snapshot().get("ingest_tcp.sink_errors", 0) == 1
+        s.settimeout(1.0)
+        assert s.recv(1) == b""  # server closed the poisoned connection
+        s.close()
         srv.shutdown()
