@@ -28,6 +28,7 @@ entry lookup with rate-limited entry creation.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Callable, Dict, List, Sequence
 
 import jax.numpy as jnp
@@ -49,6 +50,18 @@ _SUPPORTED_TAIL = frozenset({
     TransformationType.ABSOLUTE, TransformationType.ADD,
     TransformationType.PER_SECOND, TransformationType.INCREASE,
 })
+
+
+@dataclasses.dataclass(frozen=True)
+class ForwardSpec:
+    """Next pipeline stage for a forwarded metric (reference
+    forwarded_writer.go:186 Register / aggregator.go:395 AddForwarded):
+    the resolved next-stage output ID, its aggregation, and whatever
+    ops remain after it."""
+
+    id: bytes
+    aggregation_id: "AggregationID"
+    tail: tuple  # ops after this rollup (transforms / applied rollups)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -268,6 +281,7 @@ class MetricList:
         self.consumed_until: int | None = None
         self.drops = 0
         self.timed_rejects = {"too_early": 0, "too_far_future": 0}
+        self.forward_errors = 0
         # Rollup pipeline TAILS: (metric type, slot) -> transformation
         # tuple, applied to that slot's window aggregates at consume
         # with per-(slot, aggregation type, op) previous-value state
@@ -277,6 +291,11 @@ class MetricList:
         # tail ops tuple -> small stable signature for MetricMap's
         # per-slot conflict check (0 is reserved for "no tail").
         self._tail_sigs: Dict[tuple, int] = {}
+        # Stage outputs awaiting delivery to their next-stage owner:
+        # (ForwardSpec, value, window-end ts) tuples buffered at consume
+        # and drained by the owning Aggregator/Downsampler AFTER the
+        # consume pass (no re-entrant ingest mid-drain).
+        self._forward_buffer: List[tuple] = []
 
     def _arena(self, mt: MetricType):
         return {
@@ -310,6 +329,14 @@ class MetricList:
         sig, key_ops = 0, ()
         if pipeline is not None and not pipeline.is_empty():
             key_ops = self._validate_tail(pipeline)
+            if any(isinstance(op, ForwardSpec) for op in key_ops):
+                mask = self.maps[mt]._mask_for(agg_id, mt)
+                if bin(mask).count("1") != 1:
+                    raise ValueError(
+                        "a pipeline stage that forwards to a next rollup "
+                        "must aggregate exactly ONE type (got mask "
+                        f"{mask:#x}): multiple aggregate kinds would "
+                        "conflate into one next-stage series")
             sig = self._tail_sigs.setdefault(key_ops,
                                              len(self._tail_sigs) + 1)
         slots = self.maps[mt].resolve(ids, agg_id, mt, tail_sig=sig)
@@ -320,10 +347,17 @@ class MetricList:
 
     @staticmethod
     def _validate_tail(pipeline) -> tuple:
-        from m3_tpu.metrics.pipeline import RollupOp, TransformationOp
+        """Parse a pipeline tail into (transform types...,
+        ForwardSpec?) — transforms up to the first APPLIED rollup op
+        become this stage's consume-time transforms; the rollup op and
+        everything after it become the forward target (validated when
+        the next stage registers them)."""
+        from m3_tpu.metrics.pipeline import (
+            AppliedRollupOp, RollupOp, TransformationOp)
 
         tail = []
-        for op in pipeline.ops:
+        ops = list(pipeline.ops)
+        for i, op in enumerate(ops):
             if isinstance(op, TransformationOp):
                 if op.type not in _SUPPORTED_TAIL:
                     raise ValueError(
@@ -331,10 +365,22 @@ class MetricList:
                         "in rollup tail (RESET needs multi-datapoint "
                         "emission; see metrics/transformation.py)")
                 tail.append(op.type)
+            elif isinstance(op, AppliedRollupOp):
+                # Validate the WHOLE remaining chain now: a bad op deep
+                # in a multi-stage tail must fail at registration (the
+                # user-facing ingest call), never mid-consume where it
+                # would wedge flushing for every metric.
+                from m3_tpu.metrics.pipeline import Pipeline as _P
+
+                MetricList._validate_tail(_P(tuple(ops[i + 1:])))
+                tail.append(ForwardSpec(op.id, op.aggregation_id,
+                                        tuple(ops[i + 1:])))
+                break
             elif isinstance(op, RollupOp):
                 raise ValueError(
-                    "multi-stage rollup tails route through the "
-                    "forwarded-metric writer, not a MetricList tail")
+                    "unapplied RollupOp in tail: rules must resolve "
+                    "downstream rollups to AppliedRollupOp (rules.py "
+                    "forward_match) before registration")
             else:
                 raise ValueError(f"unsupported pipeline op {op!r} in tail")
         return tuple(tail)
@@ -446,30 +492,103 @@ class MetricList:
             t += r
         return out
 
-    def consume(self, target_nanos: int, flush_handler: FlushHandler | None = None):
+    def consume(self, target_nanos: int, flush_handler: FlushHandler | None = None,
+                forward_sink=None):
         """Drain every closed window (reference generic_elem.go:271
-        Consume: windows with start+resolution <= target)."""
+        Consume: windows with start+resolution <= target).
+
+        Forwarded stage outputs are delivered PER WINDOW, immediately
+        after the window that produced them drains: a stage-1 aggregate
+        of window t carries timestamp t+r, which is exactly the window
+        the ring just opened — so when one consume pass drains several
+        windows, each hop lands one window later instead of falling
+        behind the advancing watermark and being dropped.
+        ``forward_sink`` (the Aggregator's shard router) receives the
+        entries; by default they re-ingest into this list — the
+        downsampler's same-list multi-stage case."""
         results = []
-        for start in self.open_windows(target_nanos):
-            w = (start // self.resolution) % self.opts.num_windows
-            ts = start + self.resolution  # end-of-window timestamp
-            for mt in (MetricType.COUNTER, MetricType.GAUGE, MetricType.TIMER):
-                arena = self._arena(mt)
-                lanes, counts = arena.consume(w)
-                flushed = self._emit(mt, arena, lanes, counts, ts)
-                if flushed is not None:
-                    results.append(flushed)
-                    if flush_handler is not None:
-                        flush_handler(self, flushed)
-                arena.reset_window(w)
-            self.consumed_until = start + self.resolution
+        deliver = forward_sink if forward_sink is not None else self.add_forwarded
+        # Loop until no closed window remains: per-window forward
+        # delivery can put data into the window right past the ring
+        # (the last drained window's outputs), so after a long idle gap
+        # the ring must keep draining until the forward chain settles —
+        # jumping the watermark immediately would strand those entries
+        # in never-drained ring rows.
+        while True:
+            starts = self.open_windows(target_nanos)
+            if not starts:
+                break
+            delivered = False
+            for start in starts:
+                w = (start // self.resolution) % self.opts.num_windows
+                ts = start + self.resolution  # end-of-window timestamp
+                for mt in (MetricType.COUNTER, MetricType.GAUGE,
+                           MetricType.TIMER):
+                    arena = self._arena(mt)
+                    lanes, counts = arena.consume(w)
+                    flushed = self._emit(mt, arena, lanes, counts, ts)
+                    if flushed is not None:
+                        results.append(flushed)
+                        if flush_handler is not None:
+                            flush_handler(self, flushed)
+                    arena.reset_window(w)
+                self.consumed_until = start + self.resolution
+                if self._forward_buffer:
+                    buf = self._forward_buffer
+                    self._forward_buffer = []
+                    delivered = True
+                    deliver(buf)
+            if not delivered:
+                break
         if self.consumed_until is not None:
             r = self.resolution
             floor_target = (target_nanos // r) * r
             if floor_target > self.consumed_until:
-                # Idle gap beyond the window ring: skip empty windows.
+                # Idle gap beyond the window ring: skip empty windows
+                # (ingest only ever accepted [consumed_until, +W*r), all
+                # drained above, and the settle loop handled forwards).
                 self.consumed_until = floor_target
         return results
+
+    def add_forwarded(self, entries: List[tuple]) -> None:
+        """Ingest forwarded stage outputs (reference aggregator.go:395
+        AddForwarded): each (ForwardSpec, value, ts) lands under the
+        spec's output ID and aggregation with any remaining ops as this
+        stage's tail.  Carried on the gauge arena — a forwarded partial
+        aggregate is a plain float the next stage re-aggregates.
+
+        Arrivals outside this list's open ring (a cross-shard hop whose
+        destination is ahead of or behind the source this pass) clamp
+        into the nearest open window rather than dropping — the role of
+        the reference's maxAllowedForwardingDelay tolerance: bounded
+        timing skew, never silent loss.  A tail-signature conflict
+        (two rules forwarding DIFFERENT remaining tails to one output
+        ID) drops that group with ``forward_errors`` counted: raising
+        here would wedge the whole consume pass for unrelated
+        metrics."""
+        from m3_tpu.metrics.pipeline import Pipeline
+
+        groups: Dict[tuple, List[tuple]] = {}
+        r = self.resolution
+        hi = (None if self.consumed_until is None else
+              self.consumed_until + (self.opts.num_windows - 1) * r)
+        for spec, v, ts in entries:
+            if self.consumed_until is not None:
+                ts = min(max(ts, self.consumed_until), hi)
+            groups.setdefault((spec.aggregation_id, spec.tail), []).append(
+                (spec.id, v, ts))
+        for (agg_id, tail), items in groups.items():
+            try:
+                self.add_batch(
+                    MetricType.GAUGE,
+                    [mid for mid, _, _ in items],
+                    np.asarray([v for _, v, _ in items], np.float64),
+                    np.asarray([ts for _, _, ts in items], np.int64),
+                    agg_id,
+                    pipeline=Pipeline(tail) if tail else None,
+                )
+            except ValueError:
+                self.forward_errors += len(items)
 
     def expire(self, now_nanos: int, ttl_nanos: int) -> int:
         """Release slots idle for longer than ttl (the reference GCs
@@ -566,6 +685,14 @@ class MetricList:
             v = float(values[i])
             for k, tt in enumerate(tail):
                 skey = (mt, int(slot), int(t_), k)
+                if isinstance(tt, ForwardSpec):
+                    # Multi-stage pipeline: this stage's (transformed)
+                    # window aggregate forwards to the next stage's
+                    # owner instead of flushing locally (reference
+                    # generic_elem Consume -> flushForwardedFn).
+                    self._forward_buffer.append((tt, v, ts))
+                    keep[i] = False
+                    break
                 if tt == TransformationType.ABSOLUTE:
                     v = abs(v)
                 elif tt == TransformationType.ADD:
@@ -676,10 +803,13 @@ class AggregatorShard:
                 ml.timed_rejects["too_far_future"] += int(future.sum())
         return accepted
 
-    def consume(self, target_nanos: int, flush_handler=None):
+    def consume(self, target_nanos: int, flush_handler=None,
+                forward_sink=None):
         out = []
-        for ml in self.lists.values():
-            out.extend(ml.consume(target_nanos, flush_handler))
+        for sp, ml in self.lists.items():
+            sink = (None if forward_sink is None
+                    else functools.partial(forward_sink, sp))
+            out.extend(ml.consume(target_nanos, flush_handler, sink))
         return out
 
 
@@ -743,6 +873,34 @@ class Aggregator:
             accepted[sel] = acc
         return accepted
 
+    def _route_forwards(self, policy: StoragePolicy,
+                        entries: List[tuple]) -> None:
+        """Per-window forward sink (consume context): same routing as
+        add_forwarded_batch but non-strict — consume must not raise on
+        a policy mismatch; mis-delivery is impossible for self-routed
+        forwards (the policy came from our own list registry)."""
+        self.add_forwarded_batch(policy, entries, strict=False)
+
+    def add_forwarded_batch(self, policy: StoragePolicy,
+                            entries: List[tuple],
+                            strict: bool = True) -> None:
+        """AddForwarded (aggregator.go:395): deliver stage outputs —
+        from this process's consume pass or another aggregator over the
+        wire — to the owning shard's list for ``policy``, routed by the
+        NEXT stage's metric ID (forwarded_writer.go)."""
+        by_shard: Dict[int, List[tuple]] = {}
+        for spec, v, ts in entries:
+            by_shard.setdefault(self.shard_index(spec.id), []).append(
+                (spec, v, ts))
+        for sidx, items in by_shard.items():
+            ml = self.shards[sidx].lists.get(policy)
+            if ml is None:
+                if strict:
+                    raise ValueError(
+                        f"no metric list for storage policy {policy}")
+                continue
+            ml.add_forwarded(items)
+
     def add_passthrough_batch(self, ids, values, times,
                               policy: StoragePolicy) -> None:
         """Pre-aggregated metrics go straight to the output handler with
@@ -763,7 +921,8 @@ class Aggregator:
     def consume(self, target_nanos: int, flush_handler=None):
         out = []
         for sh in self.shards:
-            out.extend(sh.consume(target_nanos, flush_handler))
+            out.extend(sh.consume(target_nanos, flush_handler,
+                                  forward_sink=self._route_forwards))
         return out
 
 

@@ -29,6 +29,7 @@ Semantics preserved from the reference:
 
 from __future__ import annotations
 
+import functools
 import json
 from typing import Callable, Dict, List, Tuple
 
@@ -142,20 +143,28 @@ class FlushManager:
                 if self.flush_handler is not None:
                     self.flush_handler(ml, fm)
 
+            # Route through the aggregator's forward sink: multi-stage
+            # rollup outputs must land on the NEXT stage's owning shard,
+            # not re-ingest into their source shard's list.
             for sh in self.aggregator.shards:
-                for ml in sh.lists.values():
-                    ml.consume(now_nanos, emit)
+                sh.consume(now_nanos, emit,
+                           forward_sink=self.aggregator._route_forwards)
             self._write_times(self._collect_times())
             return "leader"
 
         # Follower: drain to the leader's watermark, discarding output
         # (our replica aggregated the same stream; the leader emitted it).
+        # Forwards still shard-route so the replica's stage-2 state
+        # matches the leader's placement.
         times, _ = self._read_times()
         for sh in self.aggregator.shards:
             for sp, ml in sh.lists.items():
                 t = times.get((sh.shard_id, str(sp)))
                 if t is not None:
-                    ml.consume(t, None)
+                    ml.consume(
+                        t, None,
+                        functools.partial(
+                            self.aggregator._route_forwards, sp))
         return "follower"
 
     def resign(self) -> None:

@@ -105,6 +105,11 @@ class Downsampler:
                         (sp, m.aggregation_id, doc.id, None), []).append(i)
             for r in res.rollups:
                 self._series_tags.setdefault(r.id, r.tags)
+                for sid2, stags2 in r.stage_tags:
+                    # Downstream pipeline stages' outputs need their
+                    # tags registered too, or the final writeback
+                    # couldn't index them.
+                    self._series_tags.setdefault(sid2, stags2)
                 pl = r.pipeline if not r.pipeline.is_empty() else None
                 for sp in r.policies:
                     batches.setdefault(
@@ -131,6 +136,9 @@ class Downsampler:
         suffixing, e.g. `.p99` for timer quantiles)."""
         written = 0
         for sp, ml in self._lists.items():
+            # Multi-stage rollups: consume self-delivers forwarded stage
+            # outputs per window back into this list (the in-process
+            # forwarded writer); each hop flushes one window later.
             for flushed in ml.consume(now_nanos):
                 owner = ml.maps[flushed.metric_type]
                 ids: List[bytes] = []

@@ -17,7 +17,7 @@ from dataclasses import dataclass, field
 from m3_tpu.metrics.aggregation import AggregationID
 from m3_tpu.metrics.filters import TagsFilter
 from m3_tpu.metrics.pipeline import (
-    AggregationOp, Pipeline, RollupOp, TransformationOp,
+    AggregationOp, AppliedRollupOp, Pipeline, RollupOp, TransformationOp,
 )
 from m3_tpu.metrics.policy import StoragePolicy
 
@@ -62,13 +62,21 @@ class MappingResult:
 @dataclass(frozen=True)
 class RollupResult:
     """Resolved rollup: the new metric ID plus its pipeline tail
-    (reference active_ruleset.go rollupResultsFor + toRollupResults)."""
+    (reference active_ruleset.go rollupResultsFor + toRollupResults).
+
+    The tail is the APPLIED form (pipeline/applied/type.go): any further
+    rollup ops are resolved against the source metric's tags into
+    AppliedRollupOp — multi-stage pipelines forward stage-N window
+    aggregates to those IDs (forwarded_writer.go:186).  ``stage_tags``
+    carries each downstream stage's (id, tags) so callers can index the
+    eventual outputs."""
 
     id: bytes
     tags: dict
     pipeline: Pipeline
     policies: tuple[StoragePolicy, ...]
     aggregation_id: AggregationID
+    stage_tags: tuple = ()
 
 
 @dataclass(frozen=True)
@@ -164,13 +172,28 @@ class ActiveRuleSet:
                 if rollup is None:
                     continue
                 rid, rtags = rollup_id(rollup.new_name, tags, rollup.tags)
+                # Apply the tail: downstream RollupOps resolve their
+                # output IDs against the SOURCE metric's tags now
+                # (reference pipeline/applied — forwarding needs the
+                # concrete next-stage ID, not a tag selector).
+                tail_ops: list = []
+                stage_tags: list = []
+                for op in ops[tail_start:]:
+                    if isinstance(op, RollupOp):
+                        sid2, stags2 = rollup_id(op.new_name, tags, op.tags)
+                        tail_ops.append(
+                            AppliedRollupOp(sid2, op.aggregation_id))
+                        stage_tags.append((sid2, stags2))
+                    else:
+                        tail_ops.append(op)
                 rollups.append(
                     RollupResult(
                         id=rid,
                         tags=rtags,
-                        pipeline=Pipeline(ops[tail_start:]),
+                        pipeline=Pipeline(tuple(tail_ops)),
                         policies=target.policies,
                         aggregation_id=agg_id,
+                        stage_tags=tuple(stage_tags),
                     )
                 )
         return MatchResult(tuple(mappings), tuple(rollups), drop)

@@ -44,6 +44,7 @@ ERROR = 7
 #  16-18 dbnode RPC in server/rpc.py; 24-26 the KV control plane.)
 TIMED_BATCH = 11        # MetricBatch payload; samples land by own time
 PASSTHROUGH_BATCH = 12  # pre-aggregated, carries a storage policy
+FORWARDED_BATCH = 13    # stage-N pipeline outputs for the next stage
 
 
 class ProtocolError(ConnectionError):
@@ -172,6 +173,77 @@ def decode_passthrough_batch(raw: bytes):
     if pos != len(raw):
         raise ProtocolError("passthrough batch trailing bytes")
     return policy, ids, values, times
+
+
+def encode_forwarded_batch(policy: str, entries) -> bytes:
+    """FORWARDED_BATCH payload (reference forwarded_writer.go wire
+    role): storage policy + per-entry (ForwardSpec, value, ts).  The
+    spec's remaining tail is flattened as op records: kind 0 =
+    transformation (type byte), kind 1 = applied rollup (id +
+    aggregation mask) — enough to reconstruct the next stages."""
+    from m3_tpu.metrics.pipeline import AppliedRollupOp, TransformationOp
+
+    p = policy.encode()
+    parts = [struct.pack("<HI", len(p), len(entries)), p]
+    for spec, v, ts in entries:
+        parts.append(struct.pack("<H", len(spec.id)))
+        parts.append(spec.id)
+        parts.append(struct.pack("<QqdB", int(spec.aggregation_id),
+                                 int(ts), float(v), len(spec.tail)))
+        for op in spec.tail:
+            if isinstance(op, TransformationOp):
+                parts.append(struct.pack("<BB", 0, int(op.type)))
+            elif isinstance(op, AppliedRollupOp):
+                parts.append(struct.pack("<BH", 1, len(op.id)))
+                parts.append(op.id)
+                parts.append(struct.pack("<Q", int(op.aggregation_id)))
+            else:
+                raise ProtocolError(f"unencodable forwarded op {op!r}")
+    return b"".join(parts)
+
+
+def decode_forwarded_batch(raw: bytes):
+    """Returns (policy str, entries list of (ForwardSpec, value, ts))."""
+    from m3_tpu.aggregator.engine import ForwardSpec
+    from m3_tpu.metrics.aggregation import AggregationID
+    from m3_tpu.metrics.pipeline import AppliedRollupOp, TransformationOp
+    from m3_tpu.metrics.transformation import TransformationType
+
+    lp, n = struct.unpack_from("<HI", raw, 0)
+    pos = 6
+    policy = raw[pos:pos + lp].decode()
+    pos += lp
+    entries = []
+    for _ in range(n):
+        (idlen,) = struct.unpack_from("<H", raw, pos)
+        pos += 2
+        sid = raw[pos:pos + idlen]
+        pos += idlen
+        agg, ts, v, nops = struct.unpack_from("<QqdB", raw, pos)
+        pos += 25
+        tail = []
+        for _ in range(nops):
+            (kind,) = struct.unpack_from("<B", raw, pos)
+            pos += 1
+            if kind == 0:
+                (tt,) = struct.unpack_from("<B", raw, pos)
+                pos += 1
+                tail.append(TransformationOp(TransformationType(tt)))
+            elif kind == 1:
+                (oplen,) = struct.unpack_from("<H", raw, pos)
+                pos += 2
+                oid = raw[pos:pos + oplen]
+                pos += oplen
+                (oagg,) = struct.unpack_from("<Q", raw, pos)
+                pos += 8
+                tail.append(AppliedRollupOp(oid, AggregationID(oagg)))
+            else:
+                raise ProtocolError(f"bad forwarded op kind {kind}")
+        entries.append((ForwardSpec(sid, AggregationID(agg), tuple(tail)),
+                        v, ts))
+    if pos != len(raw):
+        raise ProtocolError("forwarded batch trailing bytes")
+    return policy, entries
 
 
 # -- bus transport payloads -------------------------------------------------

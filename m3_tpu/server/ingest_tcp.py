@@ -45,6 +45,11 @@ def aggregator_sink(aggregator, lock: threading.Lock | None = None,
                 aggregator.add_passthrough_batch(
                     ids, values, times, StoragePolicy.parse(policy))
                 return
+            if kind == wire.FORWARDED_BATCH:
+                policy, entries = batch
+                aggregator.add_forwarded_batch(
+                    StoragePolicy.parse(policy), entries)
+                return
             mts = np.asarray(batch.metric_types)
             for mt in np.unique(mts):
                 sel = np.nonzero(mts == mt)[0]
@@ -80,13 +85,16 @@ class _IngestHandler(socketserver.BaseRequestHandler):
                 break
             ftype, payload = frame
             if ftype not in (wire.METRIC_BATCH, wire.TIMED_BATCH,
-                             wire.PASSTHROUGH_BATCH):
+                             wire.PASSTHROUGH_BATCH, wire.FORWARDED_BATCH):
                 if srv.scope is not None:
                     srv.scope.counter("unknown_frames").inc()
                 break
             try:
                 if ftype == wire.PASSTHROUGH_BATCH:
                     batch = wire.decode_passthrough_batch(payload)
+                    n = len(batch[1])
+                elif ftype == wire.FORWARDED_BATCH:
+                    batch = wire.decode_forwarded_batch(payload)
                     n = len(batch[1])
                 else:
                     batch = wire.decode_metric_batch(payload)

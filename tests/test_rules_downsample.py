@@ -362,3 +362,220 @@ class TestTailConflicts:
         ml.add_batch(MetricType.COUNTER, [b"out2"], v, t)
         with pytest.raises(ValueError, match="tail signature"):
             ml.add_batch(MetricType.COUNTER, [b"out2"], v, t, pipeline=pl)
+
+
+def _two_stage_ruleset(*, mid_transform=None):
+    """rollup to per-(dc,host) then a second-stage rollup to per-dc
+    (reference forwarded_writer.go multi-stage pipelines)."""
+    from m3_tpu.metrics.pipeline import TransformationOp
+
+    mid = (TransformationOp(mid_transform),) if mid_transform else ()
+    return RuleSet(
+        version=1,
+        mapping_rules=[],
+        rollup_rules=[
+            RollupRule(
+                "two-stage", TagsFilter.parse("__name__:req.count"),
+                (
+                    RollupTarget(
+                        Pipeline((
+                            AggregationOp(AggregationType.SUM),
+                            RollupOp(b"req.by_host", (b"dc", b"host")),
+                        ) + mid + (
+                            RollupOp(b"req.total", (b"dc",),
+                                     AggregationID.compress(
+                                         [AggregationType.SUM])),
+                        )),
+                        (SP_10S,),
+                    ),
+                ),
+            ),
+        ],
+    )
+
+
+class TestForwardedMultiStagePipelines:
+    """Round-4 VERDICT #5: stage-N partial aggregates forward to the
+    next stage's owner and the final stage matches the single-stage
+    equivalent (reference forwarded_writer.go:186, aggregator.go:395
+    AddForwarded)."""
+
+    def _db(self, tmp_path, name):
+        return Database(
+            DatabaseOptions(root=str(tmp_path / name),
+                            commitlog_enabled=False),
+            {"default": NamespaceOptions(num_shards=1, slot_capacity=1 << 10,
+                                         sample_capacity=1 << 12)},
+        )
+
+    def _write(self, ds, per_host_window_values):
+        """per_host_window_values: {host: [v_w0, v_w1, ...]} — one
+        sample per host per window, all in dc=us."""
+        n_w = max(len(v) for v in per_host_window_values.values())
+        for w in range(n_w):
+            docs, vals = [], []
+            for host, series in per_host_window_values.items():
+                if w >= len(series):
+                    continue
+                docs.append(Document.from_tags(
+                    b"req:" + host,
+                    {b"__name__": b"req.count", b"dc": b"us",
+                     b"host": host}))
+                vals.append(series[w])
+            keep = ds.write_batch(
+                docs, np.full(len(docs), START + w * R + 1, np.int64),
+                np.asarray(vals, np.float64),
+                metric_type=MetricType.COUNTER)
+            assert keep.all()
+
+    def test_rules_resolve_downstream_rollups_applied(self):
+        from m3_tpu.metrics.pipeline import AppliedRollupOp
+
+        m = Matcher(_two_stage_ruleset(), 0)
+        res = m.match(b"r", {b"__name__": b"req.count", b"dc": b"us",
+                             b"host": b"h0"})
+        (r,) = res.rollups
+        assert r.id == b"req.by_host{dc=us,host=h0}"
+        (op,) = r.pipeline.ops
+        assert isinstance(op, AppliedRollupOp)
+        assert op.id == b"req.total{dc=us}"
+        assert r.stage_tags[0][0] == b"req.total{dc=us}"
+
+    def test_two_stage_matches_single_stage_equivalent(self, tmp_path):
+        # Two-stage: per-(dc,host) sums forwarded and re-summed per dc.
+        dsA = Downsampler(self._db(tmp_path, "a"), _two_stage_ruleset(),
+                          opts=DownsamplerOptions(capacity=1 << 10,
+                                                  timer_sample_capacity=1 << 12))
+        # Single-stage equivalent: direct per-dc sum.
+        single = RuleSet(version=1, mapping_rules=[], rollup_rules=[
+            RollupRule("direct", TagsFilter.parse("__name__:req.count"), (
+                RollupTarget(Pipeline((
+                    AggregationOp(AggregationType.SUM),
+                    RollupOp(b"req.direct", (b"dc",)),
+                )), (SP_10S,)),))])
+        dsB = Downsampler(self._db(tmp_path, "b"), single,
+                          opts=DownsamplerOptions(capacity=1 << 10,
+                                                  timer_sample_capacity=1 << 12))
+        data = {b"h0": [1.0, 4.0, 9.0], b"h1": [2.0, 8.0, 16.0]}
+        self._write(dsA, data)
+        self._write(dsB, data)
+        # Stage 2 needs one extra window of pipeline latency.
+        dsA.flush(START + 4 * R)
+        dsA.flush(START + 5 * R)
+        dsB.flush(START + 4 * R)
+        # Stage-2 output rides the gauge arena with an explicit SUM, so
+        # it carries the .sum type suffix; the single-stage counter
+        # rollup's SUM is its type default (unsuffixed).
+        ptsA = dsA.db.read(str(SP_10S), b"req.total{dc=us}.sum",
+                           START, START + BLOCK)
+        ptsB = dsB.db.read(str(SP_10S), b"req.direct{dc=us}",
+                           START, START + BLOCK)
+        assert [v for _, v in ptsB] == [3.0, 12.0, 25.0]
+        # identical per-window totals, shifted one window by the
+        # forwarding hop
+        assert [v for _, v in ptsA] == [v for _, v in ptsB]
+        assert [t for t, _ in ptsA] == [t + R for t, _ in ptsB]
+        dsA.db.close()
+        dsB.db.close()
+
+    def test_transform_between_stages(self, tmp_path):
+        from m3_tpu.metrics.transformation import TransformationType as TT
+
+        ds = Downsampler(self._db(tmp_path, "t"),
+                         _two_stage_ruleset(mid_transform=TT.PER_SECOND),
+                         opts=DownsamplerOptions(capacity=1 << 10,
+                                                 timer_sample_capacity=1 << 12))
+        # per-host monotone counters: h0 rates 2.0/s, h1 rates 0.5/s
+        self._write(ds, {b"h0": [10.0, 30.0, 50.0],
+                         b"h1": [5.0, 10.0, 15.0]})
+        ds.flush(START + 4 * R)
+        ds.flush(START + 5 * R)
+        pts = ds.db.read(str(SP_10S), b"req.total{dc=us}.sum",
+                         START, START + BLOCK)
+        # first window has no perSecond prev -> only 2 stage-2 windows
+        assert [v for _, v in pts] == [pytest.approx(2.5)] * 2
+        ds.db.close()
+
+    def test_aggregator_shard_routed_forwarding(self):
+        """Engine-level: forwards cross shard boundaries by the NEXT
+        stage's ID hash (in-process shards per the VERDICT criterion)."""
+        from m3_tpu.aggregator.engine import (
+            Aggregator, AggregatorOptions, ForwardSpec)
+        from m3_tpu.metrics.pipeline import AppliedRollupOp
+
+        agg = Aggregator(num_shards=4, opts=AggregatorOptions(
+            capacity=256, num_windows=4, timer_sample_capacity=1 << 12,
+            storage_policies=(SP_10S,)))
+        sum_id = AggregationID.compress([AggregationType.SUM])
+        pl = Pipeline((AppliedRollupOp(b"stage2.total", sum_id),))
+        t0 = START + 1
+        # stage-1 ids spread across shards; all forward to one stage-2 id
+        for sid in (b"s1.a", b"s1.b", b"s1.c"):
+            sh = agg.shard_for(sid)
+            sh.lists[SP_10S].add_batch(
+                MetricType.COUNTER, [sid], np.asarray([5.0]),
+                np.asarray([t0], np.int64), sum_id, pipeline=pl)
+        # Depending on shard consume order the stage-2 flush lands in
+        # the same pass (dest consumed after source) or the next one
+        # (dest already consumed; the open-window clamp holds it) —
+        # either way nothing is lost and stage 1 never flushes locally.
+        out = agg.consume(START + 2 * R) + agg.consume(START + 3 * R)
+        owner = agg.shard_for(b"stage2.total")
+        gmap = owner.lists[SP_10S].maps[MetricType.GAUGE]
+        total = 0.0
+        stage1_ids = {b"s1.a", b"s1.b", b"s1.c"}
+        for fm in out:
+            for slot, t_, v in zip(fm.slots, fm.types, fm.values):
+                if (fm.metric_type == MetricType.GAUGE
+                        and int(t_) == int(AggregationType.SUM)
+                        and gmap.id_of(int(slot)) == b"stage2.total"):
+                    total += float(v)
+                assert fm.metric_type != MetricType.COUNTER, \
+                    "stage-1 aggregate flushed locally"
+        assert total == 15.0
+
+
+class TestForwardEdgeCases:
+    def _db(self, tmp_path, name):
+        return Database(
+            DatabaseOptions(root=str(tmp_path / name),
+                            commitlog_enabled=False),
+            {"default": NamespaceOptions(num_shards=1, slot_capacity=1 << 10,
+                                         sample_capacity=1 << 12)},
+        )
+
+    def test_idle_gap_does_not_strand_forwards(self, tmp_path):
+        """One flush far past the ring must still surface the stage-2
+        output: the consume settle-loop keeps draining until the
+        forward chain lands, instead of jumping the watermark over it."""
+        ds = Downsampler(self._db(tmp_path, "gap"), _two_stage_ruleset(),
+                         opts=DownsamplerOptions(capacity=1 << 10,
+                                                 timer_sample_capacity=1 << 12))
+        ds.write_batch(
+            [Document.from_tags(b"req:h0", {b"__name__": b"req.count",
+                                            b"dc": b"us", b"host": b"h0"})],
+            np.full(1, START + 1, np.int64), np.asarray([7.0]),
+            metric_type=MetricType.COUNTER)
+        # 40 windows later (ring is only 4 deep): one flush call.
+        ds.flush(START + 40 * R)
+        pts = ds.db.read(str(SP_10S), b"req.total{dc=us}.sum",
+                         START, START + BLOCK)
+        assert [v for _, v in pts] == [7.0]
+        ds.db.close()
+
+    def test_multi_type_stage_before_forward_rejected(self):
+        """A forwarding stage aggregating several types would conflate
+        them into one next-stage series — rejected at registration."""
+        from m3_tpu.aggregator.engine import AggregatorOptions, MetricList
+        from m3_tpu.metrics.pipeline import AppliedRollupOp, Pipeline
+
+        ml = MetricList(SP_10S, AggregatorOptions(
+            capacity=64, timer_sample_capacity=256))
+        sum_id = AggregationID.compress([AggregationType.SUM])
+        multi = AggregationID.compress(
+            [AggregationType.SUM, AggregationType.MAX])
+        pl = Pipeline((AppliedRollupOp(b"next", sum_id),))
+        with pytest.raises(ValueError, match="exactly ONE type"):
+            ml.add_batch(MetricType.COUNTER, [b"x"], np.ones(1),
+                         np.full(1, START + 1, np.int64), multi,
+                         pipeline=pl)

@@ -359,3 +359,65 @@ class TestTimedClockAnchor:
         assert s.recv(1) == b""  # server closed the poisoned connection
         s.close()
         srv.shutdown()
+
+
+class TestForwardedWire:
+    def test_forwarded_batch_codec_roundtrip(self):
+        from m3_tpu.aggregator.engine import ForwardSpec
+        from m3_tpu.metrics.aggregation import AggregationID, AggregationType
+        from m3_tpu.metrics.pipeline import AppliedRollupOp, TransformationOp
+        from m3_tpu.metrics.transformation import TransformationType
+
+        sum_id = AggregationID.compress([AggregationType.SUM])
+        entries = [
+            (ForwardSpec(b"r2{dc=us}", sum_id, (
+                TransformationOp(TransformationType.PER_SECOND),
+                AppliedRollupOp(b"r3{}", sum_id),
+            )), 2.5, T0),
+            (ForwardSpec(b"r2{dc=eu}", AggregationID.DEFAULT, ()), -1.0, T0 + 1),
+        ]
+        raw = wire.encode_forwarded_batch("10s:2d", entries)
+        policy, out = wire.decode_forwarded_batch(raw)
+        assert policy == "10s:2d"
+        assert out == entries
+        with pytest.raises(wire.ProtocolError, match="trailing"):
+            wire.decode_forwarded_batch(raw + b"\x00")
+
+    def test_forwarded_batch_over_socket(self):
+        """A remote stage-1 aggregator's outputs land in this process's
+        stage-2 arenas via the wire (aggregator.go:395 AddForwarded)."""
+        from m3_tpu import instrument
+        from m3_tpu.aggregator.engine import (
+            Aggregator, AggregatorOptions, ForwardSpec)
+        from m3_tpu.metrics.aggregation import AggregationID, AggregationType
+        from m3_tpu.metrics.policy import StoragePolicy
+
+        sp = StoragePolicy.parse("10s:2d")
+        agg = Aggregator(num_shards=4, opts=AggregatorOptions(
+            capacity=256, num_windows=4, timer_sample_capacity=1 << 12,
+            storage_policies=(sp,)))
+        reg = instrument.new_registry()
+        srv = serve_ingest_background(aggregator_sink(agg),
+                                      instrument=reg.scope(""))
+        sum_id = AggregationID.compress([AggregationType.SUM])
+        entries = [(ForwardSpec(b"stage2.x", sum_id, ()), 3.0, T0 + 1),
+                   (ForwardSpec(b"stage2.x", sum_id, ()), 4.0, T0 + 2)]
+        s = socket.create_connection(("127.0.0.1", srv.port))
+        wire.send_frame(s, wire.FORWARDED_BATCH,
+                        wire.encode_forwarded_batch(str(sp), entries))
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if reg.snapshot().get("ingest_tcp.samples", 0) >= 2:
+                break
+            time.sleep(0.05)
+        out = agg.consume(T0 + 2 * WINDOW)
+        owner = agg.shard_for(b"stage2.x")
+        gmap = owner.lists[sp].maps[MetricType.GAUGE]
+        from m3_tpu.metrics.aggregation import AggregationType as AT
+        total = sum(
+            float(v) for fm in out
+            for slot, t_, v in zip(fm.slots, fm.types, fm.values)
+            if int(t_) == int(AT.SUM) and gmap.id_of(int(slot)) == b"stage2.x")
+        assert total == 7.0
+        s.close()
+        srv.shutdown()
