@@ -27,6 +27,7 @@ entry lookup with rate-limited entry creation.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 from typing import Callable, Dict, List, Sequence
@@ -74,6 +75,11 @@ class AggregatorOptions:
     timer_sample_capacity: int = 1 << 24
     quantiles: tuple = (0.5, 0.95, 0.99)
     storage_policies: tuple = (StoragePolicy.parse("10s:2d"),)
+    # New-metric creation rate cap, entries/sec across the aggregator
+    # (reference entry.go rate limits; 0 = unlimited).  Samples whose
+    # series creation exceeds it are dropped with a typed counter —
+    # churn degrades gracefully instead of filling the slot maps.
+    new_series_limit_per_sec: float = 0.0
 
 
 @dataclasses.dataclass
@@ -104,8 +110,14 @@ class MetricMap:
     arena's device-side last_at column through MetricList.expire).
     """
 
-    def __init__(self, capacity: int, use_native: bool | None = None):
+    def __init__(self, capacity: int, use_native: bool | None = None,
+                 limiter=None):
         self.capacity = capacity
+        # Optional shared NewSeriesLimiter (storage/limits.py): entry
+        # creations past the rate resolve to slot -1; callers drop
+        # those samples and count them (reference entry.go
+        # errWriteNewMetricRateLimitExceeded).
+        self.limiter = limiter
         self._slots: Dict[tuple, int] = {}
         self._ids: List[bytes | None] = []
         self._free: List[int] = []
@@ -164,6 +176,21 @@ class MetricMap:
                 raise RuntimeError(
                     f"metric map capacity {self.capacity} exhausted"
                 ) from e
+            if len(new_pos) and self.limiter is not None:
+                # The native resolver allocated eagerly; release the
+                # over-budget creations and mark EVERY occurrence of a
+                # released id rejected (an in-batch duplicate resolved
+                # to the now-freed slot and must not write into it).
+                granted = self.limiter.acquire_up_to(len(new_pos))
+                released = set()
+                for i in new_pos[granted:]:
+                    self._native.release(ids[i], mask)
+                    released.add(ids[i])
+                if released:
+                    for j in range(len(ids)):
+                        if ids[j] in released:
+                            slots[j] = -1
+                new_pos = new_pos[:granted]
             for i in new_pos:
                 s = int(slots[i])
                 self._native_ids[s] = ids[i]
@@ -178,14 +205,23 @@ class MetricMap:
             s = get((mid, mask))
             if s is None:
                 missing.append(i)
+                slots[i] = -1
             else:
                 slots[i] = s
+        # Charge per CREATION, not per occurrence (in-batch duplicates
+        # of one new id take a single token).
+        n_new = len({ids[i] for i in missing})
+        budget = (n_new if self.limiter is None
+                  else self.limiter.acquire_up_to(n_new))
         allocated: List[int] = []
         try:
             for i in missing:
                 mid = ids[i]
                 s = self._slots.get((mid, mask))
                 if s is None:
+                    if budget <= 0:
+                        continue  # stays -1: rejected creation
+                    budget -= 1
                     s = self._allocate(mid, mask)
                     self.agg_mask[s] = np.uint64(mask)
                     self.tail_sig[s] = tail_sig
@@ -202,7 +238,8 @@ class MetricMap:
         return slots
 
     def _check_tails(self, ids, slots: np.ndarray, tail_sig: int) -> None:
-        bad = np.nonzero(self.tail_sig[slots] != np.int32(tail_sig))[0]
+        valid = slots >= 0
+        bad = np.nonzero(valid & (self.tail_sig[slots] != np.int32(tail_sig)))[0]
         if bad.size:
             i = int(bad[0])
             raise ValueError(
@@ -261,18 +298,26 @@ class MetricList:
     window bookkeeping (reference list.go baseMetricList keyed by
     (resolution, flushOffset))."""
 
-    def __init__(self, policy: StoragePolicy, opts: AggregatorOptions):
+    def __init__(self, policy: StoragePolicy, opts: AggregatorOptions,
+                 new_series_limiter=None):
         self.policy = policy
         self.opts = opts
         self.resolution = policy.resolution.window_nanos
         W, C = opts.num_windows, opts.capacity
+        if new_series_limiter is None and opts.new_series_limit_per_sec > 0:
+            from m3_tpu.storage.limits import NewSeriesLimiter
+
+            new_series_limiter = NewSeriesLimiter(
+                opts.new_series_limit_per_sec)
+        self.new_series_limiter = new_series_limiter
+        self.new_series_rejected = 0
         self.counters = CounterArena(W, C)
         self.gauges = GaugeArena(W, C)
         self.timers = TimerArena(W, C, opts.timer_sample_capacity, opts.quantiles)
         self.maps = {
-            MetricType.COUNTER: MetricMap(C),
-            MetricType.GAUGE: MetricMap(C),
-            MetricType.TIMER: MetricMap(C),
+            MetricType.COUNTER: MetricMap(C, limiter=new_series_limiter),
+            MetricType.GAUGE: MetricMap(C, limiter=new_series_limiter),
+            MetricType.TIMER: MetricMap(C, limiter=new_series_limiter),
         }
         # Earliest window (aligned nanos) not yet consumed.  Windows in
         # [consumed_until, +W*resolution) are open; later ones rejected
@@ -342,8 +387,20 @@ class MetricList:
         slots = self.maps[mt].resolve(ids, agg_id, mt, tail_sig=sig)
         if sig:
             for s in np.unique(slots).tolist():
-                self._pipelines[(mt, int(s))] = key_ops
+                if s >= 0:
+                    self._pipelines[(mt, int(s))] = key_ops
+        rej = slots < 0
+        acc = None
+        if rej.any():
+            # Rate-limited series creations: drop those samples with a
+            # typed counter (entry.go errWriteNewMetricRateLimitExceeded).
+            self.new_series_rejected += int(rej.sum())
+            acc = ~rej
+            slots = slots[acc]
+            values = np.asarray(values)[acc]
+            times = np.asarray(times)[acc]
         self.add_batch_slots(mt, slots, values, times)
+        return acc  # None = everything accepted
 
     @staticmethod
     def _validate_tail(pipeline) -> tuple:
@@ -410,6 +467,8 @@ class MetricList:
         times: np.ndarray,
     ) -> None:
         """Pure device path: slots already resolved (the hot loop)."""
+        if len(slots) == 0:  # e.g. a batch fully rejected by rate limits
+            return
         windows, too_early, too_future = self._route_windows(times)
         self.drops += int(too_early.sum()) + int(too_future.sum())
         self._arena(mt).ingest(
@@ -466,11 +525,17 @@ class MetricList:
         windows, too_early, too_future = self._route_windows(times)
         self.timed_rejects["too_early"] += int(too_early.sum())
         self.timed_rejects["too_far_future"] += int(too_future.sum())
+        rej = slots < 0
+        if rej.any():
+            # Rate-limited creations reject like window violations do.
+            self.new_series_rejected += int(rej.sum())
+            windows = np.where(rej, np.int32(self.opts.num_windows), windows)
+            slots = np.where(rej, np.int32(0), slots)
         self._arena(mt).ingest(
             jnp.asarray(windows), jnp.asarray(slots), jnp.asarray(values),
             jnp.asarray(times)
         )
-        return ~(too_early | too_future)
+        return ~(too_early | too_future | rej)
 
     def open_windows(self, now_nanos: int) -> List[int]:
         """Closed windows that can actually hold data.
@@ -760,14 +825,40 @@ class AggregatorShard:
     """One aggregator shard: a MetricList per storage policy
     (reference shard.go:171 AddUntimed + list registry)."""
 
-    def __init__(self, shard_id: int, opts: AggregatorOptions):
+    def __init__(self, shard_id: int, opts: AggregatorOptions,
+                 new_series_limiter=None):
         self.shard_id = shard_id
         self.opts = opts
-        self.lists = {sp: MetricList(sp, opts) for sp in opts.storage_policies}
+        self.lists = {
+            sp: MetricList(sp, opts, new_series_limiter=new_series_limiter)
+            for sp in opts.storage_policies
+        }
 
     def add_batch(self, mt, ids, values, times, agg_id=AggregationID.DEFAULT):
-        for ml in self.lists.values():
-            ml.add_batch(mt, ids, values, times, agg_id)
+        """The FIRST list's resolve charges the creation budget and
+        decides which samples are series-rejected; follower lists
+        ingest the accepted subset under a limiter bypass — one charge
+        per creation across policies, and no policy can hold samples
+        another rejected."""
+        lists = list(self.lists.values())
+        if not lists:
+            return
+        acc = lists[0].add_batch(mt, ids, values, times, agg_id)
+        rest = lists[1:]
+        if not rest:
+            return
+        if acc is not None:
+            sel = np.nonzero(acc)[0]
+            if sel.size == 0:
+                return
+            ids = [ids[i] for i in sel]
+            values = np.asarray(values)[sel]
+            times = np.asarray(times)[sel]
+        lim = lists[0].new_series_limiter
+        ctx = lim.bypass() if lim is not None else contextlib.nullcontext()
+        with ctx:
+            for ml in rest:
+                ml.add_batch(mt, ids, values, times, agg_id)
 
     def add_timed_batch(self, mt, ids, values, times,
                         agg_id=AggregationID.DEFAULT,
@@ -788,12 +879,25 @@ class AggregatorShard:
         sel = np.nonzero(accepted)[0]
         if sel.size:
             ids_sel = [ids[i] for i in sel]
-            for ml in lists:
-                acc = ml.add_timed_batch(mt, ids_sel, values[sel],
-                                         times[sel], agg_id)
-                # The pre-check guaranteed acceptance per list; a fresh
-                # un-seeded list seeds from this filtered batch.
-                accepted[sel] &= acc
+            # First list charges the creation budget and decides the
+            # series rejections; followers ingest its accepted subset
+            # under a bypass (one charge per creation; the reported
+            # mask stays truthful for every policy).
+            acc = lists[0].add_timed_batch(mt, ids_sel, values[sel],
+                                           times[sel], agg_id)
+            accepted[sel] &= acc
+            if len(lists) > 1:
+                sub = np.nonzero(acc)[0]
+                lim = lists[0].new_series_limiter
+                ctx = (lim.bypass() if lim is not None
+                       else contextlib.nullcontext())
+                if sub.size:
+                    sel2 = sel[sub]
+                    ids2 = [ids[i] for i in sel2]
+                    with ctx:
+                        for ml in lists[1:]:
+                            ml.add_timed_batch(mt, ids2, values[sel2],
+                                               times[sel2], agg_id)
         if not accepted.all():
             # Count cross-policy rejects on every list that did not see
             # them in its own add (pre-checked ones never reached it).
@@ -824,7 +928,20 @@ class Aggregator:
     def __init__(self, num_shards: int = 1, opts: AggregatorOptions | None = None,
                  passthrough_handler=None):
         self.opts = opts or AggregatorOptions()
-        self.shards = [AggregatorShard(i, self.opts) for i in range(num_shards)]
+        # ONE aggregator-wide creation budget shared by every shard's
+        # maps (the reference rate-limits at the aggregator options
+        # level, entry.go); None when unlimited.
+        self.new_series_limiter = None
+        if self.opts.new_series_limit_per_sec > 0:
+            from m3_tpu.storage.limits import NewSeriesLimiter
+
+            self.new_series_limiter = NewSeriesLimiter(
+                self.opts.new_series_limit_per_sec)
+        self.shards = [
+            AggregatorShard(i, self.opts,
+                            new_series_limiter=self.new_series_limiter)
+            for i in range(num_shards)
+        ]
         # Passthrough output (reference passWriter): pre-aggregated
         # samples skip the arenas and go straight here.
         self.passthrough_handler = passthrough_handler

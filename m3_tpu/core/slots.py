@@ -17,11 +17,16 @@ import numpy as np
 
 
 class SlotAllocator:
-    def __init__(self, capacity: int):
+    def __init__(self, capacity: int, limiter=None):
         self.capacity = capacity
         self._slots: Dict[bytes, int] = {}
         self._ids: List[bytes | None] = []
         self._free: List[int] = []
+        # Optional shared NewSeriesLimiter (storage/limits.py): series
+        # CHURN control — creations past the rate yield slot -1, which
+        # write paths drop and count as typed rejections (reference
+        # dbnode write-new-series runtime limits, kvconfig/keys.go).
+        self.limiter = limiter
 
     def __len__(self) -> int:
         return len(self._slots)
@@ -37,12 +42,33 @@ class SlotAllocator:
 
     def resolve(self, ids: Sequence[bytes]) -> np.ndarray:
         """Find-or-create slots for a batch of IDs (vectorized fast path
-        for all-known batches)."""
+        for all-known batches).  When a new-series limiter is attached
+        and exhausted, creations come back as slot -1 (existing series
+        always resolve)."""
         out = np.empty(len(ids), np.int32)
         get = self._slots.get
+        missing: List[int] = []
         for i, sid in enumerate(ids):
             s = get(sid)
             if s is None:
+                missing.append(i)
+                out[i] = -1
+            else:
+                out[i] = s
+        if not missing:
+            return out
+        # Budget counts CREATIONS, not occurrences: a batch repeating
+        # one new id many times must charge one token.
+        n_new = len({ids[i] for i in missing})
+        budget = (n_new if self.limiter is None
+                  else self.limiter.acquire_up_to(n_new))
+        for i in missing:
+            sid = ids[i]
+            s = self._slots.get(sid)  # duplicate id earlier in batch
+            if s is None:
+                if budget <= 0:
+                    continue  # stays -1: rejected creation
+                budget -= 1
                 s = self._allocate(sid)
             out[i] = s
         return out

@@ -251,6 +251,8 @@ def run_node(source, start_mediator: bool | None = None,
                 ("max_bytes_read", _limit_applier(limits.bytes)),
                 ("block_cache_max_bytes",
                  lambda v: setattr(db.block_cache, "max_bytes", int(v))),
+                ("write_new_series_limit_per_sec",
+                 lambda v: db.new_series_limiter.set_rate(float(v))),
             ]
             for opt, apply in appliers:
                 admin_ctx.runtime.on_change(opt, apply)

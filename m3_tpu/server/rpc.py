@@ -247,9 +247,11 @@ class _RpcHandler(socketserver.BaseRequestHandler):
             ts = np.frombuffer(raw, np.int64, n, pos)
             pos += 8 * n
             vs = np.frombuffer(raw, np.float64, n, pos)
-            db.write_batch(ns, ids, ts.copy(), vs.copy(),
-                           None if now == -1 else now)
-            return b""
+            res = db.write_batch(ns, ids, ts.copy(), vs.copy(),
+                                 None if now == -1 else now)
+            # (ncold, new-series rejections): the wire carries the typed
+            # back-pressure signal so remote writers see churn limits.
+            return struct.pack("<II", int(res), getattr(res, "rejected", 0))
         if method == M_WRITE_TAGGED:
             ns, pos = _dec_str(raw, 0)
             (now,) = struct.unpack_from("<q", raw, pos)
@@ -263,9 +265,9 @@ class _RpcHandler(socketserver.BaseRequestHandler):
             ts = np.frombuffer(raw, np.int64, n, pos)
             pos += 8 * n
             vs = np.frombuffer(raw, np.float64, n, pos)
-            db.write_tagged_batch(ns, docs, ts.copy(), vs.copy(),
-                                  None if now == -1 else now)
-            return b""
+            res = db.write_tagged_batch(ns, docs, ts.copy(), vs.copy(),
+                                        None if now == -1 else now)
+            return struct.pack("<II", int(res), getattr(res, "rejected", 0))
         if method == M_READ:
             ns, pos = _dec_str(raw, 0)
             sid, pos = _unpack_bytes(raw, pos)
@@ -410,7 +412,7 @@ class RemoteDatabase:
                 + struct.pack("<I", len(ids))
                 + b"".join(_pack_bytes(i) for i in ids)
                 + ts.tobytes() + vals.tobytes())
-        self._call(M_WRITE_BATCH, body)
+        return self._dec_write_result(self._call(M_WRITE_BATCH, body))
 
     def write_tagged_batch(self, namespace, docs, ts, vals,
                            now_nanos=None) -> None:
@@ -421,7 +423,16 @@ class RemoteDatabase:
                 + struct.pack("<I", len(docs))
                 + b"".join(_enc_doc(d) for d in docs)
                 + ts.tobytes() + vals.tobytes())
-        self._call(M_WRITE_TAGGED, body)
+        return self._dec_write_result(self._call(M_WRITE_TAGGED, body))
+
+    @staticmethod
+    def _dec_write_result(payload: bytes):
+        from m3_tpu.storage.database import WriteResult
+
+        if len(payload) < 8:
+            return WriteResult(0, 0)
+        ncold, rejected = struct.unpack_from("<II", payload, 0)
+        return WriteResult(ncold, rejected)
 
     def read(self, namespace, sid, start, end):
         body = (_enc_str(namespace) + _pack_bytes(sid)

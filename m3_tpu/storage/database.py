@@ -47,7 +47,7 @@ from m3_tpu.persist.fs import (
 )
 from m3_tpu.persist import snapshot as snap
 from m3_tpu.instrument.tracing import Tracepoint
-from m3_tpu.storage.limits import NO_LIMITS, QueryLimits
+from m3_tpu.storage.limits import NO_LIMITS, NewSeriesLimiter, QueryLimits
 from m3_tpu.storage.buffer import ShardBuffer, dedupe_last_write_wins
 from m3_tpu.storage.series_merge import merge_point_sources
 
@@ -71,6 +71,25 @@ class NamespaceOptions:
 class DatabaseOptions:
     root: str = "m3tpu_data"
     commitlog_enabled: bool = True
+    # 0 = unlimited; live-tunable via the write_new_series_limit_per_sec
+    # runtime option (reference dbnode/kvconfig/keys.go).
+    write_new_series_limit_per_sec: float = 0.0
+
+
+class WriteResult(int):
+    """Cold-write count (plain int for back-compat) carrying the typed
+    ingest-rejection info: ``rejected`` = samples dropped because their
+    series creation exceeded the new-series rate limit; ``accepted`` =
+    per-input-sample bool mask (None when nothing was rejected —
+    everything landed)."""
+
+    rejected: int
+    accepted = None
+
+    def __new__(cls, ncold: int, rejected: int = 0):
+        obj = super().__new__(cls, ncold)
+        obj.rejected = rejected
+        return obj
 
 
 def shard_for_id(sid: bytes, num_shards: int) -> int:
@@ -86,13 +105,15 @@ def shard_for_id(sid: bytes, num_shards: int) -> int:
 
 class Shard:
     def __init__(self, namespace: str, shard_id: int, opts: NamespaceOptions, root: str,
-                 block_cache=None):
+                 block_cache=None, new_series_limiter=None):
         self.namespace = namespace
         self.shard_id = shard_id
         self.opts = opts
         self.root = root
         self.block_cache = block_cache
-        self.slots = SlotAllocator(opts.slot_capacity)
+        self.slots = SlotAllocator(opts.slot_capacity,
+                                   limiter=new_series_limiter)
+        self.new_series_rejected = 0
         # Ring must cover (bufferPast + bufferFuture) / blockSize + 2 blocks.
         span = opts.buffer_past_nanos + opts.buffer_future_nanos
         num_windows = max(2, span // opts.block_size_nanos + 2)
@@ -117,7 +138,22 @@ class Shard:
     def write_batch(self, ids: Sequence[bytes], ts: np.ndarray, vals: np.ndarray,
                     now_nanos: int) -> int:
         slots = self.slots.resolve(ids)
-        return self.buffer.write(slots, ts, vals, self.open_starts(now_nanos))
+        rejected = slots < 0
+        nrej = 0
+        if rejected.any():
+            # New-series rate limit hit: drop ONLY the rejected
+            # creations (existing series in the batch still land) and
+            # count them — graceful degradation under churn, never
+            # unbounded state growth (dbnode/kvconfig/keys.go
+            # write-new-series limits).
+            nrej = int(rejected.sum())
+            self.new_series_rejected += nrej
+            keep = ~rejected
+            slots, ts, vals = slots[keep], ts[keep], vals[keep]
+        ncold = self.buffer.write(slots, ts, vals, self.open_starts(now_nanos))
+        res = WriteResult(ncold, nrej)
+        res.accepted = ~rejected
+        return res
 
     # -- flush path --------------------------------------------------------
 
@@ -291,12 +327,13 @@ class Shard:
 
 class Namespace:
     def __init__(self, name: str, opts: NamespaceOptions, root: str,
-                 block_cache=None):
+                 block_cache=None, new_series_limiter=None):
         self.name = name
         self.opts = opts
         self.root = root
         self.shards = [
-            Shard(name, i, opts, root, block_cache)
+            Shard(name, i, opts, root, block_cache,
+                  new_series_limiter=new_series_limiter)
             for i in range(opts.num_shards)
         ]
         self.index = NamespaceIndex(opts.block_size_nanos, root, name)
@@ -304,9 +341,19 @@ class Namespace:
     def write_tagged_batch(self, docs: Sequence[Document], ts: np.ndarray,
                            vals: np.ndarray, now_nanos: int) -> int:
         """Write + index tagged series (reference WriteTagged
-        `database.go:771` → shard writeAndIndex → nsIndex.WriteBatch)."""
-        self.index.write_batch(list(docs), ts)
-        return self.write_batch([d.id for d in docs], ts, vals, now_nanos)
+        `database.go:771` → shard writeAndIndex → nsIndex.WriteBatch).
+        The index only learns documents whose series were ACCEPTED —
+        rate-limited churn must not grow the reverse index either (that
+        is the unbounded-memory failure the limit exists to stop)."""
+        res = self.write_batch([d.id for d in docs], ts, vals, now_nanos)
+        if res.accepted is None:
+            self.index.write_batch(list(docs), ts)
+        else:
+            acc = res.accepted
+            kept = [d for d, a in zip(docs, acc) if a]
+            if kept:
+                self.index.write_batch(kept, ts[acc])
+        return res
 
     def query_ids(self, q: Query, start: int, end: int,
                   inc_docs=None) -> list[Document]:
@@ -319,13 +366,25 @@ class Namespace:
         by_shard: Dict[int, List[int]] = {}
         for i, sid in enumerate(ids):
             by_shard.setdefault(shard_for_id(sid, self.opts.num_shards), []).append(i)
-        ncold = 0
+        ncold = nrej = 0
+        full = np.ones(len(ids), bool)
         for sh, idxs in by_shard.items():
             sel = np.asarray(idxs)
-            ncold += self.shards[sh].write_batch(
+            res = self.shards[sh].write_batch(
                 [ids[i] for i in idxs], ts[sel], vals[sel], now_nanos
             )
-        return ncold
+            ncold += int(res)
+            nrej += res.rejected
+            if res.accepted is not None:
+                full[sel] = res.accepted
+        out = WriteResult(ncold, nrej)
+        if nrej:
+            out.accepted = full
+        return out
+
+    @property
+    def new_series_rejected(self) -> int:
+        return sum(sh.new_series_rejected for sh in self.shards)
 
     def read(self, sid: bytes, start: int, end: int) -> list[tuple[int, float]]:
         return self.shards[shard_for_id(sid, self.opts.num_shards)].read(sid, start, end)
@@ -359,7 +418,8 @@ class Database:
 
     def __init__(self, opts: DatabaseOptions | None = None,
                  namespaces: Dict[str, NamespaceOptions] | None = None,
-                 instrument=None, tracer=None, limits: QueryLimits | None = None):
+                 instrument=None, tracer=None, limits: QueryLimits | None = None,
+                 new_series_limiter: NewSeriesLimiter | None = None):
         from m3_tpu.instrument.tracing import NOOP_TRACER
 
         self.opts = opts or DatabaseOptions()
@@ -378,10 +438,17 @@ class Database:
         from m3_tpu.storage.block_cache import BlockCache
 
         self.block_cache = BlockCache(instrument=instrument)
+        # Engine-wide new-series rate limiter shared by every shard's
+        # allocator (0 = unlimited; runtime-tuned through the
+        # write_new_series_limit_per_sec KV option, kvconfig/keys.go).
+        self.new_series_limiter = (
+            new_series_limiter if new_series_limiter is not None
+            else NewSeriesLimiter(self.opts.write_new_series_limit_per_sec))
         self.namespaces: Dict[str, Namespace] = {}
         for name, nopts in (namespaces or {"default": NamespaceOptions()}).items():
             self.namespaces[name] = Namespace(
-                name, nopts, self.opts.root, self.block_cache
+                name, nopts, self.opts.root, self.block_cache,
+                new_series_limiter=self.new_series_limiter,
             )
         self.commitlog = (
             CommitLogWriter(self.opts.root) if self.opts.commitlog_enabled else None
@@ -399,6 +466,7 @@ class Database:
                 ns = self.namespaces[name] = Namespace(
                     name, opts or NamespaceOptions(), self.opts.root,
                     self.block_cache,
+                    new_series_limiter=self.new_series_limiter,
                 )
             return ns
 
@@ -412,12 +480,24 @@ class Database:
         with self._mu, self.tracer.start_span(
             Tracepoint.DB_WRITE_BATCH, {"n": len(ids), "ns": namespace}
         ):
-            if self.commitlog is not None:
-                self.commitlog.write_batch(list(ids), ts, vals,
-                                           namespace=namespace.encode())
             if self._scope is not None:
                 self._scope.counter("writes").inc(len(ids))
-            return ns.write_batch(ids, ts, vals, now_nanos)
+            res = ns.write_batch(ids, ts, vals, now_nanos)
+            # Log AFTER acceptance so the WAL never contains
+            # rate-limit-rejected samples (the reference writes the
+            # commitlog after the in-memory write succeeds, as an async
+            # enqueue - commit_log.go:716).  Bootstrap replay then
+            # re-admits exactly the accepted set, bypassing the limiter.
+            if self.commitlog is not None:
+                if res.accepted is None:
+                    self.commitlog.write_batch(list(ids), ts, vals,
+                                               namespace=namespace.encode())
+                else:
+                    acc = res.accepted
+                    self.commitlog.write_batch(
+                        [sid for sid, a in zip(ids, acc) if a],
+                        ts[acc], vals[acc], namespace=namespace.encode())
+            return res
 
     def write_tagged_batch(self, namespace: str, docs: Sequence[Document], ts, vals,
                            now_nanos: int | None = None) -> int:
@@ -430,17 +510,27 @@ class Database:
             Tracepoint.DB_WRITE_BATCH, {"n": len(docs), "ns": namespace,
                                         "tagged": True}
         ):
+            if self._scope is not None:
+                self._scope.counter("writes_tagged").inc(len(docs))
+            res = ns.write_tagged_batch(docs, ts, vals, now_nanos)
             if self.commitlog is not None:
                 # Tags ride the annotation field so WAL replay can rebuild
                 # index documents (the reference's commitlog entries carry
-                # the series metadata for the same reason).
-                self.commitlog.write_batch(
-                    [d.id for d in docs], ts, vals, namespace=namespace.encode(),
-                    annotations=[encode_tags(d) for d in docs],
-                )
-            if self._scope is not None:
-                self._scope.counter("writes_tagged").inc(len(docs))
-            return ns.write_tagged_batch(docs, ts, vals, now_nanos)
+                # the series metadata for the same reason).  Only the
+                # ACCEPTED samples are logged - see write_batch.
+                if res.accepted is None:
+                    kept = list(docs)
+                    kts, kvs = ts, vals
+                else:
+                    kept = [d for d, a in zip(docs, res.accepted) if a]
+                    kts, kvs = ts[res.accepted], vals[res.accepted]
+                if kept:
+                    self.commitlog.write_batch(
+                        [d.id for d in kept], kts, kvs,
+                        namespace=namespace.encode(),
+                        annotations=[encode_tags(d) for d in kept],
+                    )
+            return res
 
     def query_ids(self, namespace: str, q: Query, start: int, end: int):
         with self._mu, self.tracer.start_span(
@@ -665,7 +755,11 @@ class Database:
         first, then the latest snapshot, then WAL-tail replay for whatever
         isn't covered — `bootstrapper/commitlog` reads snapshots + WAL)."""
         with self._mu, self.tracer.start_span(Tracepoint.DB_BOOTSTRAP):
-            return self._bootstrap_locked()
+            # Replay re-admits previously-ACCEPTED series: the limiter
+            # gates foreground churn only (the WAL never contains
+            # rejected samples - see write_batch's log-after-accept).
+            with self.new_series_limiter.bypass():
+                return self._bootstrap_locked()
 
     def _bootstrap_locked(self) -> dict:
         restored = 0

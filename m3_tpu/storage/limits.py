@@ -9,6 +9,7 @@ than letting one heavy query exhaust the node.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from dataclasses import dataclass
@@ -99,3 +100,61 @@ class QueryLimits:
 
 
 NO_LIMITS = QueryLimits(LimitsOptions())
+
+
+class NewSeriesLimiter:
+    """Token bucket refilled at ``per_sec`` (0 = unlimited) gating
+    series/entry CREATION — the churn control of the reference's
+    entry.go rate limits and the dbnode write-new-series runtime keys
+    (kvconfig/keys.go).  Shared by every shard's allocator;
+    runtime-tunable via set_rate (the kvconfig watch calls it live).
+    The bucket capacity is one second's budget, so a quiet period
+    cannot bank an unbounded burst.  Rejections surface as typed
+    counts (WriteResult.rejected / new_series_rejected counters), not
+    exceptions — partial batch acceptance is the contract."""
+
+    def __init__(self, per_sec: float = 0, now=time.monotonic):
+        self._now = now
+        self._lock = threading.Lock()
+        self._tokens = float(per_sec)
+        self._last = now()
+        self.per_sec = float(per_sec)
+        self.rejected_total = 0
+        self.enabled = True
+
+    def set_rate(self, per_sec: float) -> None:
+        with self._lock:
+            self.per_sec = float(per_sec)
+            self._tokens = min(self._tokens, self.per_sec)
+
+    @contextlib.contextmanager
+    def bypass(self):
+        """Temporarily disable the limit: bootstrap/WAL replay must
+        re-admit every previously-accepted series (the reference limits
+        only foreground writes), and multi-policy fan-out charges the
+        budget once, with follower lists riding the first list's
+        decision under this bypass."""
+        prev = self.enabled
+        self.enabled = False
+        try:
+            yield self
+        finally:
+            self.enabled = prev
+
+    def acquire_up_to(self, n: int) -> int:
+        """Take up to ``n`` tokens; returns how many were granted
+        (n when unlimited or bypassed).  Callers reject the
+        shortfall."""
+        if n <= 0:
+            return 0
+        with self._lock:
+            if self.per_sec <= 0 or not self.enabled:
+                return n
+            t = self._now()
+            self._tokens = min(
+                self.per_sec, self._tokens + (t - self._last) * self.per_sec)
+            self._last = t
+            granted = int(min(n, self._tokens))
+            self._tokens -= granted
+            self.rejected_total += n - granted
+            return granted
