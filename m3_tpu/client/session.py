@@ -77,16 +77,128 @@ class ReplicatedSession:
         write_level: ConsistencyLevel = ConsistencyLevel.MAJORITY,
         read_level: ConsistencyLevel = ConsistencyLevel.UNSTRICT_MAJORITY,
     ):
-        self.placement = placement
-        self.connections = connections
+        # (placement, connections) swap together in ONE attribute so a
+        # topology change mid-fan-out can never pair a new placement
+        # with old handles (reference session.go:527-544 rebuilds its
+        # host queues atomically on a topology watch fire).
+        self._topo = (placement, dict(connections))
         self.write_level = write_level
         self.read_level = read_level
+        self.topology_version = 0
+        self._closed = False
+        self._retired: List[object] = []
+        self._kv = self._kv_key = self._on_change = None
+
+    @property
+    def placement(self) -> Placement:
+        return self._topo[0]
+
+    @property
+    def connections(self) -> Dict[str, object]:
+        return self._topo[1]
 
     # ---- topology ----
 
-    def _replicas_for_shard(self, shard: int, for_read: bool = False) -> List[str]:
+    @classmethod
+    def dynamic(
+        cls,
+        kv,
+        resolve: Callable[[object], object],
+        key: str = "placement",
+        write_level: ConsistencyLevel = ConsistencyLevel.MAJORITY,
+        read_level: ConsistencyLevel = ConsistencyLevel.UNSTRICT_MAJORITY,
+    ) -> "ReplicatedSession":
+        """Session bound to the LIVE placement: watches the KV key and
+        atomically swaps routing whenever the control plane changes it
+        (reference `dbnode/topology/dynamic.go` + the session's
+        topology-watch rebuild, `client/session.go:527-544`).  A node
+        add/replace/remove needs zero client restarts — in-flight
+        fan-outs finish on the old topology, the next call routes on
+        the new one.
+
+        ``resolve(instance)`` returns a Database-like handle for a
+        placement instance (e.g. a ``RemoteDatabase`` at its endpoint).
+        It MUST be cheap and non-blocking (lazy connect like
+        RemoteDatabase): the watch callback may fire inside the KV
+        store's notification path.  Handles of retained instances are
+        reused so their connections stay warm; dropped instances'
+        handles are RETIRED, not closed — in-flight fan-outs holding
+        the old topology snapshot finish undisturbed — and released by
+        ``close()``.  Call ``close()`` when done with the session or
+        the KV watch keeps it (and its handles) alive forever."""
+        vv = kv.get(key)
+        if vv is None:
+            raise ValueError(f"no placement at KV key {key!r}")
+        p = Placement.from_json(vv.data)
+        sess = cls(p, cls._build_conns(p, resolve, {}),
+                   write_level, read_level)
+        sess.topology_version = vv.version
+        sess._kv, sess._kv_key = kv, key
+
+        def on_change(v) -> None:
+            if sess._closed or v.version <= sess.topology_version:
+                return
+            sess._apply_placement(Placement.from_json(v.data), resolve,
+                                  v.version)
+
+        sess._on_change = on_change
+        kv.watch(key, on_change)
+        return sess
+
+    @staticmethod
+    def _build_conns(p: Placement, resolve, old: Dict[str, object]):
+        """Handles for instances that OWN shards (a decommissioned
+        instance lingers in the placement with an empty shard map until
+        the operator removes it — fanning queries at it would hit a
+        dead host on every call).  A resolve() failure marks the
+        instance down (None handle) instead of poisoning the swap."""
+        conns: Dict[str, object] = {}
+        for inst in p.instances.values():
+            if not inst.shards:
+                continue
+            existing = old.get(inst.id)
+            if existing is not None:
+                conns[inst.id] = existing
+                continue
+            try:
+                conns[inst.id] = resolve(inst)
+            except Exception:  # noqa: BLE001 — treated as a down replica
+                conns[inst.id] = None
+        return conns
+
+    def _apply_placement(self, p: Placement, resolve, version: int) -> None:
+        old_p, old_conns = self._topo
+        conns = self._build_conns(p, resolve, old_conns)
+        self._topo = (p, conns)  # atomic swap
+        self.topology_version = version
+        # Retire (never close inline): a fan-out that snapshotted the
+        # old topology may still be mid-call on these handles, and the
+        # watch can fire inside the KV store's notify path where a
+        # blocking close would stall every KV user.
+        for iid, handle in old_conns.items():
+            if iid not in conns and handle is not None:
+                self._retired.append(handle)
+
+    def close(self) -> None:
+        """Detach from the KV watch and release retired handles."""
+        self._closed = True
+        if self._kv is not None and hasattr(self._kv, "unwatch"):
+            self._kv.unwatch(self._kv_key, self._on_change)
+        retired, self._retired = self._retired, []
+        _, conns = self._topo
+        for handle in list(conns.values()) + retired:
+            if handle is not None and hasattr(handle, "close"):
+                try:
+                    handle.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _replicas_for_shard(self, shard: int, for_read: bool = False,
+                            placement: Placement | None = None) -> List[str]:
         out = []
-        for inst in self.placement.instances_for_shard(shard):
+        if placement is None:
+            placement = self.placement
+        for inst in placement.instances_for_shard(shard):
             st = inst.shards[shard].state
             # Leaving instances still serve both paths.  Initializing
             # ones take writes but are excluded from reads: they may not
@@ -114,11 +226,12 @@ class ReplicatedSession:
         fn: Callable[[object], object],
         for_read: bool = False,
     ) -> List[object]:
-        replicas = self._replicas_for_shard(shard, for_read)
+        placement, connections = self._topo  # one consistent snapshot
+        replicas = self._replicas_for_shard(shard, for_read, placement)
         need = level.required(len(replicas))
         results, errors = [], []
         for iid in replicas:
-            conn = self.connections.get(iid)
+            conn = connections.get(iid)
             if conn is None:
                 errors.append(f"{iid}: down")
                 continue
@@ -202,7 +315,8 @@ class ReplicatedSession:
         docs: Dict[bytes, object] = {}
         ok = 0
         errors: List[str] = []
-        for iid, conn in self.connections.items():
+        placement, connections = self._topo  # one consistent snapshot
+        for iid, conn in connections.items():
             if conn is None:
                 errors.append(f"{iid}: down")
                 continue
@@ -212,7 +326,7 @@ class ReplicatedSession:
                 ok += 1
             except Exception as e:
                 errors.append(f"{iid}: {e}")
-        need = self.read_level.required(self.placement.replica_factor)
+        need = self.read_level.required(placement.replica_factor)
         if (self.read_level.strict and ok < need) or ok == 0:
             raise ConsistencyError("query_ids", ok, max(need, 1), errors)
         return [docs[sid] for sid in sorted(docs)]

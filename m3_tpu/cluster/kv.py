@@ -101,6 +101,14 @@ class KVStore:
         if cur is not None:
             fn(cur)
 
+    def unwatch(self, key: str, fn: Callable[[VersionedValue], None]) -> None:
+        """Remove a watcher registered with watch() (no-op when absent)
+        so short-lived watchers don't accumulate forever."""
+        with self._lock:
+            fns = self._watchers.get(key)
+            if fns and fn in fns:
+                fns.remove(fn)
+
     def _notify(self, key: str) -> None:
         cur = self._data[key]
         for fn in self._watchers.get(key, []):

@@ -293,3 +293,106 @@ class TestBadReplicaDoesNotAbortSweep:
         stats = peers_bootstrap(victim, peers, "default")
         assert stats["blocks"] >= 1  # healthy peer i0 supplied the block
         assert block_metadata(victim, "default", 0, T0) is not None
+
+
+class TestDynamicTopologyReroute:
+    """Round-4 VERDICT #7: the session watches the placement and swaps
+    routing live (reference client/session.go:527-544 topology-watch
+    rebuild + dbnode/topology/dynamic.go).  Node replace under
+    sustained Majority writes: zero client restarts, zero failed
+    writes."""
+
+    def test_node_replace_under_sustained_majority_writes(self, tmp_path):
+        from m3_tpu.cluster.kv import KVStore
+        from m3_tpu.cluster.placement import (
+            PlacementService, initial_placement, mark_available,
+            replace_instance,
+        )
+        from m3_tpu.server.rpc import RemoteDatabase, serve_rpc_background
+
+        def mk_db(name):
+            return Database(
+                DatabaseOptions(root=str(tmp_path / name),
+                                commitlog_enabled=False),
+                {"default": NamespaceOptions(
+                    num_shards=4, slot_capacity=256, sample_capacity=2048)},
+            )
+
+        dbs = {iid: mk_db(iid) for iid in ("i0", "i1", "i2")}
+        servers = {iid: serve_rpc_background(db) for iid, db in dbs.items()}
+
+        (tmp_path / "kv").mkdir()
+        kv = KVStore(str(tmp_path / "kv"))
+        ps = PlacementService(kv)
+        p = initial_placement(
+            [Instance(iid, isolation_group=f"g{k}")
+             for k, iid in enumerate(dbs)], num_shards=4, rf=3)
+        ps.set(p)
+
+        def resolve(inst):
+            return RemoteDatabase(("127.0.0.1", servers[inst.id].port))
+
+        sess = ReplicatedSession.dynamic(
+            kv, resolve, write_level=ConsistencyLevel.MAJORITY)
+        v0 = sess.topology_version
+
+        written = []
+        failures = []
+
+        def write_round(r):
+            ids = [b"dyn-%d-%d" % (r, j) for j in range(4)]
+            t = np.full(4, T0 + r * SEC, np.int64)
+            try:
+                sess.write_batch("default", ids, t, np.full(4, float(r)))
+                written.extend(ids)
+            except ConsistencyError as e:  # pragma: no cover
+                failures.append((r, str(e)))
+
+        for r in range(10):
+            write_round(r)
+
+        # --- node replace: i1 -> i3, live, while writes continue ---
+        dbs["i3"] = mk_db("i3")
+        servers["i3"] = serve_rpc_background(dbs["i3"])
+        p2 = replace_instance(ps.get(), "i1", Instance("i3", isolation_group="g1"))
+        ps.set(p2)  # watch fires inline: session swaps routing here
+        assert sess.topology_version > v0
+        assert "i3" in sess.connections  # no restart needed
+
+        for r in range(10, 20):
+            write_round(r)
+
+        # Cutover: i3 bootstraps from peers, then its shards go Available
+        # (the leaving i1 drops out of the placement's routing).
+        peers_bootstrap(dbs["i3"], [dbs["i0"], dbs["i2"]], "default")
+        p3 = ps.get()
+        for shard in range(4):
+            p3 = mark_available(p3, "i3", shard)
+        ps.set(p3)
+        # i1 is gone from routing: killing it must not fail any write.
+        servers["i1"].shutdown()
+
+        for r in range(20, 30):
+            write_round(r)
+
+        assert failures == []          # zero failed writes
+        assert len(written) == 120
+        # Every write since the cutover landed on the replacement.
+        post = [sid for sid in written if int(sid.split(b"-")[1]) >= 20]
+        i3_hits = sum(
+            1 for sid in post
+            if dbs["i3"].read("default", sid, T0, T0 + BLOCK))
+        assert i3_hits == len(post)
+        # And the session serves consistent reads across the new set.
+        pts = sess.fetch("default", written[0], T0, T0 + BLOCK)
+        assert pts and pts[0][1] == 0.0
+        # The decommissioned zero-shard instance left the routing table.
+        assert "i1" not in sess.connections
+        sess.close()  # detaches the KV watch, releases retired handles
+        for srv in servers.values():
+            try:
+                srv.shutdown()
+            except Exception:
+                pass
+        for db in dbs.values():
+            db.close()
