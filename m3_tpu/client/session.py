@@ -19,6 +19,7 @@ sorted dict-merge over (timestamp → value) here.
 from __future__ import annotations
 
 import enum
+import threading
 from typing import Callable, Dict, List, Sequence, Tuple
 
 import numpy as np
@@ -88,6 +89,10 @@ class ReplicatedSession:
         self._closed = False
         self._retired: List[object] = []
         self._kv = self._kv_key = self._on_change = None
+        # Serializes topology swaps against close(): without it a
+        # placement update racing close() could leak fresh handles or
+        # close ones just installed as live.
+        self._swap_mu = threading.Lock()
 
     @property
     def placement(self) -> Placement:
@@ -167,25 +172,29 @@ class ReplicatedSession:
         return conns
 
     def _apply_placement(self, p: Placement, resolve, version: int) -> None:
-        old_p, old_conns = self._topo
-        conns = self._build_conns(p, resolve, old_conns)
-        self._topo = (p, conns)  # atomic swap
-        self.topology_version = version
-        # Retire (never close inline): a fan-out that snapshotted the
-        # old topology may still be mid-call on these handles, and the
-        # watch can fire inside the KV store's notify path where a
-        # blocking close would stall every KV user.
-        for iid, handle in old_conns.items():
-            if iid not in conns and handle is not None:
-                self._retired.append(handle)
+        with self._swap_mu:
+            if self._closed:  # raced close(): don't resurrect handles
+                return
+            old_p, old_conns = self._topo
+            conns = self._build_conns(p, resolve, old_conns)
+            self._topo = (p, conns)  # atomic swap
+            self.topology_version = version
+            # Retire (never close inline): a fan-out that snapshotted
+            # the old topology may still be mid-call on these handles,
+            # and the watch can fire inside the KV store's notify path
+            # where a blocking close would stall every KV user.
+            for iid, handle in old_conns.items():
+                if iid not in conns and handle is not None:
+                    self._retired.append(handle)
 
     def close(self) -> None:
         """Detach from the KV watch and release retired handles."""
-        self._closed = True
+        with self._swap_mu:
+            self._closed = True
+            retired, self._retired = self._retired, []
+            _, conns = self._topo
         if self._kv is not None and hasattr(self._kv, "unwatch"):
             self._kv.unwatch(self._kv_key, self._on_change)
-        retired, self._retired = self._retired, []
-        _, conns = self._topo
         for handle in list(conns.values()) + retired:
             if handle is not None and hasattr(handle, "close"):
                 try:

@@ -111,7 +111,10 @@ class KVStore:
 
     def _notify(self, key: str) -> None:
         cur = self._data[key]
-        for fn in self._watchers.get(key, []):
+        # Snapshot: a callback may unwatch() mid-delivery (the list is
+        # shrinkable now), and mutating the live list would skip the
+        # next watcher's notification.
+        for fn in list(self._watchers.get(key, ())):
             fn(cur)
 
 

@@ -706,10 +706,34 @@ class ReaderIterator:
         return self.curr
 
 
+def unit_for_timestamp(t_nanos: int) -> Unit:
+    """Coarsest unit that represents ``t_nanos`` exactly — the role of
+    the reference's per-write time-unit metadata (xtime.Unit on every
+    write; `timestamp_encoder.go:205-246` switches units via markers so
+    a finer-grained timestamp is never rounded)."""
+    if t_nanos % 1_000_000_000 == 0:
+        return Unit.SECOND
+    if t_nanos % 1_000_000 == 0:
+        return Unit.MILLISECOND
+    if t_nanos % 1_000 == 0:
+        return Unit.MICROSECOND
+    return Unit.NANOSECOND
+
+
 def encode_series(datapoints, start: int | None = None,
                   int_optimized: bool = True, unit: Unit = Unit.SECOND) -> bytes:
-    """Encode a sequence of (timestamp, value) or Datapoint into one stream."""
-    dps = [dp if isinstance(dp, Datapoint) else Datapoint(dp[0], dp[1]) for dp in datapoints]
+    """Encode a sequence of (timestamp, value) or Datapoint into one stream.
+
+    Bare (timestamp, value) tuples get their unit derived from the
+    timestamp's own granularity (unit_for_timestamp): a sub-second
+    timestamp switches the stream to a finer unit with a marker instead
+    of being SILENTLY ROUNDED to the default unit (the rounding bug the
+    round-4 race tier caught: flushed blocks lost nanosecond offsets).
+    Explicit Datapoint inputs keep their caller-declared unit — the
+    reference's semantics, where precision is per-write metadata."""
+    dps = [dp if isinstance(dp, Datapoint)
+           else Datapoint(dp[0], dp[1], unit_for_timestamp(dp[0]))
+           for dp in datapoints]
     if not dps:
         return b""
     if start is None:

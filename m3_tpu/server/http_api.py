@@ -79,6 +79,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._metrics()
             if u.path == "/debug/traces":
                 return self._traces()
+            if u.path == "/debug/dump":
+                return self._debug_dump(q)
             if u.path in ("/api/v1/query_range", "/api/v1/query"):
                 return self._query(u.path.endswith("query_range"), q)
             if u.path == "/api/v1/labels":
@@ -119,6 +121,20 @@ class _Handler(BaseHTTPRequestHandler):
             return self._error(400, str(e))
 
     # -- handlers ----------------------------------------------------------
+
+    def _debug_dump(self, q):
+        """One-stop debug zip: thread stacks, a short CPU profile, a
+        heap view, host info + metrics snapshot (reference
+        x/debug/debug.go's pprof bundle served over HTTP)."""
+        from m3_tpu.instrument.debug import debug_bundle
+
+        seconds = min(float(q.get("seconds", ["0.5"])[0]), 10.0)
+        data = debug_bundle(self.ctx.registry, cpu_seconds=seconds)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/zip")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
 
     def _metrics(self):
         """Prometheus text exposition of the process registry (reference

@@ -54,12 +54,17 @@ class Mediator:
         self._scope = (
             instrument.scope("mediator") if instrument is not None else None
         )
+        # Optional condition-triggered profiler (reference
+        # triggering_profile.go): observe() gets each pass's wall
+        # duration, so a slow tick auto-captures a debug bundle.
+        self.profiler = None
 
     def run_once(self, now_nanos: int | None = None) -> dict:
         """One maintenance pass: tick (seal+flush) every call, snapshot and
         cleanup on their cadence (mediator.go:284 ongoingTick + :318
         runFileSystemProcesses)."""
         with self._lock:
+            t0 = time.monotonic()
             now = self.clock() if now_nanos is None else now_nanos
             stats: dict = {"tick": self.db.tick(now)}
             self._ticks += 1
@@ -76,6 +81,9 @@ class Mediator:
                     self._scope.counter("cold_flushed").inc(
                         ns_stats.get("cold_flushed", 0)
                     )
+            stats["duration_s"] = time.monotonic() - t0
+            if self.profiler is not None:
+                stats["profile"] = self.profiler.observe(stats["duration_s"])
             return stats
 
     # -- background loop ---------------------------------------------------

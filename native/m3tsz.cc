@@ -382,6 +382,10 @@ long m3tsz_encode(const int64_t* ts, const double* vals, long n,
     prev_time = ts[k];
     int64_t dod_n = delta - prev_delta;
     int64_t dod = dod_n >= 0 ? dod_n / u_nanos : -((-dod_n) / u_nanos);
+    // Sub-unit precision needs a time-unit switch (markers) — the
+    // Python codec's path.  Truncating here would silently round the
+    // timestamp (the round-4 flush-precision bug).
+    if (dod * u_nanos != dod_n) return -2;
     if (scheme.default_bits == 32 && (dod < -(1LL << 31) || dod >= (1LL << 31)))
       return -2;  // overflow error in the reference
     write_dod_bucketed(os, dod, scheme.default_bits);

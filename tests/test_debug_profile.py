@@ -1,0 +1,107 @@
+"""Debug bundles + condition-triggered profiling (reference
+x/debug/debug.go pprof zip over HTTP and triggering_profile.go
+auto-capture)."""
+
+import io
+import json
+import urllib.request
+import zipfile
+
+import numpy as np
+import pytest
+
+from m3_tpu import instrument
+from m3_tpu.instrument.debug import (
+    TriggeringProfiler, cpu_profile, debug_bundle, heap_profile, thread_dump,
+)
+
+BLOCK = 2 * 3600 * 10**9
+START = (1_700_000_000 * 10**9) // BLOCK * BLOCK
+
+
+class TestCaptures:
+    def test_thread_dump_contains_this_thread(self):
+        dump = thread_dump()
+        assert "test_thread_dump_contains_this_thread" in dump
+        assert "--- thread" in dump
+
+    def test_cpu_and_heap_profiles_render(self):
+        assert "sampling profile" in cpu_profile(0.05)
+        heap = heap_profile()
+        assert "census" in heap or "tracemalloc" in heap
+
+    def test_bundle_is_a_complete_zip(self):
+        reg = instrument.new_registry()
+        reg.scope("x").counter("c").inc(3)
+        data = debug_bundle(reg, cpu_seconds=0.05)
+        z = zipfile.ZipFile(io.BytesIO(data))
+        assert sorted(z.namelist()) == ["cpu.txt", "heap.txt", "host.json",
+                                        "threads.txt"]
+        host = json.loads(z.read("host.json"))
+        assert host["pid"] > 0 and "metrics" in host
+
+
+class TestTriggeringProfiler:
+    def test_capture_rate_limit_and_cap(self, tmp_path):
+        clock = [0.0]
+        prof = TriggeringProfiler(
+            str(tmp_path), lambda d: d > 1.0, min_interval_s=60,
+            max_captures=2, cpu_seconds=0.05, now=lambda: clock[0])
+        assert prof.observe(0.5) is None          # condition not met
+        p1 = prof.observe(5.0)                    # fires
+        assert p1 is not None and p1.exists()
+        assert zipfile.ZipFile(p1).namelist()     # a real bundle
+        assert prof.observe(5.0) is None          # rate-limited
+        clock[0] += 61
+        assert prof.observe(5.0) is not None      # interval elapsed
+        clock[0] += 61
+        assert prof.observe(5.0) is None          # max_captures cap
+        assert prof.captures == 2
+
+    def test_broken_predicate_never_raises(self, tmp_path):
+        prof = TriggeringProfiler(str(tmp_path), lambda d: 1 / 0)
+        assert prof.observe(1.0) is None
+
+    def test_mediator_slow_tick_triggers_capture(self, tmp_path):
+        from m3_tpu.storage.database import (
+            Database, DatabaseOptions, NamespaceOptions)
+        from m3_tpu.storage.mediator import Mediator
+
+        db = Database(
+            DatabaseOptions(root=str(tmp_path / "db"),
+                            commitlog_enabled=False),
+            {"default": NamespaceOptions(num_shards=1, slot_capacity=64,
+                                         sample_capacity=256)},
+        )
+        med = Mediator(db, clock=lambda: START + 1)
+        med.profiler = TriggeringProfiler(
+            str(tmp_path / "prof"), lambda d: d >= 0.0,  # always slow
+            cpu_seconds=0.05)
+        stats = med.run_once()
+        assert stats["profile"] is not None and stats["profile"].exists()
+        assert "duration_s" in stats
+        db.close()
+
+
+class TestDebugDumpEndpoint:
+    def test_http_debug_dump(self, tmp_path):
+        from m3_tpu.server.http_api import ApiContext, serve_background
+        from m3_tpu.storage.database import (
+            Database, DatabaseOptions, NamespaceOptions)
+
+        db = Database(
+            DatabaseOptions(root=str(tmp_path), commitlog_enabled=False),
+            {"default": NamespaceOptions(num_shards=1, slot_capacity=64,
+                                         sample_capacity=256)},
+        )
+        reg = instrument.new_registry()
+        srv = serve_background(ApiContext(db, registry=reg), "127.0.0.1", 0)
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}/debug/dump?seconds=0.05"
+            with urllib.request.urlopen(url, timeout=30) as r:
+                assert r.headers["Content-Type"] == "application/zip"
+                data = r.read()
+            assert "threads.txt" in zipfile.ZipFile(io.BytesIO(data)).namelist()
+        finally:
+            srv.shutdown()
+            db.close()

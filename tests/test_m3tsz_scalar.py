@@ -184,3 +184,38 @@ def test_huge_magnitude_first_value_decodable():
     out = decode_series(data)
     assert len(out) == 2
     assert out[1].value == 1.0
+
+
+class TestSubUnitPrecision:
+    """Round-4 regression (caught by the race tier): encode_series must
+    never silently round a timestamp finer than the stream unit — the
+    reference switches units with markers (timestamp_encoder.go:205-246)."""
+
+    def test_nanosecond_offsets_roundtrip_exactly(self):
+        from m3_tpu.encoding.m3tsz import (
+            decode_series, encode_series, unit_for_timestamp)
+        from m3_tpu.core.xtime import Unit
+
+        start = 1_699_992_000 * 10**9
+        for off, want_unit in ((1, Unit.NANOSECOND),
+                               (1_000, Unit.MICROSECOND),
+                               (1_000_000, Unit.MILLISECOND),
+                               (0, Unit.SECOND)):
+            pts = [(start + k * 60 * 10**9 + off, float(k))
+                   for k in range(1, 6)]
+            assert unit_for_timestamp(pts[0][0]) == want_unit
+            out = [(p.timestamp, p.value)
+                   for p in decode_series(encode_series(pts, start=start))]
+            assert out == pts, (off, out[:2], pts[:2])
+
+    def test_mixed_alignment_roundtrip(self):
+        from m3_tpu.encoding.m3tsz import decode_series, encode_series
+
+        start = 1_699_992_000 * 10**9
+        pts = [(start + 10**10, 1.0),            # second-aligned
+               (start + 2 * 10**10 + 7, 2.0),    # ns outlier
+               (start + 3 * 10**10, 3.0),        # back to aligned
+               (start + 4 * 10**10 + 7_000, 4.0)]  # us-aligned
+        out = [(p.timestamp, p.value)
+               for p in decode_series(encode_series(pts, start=start))]
+        assert out == pts
