@@ -1,0 +1,146 @@
+"""Pallas TPU kernel for the aggregator's segmented ingest reduction.
+
+SURVEY §7 phase 1 prescribes hand-written Pallas where XLA's cost model
+fails; for this framework's hot ops the measured decisions are:
+
+* **M3TSZ decode** — NOT Pallas.  The codec's per-lane dynamic bit
+  cursors need per-lane gathers, which Mosaic lowers to the same
+  O(S×W) masked reductions XLA does; the production formulation
+  (encoding/m3tsz_jax.py) already avoids them with a carried register
+  window, its HBM ceiling sits ~10× above the BASELINE target, and the
+  host tail is covered by the threaded native codec (34M dp/s/core).
+* **Rollup ingest** — the one op where XLA's lowering is known-risky:
+  `at[idx].add` with colliding indices serializes on TPU.  The arena
+  path uses XLA scatter (validated, exact); THIS module provides the
+  hand-scheduled alternative — a sort-free, two-pass binned segment
+  reduction shaped for the VPU — for hardware/XLA versions where the
+  scatter dominates the north-star bench.
+
+The kernel: ingest N (slot, value) pairs into C accumulator slots.
+Grid over slot tiles of 128×8; each grid step streams the whole batch
+through VMEM and accumulates `value * (slot == lane_slot)` partial sums
+with an 8×128-shaped reduction — no scatter, no atomics, deterministic.
+Cost is O(N × C / tile) vector work: wins over serialized scatter when
+the collision rate is high and C is moderate (the downsampler's rollup
+arenas), loses for huge sparse C — callers choose per shape.
+
+Correctness is pinned against the XLA scatter path in
+tests/test_pallas_ingest.py (interpret mode on CPU — semantics only;
+Mosaic lowering needs real-TPU validation, which is why the arena
+default remains XLA scatter until the bench can measure both).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas ships with jax, but guard anyway: this module is optional
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    HAVE_PALLAS = True
+except Exception:  # pragma: no cover - environment without pallas
+    HAVE_PALLAS = False
+
+TILE = 1024   # slots per grid step: 8 sublanes x 128 lanes of f32 work
+SLAB = 512    # batch points per inner step: (TILE, SLAB) must fit VMEM
+MAX_BATCH = 1 << 18  # both (npad,) inputs are VMEM-resident per grid step:
+                     # ~4MB at f64 — callers chunk bigger batches (the
+                     # arenas already ingest in bounded device batches)
+
+
+def _ingest_kernel(slots_ref, values_ref, out_sum_ref, out_cnt_ref):
+    """One grid step: accumulate the WHOLE batch into this step's
+    1024-slot tile.  slots/values are (N,) in VMEM (same block every
+    step); outputs are (TILE,) blocks of the (C,) accumulators."""
+    step = pl.program_id(0)
+    base = step * TILE
+    slots = slots_ref[:]
+    values = values_ref[:]
+    n = slots.shape[0]
+    # A (TILE, n) one-hot membership matrix would blow VMEM, so the
+    # batch reduces in SLAB-point steps: each inner step materializes
+    # only a (TILE, SLAB) mask (4MB at f64) and accumulates into the
+    # tile's running sums.
+    nslabs = (n + SLAB - 1) // SLAB
+    lane_slots = base + jax.lax.broadcasted_iota(jnp.int32, (TILE, 1), 0)
+
+    def slab_body(k, acc):
+        s_sum, s_cnt = acc
+        lo = k * SLAB
+        sl = jax.lax.dynamic_slice(slots, (lo,), (SLAB,))
+        va = jax.lax.dynamic_slice(values, (lo,), (SLAB,))
+        hit = sl[None, :] == lane_slots  # (TILE, SLAB) bool
+        s_sum = s_sum + jnp.sum(hit.astype(values.dtype) * va[None, :], axis=1)
+        # counts accumulate in int32 regardless of value dtype: a
+        # low-precision value dtype (bf16) would saturate its counts
+        # (dtype pinned — x64 mode would promote the sum to int64)
+        s_cnt = s_cnt + jnp.sum(hit, axis=1, dtype=jnp.int32)
+        return s_sum, s_cnt
+
+    zero_v = jnp.zeros((TILE,), values.dtype)
+    zero_c = jnp.zeros((TILE,), jnp.int32)
+    total, cnt = jax.lax.fori_loop(0, nslabs, slab_body, (zero_v, zero_c))
+    out_sum_ref[:] = total
+    out_cnt_ref[:] = cnt
+
+
+@functools.partial(jax.jit, static_argnames=("capacity", "interpret"))
+def pallas_segment_ingest(slots: jnp.ndarray, values: jnp.ndarray,
+                          capacity: int, interpret: bool = False):
+    """Sum + count ``values`` grouped by ``slots`` into (capacity,)
+    accumulators with a Pallas grid over slot tiles.
+
+    ``slots`` out of [0, capacity) are dropped (the arena drop-sentinel
+    contract).  The batch is padded to whole slabs with an
+    out-of-range slot so the kernel needs no tail masking.
+    """
+    if not HAVE_PALLAS:  # pragma: no cover
+        raise RuntimeError("pallas unavailable in this jax build")
+    C = capacity
+    Cpad = ((C + TILE - 1) // TILE) * TILE
+    n = values.shape[0]
+    if n > MAX_BATCH:
+        raise ValueError(
+            f"batch of {n} exceeds MAX_BATCH={MAX_BATCH}: both input "
+            "arrays are VMEM-resident per grid step — chunk the batch")
+    npad = max(SLAB, ((n + SLAB - 1) // SLAB) * SLAB)  # >=1 slab (empty ok)
+    # pad with an impossible slot: contributes to no tile
+    slots_p = jnp.full(npad, Cpad, jnp.int32).at[:n].set(
+        jnp.where((slots < 0) | (slots >= C), Cpad, slots).astype(jnp.int32))
+    values_p = jnp.zeros(npad, values.dtype).at[:n].set(values)
+
+    grid = Cpad // TILE
+    out_sum, out_cnt = pl.pallas_call(
+        _ingest_kernel,
+        grid=(grid,),
+        in_specs=[
+            # every grid step streams the whole batch
+            pl.BlockSpec((npad,), lambda i: (0,)),
+            pl.BlockSpec((npad,), lambda i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((TILE,), lambda i: (i,)),
+            pl.BlockSpec((TILE,), lambda i: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Cpad,), values.dtype),
+            jax.ShapeDtypeStruct((Cpad,), jnp.int32),
+        ],
+        interpret=interpret,
+    )(slots_p, values_p)
+    return out_sum[:C], out_cnt[:C]
+
+
+def xla_segment_ingest(slots, values, capacity: int):
+    """The validated default: XLA scatter-add (what the arenas use)."""
+    idx = jnp.where((slots < 0) | (slots >= capacity), capacity,
+                    slots).astype(jnp.int32)
+    s = jnp.zeros(capacity + 1, values.dtype).at[idx].add(
+        values, mode="drop")[:capacity]
+    c = jnp.zeros(capacity + 1, jnp.int32).at[idx].add(
+        1, mode="drop")[:capacity]
+    return s, c

@@ -1,0 +1,55 @@
+"""Pallas segmented-ingest kernel vs the XLA scatter oracle.
+
+Interpret mode (CPU): validates SEMANTICS — the (slot, value) binned
+sum/count reduction, drop-sentinel handling, padding.  Mosaic lowering
+and the scatter-vs-binned crossover need real-TPU measurement (see the
+module docstring's decision record)."""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from m3_tpu.parallel.pallas_ingest import (  # noqa: E402
+    HAVE_PALLAS, pallas_segment_ingest, xla_segment_ingest,
+)
+
+pytestmark = pytest.mark.skipif(not HAVE_PALLAS, reason="no pallas")
+
+
+@pytest.mark.parametrize("C,N,seed", [(100, 257, 0), (3000, 5000, 1),
+                                      (1024, 1024, 2), (17, 10_000, 3)])
+def test_matches_xla_scatter(C, N, seed):
+    rng = np.random.default_rng(seed)
+    slots = rng.integers(-3, C + 3, N).astype(np.int32)  # incl. OOR drops
+    vals = np.round(rng.normal(0, 10, N), 6)
+    ps, pc = pallas_segment_ingest(jnp.asarray(slots), jnp.asarray(vals),
+                                   C, interpret=True)
+    xs, xc = xla_segment_ingest(jnp.asarray(slots), jnp.asarray(vals), C)
+    np.testing.assert_allclose(np.asarray(ps), np.asarray(xs), atol=1e-9)
+    np.testing.assert_array_equal(np.asarray(pc), np.asarray(xc))
+
+
+def test_oversized_batch_rejected():
+    from m3_tpu.parallel.pallas_ingest import MAX_BATCH
+
+    with pytest.raises(ValueError, match="MAX_BATCH"):
+        pallas_segment_ingest(jnp.zeros(MAX_BATCH + 1, jnp.int32),
+                              jnp.zeros(MAX_BATCH + 1), 64, interpret=True)
+
+
+def test_high_collision_all_one_slot():
+    """The shape where binned reduction beats serialized scatter."""
+    N, C = 4096, 128
+    slots = np.zeros(N, np.int32)
+    vals = np.ones(N)
+    ps, pc = pallas_segment_ingest(jnp.asarray(slots), jnp.asarray(vals),
+                                   C, interpret=True)
+    assert float(ps[0]) == N and float(pc[0]) == N
+    assert float(ps[1:].sum()) == 0.0
+
+
+def test_empty_batch():
+    ps, pc = pallas_segment_ingest(jnp.zeros(0, jnp.int32),
+                                   jnp.zeros(0), 64, interpret=True)
+    assert float(ps.sum()) == 0.0 and float(pc.sum()) == 0.0
