@@ -32,8 +32,10 @@ _TEMPORAL_SUM = {"sum_over_time", "count_over_time", "avg_over_time",
 _TEMPORAL_MINMAXQ = {"min_over_time", "max_over_time", "quantile_over_time"}
 _TEMPORAL_RATE = {"rate", "increase", "delta", "irate", "idelta"}
 _TEMPORAL_REG = {"deriv", "predict_linear"}
+_TEMPORAL_TRANS = {"resets", "changes"}
 _TEMPORAL_ALL = (_TEMPORAL_SUM | _TEMPORAL_MINMAXQ | _TEMPORAL_RATE
-                 | _TEMPORAL_REG | {"last_over_time", "present_over_time"})
+                 | _TEMPORAL_REG | _TEMPORAL_TRANS
+                 | {"last_over_time", "present_over_time", "holt_winters"})
 
 
 class Storage(Protocol):
@@ -145,6 +147,8 @@ class Engine:
             elif f == "predict_linear":
                 sel_arg = call.args[0]
                 extra = self._scalar_arg(call.args[1], steps)
+            elif f == "holt_winters":
+                sel_arg = call.args[0]
             if not isinstance(sel_arg, VectorSelector) or sel_arg.range_nanos == 0:
                 raise ValueError(f"{f} requires a range selector")
             raw, eval_steps = self._fetch(sel_arg, steps, sel_arg.range_nanos)
@@ -161,6 +165,19 @@ class Engine:
                 out = tp.rate_family(ts_j, vals_j, st_j, rng, f)
             elif f in _TEMPORAL_REG:
                 out = tp.regression_family(ts_j, vals_j, st_j, rng, f, extra)
+            elif f in _TEMPORAL_TRANS:
+                out = tp.transitions_family(ts_j, vals_j, st_j, rng, f)
+            elif f == "holt_winters":
+                sfv = float(self._scalar_arg(call.args[1], steps))
+                tfv = float(self._scalar_arg(call.args[2], steps))
+                # Prometheus funcHoltWinters: sf in (0, 1), tf in (0, 1]
+                if not (0.0 < sfv < 1.0) or not (0.0 < tfv <= 1.0):
+                    raise ValueError(
+                        "holt_winters smoothing factor must be in (0, 1) "
+                        "and trend factor in (0, 1]")
+                W = tp.window_pad_for(raw.counts, raw.ts, rng)
+                out = tp.holt_winters(ts_j, vals_j, st_j, rng, max(W, 2),
+                                      sfv, tfv)
             elif f == "last_over_time":
                 out = tp.last_over_time(ts_j, vals_j, st_j, rng)
             else:  # present_over_time
@@ -216,6 +233,21 @@ class Engine:
                                  [m.drop_name() for m in b.series])
         if f == "time":
             return _Scalar(steps.astype(np.float64) / 1e9)
+        if f in ("sort", "sort_desc"):
+            # Prometheus sorts instant vectors by value; for a range
+            # evaluation the order is taken at the final step (stable
+            # for ties, NaNs last), matching how dashboards consume it.
+            b = self._eval(call.args[0], steps)
+            if isinstance(b, _Scalar):
+                raise ValueError(f"{f} expects an instant vector")
+            if b.num_series <= 1:
+                return b
+            key = b.values[:, -1]
+            key = np.where(np.isnan(key), np.inf if f == "sort" else -np.inf,
+                           key)
+            order = np.argsort(key if f == "sort" else -key, kind="stable")
+            return Block(steps, b.values[order],
+                         [b.series[i] for i in order])
         raise ValueError(f"unsupported function {f!r}")
 
     def _label_replace(self, call: Call, steps: np.ndarray) -> Block:

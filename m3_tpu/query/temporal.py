@@ -75,19 +75,27 @@ def sum_count_family(ts, vals, step_times, range_nanos, func: str):
     return jnp.where(empty, NAN, out)
 
 
-@functools.partial(jax.jit, static_argnames=("func", "window_pad"))
-def minmax_quantile_family(ts, vals, step_times, range_nanos, func: str,
-                           window_pad: int, q: float = 0.0):
-    """min/max/quantile_over_time via the (S, T, W) gathered stencil."""
-    lo, hi = _window_bounds(ts, step_times, range_nanos)
+def _gather_window(vals, lo, hi, W: int):
+    """(S, T, W) stencil gather of each window's samples plus the valid
+    mask — the shared idiom of every W-bounded kernel."""
     S, P = vals.shape
-    W = window_pad
+    T = lo.shape[1]
     idx = lo[:, :, None] + jnp.arange(W, dtype=jnp.int32)[None, None, :]
     valid = idx < hi[:, :, None]
     idx = jnp.clip(idx, 0, P - 1)
     g = jnp.take_along_axis(
         vals[:, None, :], idx.reshape(S, -1)[:, None, :], axis=2
-    ).reshape(S, step_times.shape[0], W)
+    ).reshape(S, T, W)
+    return g, valid
+
+
+@functools.partial(jax.jit, static_argnames=("func", "window_pad"))
+def minmax_quantile_family(ts, vals, step_times, range_nanos, func: str,
+                           window_pad: int, q: float = 0.0):
+    """min/max/quantile_over_time via the (S, T, W) gathered stencil."""
+    lo, hi = _window_bounds(ts, step_times, range_nanos)
+    g, valid = _gather_window(vals, lo, hi, window_pad)
+    W = window_pad
     n = (hi - lo).astype(jnp.int32)
     empty = n == 0
     if func == "min_over_time":
@@ -214,6 +222,63 @@ def regression_family(ts, vals, step_times, range_nanos, func: str,
     if func == "deriv":
         return jnp.where(ok, slope, NAN)
     return jnp.where(ok, intercept + slope * predict_offset_s, NAN)
+
+
+@functools.partial(jax.jit, static_argnames=("func",))
+def transitions_family(ts, vals, step_times, range_nanos, func: str):
+    """resets / changes (reference functions.go funcResets/funcChanges):
+    count the transitions between CONSECUTIVE samples inside each
+    window — resets counts v[i] < v[i-1] (counter restarts), changes
+    counts v[i] != v[i-1].  Prefix-summed over the adjacent-pair
+    indicator, so the windowed count is two gathers: pairs (i-1, i)
+    with both ends inside [lo, hi) are those with i in [lo+1, hi)."""
+    lo, hi = _window_bounds(ts, step_times, range_nanos)
+    prev = jnp.concatenate([vals[:, :1], vals[:, :-1]], axis=1)
+    if func == "resets":
+        ind = (vals < prev).astype(jnp.float64)
+    else:  # changes
+        ind = (vals != prev).astype(jnp.float64)
+    c = _prefix(ind)
+    P = vals.shape[1]
+    count = (_gather_rows(c, hi) -
+             _gather_rows(c, jnp.clip(lo + 1, 0, P)))
+    n = hi - lo
+    # >=1 sample emits (0 transitions for a single sample); empty -> NaN
+    return jnp.where(n >= 1, jnp.maximum(count, 0.0), NAN)
+
+
+@functools.partial(jax.jit, static_argnames=("window_pad",))
+def holt_winters(ts, vals, step_times, range_nanos, window_pad: int,
+                 sf: float, tf: float):
+    """holt_winters / double_exponential_smoothing (reference
+    functions/temporal + Prometheus funcHoltWinters): per window,
+    level/trend smoothing over the gathered (S, T, W) stencil with a
+    masked fori over W — s1 seeds from x0, trend from x1-x0, and each
+    in-window sample advances (s1, b) exactly like the sequential
+    reference loop."""
+    lo, hi = _window_bounds(ts, step_times, range_nanos)
+    W = window_pad
+    g, valid = _gather_window(vals, lo, hi, W)
+    g = jnp.where(valid, g, 0.0)
+    n = hi - lo
+
+    x0 = g[:, :, 0]
+    x1 = g[:, :, 1] if W > 1 else x0
+    s1_0 = x0
+    b_0 = x1 - x0
+
+    def body(i, carry):
+        s1, b = carry
+        x = jax.lax.dynamic_index_in_dim(g, i, axis=2, keepdims=False)
+        active = i < n
+        xs = sf * x
+        y = (1.0 - sf) * (s1 + b)
+        s0_new, s1_new = s1, xs + y
+        b_new = tf * (s1_new - s0_new) + (1.0 - tf) * b
+        return (jnp.where(active, s1_new, s1), jnp.where(active, b_new, b))
+
+    s1, _b = jax.lax.fori_loop(1, W, body, (s1_0, b_0))
+    return jnp.where(n >= 2, s1, NAN)
 
 
 @jax.jit

@@ -233,3 +233,21 @@ def test_encode_zero_count_series_empty():
     assert not fb.any()
     assert streams[1] == b"" and streams[3] == b""
     assert streams[0] != b""
+
+
+def test_batched_decode_mixed_unit_streams():
+    """Streams produced by the per-datapoint-unit encoder (round-4
+    precision fix: TU markers mid-stream) decode exactly on the device
+    path too — the is_tu branch handles every switch."""
+    from m3_tpu.encoding.m3tsz import encode_series
+    from m3_tpu.encoding.m3tsz_jax import decode_batch
+
+    SEC = 10**9
+    start = 1_699_992_000 * SEC
+    pts = [(start + 10**10, 1.0), (start + 2 * 10**10 + 7, 2.0),
+           (start + 3 * 10**10, 3.0), (start + 4 * 10**10 + 7000, 4.5)]
+    blob = encode_series(pts, start=start)
+    ts, vals, counts, fb = decode_batch([blob], max_points=16)
+    assert not fb[0] and counts[0] == 4
+    got = list(zip(ts[0, :4].tolist(), vals[0, :4].tolist()))
+    assert got == pts

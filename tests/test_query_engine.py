@@ -195,3 +195,39 @@ class TestEngine:
             QSTART, QEND, STEP,
         )
         assert all(b"node" in m.as_dict() for m in b.series)
+
+
+class TestRound4Functions:
+    def test_resets_and_changes(self, engine):
+        # monotone counters: zero resets; changes > 0 where it moves
+        b = engine.execute_range(
+            'resets(http_requests_total{host="h0", job="api"}[5m])',
+            QSTART, QEND, STEP)
+        assert b.num_series == 1
+        assert np.nanmax(b.values) == 0.0
+        b2 = engine.execute_range(
+            'changes(http_requests_total{host="h0", job="api"}[5m])',
+            QSTART, QEND, STEP)
+        assert np.nanmax(b2.values) > 0
+
+    def test_holt_winters_smooths(self, engine):
+        b = engine.execute_range(
+            'holt_winters(http_requests_total{host="h0", job="api"}[5m], 0.3, 0.6)',
+            QSTART, QEND, STEP)
+        assert b.num_series == 1
+        assert np.isfinite(b.values[0, -1])
+        with pytest.raises(ValueError, match="smoothing"):
+            engine.execute_range(
+                'holt_winters(http_requests_total[5m], 1.5, 0.6)',
+                QSTART, QEND, STEP)
+
+    def test_sort_orders_series_by_final_value(self, engine):
+        a = engine.execute_range('sort(http_requests_total{job="api"})',
+                                 QSTART, QEND, STEP)
+        d = engine.execute_range('sort_desc(http_requests_total{job="api"})',
+                                 QSTART, QEND, STEP)
+        assert a.num_series == d.num_series == 4
+        fa = a.values[:, -1]
+        fd = d.values[:, -1]
+        assert np.all(np.diff(fa) >= 0)
+        assert np.all(np.diff(fd) <= 0)

@@ -191,3 +191,71 @@ def test_last_over_time():
                 assert np.isnan(got[s, j])
             else:
                 assert got[s, j] == wv[-1]
+
+
+def _oracle_transitions(ts, vals, counts, steps, rng_nanos, func):
+    S = ts.shape[0]
+    out = np.full((S, len(steps)), np.nan)
+    for s in range(S):
+        for j, t in enumerate(steps):
+            _, wv = _window(ts[s], vals[s], counts[s], t, rng_nanos)
+            if len(wv) == 0:
+                continue
+            if func == "resets":
+                out[s, j] = float(np.sum(wv[1:] < wv[:-1]))
+            else:
+                out[s, j] = float(np.sum(wv[1:] != wv[:-1]))
+    return out
+
+
+def _oracle_holt_winters(ts, vals, counts, steps, rng_nanos, sf, tf):
+    """Prometheus funcHoltWinters, verbatim sequential loop."""
+    S = ts.shape[0]
+    out = np.full((S, len(steps)), np.nan)
+    for s in range(S):
+        for j, t in enumerate(steps):
+            _, wv = _window(ts[s], vals[s], counts[s], t, rng_nanos)
+            if len(wv) < 2:
+                continue
+            s1 = wv[0]
+            b = wv[1] - wv[0]
+            for i in range(1, len(wv)):
+                x = sf * wv[i]
+                y = (1.0 - sf) * (s1 + b)
+                s0, s1 = s1, x + y
+                b = tf * (s1 - s0) + (1.0 - tf) * b
+            out[s, j] = s1
+    return out
+
+
+class TestTransitionsFamily:
+    @pytest.mark.parametrize("func", ["resets", "changes"])
+    def test_vs_oracle(self, func):
+        ts, vals, counts, steps = _mk_series(counter=True, seed=11)
+        got = np.asarray(tp.transitions_family(
+            jnp.asarray(ts), jnp.asarray(np.nan_to_num(vals)),
+            jnp.asarray(steps), RANGE, func))
+        want = _oracle_transitions(ts, np.nan_to_num(vals), counts, steps,
+                                   RANGE, func)
+        np.testing.assert_allclose(got, want, equal_nan=True)
+
+    def test_single_sample_window_is_zero(self):
+        ts = np.asarray([[T0 + 10**9]], np.int64)
+        vals = np.asarray([[5.0]])
+        steps = np.asarray([T0 + 2 * 10**9], np.int64)
+        got = np.asarray(tp.transitions_family(
+            jnp.asarray(ts), jnp.asarray(vals), jnp.asarray(steps),
+            RANGE, "resets"))
+        assert got[0, 0] == 0.0
+
+
+class TestHoltWinters:
+    def test_vs_prometheus_loop(self):
+        ts, vals, counts, steps = _mk_series(seed=5)
+        W = tp.window_pad_for(counts, ts, RANGE)
+        got = np.asarray(tp.holt_winters(
+            jnp.asarray(ts), jnp.asarray(np.nan_to_num(vals)),
+            jnp.asarray(steps), RANGE, max(W, 2), 0.3, 0.6))
+        want = _oracle_holt_winters(ts, np.nan_to_num(vals), counts, steps,
+                                    RANGE, 0.3, 0.6)
+        np.testing.assert_allclose(got, want, rtol=1e-10, equal_nan=True)
