@@ -158,7 +158,10 @@ def convert_from_int_float(val: float, mult: int) -> float:
 class Datapoint:
     timestamp: int  # UnixNano
     value: float
-    unit: Unit = Unit.SECOND
+    # None = "derive from encoder state" (auto units): tuples and plain
+    # constructions get exactness-preserving unit selection; decode
+    # paths set the stream's explicit unit.
+    unit: Unit | None = None
     annotation: bytes = b""
 
 
@@ -242,6 +245,20 @@ class TimestampEncoder:
         os.write_byte(int(unit))
         self.time_unit = unit
         self.time_unit_encoded_manually = True
+
+    def auto_unit_for(self, curr: int) -> Unit:
+        """State-aware unit choice: the current unit while it represents
+        the next delta-of-delta exactly, else the coarsest unit that
+        does (reference timestamp_encoder.go:205-246 switches units via
+        markers; precision is never rounded away)."""
+        dod = (curr - self.prev_time) - self.prev_time_delta
+        u = self.time_unit
+        if u.is_valid() and u.nanos() > 0 and dod % u.nanos() == 0:
+            return u
+        for cand in (Unit.SECOND, Unit.MILLISECOND, Unit.MICROSECOND):
+            if dod % cand.nanos() == 0:
+                return cand
+        return Unit.NANOSECOND
 
     def _maybe_write_time_unit_change(self, os: OStream, unit: Unit) -> bool:
         if not unit.is_valid() or unit == self.time_unit:
@@ -390,9 +407,19 @@ class IntSigBitsTracker:
 
 
 class Encoder:
-    """M3TSZ stream encoder (encoder.go:42-250)."""
+    """M3TSZ stream encoder (encoder.go:42-250).
 
-    def __init__(self, start: int, int_optimized: bool = True, unit: Unit = Unit.SECOND):
+    ``auto_unit=True`` derives each datapoint's time unit from the
+    encoder state instead of trusting ``dp.unit``: keep the current
+    stream unit while it divides the delta-of-delta exactly, otherwise
+    switch (with a marker) to the coarsest unit that does.  This is the
+    faithful mapping of the reference's per-write unit metadata onto an
+    API whose timestamps are raw int64 nanos — a sub-unit timestamp can
+    NEVER be silently rounded (the round-4 flush-precision bug), and
+    aligned streams stay byte-identical to the fixed-unit form."""
+
+    def __init__(self, start: int, int_optimized: bool = True,
+                 unit: Unit = Unit.SECOND, auto_unit: bool = False):
         self.os = OStream()
         self.ts = TimestampEncoder.new(start, unit)
         self.float_enc = FloatXOR()
@@ -402,9 +429,13 @@ class Encoder:
         self.max_mult = 0
         self.int_optimized = int_optimized
         self.is_float = False
+        self.auto_unit = auto_unit
 
     def encode(self, dp: Datapoint) -> None:
-        self.ts.write_time(self.os, dp.timestamp, dp.annotation, dp.unit)
+        unit = dp.unit
+        if unit is None or self.auto_unit:
+            unit = self.ts.auto_unit_for(dp.timestamp)
+        self.ts.write_time(self.os, dp.timestamp, dp.annotation, unit)
         if self.num_encoded == 0:
             self._write_first_value(dp.value)
         else:
@@ -724,15 +755,15 @@ def encode_series(datapoints, start: int | None = None,
                   int_optimized: bool = True, unit: Unit = Unit.SECOND) -> bytes:
     """Encode a sequence of (timestamp, value) or Datapoint into one stream.
 
-    Bare (timestamp, value) tuples get their unit derived from the
-    timestamp's own granularity (unit_for_timestamp): a sub-second
-    timestamp switches the stream to a finer unit with a marker instead
-    of being SILENTLY ROUNDED to the default unit (the rounding bug the
-    round-4 race tier caught: flushed blocks lost nanosecond offsets).
-    Explicit Datapoint inputs keep their caller-declared unit — the
-    reference's semantics, where precision is per-write metadata."""
-    dps = [dp if isinstance(dp, Datapoint)
-           else Datapoint(dp[0], dp[1], unit_for_timestamp(dp[0]))
+    Bare (timestamp, value) tuples become unit=None datapoints, whose
+    units derive per datapoint from the encoder state: a sub-unit delta
+    switches the stream to a finer unit with a marker instead of being
+    SILENTLY ROUNDED (the bug the round-4 race tier caught: flushed
+    blocks lost nanosecond offsets), while aligned streams stay
+    byte-identical to the fixed-unit form.  Datapoints with an explicit
+    unit keep it — the reference's semantics, where precision is
+    per-write metadata — and mixing the two forms is safe."""
+    dps = [dp if isinstance(dp, Datapoint) else Datapoint(dp[0], dp[1])
            for dp in datapoints]
     if not dps:
         return b""
@@ -740,7 +771,7 @@ def encode_series(datapoints, start: int | None = None,
         start = dps[0].timestamp
     enc = Encoder(start, int_optimized=int_optimized, unit=unit)
     for dp in dps:
-        enc.encode(dp)
+        enc.encode(dp)  # unit=None datapoints (tuples) auto-derive
     return enc.stream()
 
 

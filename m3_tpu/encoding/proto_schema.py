@@ -16,12 +16,14 @@ protobuf message descriptors onto its (name, kind) schema:
   fully-qualified message name (``pack_schema_annotation``), the same
   payload shape the reference stores, so it can ride the codec
   annotation path (commitlog annotations / M3TSZ first-datapoint
-  annotations).
-
-Out of scope (explicit errors, host fallback): repeated fields, maps,
-and ``oneof`` groups — the reference's custom marshal handles these
-through proto reflection; this framework keeps the device-friendly
-dense-column contract.
+  annotations);
+* REPEATED fields, MAPS, and ``oneof`` groups ride OPAQUE BYTES
+  columns: the field (or the oneof's set branch) serializes to its own
+  proto wire bytes (deterministic map ordering) and compresses through
+  the byte-field LRU — the role of the reference's "remaining fields"
+  custom marshal (`encoder.go` marshals non-custom fields as a delta'd
+  proto blob) rather than per-element columns, which would break the
+  dense-column device contract.
 """
 
 from __future__ import annotations
@@ -34,14 +36,21 @@ class UnsupportedFieldError(ValueError):
     pass
 
 
+def _real_oneofs(desc):
+    """Declared oneof groups only: proto3 `optional` fields synthesize a
+    single-member oneof named `_<field>` — those are plain presence
+    tracking and must keep their native scalar columns, not an opaque
+    blob (the python descriptor API exposes no is_synthetic flag; the
+    protoc naming contract is the detection)."""
+    return [o for o in desc.oneofs
+            if not (len(o.fields) == 1
+                    and o.name == "_" + o.fields[0].name)]
+
+
 def _kind_for(field) -> FieldKind:
     from google.protobuf import descriptor as _d
 
     FD = _d.FieldDescriptor
-    if field.is_repeated:
-        raise UnsupportedFieldError(
-            f"repeated/map field {field.full_name!r} is host-fallback scope"
-        )
     t = field.type
     if t in (FD.TYPE_INT32, FD.TYPE_INT64, FD.TYPE_UINT32, FD.TYPE_UINT64,
              FD.TYPE_SINT32, FD.TYPE_SINT64, FD.TYPE_FIXED32,
@@ -70,19 +79,53 @@ def schema_from_descriptor(desc, prefix: str = "",
     if _depth > 16:
         raise UnsupportedFieldError("message nesting too deep")
     fields: list[tuple[str, FieldKind]] = []
+    real = _real_oneofs(desc)
+    oneofs = {f.name for o in real for f in o.fields}
+    for o in real:
+        # one opaque column per oneof group: only the SET branch
+        # serializes, so which-branch state survives the round trip
+        fields.append((prefix + "__oneof__." + o.name, FieldKind.BYTES))
     for field in desc.fields:
+        if field.name in oneofs:
+            continue
         name = prefix + field.name
-        if field.type == _d.FieldDescriptor.TYPE_MESSAGE:
-            if field.is_repeated:
-                raise UnsupportedFieldError(
-                    f"repeated message field {field.full_name!r}"
-                )
+        if field.is_repeated:
+            fields.append((name, FieldKind.BYTES))  # opaque wire bytes
+        elif field.type == _d.FieldDescriptor.TYPE_MESSAGE:
             sub = schema_from_descriptor(field.message_type, name + ".",
                                          _depth + 1)
             fields.extend(sub.fields)
         else:
             fields.append((name, _kind_for(field)))
     return Schema(tuple(fields))
+
+
+def _field_wire_bytes(m, field) -> bytes:
+    """Serialize ONE field's state to proto wire bytes (tag included)
+    by copying it into an empty sibling message — deterministic map
+    ordering so equal states produce equal bytes."""
+    tmp = type(m)()
+    src = getattr(m, field.name)
+    dst = getattr(tmp, field.name)
+    if field.message_type is not None and field.message_type.GetOptions(
+    ).map_entry:
+        # map field; message-valued maps forbid update()/assignment
+        vf = field.message_type.fields_by_name["value"]
+        if vf.type == vf.TYPE_MESSAGE:
+            for k in src:
+                dst[k].CopyFrom(src[k])
+        else:
+            dst.update(src)
+    elif field.is_repeated:
+        if field.type == field.TYPE_MESSAGE:
+            dst.MergeFrom(src)
+        else:
+            dst.extend(src)
+    elif field.type == field.TYPE_MESSAGE:
+        dst.CopyFrom(src)
+    else:
+        setattr(tmp, field.name, src)
+    return tmp.SerializePartialToString(deterministic=True)
 
 
 def message_to_columns(msg) -> dict:
@@ -93,9 +136,20 @@ def message_to_columns(msg) -> dict:
     out: dict = {}
 
     def walk(m, prefix: str):
+        real = _real_oneofs(m.DESCRIPTOR)
+        oneofs = {f.name for o in real for f in o.fields}
+        for o in real:
+            set_field = m.WhichOneof(o.name)
+            out[prefix + "__oneof__." + o.name] = (
+                b"" if set_field is None
+                else _field_wire_bytes(m, m.DESCRIPTOR.fields_by_name[set_field]))
         for field in m.DESCRIPTOR.fields:
+            if field.name in oneofs:
+                continue
             name = prefix + field.name
-            if field.type == _d.FieldDescriptor.TYPE_MESSAGE:
+            if field.is_repeated:
+                out[name] = _field_wire_bytes(m, field)
+            elif field.type == _d.FieldDescriptor.TYPE_MESSAGE:
                 walk(getattr(m, field.name), name + ".")
             else:
                 v = getattr(m, field.name)
@@ -116,8 +170,21 @@ def columns_to_message(msg, columns: dict):
     from google.protobuf import descriptor as _d
 
     def walk(m, prefix: str):
+        real = _real_oneofs(m.DESCRIPTOR)
+        oneofs = {f.name for o in real for f in o.fields}
+        for o in real:
+            blob = columns.get(prefix + "__oneof__." + o.name)
+            if blob:
+                m.MergeFromString(blob)
         for field in m.DESCRIPTOR.fields:
+            if field.name in oneofs:
+                continue
             name = prefix + field.name
+            if field.is_repeated:
+                blob = columns.get(name)
+                if blob:
+                    m.MergeFromString(blob)
+                continue
             if field.type == _d.FieldDescriptor.TYPE_MESSAGE:
                 walk(getattr(m, field.name), name + ".")
                 continue

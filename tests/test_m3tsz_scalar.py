@@ -219,3 +219,17 @@ class TestSubUnitPrecision:
         out = [(p.timestamp, p.value)
                for p in decode_series(encode_series(pts, start=start))]
         assert out == pts
+
+    def test_mixed_datapoint_and_tuple_inputs_keep_precision(self):
+        """Round-4 review regression: tuples mixed with explicit
+        Datapoints still auto-derive their units — a sub-unit tuple
+        timestamp is never rounded."""
+        from m3_tpu.core.xtime import Unit
+        from m3_tpu.encoding.m3tsz import (
+            Datapoint, decode_series, encode_series)
+
+        base = 1_699_992_000 * 10**9
+        pts = [Datapoint(base + 10**10, 1.0, Unit.SECOND),
+               (base + 2 * 10**10 + 500, 3.0)]
+        out = decode_series(encode_series(pts, start=base))
+        assert out[1].timestamp == base + 2 * 10**10 + 500

@@ -83,7 +83,11 @@ class TestDescriptorSchema:
             ("position.longitude", FieldKind.FLOAT),
         )
 
-    def test_repeated_rejected(self):
+    def test_repeated_map_oneof_ride_opaque_columns(self):
+        """Round-4: repeated fields, maps and oneofs serialize to
+        opaque wire-bytes columns (the reference's remaining-fields
+        marshal role) and round-trip exactly, including which-oneof
+        state."""
         from google.protobuf import descriptor_pb2, descriptor_pool
 
         f = descriptor_pb2.FileDescriptorProto()
@@ -91,16 +95,63 @@ class TestDescriptorSchema:
         f.package = "m3test2"
         f.syntax = "proto3"
         m = f.message_type.add()
-        m.name = "HasRepeated"
+        m.name = "Fancy"
+        FD = descriptor_pb2.FieldDescriptorProto
         fd = m.field.add()
         fd.name, fd.number = "xs", 1
-        fd.type = descriptor_pb2.FieldDescriptorProto.TYPE_INT64
-        fd.label = descriptor_pb2.FieldDescriptorProto.LABEL_REPEATED
+        fd.type, fd.label = FD.TYPE_INT64, FD.LABEL_REPEATED
+        # map<string, int64> counts = 2;  (a nested MapEntry message)
+        entry = m.nested_type.add()
+        entry.name = "CountsEntry"
+        entry.options.map_entry = True
+        k = entry.field.add()
+        k.name, k.number, k.type, k.label = "key", 1, FD.TYPE_STRING, FD.LABEL_OPTIONAL
+        v = entry.field.add()
+        v.name, v.number, v.type, v.label = "value", 2, FD.TYPE_INT64, FD.LABEL_OPTIONAL
+        fd2 = m.field.add()
+        fd2.name, fd2.number = "counts", 2
+        fd2.type, fd2.label = FD.TYPE_MESSAGE, FD.LABEL_REPEATED
+        fd2.type_name = ".m3test2.Fancy.CountsEntry"
+        # oneof choice { int64 a = 3; string b = 4; }
+        oo = m.oneof_decl.add()
+        oo.name = "choice"
+        for i, (nm, t) in enumerate((("a", FD.TYPE_INT64),
+                                     ("b", FD.TYPE_STRING)), 3):
+            fdo = m.field.add()
+            fdo.name, fdo.number, fdo.type = nm, i, t
+            fdo.label = FD.LABEL_OPTIONAL
+            fdo.oneof_index = 0
         pool = descriptor_pool.DescriptorPool()
         pool.Add(f)
-        with pytest.raises(UnsupportedFieldError):
-            schema_from_descriptor(
-                pool.FindMessageTypeByName("m3test2.HasRepeated"))
+        desc = pool.FindMessageTypeByName("m3test2.Fancy")
+
+        schema = schema_from_descriptor(desc)
+        names = [n for n, _ in schema.fields]
+        assert "xs" in names and "counts" in names
+        assert "__oneof__.choice" in names
+        kinds = dict(schema.fields)
+        assert kinds["xs"] == FieldKind.BYTES
+        assert kinds["counts"] == FieldKind.BYTES
+
+        cls = message_class_for(desc)
+        msg = cls()
+        msg.xs.extend([5, -2, 7])
+        msg.counts["api"] = 3
+        msg.counts["db"] = 9
+        msg.b = "branch-b"
+        cols = message_to_columns(msg)
+        out = columns_to_message(cls(), cols)
+        assert list(out.xs) == [5, -2, 7]
+        assert dict(out.counts) == {"api": 3, "db": 9}
+        assert out.WhichOneof("choice") == "b" and out.b == "branch-b"
+        # unset oneof round-trips as unset
+        empty = columns_to_message(cls(), message_to_columns(cls()))
+        assert empty.WhichOneof("choice") is None
+        # deterministic: equal states serialize to equal column bytes
+        msg2 = cls()
+        msg2.counts["db"] = 9
+        msg2.counts["api"] = 3
+        assert message_to_columns(msg2)["counts"] == cols["counts"]
 
     def test_roundtrip_real_messages_through_codec(self):
         pool, fds_bytes = _build_pool()
@@ -167,3 +218,70 @@ class TestDescriptorSchema:
         pts = decode_series(streams[0])
         desc = descriptor_from_annotation(pts[0].annotation)
         assert desc.full_name == "m3test.VehicleUpdate"
+
+
+class TestProto3OptionalAndMessageMaps:
+    def test_proto3_optional_keeps_native_column(self):
+        """Synthetic single-field oneofs (proto3 `optional`) must not
+        become opaque blobs — the scalar rides its native column."""
+        from google.protobuf import descriptor_pb2, descriptor_pool
+
+        f = descriptor_pb2.FileDescriptorProto()
+        f.name = "opt.proto"
+        f.package = "m3opt"
+        f.syntax = "proto3"
+        m = f.message_type.add()
+        m.name = "M"
+        FD = descriptor_pb2.FieldDescriptorProto
+        fd = m.field.add()
+        fd.name, fd.number, fd.type = "maybe", 1, FD.TYPE_INT64
+        fd.label = FD.LABEL_OPTIONAL
+        fd.proto3_optional = True
+        oo = m.oneof_decl.add()
+        oo.name = "_maybe"
+        fd.oneof_index = 0
+        pool = descriptor_pool.DescriptorPool()
+        pool.Add(f)
+        desc = pool.FindMessageTypeByName("m3opt.M")
+        schema = schema_from_descriptor(desc)
+        assert schema.fields == (("maybe", FieldKind.INT),)
+        cls = message_class_for(desc)
+        msg = cls()
+        msg.maybe = 42
+        out = columns_to_message(cls(), message_to_columns(msg))
+        assert out.maybe == 42
+
+    def test_message_valued_map_roundtrips(self):
+        from google.protobuf import descriptor_pb2, descriptor_pool
+
+        f = descriptor_pb2.FileDescriptorProto()
+        f.name = "mm.proto"
+        f.package = "m3mm"
+        f.syntax = "proto3"
+        sub = f.message_type.add()
+        sub.name = "Sub"
+        FD = descriptor_pb2.FieldDescriptorProto
+        sf = sub.field.add()
+        sf.name, sf.number, sf.type, sf.label = "x", 1, FD.TYPE_INT64, FD.LABEL_OPTIONAL
+        m = f.message_type.add()
+        m.name = "M"
+        entry = m.nested_type.add()
+        entry.name = "DEntry"
+        entry.options.map_entry = True
+        k = entry.field.add()
+        k.name, k.number, k.type, k.label = "key", 1, FD.TYPE_STRING, FD.LABEL_OPTIONAL
+        v = entry.field.add()
+        v.name, v.number, v.type, v.label = "value", 2, FD.TYPE_MESSAGE, FD.LABEL_OPTIONAL
+        v.type_name = ".m3mm.Sub"
+        fd = m.field.add()
+        fd.name, fd.number, fd.type, fd.label = "d", 1, FD.TYPE_MESSAGE, FD.LABEL_REPEATED
+        fd.type_name = ".m3mm.M.DEntry"
+        pool = descriptor_pool.DescriptorPool()
+        pool.Add(f)
+        desc = pool.FindMessageTypeByName("m3mm.M")
+        cls = message_class_for(desc)
+        msg = cls()
+        msg.d["a"].x = 7
+        msg.d["b"].x = -3
+        out = columns_to_message(cls(), message_to_columns(msg))
+        assert out.d["a"].x == 7 and out.d["b"].x == -3
