@@ -409,17 +409,17 @@ class IntSigBitsTracker:
 class Encoder:
     """M3TSZ stream encoder (encoder.go:42-250).
 
-    ``auto_unit=True`` derives each datapoint's time unit from the
-    encoder state instead of trusting ``dp.unit``: keep the current
-    stream unit while it divides the delta-of-delta exactly, otherwise
-    switch (with a marker) to the coarsest unit that does.  This is the
-    faithful mapping of the reference's per-write unit metadata onto an
-    API whose timestamps are raw int64 nanos — a sub-unit timestamp can
-    NEVER be silently rounded (the round-4 flush-precision bug), and
-    aligned streams stay byte-identical to the fixed-unit form."""
+    Datapoints with ``unit=None`` derive their time unit from the
+    encoder state: keep the current stream unit while it divides the
+    delta-of-delta exactly, otherwise switch (with a marker) to the
+    coarsest unit that does.  This is the faithful mapping of the
+    reference's per-write unit metadata onto an API whose timestamps
+    are raw int64 nanos — a sub-unit timestamp can NEVER be silently
+    rounded (the round-4 flush-precision bug), and aligned streams stay
+    byte-identical to the fixed-unit form."""
 
     def __init__(self, start: int, int_optimized: bool = True,
-                 unit: Unit = Unit.SECOND, auto_unit: bool = False):
+                 unit: Unit = Unit.SECOND):
         self.os = OStream()
         self.ts = TimestampEncoder.new(start, unit)
         self.float_enc = FloatXOR()
@@ -429,11 +429,10 @@ class Encoder:
         self.max_mult = 0
         self.int_optimized = int_optimized
         self.is_float = False
-        self.auto_unit = auto_unit
 
     def encode(self, dp: Datapoint) -> None:
         unit = dp.unit
-        if unit is None or self.auto_unit:
+        if unit is None:  # derive exactness-preserving unit from state
             unit = self.ts.auto_unit_for(dp.timestamp)
         self.ts.write_time(self.os, dp.timestamp, dp.annotation, unit)
         if self.num_encoded == 0:
@@ -735,20 +734,6 @@ class ReaderIterator:
             value = convert_from_int_float(self.int_val, self.mult)
         self.curr = Datapoint(self.prev_time, value, self.time_unit, self.cur_annotation)
         return self.curr
-
-
-def unit_for_timestamp(t_nanos: int) -> Unit:
-    """Coarsest unit that represents ``t_nanos`` exactly — the role of
-    the reference's per-write time-unit metadata (xtime.Unit on every
-    write; `timestamp_encoder.go:205-246` switches units via markers so
-    a finer-grained timestamp is never rounded)."""
-    if t_nanos % 1_000_000_000 == 0:
-        return Unit.SECOND
-    if t_nanos % 1_000_000 == 0:
-        return Unit.MILLISECOND
-    if t_nanos % 1_000 == 0:
-        return Unit.MICROSECOND
-    return Unit.NANOSECOND
 
 
 def encode_series(datapoints, start: int | None = None,

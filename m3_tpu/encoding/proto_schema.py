@@ -47,6 +47,14 @@ def _real_oneofs(desc):
                     and o.name == "_" + o.fields[0].name)]
 
 
+def _optional_fields(desc) -> set:
+    """Field names with proto3 `optional` presence (synthetic oneofs):
+    they ride their native column PLUS a `<name>@set` bool column so
+    unset-vs-explicit-default survives the round trip."""
+    return {o.fields[0].name for o in desc.oneofs
+            if len(o.fields) == 1 and o.name == "_" + o.fields[0].name}
+
+
 def _kind_for(field) -> FieldKind:
     from google.protobuf import descriptor as _d
 
@@ -85,6 +93,7 @@ def schema_from_descriptor(desc, prefix: str = "",
         # one opaque column per oneof group: only the SET branch
         # serializes, so which-branch state survives the round trip
         fields.append((prefix + "__oneof__." + o.name, FieldKind.BYTES))
+    optionals = _optional_fields(desc)
     for field in desc.fields:
         if field.name in oneofs:
             continue
@@ -97,6 +106,8 @@ def schema_from_descriptor(desc, prefix: str = "",
             fields.extend(sub.fields)
         else:
             fields.append((name, _kind_for(field)))
+            if field.name in optionals:
+                fields.append((name + "@set", FieldKind.BOOL))
     return Schema(tuple(fields))
 
 
@@ -143,6 +154,7 @@ def message_to_columns(msg) -> dict:
             out[prefix + "__oneof__." + o.name] = (
                 b"" if set_field is None
                 else _field_wire_bytes(m, m.DESCRIPTOR.fields_by_name[set_field]))
+        optionals = _optional_fields(m.DESCRIPTOR)
         for field in m.DESCRIPTOR.fields:
             if field.name in oneofs:
                 continue
@@ -159,6 +171,8 @@ def message_to_columns(msg) -> dict:
                                     _d.FieldDescriptor.TYPE_DOUBLE):
                     v = float(v)
                 out[name] = v
+                if field.name in optionals:
+                    out[name + "@set"] = m.HasField(field.name)
 
     walk(msg, "")
     return out
@@ -176,6 +190,7 @@ def columns_to_message(msg, columns: dict):
             blob = columns.get(prefix + "__oneof__." + o.name)
             if blob:
                 m.MergeFromString(blob)
+        optionals = _optional_fields(m.DESCRIPTOR)
         for field in m.DESCRIPTOR.fields:
             if field.name in oneofs:
                 continue
@@ -188,6 +203,8 @@ def columns_to_message(msg, columns: dict):
             if field.type == _d.FieldDescriptor.TYPE_MESSAGE:
                 walk(getattr(m, field.name), name + ".")
                 continue
+            if field.name in optionals and not columns.get(name + "@set"):
+                continue  # unset `optional` stays unset
             v = columns.get(name)
             if v is None:
                 continue

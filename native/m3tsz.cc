@@ -131,34 +131,58 @@ struct IStream {
   bool can(int n) const { return bitpos + n <= nbits; }
 };
 
-// Word-at-a-time bit reader for the batched path: one unaligned 9-byte
-// load per peek instead of IStream's byte loop.  Requires the caller to
-// guarantee >= 16 readable bytes past the stream end (the batch entry
-// points document this; the ctypes binding pads the concatenated buffer).
-struct FastIStream {
+// Buffered bit reader for the batched path: maintains a 64-bit window
+// of upcoming bits so a field read is usually two shifts, with ONE
+// unaligned 8-byte refill per ~56 consumed bits (vs IStream's byte
+// loop per field).  Requires >= 16 readable bytes past the stream end
+// (refill loads 8 bytes at the current byte position, which can sit at
+// the last stream byte; the ctypes binding pads the batch buffer).
+struct BufferedIStream {
   static_assert(__BYTE_ORDER__ == __ORDER_LITTLE_ENDIAN__,
-                "FastIStream's load+bswap word reads assume a little-endian "
-                "host; use IStream on big-endian builds");
+                "load+bswap word reads assume a little-endian host");
   const uint8_t* data;
   int64_t nbits;
   int64_t bitpos = 0;
   bool eof = false;
+  uint64_t buf = 0;   // upcoming bits, left-aligned (MSB first)
+  int avail = 0;      // valid bits in buf
 
-  uint64_t peek(int n) {
+  inline void refill() {
+    // reload the full window at the current position: byte-aligned load
+    // of 8 bytes starting at bitpos>>3, discard the sub-byte offset
     int64_t byte = bitpos >> 3;
     int off = (int)(bitpos & 7);
-    uint64_t hi;
-    std::memcpy(&hi, data + byte, 8);
-    hi = __builtin_bswap64(hi);
-    unsigned __int128 w = ((unsigned __int128)hi << 8) | data[byte + 8];
-    uint64_t out = (uint64_t)(w >> (72 - off - n));
-    if (n < 64) out &= (1ULL << n) - 1;
-    return out;
+    uint64_t w;
+    std::memcpy(&w, data + byte, 8);
+    w = __builtin_bswap64(w);
+    buf = w << off;
+    avail = 64 - off;
+  }
+
+  uint64_t peek(int n) {  // n <= 56: refill guarantees >= 57 bits
+    if (n > avail) refill();
+    return buf >> (64 - n);
   }
   uint64_t read(int n) {
     if (n == 0) return 0;
     if (bitpos + n > nbits) { eof = true; return 0; }
-    uint64_t v = peek(n);
+    if (n > 56) {
+      // A refill at byte offset 7 yields only 57 valid bits, so wide
+      // reads (57..64, e.g. full XOR windows and 64-bit dods) split
+      // into two halves of <= 32 bits each; also dodges the n==64
+      // shift UB.
+      int half = n / 2;
+      uint64_t hi = read_small(half);
+      uint64_t lo = read_small(n - half);
+      return (hi << (n - half)) | lo;
+    }
+    return read_small(n);
+  }
+  inline uint64_t read_small(int n) {  // n in [1, 56]
+    if (n > avail) refill();
+    uint64_t v = buf >> (64 - n);
+    buf <<= n;
+    avail -= n;
     bitpos += n;
     return v;
   }
@@ -652,8 +676,8 @@ extern "C" long m3tsz_decode_trace(const uint8_t* data, long nbytes,
 
 // Batched decode: B streams concatenated in `data` at
 // [offsets[i], offsets[i+1]) byte ranges.  The buffer MUST stay readable
-// for >= 16 bytes past offsets[B] (FastIStream loads 9 bytes at a time);
-// the Python binding pads.  Series i's datapoints land in
+// for >= 16 bytes past offsets[B] (BufferedIStream refills with 8-byte
+// loads at arbitrary byte positions); the Python binding pads.  Series i's datapoints land in
 // out_ts/out_vals[i*max_points ...]; counts[i] gets the datapoint count
 // or the negative status (-1 cap, -2 unsupported, -3 corrupt).  Returns
 // the number of series with negative status.  `nthreads` <= 1 runs
@@ -665,7 +689,7 @@ extern "C" long m3tsz_decode_batch(const uint8_t* data, const int64_t* offsets,
                                    int64_t* counts, int nthreads) {
   parallel_for(B, nthreads, [=](long lo, long hi) {
     for (long i = lo; i < hi; i++) {
-      counts[i] = decode_impl<FastIStream>(
+      counts[i] = decode_impl<BufferedIStream>(
           data + offsets[i], offsets[i + 1] - offsets[i], default_unit,
           out_ts + i * max_points, out_vals + i * max_points, nullptr,
           nullptr, nullptr, nullptr, max_points);

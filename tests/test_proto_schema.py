@@ -244,12 +244,20 @@ class TestProto3OptionalAndMessageMaps:
         pool.Add(f)
         desc = pool.FindMessageTypeByName("m3opt.M")
         schema = schema_from_descriptor(desc)
-        assert schema.fields == (("maybe", FieldKind.INT),)
+        assert schema.fields == (("maybe", FieldKind.INT),
+                                 ("maybe@set", FieldKind.BOOL))
         cls = message_class_for(desc)
         msg = cls()
         msg.maybe = 42
         out = columns_to_message(cls(), message_to_columns(msg))
-        assert out.maybe == 42
+        assert out.maybe == 42 and out.HasField("maybe")
+        # explicit default is SET; untouched is UNSET - presence survives
+        z = cls()
+        z.maybe = 0
+        rz = columns_to_message(cls(), message_to_columns(z))
+        assert rz.HasField("maybe") and rz.maybe == 0
+        ru = columns_to_message(cls(), message_to_columns(cls()))
+        assert not ru.HasField("maybe")
 
     def test_message_valued_map_roundtrips(self):
         from google.protobuf import descriptor_pb2, descriptor_pool
