@@ -21,6 +21,7 @@ from m3_tpu.query import functions as fn
 from m3_tpu.query import temporal as tp
 from m3_tpu.query.block import Block, RawBlock, SeriesMeta
 from m3_tpu.query.promql import (
+    Subquery,
     Aggregation, BinaryOp, Call, Expr, LabelMatcher, NumberLiteral,
     StringLiteral, Unary, VectorSelector, parse,
 )
@@ -35,7 +36,8 @@ _TEMPORAL_REG = {"deriv", "predict_linear"}
 _TEMPORAL_TRANS = {"resets", "changes"}
 _TEMPORAL_ALL = (_TEMPORAL_SUM | _TEMPORAL_MINMAXQ | _TEMPORAL_RATE
                  | _TEMPORAL_REG | _TEMPORAL_TRANS
-                 | {"last_over_time", "present_over_time", "holt_winters"})
+                 | {"last_over_time", "present_over_time",
+                    "absent_over_time", "holt_winters"})
 
 
 class Storage(Protocol):
@@ -127,6 +129,42 @@ class Engine:
         eval_steps = steps - sel.offset_nanos
         return raw, eval_steps
 
+    def _eval_subquery(self, sub: Subquery, steps: np.ndarray):
+        """Evaluate ``expr[range:step]``: run the inner INSTANT
+        expression on the subquery's absolute-aligned step grid, then
+        hand the samples to the temporal kernels exactly like fetched
+        raw datapoints (Prometheus subquery semantics: inner steps are
+        aligned to multiples of the subquery step; NaN results are
+        stale and yield no sample)."""
+        step = sub.step_nanos
+        if step == 0:
+            # Prometheus uses the global evaluation interval as the
+            # default resolution; the closest engine-native analogue is
+            # the outer query's step, falling back to 60s for
+            # single-step (instant) evaluations.
+            step = (int(steps[1] - steps[0]) if len(steps) > 1
+                    else 60 * 10**9)
+        end = int(steps[-1]) - sub.offset_nanos
+        start = int(steps[0]) - sub.range_nanos - sub.offset_nanos
+        first = -(-start // step) * step  # absolute alignment (ceil)
+        inner = np.arange(first, end + 1, step, dtype=np.int64)
+        if len(inner) == 0:
+            inner = np.asarray([end], np.int64)
+        b = self._eval(sub.expr, inner)
+        if isinstance(b, _Scalar):
+            # scalar-valued inner exprs (time(), literals) broadcast to
+            # one anonymous series over the inner grid
+            vals = np.broadcast_to(
+                np.asarray(b.value, np.float64), (len(inner),))
+            b = Block(inner, vals[None, :].copy(), [SeriesMeta(())])
+        pts = [
+            [(int(t), float(v)) for t, v in zip(inner, row)
+             if not math.isnan(v)]
+            for row in b.values
+        ]
+        raw = RawBlock.from_lists(pts, b.series)
+        return raw, steps - sub.offset_nanos
+
     def _eval_instant_selector(self, sel: VectorSelector, steps: np.ndarray) -> Block:
         raw, eval_steps = self._fetch(sel, steps, self.lookback)
         vals = np.asarray(
@@ -149,9 +187,15 @@ class Engine:
                 extra = self._scalar_arg(call.args[1], steps)
             elif f == "holt_winters":
                 sel_arg = call.args[0]
-            if not isinstance(sel_arg, VectorSelector) or sel_arg.range_nanos == 0:
-                raise ValueError(f"{f} requires a range selector")
-            raw, eval_steps = self._fetch(sel_arg, steps, sel_arg.range_nanos)
+            if isinstance(sel_arg, Subquery):
+                raw, eval_steps = self._eval_subquery(sel_arg, steps)
+            elif (not isinstance(sel_arg, VectorSelector)
+                    or sel_arg.range_nanos == 0):
+                raise ValueError(
+                    f"{f} requires a range selector or subquery")
+            else:
+                raw, eval_steps = self._fetch(sel_arg, steps,
+                                              sel_arg.range_nanos)
             ts_j = jnp.asarray(raw.ts)
             vals_j = jnp.asarray(np.nan_to_num(raw.values))
             st_j = jnp.asarray(eval_steps)
@@ -180,6 +224,18 @@ class Engine:
                                       sfv, tfv)
             elif f == "last_over_time":
                 out = tp.last_over_time(ts_j, vals_j, st_j, rng)
+            elif f == "absent_over_time":
+                # 1 for every step where NO matched series has samples
+                # in the window; when nothing matched at all, a single
+                # empty-labelled series of 1s (Prometheus semantics).
+                if len(raw.series) == 0:
+                    return Block(steps, np.ones((1, len(steps))),
+                                 [SeriesMeta(())])
+                cnt = np.asarray(tp.sum_count_family(
+                    ts_j, vals_j, st_j, rng, "count_over_time"))
+                any_present = (~np.isnan(cnt) & (cnt > 0)).any(axis=0)
+                vals_out = np.where(any_present, np.nan, 1.0)[None, :]
+                return Block(steps, vals_out, [SeriesMeta(())])
             else:  # present_over_time
                 out = tp.sum_count_family(ts_j, vals_j, st_j, rng, "count_over_time")
                 out = jnp.where(jnp.isnan(out), out, jnp.minimum(out, 1.0))

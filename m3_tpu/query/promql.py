@@ -55,6 +55,18 @@ class VectorSelector(Expr):
 
 
 @dataclass(frozen=True)
+class Subquery(Expr):
+    """``<expr>[range:step]`` (Prometheus subqueries): evaluate the
+    inner INSTANT expression on a step grid, then window it like a
+    range vector.  step 0 = the engine's default resolution."""
+
+    expr: Expr
+    range_nanos: int
+    step_nanos: int = 0
+    offset_nanos: int = 0
+
+
+@dataclass(frozen=True)
 class Call(Expr):
     func: str
     args: tuple[Expr, ...]
@@ -263,16 +275,29 @@ class _Parser:
                 self.next()
                 dur = self.next()
                 rng = parse_duration(dur.text)
+                if self.accept(":"):
+                    # subquery: [range:step] or [range:] (default step)
+                    sub_step = 0
+                    if self.peek().text != "]":
+                        sub_step = parse_duration(self.next().text)
+                    self.expect("]")
+                    e = Subquery(e, rng, sub_step)
+                    continue
                 self.expect("]")
                 if not isinstance(e, VectorSelector):
-                    raise ValueError("range selector on non-selector")
+                    raise ValueError(
+                        "range selector on non-selector (use [range:step] "
+                        "for a subquery)")
                 e = VectorSelector(e.name, e.matchers, rng, e.offset_nanos)
             elif self.peek().text == "offset":
                 self.next()
                 off = parse_duration(self.next().text)
-                if not isinstance(e, VectorSelector):
+                if isinstance(e, Subquery):
+                    e = Subquery(e.expr, e.range_nanos, e.step_nanos, off)
+                elif isinstance(e, VectorSelector):
+                    e = VectorSelector(e.name, e.matchers, e.range_nanos, off)
+                else:
                     raise ValueError("offset on non-selector")
-                e = VectorSelector(e.name, e.matchers, e.range_nanos, off)
             else:
                 return e
 

@@ -231,3 +231,57 @@ class TestRound4Functions:
         fd = d.values[:, -1]
         assert np.all(np.diff(fa) >= 0)
         assert np.all(np.diff(fd) <= 0)
+
+
+class TestSubqueries:
+    def test_subquery_parses(self):
+        from m3_tpu.query.promql import Subquery, parse
+
+        e = parse("max_over_time(rate(x[5m])[30m:1m])")
+        sq = e.args[0]
+        assert isinstance(sq, Subquery)
+        assert sq.range_nanos == 30 * 60 * 10**9
+        assert sq.step_nanos == 60 * 10**9
+        # default-step + offset forms
+        e2 = parse("avg_over_time(y[1h:] offset 5m)").args[0]
+        assert e2.step_nanos == 0 and e2.offset_nanos == 300 * 10**9
+
+    def test_max_over_time_of_rate_subquery(self, engine):
+        """The canonical subquery: max of a rate over a longer window
+        must be >= the instantaneous rate at every step and finite for
+        a steadily increasing counter."""
+        inner = engine.execute_range(
+            'rate(http_requests_total{host="h0", job="api"}[5m])',
+            QSTART, QEND, STEP)
+        outer = engine.execute_range(
+            'max_over_time(rate(http_requests_total{host="h0", job="api"}[5m])[10m:1m])',
+            QSTART, QEND, STEP)
+        assert outer.num_series == 1
+        ok = ~(np.isnan(outer.values[0]) | np.isnan(inner.values[0]))
+        assert ok.any()
+        assert np.all(outer.values[0][ok] >= inner.values[0][ok] - 1e-9)
+
+    def test_avg_over_time_subquery_of_instant_vector(self, engine):
+        b = engine.execute_range(
+            'avg_over_time(http_requests_total{host="h0", job="api"}[10m:1m])',
+            QSTART, QEND, STEP)
+        assert b.num_series == 1
+        assert np.isfinite(b.values[0, -1])
+
+    def test_absent_over_time(self, engine):
+        gone = engine.execute_range(
+            'absent_over_time(no_such_metric[5m])', QSTART, QEND, STEP)
+        assert gone.num_series == 1
+        assert np.all(gone.values == 1.0)
+        there = engine.execute_range(
+            'absent_over_time(http_requests_total{job="api"}[5m])',
+            QSTART, QEND, STEP)
+        assert np.all(np.isnan(there.values))
+
+    def test_subquery_over_scalar_expr(self, engine):
+        b = engine.execute_range('min_over_time(time()[10m:1m])',
+                                 QSTART, QEND, STEP)
+        assert b.num_series == 1
+        # min over the trailing 10m grid of time() <= current time
+        assert np.all(b.values[0] <= QEND / 1e9 + 1)
+        assert np.isfinite(b.values[0, -1])
