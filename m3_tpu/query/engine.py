@@ -11,6 +11,7 @@ every interior node is a whole-block array op (`temporal.py`,
 from __future__ import annotations
 
 import math
+import threading
 from dataclasses import dataclass
 from typing import Protocol
 
@@ -63,6 +64,11 @@ class Engine:
         self.storage = storage
         self.lookback = lookback_nanos
         self.tracer = tracer if tracer is not None else NOOP_TRACER
+        # Per-query (start, end) for @ start()/end() resolution: they
+        # ALWAYS refer to the top-level query parameters (Prometheus),
+        # never an inner subquery grid.  Thread-local because one
+        # engine serves concurrent HTTP requests.
+        self._query_bounds = threading.local()
 
     # -- public API --------------------------------------------------------
 
@@ -81,7 +87,11 @@ class Engine:
                        step_nanos: int) -> Block:
         ast = parse(query)
         steps = np.arange(start_nanos, end_nanos + 1, step_nanos, dtype=np.int64)
-        out = self._eval(ast, steps)
+        self._query_bounds.range = (start_nanos, end_nanos)
+        try:
+            out = self._eval(ast, steps)
+        finally:
+            del self._query_bounds.range
         if isinstance(out, _Scalar):
             vals = np.broadcast_to(
                 np.asarray(out.value, np.float64), (1, len(steps))
@@ -118,15 +128,32 @@ class Engine:
             return self._eval_binary(e, steps)
         raise ValueError(f"cannot evaluate {e}")
 
+    def _resolve_at(self, node, steps: np.ndarray) -> int | None:
+        """The @ modifier's fixed evaluation time, or None.  start()/
+        end() resolve to the TOP-LEVEL query range parameters — even
+        inside a subquery, whose inner grid is wider and step-aligned —
+        and to the true end timestamp even when the range is not a
+        step multiple (Prometheus @ semantics)."""
+        if node.at_edge in ("start", "end"):
+            bounds = getattr(self._query_bounds, "range",
+                             (int(steps[0]), int(steps[-1])))
+            return bounds[0] if node.at_edge == "start" else bounds[1]
+        return node.at_nanos
+
     def _fetch(self, sel: VectorSelector, steps: np.ndarray, range_nanos: int):
-        start = int(steps[0]) - range_nanos - sel.offset_nanos
+        at = self._resolve_at(sel, steps)
+        if at is not None:
+            eval_steps = np.full(len(steps), at - sel.offset_nanos,
+                                 np.int64)
+        else:
+            eval_steps = steps - sel.offset_nanos
+        start = int(eval_steps[0]) - range_nanos
         # +1: storage reads are end-EXCLUSIVE, but a sample exactly at
         # the final evaluation step belongs to it (Prometheus windows
         # are (t-range, t] — found by the comparator harness, which
         # caught the last step evaluating with the previous sample).
-        end = int(steps[-1]) - sel.offset_nanos + 1
+        end = int(eval_steps[-1]) + 1
         raw = self.storage.fetch_raw(sel.name, sel.matchers, start, end)
-        eval_steps = steps - sel.offset_nanos
         return raw, eval_steps
 
     def _eval_subquery(self, sub: Subquery, steps: np.ndarray):
@@ -141,9 +168,13 @@ class Engine:
             # Prometheus uses the global evaluation interval as the
             # default resolution; the closest engine-native analogue is
             # the outer query's step, falling back to 60s for
-            # single-step (instant) evaluations.
+            # single-step (instant) evaluations.  (Resolved BEFORE any
+            # @ pinning collapses the grid to a constant.)
             step = (int(steps[1] - steps[0]) if len(steps) > 1
                     else 60 * 10**9)
+        at = self._resolve_at(sub, steps)
+        if at is not None:
+            steps = np.full(len(steps), at, np.int64)
         end = int(steps[-1]) - sub.offset_nanos
         start = int(steps[0]) - sub.range_nanos - sub.offset_nanos
         first = -(-start // step) * step  # absolute alignment (ceil)

@@ -16,6 +16,7 @@ from-scratch parser for the supported subset:
 from __future__ import annotations
 
 import re
+import dataclasses
 from dataclasses import dataclass, field
 
 
@@ -52,6 +53,11 @@ class VectorSelector(Expr):
     matchers: tuple[LabelMatcher, ...] = ()
     range_nanos: int = 0  # 0 = instant
     offset_nanos: int = 0
+    # @ modifier: pin evaluation to a fixed time.  at_nanos holds the
+    # literal timestamp; at_edge "start"/"end" resolves to the query
+    # range boundary at evaluation (Prometheus start()/end()).
+    at_nanos: int | None = None
+    at_edge: str = ""
 
 
 @dataclass(frozen=True)
@@ -64,6 +70,8 @@ class Subquery(Expr):
     range_nanos: int
     step_nanos: int = 0
     offset_nanos: int = 0
+    at_nanos: int | None = None
+    at_edge: str = ""
 
 
 @dataclass(frozen=True)
@@ -110,7 +118,7 @@ _TOKEN_RE = re.compile(
       | (?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?
       | [iI][nN][fF] | [nN][aA][nN])
   | (?P<string>"(?:\\.|[^"\\])*"|'(?:\\.|[^'\\])*')
-  | (?P<op>=~|!~|==|!=|>=|<=|<|>|=|\+|-|\*|/|%|\^|\(|\)|\{|\}|\[|\]|,|:)
+  | (?P<op>=~|!~|==|!=|>=|<=|<|>|=|\+|-|\*|/|%|\^|\(|\)|\{|\}|\[|\]|,|:|@)
   | (?P<ident>[a-zA-Z_:][a-zA-Z0-9_:.]*)
     """,
     re.VERBOSE,
@@ -288,16 +296,31 @@ class _Parser:
                     raise ValueError(
                         "range selector on non-selector (use [range:step] "
                         "for a subquery)")
-                e = VectorSelector(e.name, e.matchers, rng, e.offset_nanos)
+                e = dataclasses.replace(e, range_nanos=rng)
             elif self.peek().text == "offset":
                 self.next()
                 off = parse_duration(self.next().text)
-                if isinstance(e, Subquery):
-                    e = Subquery(e.expr, e.range_nanos, e.step_nanos, off)
-                elif isinstance(e, VectorSelector):
-                    e = VectorSelector(e.name, e.matchers, e.range_nanos, off)
-                else:
+                if not isinstance(e, (Subquery, VectorSelector)):
                     raise ValueError("offset on non-selector")
+                e = dataclasses.replace(e, offset_nanos=off)
+            elif self.peek().text == "@":
+                self.next()
+                at_nanos: int | None = None
+                edge = ""
+                t = self.next()
+                if t.text in ("start", "end"):
+                    self.expect("(")
+                    self.expect(")")
+                    edge = t.text
+                else:
+                    # unix seconds, possibly fractional or signed
+                    txt = t.text
+                    if txt == "-":
+                        txt += self.next().text
+                    at_nanos = int(float(txt) * 1e9)
+                if not isinstance(e, (Subquery, VectorSelector)):
+                    raise ValueError("@ modifier on non-selector")
+                e = dataclasses.replace(e, at_nanos=at_nanos, at_edge=edge)
             else:
                 return e
 

@@ -285,3 +285,43 @@ class TestSubqueries:
         # min over the trailing 10m grid of time() <= current time
         assert np.all(b.values[0] <= QEND / 1e9 + 1)
         assert np.isfinite(b.values[0, -1])
+
+
+class TestAtModifier:
+    def test_at_end_pins_instant_vector(self, engine):
+        pinned = engine.execute_range(
+            'http_requests_total{host="h0", job="api"} @ end()',
+            QSTART, QEND, STEP)
+        plain = engine.execute_range(
+            'http_requests_total{host="h0", job="api"}',
+            QSTART, QEND, STEP)
+        assert pinned.num_series == 1
+        # constant across steps, equal to the un-pinned final value
+        assert np.all(pinned.values[0] == pinned.values[0, -1])
+        assert pinned.values[0, -1] == plain.values[0, -1]
+
+    def test_at_literal_timestamp_on_range_vector(self, engine):
+        at_s = (QSTART + 6 * 60 * 10**9) / 1e9
+        pinned = engine.execute_range(
+            f'rate(http_requests_total{{host="h0", job="api"}}[5m] @ {at_s:.0f})',
+            QSTART, QEND, STEP)
+        assert np.all(pinned.values[0] == pinned.values[0, 0])
+        assert np.isfinite(pinned.values[0, 0])
+
+    def test_at_start_on_subquery(self, engine):
+        b = engine.execute_range(
+            'avg_over_time(http_requests_total{host="h0", job="api"}[10m:1m] @ start())',
+            QSTART, QEND, STEP)
+        assert np.all(b.values[0] == b.values[0, 0])
+
+    def test_at_inside_subquery_resolves_top_level_bounds(self, engine):
+        """Prometheus: start()/end() always mean the TOP-LEVEL query
+        range, even inside a subquery whose inner grid is wider."""
+        direct = engine.execute_range(
+            'http_requests_total{host="h0", job="api"} @ start()',
+            QSTART, QEND, STEP)
+        sub = engine.execute_range(
+            'last_over_time((http_requests_total{host="h0", job="api"}'
+            ' @ start())[10m:1m])',
+            QSTART, QEND, STEP)
+        assert sub.values[0, -1] == direct.values[0, 0]
