@@ -143,8 +143,9 @@ class Engine:
     def _fetch(self, sel: VectorSelector, steps: np.ndarray, range_nanos: int):
         at = self._resolve_at(sel, steps)
         if at is not None:
-            eval_steps = np.full(len(steps), at - sel.offset_nanos,
-                                 np.int64)
+            # pinned evaluation computes ONE column; callers broadcast
+            # the constant result across the output steps
+            eval_steps = np.asarray([at - sel.offset_nanos], np.int64)
         else:
             eval_steps = steps - sel.offset_nanos
         start = int(eval_steps[0]) - range_nanos
@@ -174,7 +175,7 @@ class Engine:
                     else 60 * 10**9)
         at = self._resolve_at(sub, steps)
         if at is not None:
-            steps = np.full(len(steps), at, np.int64)
+            steps = np.asarray([at], np.int64)  # single pinned column
         end = int(steps[-1]) - sub.offset_nanos
         start = int(steps[0]) - sub.range_nanos - sub.offset_nanos
         first = -(-start // step) * step  # absolute alignment (ceil)
@@ -202,6 +203,8 @@ class Engine:
             tp.last_over_time(jnp.asarray(raw.ts), jnp.asarray(raw.values),
                               jnp.asarray(eval_steps), self.lookback)
         )
+        if vals.shape[1] != len(steps):  # @-pinned single column
+            vals = np.broadcast_to(vals, (vals.shape[0], len(steps))).copy()
         return Block(steps, vals, raw.series)
 
     def _eval_call(self, call: Call, steps: np.ndarray):
@@ -266,12 +269,19 @@ class Engine:
                     ts_j, vals_j, st_j, rng, "count_over_time"))
                 any_present = (~np.isnan(cnt) & (cnt > 0)).any(axis=0)
                 vals_out = np.where(any_present, np.nan, 1.0)[None, :]
+                if vals_out.shape[1] != len(steps):  # @-pinned
+                    vals_out = np.broadcast_to(
+                        vals_out, (1, len(steps))).copy()
                 return Block(steps, vals_out, [SeriesMeta(())])
             else:  # present_over_time
                 out = tp.sum_count_family(ts_j, vals_j, st_j, rng, "count_over_time")
                 out = jnp.where(jnp.isnan(out), out, jnp.minimum(out, 1.0))
             metas = [m.drop_name() for m in raw.series]
-            return Block(steps, np.asarray(out), metas)
+            out = np.asarray(out)
+            if out.ndim == 2 and out.shape[1] != len(steps):
+                # @-pinned: one computed column broadcast across steps
+                out = np.broadcast_to(out, (out.shape[0], len(steps)))
+            return Block(steps, out, metas)
 
         if f == "histogram_quantile":
             q = self._scalar_arg(call.args[0], steps)

@@ -313,11 +313,15 @@ class _Parser:
                     self.expect(")")
                     edge = t.text
                 else:
-                    # unix seconds, possibly fractional or signed
+                    # unix seconds, possibly fractional or signed.
+                    # Parsed at millisecond precision like Prometheus:
+                    # float seconds * 1e9 at epoch magnitude is ~200ns
+                    # off, enough to exclude a sample stored exactly at
+                    # the pinned time from its (t-range, t] window.
                     txt = t.text
                     if txt == "-":
                         txt += self.next().text
-                    at_nanos = int(float(txt) * 1e9)
+                    at_nanos = int(round(float(txt) * 1000)) * 10**6
                 if not isinstance(e, (Subquery, VectorSelector)):
                     raise ValueError("@ modifier on non-selector")
                 e = dataclasses.replace(e, at_nanos=at_nanos, at_edge=edge)

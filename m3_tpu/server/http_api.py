@@ -205,8 +205,11 @@ class _Handler(BaseHTTPRequestHandler):
             k + b"=" + v for k, v in sorted(tags.items()) if k != b"__name__"
         ) + b"}"
 
-    def _ingest_tagged(self, docs, ts, vals) -> int:
-        """Shared downsample-then-write tail of every write handler."""
+    def _ingest_tagged(self, docs, ts, vals) -> tuple[int, int]:
+        """Shared downsample-then-write tail of every write handler.
+        Returns (written, rejected): rejected = samples whose series
+        creation hit the new-series rate limit — the typed
+        back-pressure signal, surfaced so HTTP writers can back off."""
         ctx = self.ctx
         keep = np.ones(len(docs), bool)
         if ctx.downsampler is not None:
@@ -214,14 +217,16 @@ class _Handler(BaseHTTPRequestHandler):
                 docs, np.asarray(ts, np.int64), np.asarray(vals)
             )
         idx = np.nonzero(keep)[0]
+        rejected = 0
         if len(idx):
-            ctx.db.write_tagged_batch(
+            res = ctx.db.write_tagged_batch(
                 ctx.namespace,
                 [docs[i] for i in idx],
                 np.asarray(ts, np.int64)[idx],
                 np.asarray(vals)[idx],
             )
-        return int(len(idx))
+            rejected = getattr(res, "rejected", 0)
+        return int(len(idx)) - rejected, rejected
 
     def _prom_remote_write(self):
         """Prometheus remote write: snappy+protobuf WriteRequest
@@ -237,9 +242,14 @@ class _Handler(BaseHTTPRequestHandler):
                 docs.append(doc)
                 ts.append(t_nanos)
                 vals.append(v)
+        rejected = 0
         if docs:
-            self._ingest_tagged(docs, ts, vals)
-        self.send_response(204)  # Prometheus expects 2xx, no body needed
+            _, rejected = self._ingest_tagged(docs, ts, vals)
+        # Prometheus remote-write clients back off on 429 — the typed
+        # signal for new-series rate limiting; 2xx otherwise.
+        self.send_response(429 if rejected else 204)
+        if rejected:
+            self.send_header("X-Rejected", str(rejected))
         self.send_header("Content-Length", "0")
         self.end_headers()
         return None
@@ -293,8 +303,15 @@ class _Handler(BaseHTTPRequestHandler):
             t = s["timestamp"]
             ts.append(int(t * 1e9) if t < 1e12 else int(t))
             vals.append(float(s["value"]))
-        written = self._ingest_tagged(docs, ts, vals) if docs else 0
-        return self._json(200, {"status": "success", "written": written})
+        written, rejected = (self._ingest_tagged(docs, ts, vals)
+                             if docs else (0, 0))
+        body = {"status": "success", "written": written}
+        if rejected:
+            # partial acceptance: series churn hit the rate limit
+            body.update(status="partial", rejected=rejected,
+                        error="new-series rate limit exceeded")
+            return self._json(429, body)
+        return self._json(200, body)
 
     def _influx_write(self, q):
         """InfluxDB line-protocol write endpoint (reference
@@ -308,9 +325,12 @@ class _Handler(BaseHTTPRequestHandler):
         points = parse_lines(self._body().decode(), precision,
                              now_nanos=int(_time.time() * 1e9))
         docs, ts, vals = points_to_writes(points)
-        written = self._ingest_tagged(docs, ts, vals) if docs else 0
-        self.send_response(204)
+        written, rejected = (self._ingest_tagged(docs, ts, vals)
+                             if docs else (0, 0))
+        self.send_response(429 if rejected else 204)
         self.send_header("X-Written", str(written))
+        if rejected:
+            self.send_header("X-Rejected", str(rejected))
         self.send_header("Content-Length", "0")
         self.end_headers()
 

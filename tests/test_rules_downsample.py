@@ -710,3 +710,91 @@ class TestNewSeriesBackPressure:
             assert sh.slots.get(sid) is not None, sid
         assert len(sh.slots) == 30
         db2.close()
+
+    def test_http_writes_surface_rejections(self, tmp_path):
+        """The typed back-pressure signal crosses the HTTP APIs: JSON
+        write returns 429/partial with the rejected count."""
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        from m3_tpu.server.http_api import ApiContext, serve_background
+        from m3_tpu.storage.limits import NewSeriesLimiter
+
+        lim = NewSeriesLimiter(3, now=lambda: 1000.0)
+        db = Database(
+            DatabaseOptions(root=str(tmp_path), commitlog_enabled=False),
+            {"default": NamespaceOptions(num_shards=1, slot_capacity=1 << 10,
+                                         sample_capacity=1 << 12)},
+            new_series_limiter=lim,
+        )
+        srv = serve_background(ApiContext(db), "127.0.0.1", 0)
+        try:
+            port = srv.server_address[1]
+            samples = [{"tags": {"__name__": f"churn{i}"},
+                        "timestamp": START // 10**9 + 1, "value": 1.0}
+                       for i in range(10)]
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/v1/json/write",
+                data=_json.dumps(samples).encode(), method="POST")
+            try:
+                urllib.request.urlopen(req, timeout=30)
+                raise AssertionError("expected 429")
+            except urllib.error.HTTPError as e:
+                assert e.code == 429
+                body = _json.loads(e.read())
+                assert body["status"] == "partial"
+                assert body["written"] == 3 and body["rejected"] == 7
+        finally:
+            srv.shutdown()
+            db.close()
+
+    def test_remote_write_and_influx_backoff_with_429(self, tmp_path):
+        """Prometheus remote write and the Influx endpoint both return
+        429 (+X-Rejected) when series churn hits the rate limit."""
+        import urllib.error
+        import urllib.request
+
+        from m3_tpu.server.http_api import ApiContext, serve_background
+        from m3_tpu.server.prom_remote import PromTimeSeries, build_write_request
+        from m3_tpu.storage.limits import NewSeriesLimiter
+
+        lim = NewSeriesLimiter(2, now=lambda: 1000.0)
+        db = Database(
+            DatabaseOptions(root=str(tmp_path), commitlog_enabled=False),
+            {"default": NamespaceOptions(num_shards=1, slot_capacity=1 << 10,
+                                         sample_capacity=1 << 12)},
+            new_series_limiter=lim,
+        )
+        srv = serve_background(ApiContext(db), "127.0.0.1", 0)
+        try:
+            port = srv.server_address[1]
+            body = build_write_request([
+                PromTimeSeries({b"__name__": b"rw%d" % i},
+                               [(START + 10**9, 1.0)])
+                for i in range(6)
+            ])
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/v1/prom/remote/write",
+                data=body, method="POST")
+            try:
+                urllib.request.urlopen(req, timeout=30)
+                raise AssertionError("expected 429")
+            except urllib.error.HTTPError as e:
+                assert e.code == 429
+                assert int(e.headers["X-Rejected"]) == 4
+            # influx line protocol: limiter already drained
+            lines = "\n".join(
+                f"ifx{i},host=h value=1 {START + 2 * 10**9}"
+                for i in range(3)).encode()
+            req2 = urllib.request.Request(
+                f"http://127.0.0.1:{port}/write", data=lines, method="POST")
+            try:
+                urllib.request.urlopen(req2, timeout=30)
+                raise AssertionError("expected 429")
+            except urllib.error.HTTPError as e:
+                assert e.code == 429
+                assert int(e.headers["X-Rejected"]) == 3
+        finally:
+            srv.shutdown()
+            db.close()
