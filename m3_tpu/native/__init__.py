@@ -34,7 +34,10 @@ def _build() -> bool:
         subprocess.run(
             # -ffp-contract=off: FMA contraction would change the rounding
             # of the decoder's int_val accumulation vs strict IEEE.
-            ["g++", "-O2", "-ffp-contract=off", "-pthread", "-shared",
+            # -O3 measures ~5-10% faster than -O2 on the decode hot loop;
+            # -march=native measured SLOWER (worse layout for this
+            # branchy code) and would break portability of the .so.
+            ["g++", "-O3", "-ffp-contract=off", "-pthread", "-shared",
              "-fPIC", "-o", str(_SO), str(_SRC)],
             check=True, capture_output=True, timeout=120,
         )

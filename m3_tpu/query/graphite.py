@@ -1602,3 +1602,125 @@ class GraphiteEngine:
 
 def supported_functions() -> list[str]:
     return sorted(_FUNCS)
+
+
+# ---------------------------------------------------------------------------
+# Round-4 breadth: functions moved out of the out-of-scope set.
+# ---------------------------------------------------------------------------
+
+
+@_func("randomWalkFunction", "randomWalk")
+def _random_walk(ctx, name, step=60):
+    """Synthetic random-walk series over the render window (graphite-web
+    functions.py randomWalkFunction).  Seeded from the name so repeated
+    renders of one target are stable — a test-friendly divergence from
+    graphite's unseeded random.random()."""
+    import zlib
+
+    step_nanos = max(1, int(step)) * 10**9
+    n = max(1, int((ctx.end - ctx.start) // step_nanos))
+    rng = np.random.default_rng(zlib.crc32(str(name).encode()))
+    vals = np.cumsum(rng.random(n) - 0.5)
+    return [GraphiteSeries(str(name), str(name), vals, step_nanos, ctx.start)]
+
+
+@_func("timeSlice")
+def _time_slice(ctx, series, start_str, end_str="now"):
+    """Null out values outside [startSliceAt, endSliceAt] (graphite-web
+    timeSlice); the window parses with graphite's relative time syntax
+    against the render end (render() always sets it — no wall-clock
+    fallback, which would make epoch-0 test windows nondeterministic)."""
+    now = ctx.end
+    lo = parse_graphite_time(str(start_str), now)
+    hi = parse_graphite_time(str(end_str), now)
+    out = []
+    for s in series:
+        t = s.start_nanos + np.arange(len(s.values), dtype=np.int64) * s.step_nanos
+        v = np.where((t >= lo) & (t <= hi), s.values, NAN)
+        out.append(s.with_values(
+            v, f'timeSlice({s.name},"{start_str}","{end_str}")'))
+    return out
+
+
+def _fmt_legend(v: float) -> str:
+    return "None" if np.isnan(v) else f"{v:g}"
+
+
+# Per-series legend statistics (shared by cactiStyle/legendValue):
+# _nan_agg silences the all-NaN-slice warning; the NaN result is right.
+_LEGEND_FNS = {
+    "avg": _nan_agg(np.nanmean),
+    "average": _nan_agg(np.nanmean),
+    "min": _nan_agg(np.nanmin),
+    "max": _nan_agg(np.nanmax),
+    "last": lambda v: (v[~np.isnan(v)][-1] if (~np.isnan(v)).any()
+                       else np.nan),
+    "total": _nan_agg(np.nansum),
+}
+
+
+@_func("cactiStyle")
+def _cacti_style(ctx, series, system=None, units=None):
+    """Append Current/Max/Min to each alias (graphite-web cactiStyle;
+    the si-system scaling of the reference renderer is presentational
+    and out of scope — raw values render instead)."""
+    suffix_units = f" {units}" if units else ""
+    out = []
+    for s in series:
+        cur = _LEGEND_FNS["last"](s.values)
+        name = (f"{s.name} Current:{_fmt_legend(cur)}{suffix_units} "
+                f"Max:{_fmt_legend(_LEGEND_FNS['max'](s.values))}"
+                f"{suffix_units} "
+                f"Min:{_fmt_legend(_LEGEND_FNS['min'](s.values))}"
+                f"{suffix_units}")
+        out.append(s.with_values(s.values, name))
+    return out
+
+
+@_func("legendValue")
+def _legend_value(ctx, series, *value_types):
+    """Append requested statistics to each alias (graphite-web
+    legendValue).  A trailing "si"/"binary" system argument is accepted
+    (graphite-web uses it to pick unit formatting; values render
+    unscaled here)."""
+    value_types = list(value_types)
+    if value_types and str(value_types[-1]) in ("si", "binary"):
+        value_types.pop()  # formatting-system hint, not a value type
+    out = []
+    for s in series:
+        name = s.name
+        for vt in value_types:
+            fn_ = _LEGEND_FNS.get(str(vt))
+            if fn_ is None:
+                raise ParseError(f"legendValue: unknown value type {vt!r}")
+            name += f" ({vt}: {_fmt_legend(fn_(s.values))})"
+        out.append(s.with_values(s.values, name))
+    return out
+
+
+@_func("dashed")
+def _dashed(ctx, series, dash_length=5):
+    # A render-style hint: data passes through under the dashed() alias
+    # (the drawing itself belongs to a renderer this API does not have).
+    return [s.with_values(s.values, f"dashed({s.name},{dash_length})")
+            for s in series]
+
+
+@_func("useSeriesAbove")
+def _use_series_above(ctx, series, value, search, replace):
+    """For every series whose max exceeds ``value``, fetch the series
+    whose path substitutes search->replace (graphite-web useSeriesAbove
+    applies ``re.sub`` — regex patterns work; a series whose
+    substitution leaves the path unchanged is skipped rather than
+    re-fetched as itself)."""
+    rx = re.compile(str(search))
+    out = []
+    for s in series:
+        if (~np.isnan(s.values)).any() and np.nanmax(s.values) > value:
+            newpath = rx.sub(str(replace), s.path)
+            if newpath == s.path:
+                continue
+            for hit in ctx.storage.fetch(newpath, ctx.start, ctx.end,
+                                         ctx.step):
+                out.append(hit)
+    return out

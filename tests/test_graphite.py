@@ -277,17 +277,11 @@ class TestFunctionParityTable:
 
     # The reference registry, partitioned by our support policy.
     OUT_OF_SCOPE = {
-        # presentational / render hints
-        "cactiStyle", "dashed", "legendValue",
-        # synthetic data generators
-        "randomWalkFunction",
         # holt-winters family (post-MVP forecasting tier)
         "holtWintersAberration", "holtWintersConfidenceBands",
         "holtWintersForecast",
         # template re-evaluation
         "applyByNode",
-        # window re-fetch variants
-        "timeSlice", "useSeriesAbove",
     }
     REFERENCE_REGISTRY = {
         "absolute", "aggregate", "aggregateLine", "aggregateWithWildcards",
@@ -529,3 +523,62 @@ class TestAdvisedSemantics:
         # default tolerance 0.1 keeps single-valid windows
         (out2,) = _FUNCS["stdev"](self._ctx(), [s], 4)
         assert out2.values[0] == 0.0
+
+
+class TestRound4Breadth:
+    def _series(self, name, vals, step=10 * 10**9, start=0):
+        from m3_tpu.query.graphite import GraphiteSeries
+
+        return GraphiteSeries(name, name, np.asarray(vals, np.float64),
+                              step, start)
+
+    def _ctx(self, storage=None, start=0, end=80 * 10**9):
+        from m3_tpu.query.graphite import _Ctx
+
+        return _Ctx(storage, start, end, 10 * 10**9)
+
+    def test_random_walk_stable_and_sized(self):
+        from m3_tpu.query.graphite import _FUNCS
+
+        (a,) = _FUNCS["randomWalkFunction"](self._ctx(end=600 * 10**9),
+                                            "rw.test", 60)
+        (b,) = _FUNCS["randomWalkFunction"](self._ctx(end=600 * 10**9),
+                                            "rw.test", 60)
+        assert len(a.values) == 10 and a.step_nanos == 60 * 10**9
+        np.testing.assert_array_equal(a.values, b.values)  # seeded
+
+    def test_time_slice_nulls_outside_window(self):
+        from m3_tpu.query.graphite import _FUNCS
+
+        s = self._series("ts", [1.0] * 8)
+        (out,) = _FUNCS["timeSlice"](self._ctx(end=80 * 10**9), [s],
+                                     "-60s", "-30s")
+        t = np.arange(8) * 10
+        expect_live = (t >= 20) & (t <= 50)
+        assert np.array_equal(~np.isnan(out.values), expect_live)
+
+    def test_cacti_style_and_legend_value(self):
+        from m3_tpu.query.graphite import _FUNCS
+
+        s = self._series("web.cpu", [1.0, 3.0, 2.0])
+        (c,) = _FUNCS["cactiStyle"](self._ctx(), [s])
+        assert c.name == "web.cpu Current:2 Max:3 Min:1"
+        (l,) = _FUNCS["legendValue"](self._ctx(), [s], "avg", "last")
+        assert l.name == "web.cpu (avg: 2) (last: 2)"
+        import pytest as _pytest
+
+        from m3_tpu.query.graphite import ParseError
+
+        with _pytest.raises(ParseError):
+            _FUNCS["legendValue"](self._ctx(), [s], "p99")
+
+    def test_use_series_above(self, tmp_path):
+        db = _seed_db(tmp_path)
+        eng = GraphiteEngine(GraphiteStorage(db))
+        # db01.cpu peaks at 3*30=90 > 50 -> fetch its .mem counterpart?
+        # only web01 has .mem; use web threshold instead: web02 peaks 60.
+        out = eng.render(
+            'useSeriesAbove(servers.web01.cpu, 5, "cpu", "mem")',
+            START, START + 10 * STEP, STEP)
+        assert [s.path for s in out] == ["servers.web01.mem"]
+        db.close()
