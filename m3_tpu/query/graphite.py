@@ -1555,6 +1555,7 @@ class _Ctx:
     start: int
     end: int
     step: int
+    engine: "GraphiteEngine | None" = None  # re-entrant evaluation
 
 
 class GraphiteEngine:
@@ -1566,7 +1567,7 @@ class GraphiteEngine:
     def render(self, target: str, start_nanos: int, end_nanos: int,
                step_nanos: int) -> list[GraphiteSeries]:
         ast = parse_target(target)
-        ctx = _Ctx(self.storage, start_nanos, end_nanos, step_nanos)
+        ctx = _Ctx(self.storage, start_nanos, end_nanos, step_nanos, self)
         out = self._eval(ast, ctx)
         if not isinstance(out, list):
             raise ParseError(f"target does not evaluate to series: {target!r}")
@@ -1581,8 +1582,8 @@ class GraphiteEngine:
                     raise ParseError("timeShift(expr, shift) takes 2 args")
                 shift = node.args[1]
                 nanos = _duration_nanos(str(shift))
-                shifted = _Ctx(ctx.storage, ctx.start - nanos,
-                               ctx.end - nanos, ctx.step)
+                shifted = replace(ctx, start=ctx.start - nanos,
+                                  end=ctx.end - nanos)
                 inner = self._eval(node.args[0], shifted)
                 return [
                     replace(s, start_nanos=ctx.start,
@@ -1610,15 +1611,23 @@ def supported_functions() -> list[str]:
 
 
 @_func("randomWalkFunction", "randomWalk")
-def _random_walk(ctx, name, step=60):
+def _random_walk(ctx, name, step=None):
     """Synthetic random-walk series over the render window (graphite-web
     functions.py randomWalkFunction).  Seeded from the name so repeated
     renders of one target are stable — a test-friendly divergence from
-    graphite's unseeded random.random()."""
+    graphite's unseeded random.random().  ``step`` defaults to the
+    RENDER step so the series stays grid-compatible with fetched ones
+    (this engine does not LCM-normalize mixed grids), and the point
+    count honors the same OOM cap as every fetch path."""
     import zlib
 
-    step_nanos = max(1, int(step)) * 10**9
+    step_nanos = (ctx.step if step is None
+                  else max(1, int(step)) * 10**9)
     n = max(1, int((ctx.end - ctx.start) // step_nanos))
+    cap = (ctx.storage.max_points if ctx.storage is not None
+           else 100_000)
+    if n > cap:
+        raise ParseError(f"render grid too large: {n} > {cap} points")
     rng = np.random.default_rng(zlib.crc32(str(name).encode()))
     vals = np.cumsum(rng.random(n) - 0.5)
     return [GraphiteSeries(str(name), str(name), vals, step_nanos, ctx.start)]
@@ -1692,7 +1701,8 @@ def _legend_value(ctx, series, *value_types):
         for vt in value_types:
             fn_ = _LEGEND_FNS.get(str(vt))
             if fn_ is None:
-                raise ParseError(f"legendValue: unknown value type {vt!r}")
+                name += " (?)"  # graphite-web degrades, never errors
+                continue
             name += f" ({vt}: {_fmt_legend(fn_(s.values))})"
         out.append(s.with_values(s.values, name))
     return out
@@ -1723,4 +1733,188 @@ def _use_series_above(ctx, series, value, search, replace):
             for hit in ctx.storage.fetch(newpath, ctx.start, ctx.end,
                                          ctx.step):
                 out.append(hit)
+    return out
+
+
+@_func("applyByNode")
+def _apply_by_node(ctx, series, node_num, template, new_name=None):
+    """Re-evaluate a template per distinct node prefix (graphite-web
+    applyByNode): for each unique first-(node+1)-components prefix of
+    the input paths, render ``template`` with '%' replaced by the
+    prefix; ``newName`` (also %-substituted) renames the results."""
+    if ctx.engine is None:
+        raise ParseError("applyByNode needs an engine-bound context")
+    n = int(node_num)
+    prefixes = []
+    for s in series:
+        parts = s.path.split(".")
+        if len(parts) <= n:
+            continue
+        pre = ".".join(parts[: n + 1])
+        if pre not in prefixes:
+            prefixes.append(pre)
+    out = []
+    for pre in prefixes:
+        target = str(template).replace("%", pre)
+        for r in ctx.engine._eval(parse_target(target), ctx):
+            if new_name is not None:
+                r = r.with_values(r.values,
+                                  str(new_name).replace("%", pre))
+            out.append(r)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Holt-Winters forecasting (graphite-web functions.py holtWintersAnalysis:
+# triple exponential smoothing, one-day season, alpha=gamma=0.1,
+# beta=0.0035).  The sequential reference loop is mirrored exactly —
+# including its None-breaks-the-math restart behavior (NaN here).
+# ---------------------------------------------------------------------------
+
+_HW_ALPHA = 0.1
+_HW_GAMMA = 0.1
+_HW_BETA = 0.0035
+
+
+def _holt_winters_analysis(values: np.ndarray, step_nanos: int):
+    """Returns (predictions, deviations) float64 arrays (NaN = None)."""
+    season = max(1, int((24 * 3600 * 10**9) // max(1, step_nanos)))
+    n = len(values)
+    intercepts: list = []
+    slopes: list = []
+    seasonals: list = []
+    predictions = np.full(n, NAN)
+    deviations = np.full(n, NAN)
+
+    def last_seasonal(i):
+        # bounds-checked both ways: at season=1 (steps >= 1 day) the
+        # lookahead last_seasonal(i+1) would otherwise index a slot not
+        # yet appended (a latent IndexError in the graphite-web loop)
+        j = i - season
+        return seasonals[j] if 0 <= j < len(seasonals) else 0.0
+
+    def last_deviation(i):
+        j = i - season
+        return deviations[j] if j >= 0 and not math.isnan(deviations[j]) else 0.0
+
+    next_pred = NAN
+    for i in range(n):
+        actual = values[i]
+        if math.isnan(actual):
+            # missing input values break all the math: restart
+            intercepts.append(None)
+            slopes.append(0.0)
+            seasonals.append(0.0)
+            predictions[i] = next_pred
+            deviations[i] = 0.0
+            next_pred = NAN
+            continue
+        if i == 0:
+            last_intercept = actual
+            last_slope = 0.0
+            prediction = actual  # seed: first prediction = first actual
+        else:
+            last_intercept = intercepts[-1]
+            last_slope = slopes[-1]
+            if last_intercept is None:
+                last_intercept = actual
+            prediction = next_pred
+        ls = last_seasonal(i)
+        next_ls = last_seasonal(i + 1)
+        lsd = last_deviation(i)
+        intercept = (_HW_ALPHA * (actual - ls)
+                     + (1 - _HW_ALPHA) * (last_intercept + last_slope))
+        slope = (_HW_BETA * (intercept - last_intercept)
+                 + (1 - _HW_BETA) * last_slope)
+        seasonal = (_HW_GAMMA * (actual - intercept)
+                    + (1 - _HW_GAMMA) * ls)
+        next_pred = intercept + slope + next_ls
+        pred_for_dev = 0.0 if math.isnan(prediction) else prediction
+        deviation = (_HW_GAMMA * abs(actual - pred_for_dev)
+                     + (1 - _HW_GAMMA) * lsd)
+        intercepts.append(intercept)
+        slopes.append(slope)
+        seasonals.append(seasonal)
+        predictions[i] = prediction
+        deviations[i] = deviation
+    return predictions, deviations
+
+
+def _hw_bootstrapped(ctx, series, bootstrap_interval):
+    """(bootstrapped GraphiteSeries, trim point count) per input: the
+    series re-fetched with `bootstrapInterval` of leading history
+    (graphite-web previewSeconds) so the seasonal state is warm when
+    the render window starts.  Computed series that cannot re-fetch
+    (path no longer a plain metric) analyze the window alone."""
+    nanos = _duration_nanos(str(bootstrap_interval))
+    out = []
+    for s in series:
+        try:
+            fetched = ctx.storage.fetch(
+                s.path, ctx.start - nanos, ctx.end, ctx.step
+            ) if ctx.storage is not None else []
+        except ParseError:
+            # e.g. the extended grid exceeds the render cap: analyze
+            # the window alone rather than failing the whole render
+            fetched = []
+        if len(fetched) == 1:
+            boot = fetched[0]
+            trim = int((ctx.start - boot.start_nanos) // boot.step_nanos)
+            out.append((boot, max(0, trim), s))
+        else:
+            out.append((s, 0, s))
+    return out
+
+
+def _hw_forecast_parts(ctx, series, bootstrap_interval):
+    for boot, trim, orig in _hw_bootstrapped(ctx, series, bootstrap_interval):
+        pred, dev = _holt_winters_analysis(boot.values, boot.step_nanos)
+        start = boot.start_nanos + trim * boot.step_nanos
+        yield orig, boot, trim, start, pred, dev
+
+
+@_func("holtWintersForecast")
+def _holt_winters_forecast(ctx, series, bootstrap_interval="7d"):
+    out = []
+    for orig, boot, trim, start, pred, dev in _hw_forecast_parts(
+            ctx, series, bootstrap_interval):
+        out.append(GraphiteSeries(
+            f"holtWintersForecast({orig.name})", orig.path,
+            pred[trim:], boot.step_nanos, start))
+    return out
+
+
+@_func("holtWintersConfidenceBands")
+def _holt_winters_confidence_bands(ctx, series, delta=3,
+                                   bootstrap_interval="7d"):
+    out = []
+    for orig, boot, trim, start, pred, dev in _hw_forecast_parts(
+            ctx, series, bootstrap_interval):
+        upper = pred[trim:] + delta * dev[trim:]
+        lower = pred[trim:] - delta * dev[trim:]
+        out.append(GraphiteSeries(
+            f"holtWintersConfidenceUpper({orig.name})", orig.path,
+            upper, boot.step_nanos, start))
+        out.append(GraphiteSeries(
+            f"holtWintersConfidenceLower({orig.name})", orig.path,
+            lower, boot.step_nanos, start))
+    return out
+
+
+@_func("holtWintersAberration")
+def _holt_winters_aberration(ctx, series, delta=3, bootstrap_interval="7d"):
+    out = []
+    for orig, boot, trim, start, pred, dev in _hw_forecast_parts(
+            ctx, series, bootstrap_interval):
+        upper = pred[trim:] + delta * dev[trim:]
+        lower = pred[trim:] - delta * dev[trim:]
+        actual = boot.values[trim:]
+        ab = np.where(
+            np.isnan(actual), 0.0,
+            np.where(actual > upper, actual - upper,
+                     np.where(actual < lower, actual - lower, 0.0)))
+        ab = np.where(np.isnan(upper) | np.isnan(lower), 0.0, ab)
+        out.append(GraphiteSeries(
+            f"holtWintersAberration({orig.name})", orig.path,
+            ab, boot.step_nanos, start))
     return out
