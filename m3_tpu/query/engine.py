@@ -289,6 +289,21 @@ class Engine:
             return fn.histogram_quantile(block, q)
         if f in fn._UNARY:
             return fn.unary_math(self._eval(call.args[0], steps), f)
+        if f == "pi":
+            return _Scalar(math.pi)
+        if f in fn._DATE_FNS:
+            # date parts of the argument's unix-seconds values;
+            # argument defaults to vector(time()) like Prometheus
+            if call.args:
+                b = self._eval(call.args[0], steps)
+            else:
+                b = Block(steps, (steps.astype(np.float64) / 1e9)[None, :],
+                          [SeriesMeta(())])
+            if isinstance(b, _Scalar):
+                b = Block(steps, np.broadcast_to(
+                    np.asarray(b.value, np.float64),
+                    (1, len(steps))).copy(), [SeriesMeta(())])
+            return fn.date_fn(b, f)
         if f == "round":
             nearest = (self._scalar_arg(call.args[1], steps)
                        if len(call.args) > 1 else 1.0)

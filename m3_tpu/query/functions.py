@@ -188,7 +188,48 @@ _UNARY = {
     "log10": np.log10,
     "sqrt": np.sqrt,
     "sgn": np.sign,
+    # trigonometric family (Prometheus 2.31+)
+    "sin": np.sin, "cos": np.cos, "tan": np.tan,
+    "asin": np.arcsin, "acos": np.arccos, "atan": np.arctan,
+    "sinh": np.sinh, "cosh": np.cosh, "tanh": np.tanh,
+    "asinh": np.arcsinh, "acosh": np.arccosh, "atanh": np.arctanh,
+    "deg": np.degrees, "rad": np.radians,
 }
+
+# Date parts of a unix-seconds vector (Prometheus functions.go
+# funcDaysInMonth..funcYear; UTC, like Prometheus).  Each function
+# receives the precomputed (dt, Y, M, D) datetime64 casts ONCE.
+_DATE_FNS = {
+    "minute": lambda dt, Y, M, D: (dt.astype("datetime64[m]")
+                                   - dt.astype("datetime64[h]")
+                                   ).astype("int64"),
+    "hour": lambda dt, Y, M, D: (dt.astype("datetime64[h]") - D
+                                 ).astype("int64"),
+    "day_of_week": lambda dt, Y, M, D: (D.astype("int64") + 4) % 7,
+    "day_of_month": lambda dt, Y, M, D: (D - M).astype("int64") + 1,
+    "day_of_year": lambda dt, Y, M, D: (D - Y).astype("int64") + 1,
+    "days_in_month": lambda dt, Y, M, D: (
+        (M + 1).astype("datetime64[D]") - M.astype("datetime64[D]")
+    ).astype("int64"),
+    "month": lambda dt, Y, M, D: (M - Y).astype("int64") + 1,
+    "year": lambda dt, Y, M, D: Y.astype("int64") + 1970,
+}
+
+
+def date_fn(block: Block, func: str) -> Block:
+    v = block.values
+    finite = np.isfinite(v)
+    secs = np.where(finite, v, 0.0).astype("int64")
+    dt = secs.astype("datetime64[s]")
+    Y = dt.astype("datetime64[Y]")
+    M = dt.astype("datetime64[M]")
+    D = dt.astype("datetime64[D]")
+    with np.errstate(all="ignore"):
+        out = _DATE_FNS[func](dt, Y, M, D).astype(np.float64)
+    # non-finite inputs (NaN gaps AND +/-Inf poison) stay NaN — an
+    # Inf-valued sample must not masquerade as the epoch's date parts
+    out = np.where(finite, out, np.nan)
+    return block.with_values(out, [m.drop_name() for m in block.series])
 
 
 def unary_math(block: Block, func: str) -> Block:

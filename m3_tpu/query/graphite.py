@@ -1859,7 +1859,12 @@ def _hw_bootstrapped(ctx, series, bootstrap_interval):
             fetched = []
         if len(fetched) == 1:
             boot = fetched[0]
-            trim = int((ctx.start - boot.start_nanos) // boot.step_nanos)
+            # round UP: a bootstrap interval that is not a step
+            # multiple must not leave the forecast shifted off the
+            # render grid (the first on-grid point is the one at or
+            # after ctx.start)
+            trim = int(-(-(ctx.start - boot.start_nanos)
+                         // boot.step_nanos))
             out.append((boot, max(0, trim), s))
         else:
             out.append((s, 0, s))

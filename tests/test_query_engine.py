@@ -325,3 +325,32 @@ class TestAtModifier:
             ' @ start())[10m:1m])',
             QSTART, QEND, STEP)
         assert sub.values[0, -1] == direct.values[0, 0]
+
+
+class TestDateAndTrigFunctions:
+    def test_date_parts_of_time(self, engine):
+        import datetime as _dt
+
+        b = engine.execute_range("day_of_week()", QSTART, QEND, STEP)
+        want = _dt.datetime.fromtimestamp(
+            QSTART / 1e9, _dt.timezone.utc)
+        # python: Monday=0..Sunday=6; Prometheus: Sunday=0..Saturday=6
+        assert b.values[0, 0] == (want.weekday() + 1) % 7
+        h = engine.execute_range("hour()", QSTART, QEND, STEP)
+        assert h.values[0, 0] == want.hour
+        m = engine.execute_range("month()", QSTART, QEND, STEP)
+        assert m.values[0, 0] == want.month
+        y = engine.execute_range("year()", QSTART, QEND, STEP)
+        assert y.values[0, 0] == want.year
+        dim = engine.execute_range("days_in_month()", QSTART, QEND, STEP)
+        nxt = (want.replace(day=28) + _dt.timedelta(days=4)).replace(day=1)
+        assert dim.values[0, 0] == (nxt - _dt.timedelta(days=1)).day
+
+    def test_trig_and_pi(self, engine):
+        b = engine.execute_range("sin(vector(0))", QSTART, QEND, STEP)
+        assert b.values[0, 0] == 0.0
+        p = engine.execute_range("pi()", QSTART, QEND, STEP)
+        assert abs(p.values[0, 0] - np.pi) < 1e-15
+        d = engine.execute_range("deg(vector(3.141592653589793))",
+                                 QSTART, QEND, STEP)
+        assert abs(d.values[0, 0] - 180.0) < 1e-9
