@@ -521,21 +521,36 @@ class MetricList:
         too-far-future.  Servers pass their wall clock."""
         if now_nanos is not None:
             self.seed_windows(now_nanos)
-        slots = self.maps[mt].resolve(ids, agg_id, mt)
+        values = np.asarray(values, np.float64)
+        times = np.asarray(times, np.int64)
+        # Validate windows BEFORE resolving: an out-of-window flood must
+        # not allocate slots or consume new-series limiter budget — the
+        # churn the limit exists to stop (reference entry.go addTimed
+        # validates against now±buffer before writing).
         windows, too_early, too_future = self._route_windows(times)
         self.timed_rejects["too_early"] += int(too_early.sum())
         self.timed_rejects["too_far_future"] += int(too_future.sum())
+        accepted = ~(too_early | too_future)
+        sel = np.nonzero(accepted)[0]
+        if sel.size == 0:
+            return accepted
+        slots = self.maps[mt].resolve([ids[i] for i in sel], agg_id, mt)
         rej = slots < 0
         if rej.any():
             # Rate-limited creations reject like window violations do.
+            # Window-rejected samples never reached the limiter, so no
+            # rejection is double-counted across the two counters.
             self.new_series_rejected += int(rej.sum())
-            windows = np.where(rej, np.int32(self.opts.num_windows), windows)
-            slots = np.where(rej, np.int32(0), slots)
+            accepted[sel[rej]] = False
+            sel = sel[~rej]
+            slots = slots[~rej]
+            if sel.size == 0:
+                return accepted
         self._arena(mt).ingest(
-            jnp.asarray(windows), jnp.asarray(slots), jnp.asarray(values),
-            jnp.asarray(times)
+            jnp.asarray(windows[sel]), jnp.asarray(slots),
+            jnp.asarray(values[sel]), jnp.asarray(times[sel])
         )
-        return ~(too_early | too_future | rej)
+        return accepted
 
     def open_windows(self, now_nanos: int) -> List[int]:
         """Closed windows that can actually hold data.

@@ -157,6 +157,12 @@ class RemoteKVStore:
         self._watch_poll_s = watch_poll_s
         self._watchers: dict[str, list[Callable]] = {}
         self._watch_seen: dict[str, int] = {}
+        # Watchers owed a re-delivery: registration raced the poll loop,
+        # the reconciling re-read failed, and the watcher was fired with
+        # a value older than _watch_seen — the poll loop re-delivers the
+        # current value to these on its next tick even when the version
+        # has not advanced past seen.
+        self._watch_pending: dict[str, set] = {}
         self._watch_thread: threading.Thread | None = None
         self._closed = threading.Event()
 
@@ -254,8 +260,17 @@ class RemoteKVStore:
                 pass
             with self._wmu:
                 fire = self._decide_locked(key, fn, cur)
-            if fire is None:
-                fire = [fn]
+                if fire is None:
+                    # Still stale after the re-read (or the re-read
+                    # failed): mark this watcher pending so the next
+                    # poll tick delivers the current value instead of
+                    # waiting for the key to change again.  Do NOT fire
+                    # the stale value here — an unlocked stale fire can
+                    # race the poll tick's re-delivery and land AFTER
+                    # it, regressing the watcher's view until the next
+                    # version change.
+                    self._watch_pending.setdefault(key, set()).add(fn)
+                    fire = []
         for f in fire:
             self._fire(f, cur)
         if start:
@@ -307,7 +322,13 @@ class RemoteKVStore:
                     changed = cur.version != self._watch_seen.get(key)
                     if changed:
                         self._watch_seen[key] = cur.version
-                    fns = list(self._watchers.get(key, ())) if changed else []
+                        fns = list(self._watchers.get(key, ()))
+                        # A full delivery covers any owed re-delivery.
+                        self._watch_pending.pop(key, None)
+                    else:
+                        pend = self._watch_pending.pop(key, None)
+                        live = self._watchers.get(key, ())
+                        fns = [f for f in pend if f in live] if pend else []
                 for fn in fns:
                     self._fire(fn, cur)
 

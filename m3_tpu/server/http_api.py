@@ -246,7 +246,18 @@ class _Handler(BaseHTTPRequestHandler):
         if docs:
             _, rejected = self._ingest_tagged(docs, ts, vals)
         # Prometheus remote-write clients back off on 429 — the typed
-        # signal for new-series rate limiting; 2xx otherwise.
+        # signal for new-series rate limiting; 2xx otherwise.  The 429
+        # is deliberate despite the accepted subset having been
+        # persisted: spec-compliant clients retry the WHOLE batch, and
+        # retrying is what eventually admits the REJECTED series (a 2xx
+        # would silently drop them).  Costs of that choice: accepted
+        # samples are re-written into the WAL (harmless — raw-namespace
+        # dedupe is last-write-wins — but WAL volume inflates under
+        # sustained churn), and if a downsampler is attached the retry
+        # RE-AGGREGATES accepted samples into any still-open window
+        # (sum/count lanes double-count until the window closes).
+        # Deployments pairing the limiter with downsampling should set
+        # the limit headroom so steady-state traffic never 429s.
         self.send_response(429 if rejected else 204)
         if rejected:
             self.send_header("X-Rejected", str(rejected))

@@ -120,7 +120,10 @@ class NewSeriesLimiter:
         self._last = now()
         self.per_sec = float(per_sec)
         self.rejected_total = 0
-        self.enabled = True
+        # Bypass depth is THREAD-LOCAL: a bootstrap/follower-ingest
+        # bypass window on one thread must not exempt concurrent
+        # foreground writes on other threads from the limit.
+        self._bypass = threading.local()
 
     def set_rate(self, per_sec: float) -> None:
         with self._lock:
@@ -133,13 +136,15 @@ class NewSeriesLimiter:
         re-admit every previously-accepted series (the reference limits
         only foreground writes), and multi-policy fan-out charges the
         budget once, with follower lists riding the first list's
-        decision under this bypass."""
-        prev = self.enabled
-        self.enabled = False
+        decision under this bypass.  Scoped to the CALLING THREAD only
+        (nestable depth counter): other threads' foreground writes keep
+        paying the limit while a replay runs."""
+        depth = getattr(self._bypass, "depth", 0)
+        self._bypass.depth = depth + 1
         try:
             yield self
         finally:
-            self.enabled = prev
+            self._bypass.depth = depth
 
     def acquire_up_to(self, n: int) -> int:
         """Take up to ``n`` tokens; returns how many were granted
@@ -148,7 +153,7 @@ class NewSeriesLimiter:
         if n <= 0:
             return 0
         with self._lock:
-            if self.per_sec <= 0 or not self.enabled:
+            if self.per_sec <= 0 or getattr(self._bypass, "depth", 0):
                 return n
             t = self._now()
             self._tokens = min(

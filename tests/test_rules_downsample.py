@@ -681,6 +681,66 @@ class TestNewSeriesBackPressure:
             np.full(3, START + 1, np.int64), now_nanos=START + 1)
         assert int(acc.sum()) == 2  # third creation over budget
 
+    def test_out_of_window_timed_flood_spends_no_budget(self):
+        """ADVICE r4: window validation runs BEFORE slot resolution, so
+        an out-of-window timed flood neither allocates slots nor
+        consumes new-series limiter budget, and a sample cannot be
+        double-counted across the window and limiter counters."""
+        from m3_tpu.aggregator.engine import Aggregator, AggregatorOptions
+
+        agg = Aggregator(num_shards=1, opts=AggregatorOptions(
+            capacity=64, num_windows=2, timer_sample_capacity=1 << 10,
+            storage_policies=(SP_10S,), new_series_limit_per_sec=2))
+        agg.new_series_limiter._now = lambda: 1000.0  # freeze refill
+        agg.new_series_limiter._last = 1000.0
+        # 50 ancient samples: all window-rejected, none may touch the
+        # limiter or the slot map.
+        ancient = [b"old-%d" % i for i in range(50)]
+        acc = agg.add_timed_batch(
+            MetricType.COUNTER, ancient, np.ones(50),
+            np.full(50, START - 100 * R, np.int64), now_nanos=START + 1)
+        ml = agg.shards[0].lists[SP_10S]
+        assert not acc.any()
+        assert len(ml.maps[MetricType.COUNTER]) == 0
+        assert ml.new_series_rejected == 0
+        assert ml.timed_rejects["too_early"] == 50
+        # The full creation budget is still available for valid samples.
+        acc2 = agg.add_timed_batch(
+            MetricType.COUNTER, [b"f1", b"f2", b"f3"], np.ones(3),
+            np.full(3, START + 1, np.int64), now_nanos=START + 1)
+        assert int(acc2.sum()) == 2
+        # Exactly one counter accounts for the limited sample.
+        assert ml.new_series_rejected == 1
+        assert ml.timed_rejects["too_early"] == 50
+
+    def test_limiter_bypass_is_thread_scoped(self):
+        """ADVICE r4: a bootstrap/replay bypass on one thread must not
+        exempt concurrent foreground writes on other threads."""
+        import threading
+
+        from m3_tpu.storage.limits import NewSeriesLimiter
+
+        lim = NewSeriesLimiter(5, now=lambda: 1000.0)
+        got = {}
+        entered = threading.Event()
+        release = threading.Event()
+
+        def replay():
+            with lim.bypass():
+                entered.set()
+                release.wait(5)
+                got["replay"] = lim.acquire_up_to(100)
+
+        th = threading.Thread(target=replay)
+        th.start()
+        entered.wait(5)
+        # Foreground thread, while the bypass is open elsewhere: pays.
+        got["fg"] = lim.acquire_up_to(100)
+        release.set()
+        th.join(5)
+        assert got["fg"] == 5  # one second's budget
+        assert got["replay"] == 100  # bypassed thread is exempt
+
     def test_bootstrap_replay_bypasses_limiter(self, tmp_path):
         """Restart must re-admit every previously-accepted series: the
         limiter gates foreground churn only, and the WAL never holds
