@@ -43,13 +43,14 @@ from m3_tpu.metrics.transformation import TransformationType
 from m3_tpu.metrics.types import MetricType
 
 # Transform tails a MetricList can execute at consume.  RESET
-# (unary_multi.go: emits the datapoint plus a zero 1s later) needs a
-# second out-of-window timestamp per row, which FlushedMetric's
-# single-timestamp batch cannot carry — rejected loudly rather than
-# silently mis-aggregated.
+# (unary_multi.go transformReset: the datapoint unchanged plus a forced
+# zero half a resolution later) emits a SECOND FlushedMetric per consume
+# carrying the zero rows at ts + max(resolution//2, 1) — multi-datapoint
+# emission, the HA-failover counter-reset signal for PromQL rate().
 _SUPPORTED_TAIL = frozenset({
     TransformationType.ABSOLUTE, TransformationType.ADD,
     TransformationType.PER_SECOND, TransformationType.INCREASE,
+    TransformationType.RESET,
 })
 
 
@@ -419,8 +420,18 @@ class MetricList:
                 if op.type not in _SUPPORTED_TAIL:
                     raise ValueError(
                         f"unsupported pipeline transformation {op.type!r} "
-                        "in rollup tail (RESET needs multi-datapoint "
-                        "emission; see metrics/transformation.py)")
+                        "in rollup tail (see metrics/transformation.py)")
+                if tail and tail[-1] == TransformationType.RESET:
+                    # The forced zero is emitted raw — it never passes
+                    # through later transforms, so RESET anywhere but
+                    # the end of its stage would mis-emit.  (RESET
+                    # directly before a rollup op is allowed: the extra
+                    # datapoint simply never forwards, matching the
+                    # reference's HasRollup branch.)
+                    raise ValueError(
+                        "RESET must be the last transformation of its "
+                        "pipeline stage (its forced zero bypasses "
+                        "subsequent transforms)")
                 tail.append(op.type)
             elif isinstance(op, AppliedRollupOp):
                 # Validate the WHOLE remaining chain now: a bad op deep
@@ -606,8 +617,7 @@ class MetricList:
                            MetricType.TIMER):
                     arena = self._arena(mt)
                     lanes, counts = arena.consume(w)
-                    flushed = self._emit(mt, arena, lanes, counts, ts)
-                    if flushed is not None:
+                    for flushed in self._emit(mt, arena, lanes, counts, ts):
                         results.append(flushed)
                         if flush_handler is not None:
                             flush_handler(self, flushed)
@@ -702,12 +712,15 @@ class MetricList:
                     del self._tf_state[k]
         return released
 
-    def _emit(self, mt, arena, lanes, counts, ts) -> FlushedMetric | None:
+    def _emit(self, mt, arena, lanes, counts, ts) -> List[FlushedMetric]:
+        """Returns 0, 1, or 2 FlushedMetrics for one drained window:
+        the window's aggregates, plus (when some slot's tail carries
+        RESET) the forced-zero batch half a resolution later."""
         lanes = np.asarray(lanes)
         counts = np.asarray(counts)
         active = np.nonzero(counts > 0)[0]
         if active.size == 0:
-            return None
+            return []
         mask = self.maps[mt].agg_mask[active]
         out_slots: List[np.ndarray] = []
         out_types: List[np.ndarray] = []
@@ -727,7 +740,7 @@ class MetricList:
             out_types.append(np.full(rows.size, int(t), np.int8))
             out_vals.append(lanes[rows, lane_i])
         if not out_slots:
-            return None
+            return []
         flushed = FlushedMetric(
             policy=self.policy,
             timestamp_nanos=ts,
@@ -737,43 +750,56 @@ class MetricList:
             metric_type=mt,
         )
         if self._pipelines:
-            flushed = self._apply_tails(flushed)
-        return flushed
+            return self._apply_tails(flushed)
+        return [flushed]
 
-    def _apply_tails(self, fm: FlushedMetric) -> FlushedMetric | None:
+    def _apply_tails(self, fm: FlushedMetric) -> List[FlushedMetric]:
         """Run each pipeline-carrying slot's transform tail over its
         window aggregates (reference generic_elem.go:271-380: Consume
         applies the parsed pipeline with prevValues state before
         flushing).  Rows whose binary transform has no usable previous
         value (first window, time going backwards, negative delta for
         monotonic transforms) are dropped from the flush — the
-        reference emits nothing for empty datapoints."""
+        reference emits nothing for empty datapoints.
+
+        RESET rows additionally schedule a forced zero half a
+        resolution after the window timestamp (unary_multi.go
+        transformReset; generic_elem.go flushes the extra datapoint
+        only on the local path — a forwarded row drops it, matching
+        the reference's HasRollup branch)."""
         mt, ts = fm.metric_type, fm.timestamp_nanos
         piped = np.fromiter(
             (s for (m, s) in self._pipelines if m == mt), np.int64)
         if piped.size == 0:
-            return fm
+            return [fm]
         hits = np.nonzero(np.isin(fm.slots, piped))[0]
         if hits.size == 0:
-            return fm
+            return [fm]
         values = fm.values.copy()
         keep = np.ones(len(values), bool)
+        reset_rows: List[int] = []
         state = self._tf_state
         for i in hits:
             slot, t_ = fm.slots[i], fm.types[i]
             tail = self._pipelines[(mt, int(slot))]
             v = float(values[i])
+            want_reset = False
             for k, tt in enumerate(tail):
                 skey = (mt, int(slot), int(t_), k)
                 if isinstance(tt, ForwardSpec):
                     # Multi-stage pipeline: this stage's (transformed)
                     # window aggregate forwards to the next stage's
                     # owner instead of flushing locally (reference
-                    # generic_elem Consume -> flushForwardedFn).
+                    # generic_elem Consume -> flushForwardedFn).  The
+                    # extra RESET datapoint never forwards.
                     self._forward_buffer.append((tt, v, ts))
                     keep[i] = False
                     break
-                if tt == TransformationType.ABSOLUTE:
+                if tt == TransformationType.RESET:
+                    # Value passes through unchanged; the forced zero
+                    # flushes as a second batch (see below).
+                    want_reset = True
+                elif tt == TransformationType.ABSOLUTE:
                     v = abs(v)
                 elif tt == TransformationType.ADD:
                     run = state.get(skey, (0.0,))[0]
@@ -811,16 +837,32 @@ class MetricList:
                             break
                         v = v - pv
             values[i] = v
+            if want_reset and keep[i]:
+                # Dropped rows (forwarded / empty datapoint) emit no
+                # extra zero — the reference's continue skips both.
+                reset_rows.append(i)
+        out: List[FlushedMetric] = []
         if not keep.all():
-            if not keep.any():
-                return None
-            return FlushedMetric(
-                policy=fm.policy, timestamp_nanos=ts,
-                slots=fm.slots[keep], types=fm.types[keep],
-                values=values[keep], metric_type=mt,
-            )
-        fm.values = values
-        return fm
+            if keep.any():
+                out.append(FlushedMetric(
+                    policy=fm.policy, timestamp_nanos=ts,
+                    slots=fm.slots[keep], types=fm.types[keep],
+                    values=values[keep], metric_type=mt,
+                ))
+        else:
+            fm.values = values
+            out.append(fm)
+        if reset_rows:
+            rows = np.asarray(reset_rows)
+            out.append(FlushedMetric(
+                policy=fm.policy,
+                timestamp_nanos=ts + max(self.resolution // 2, 1),
+                slots=fm.slots[rows].copy(),
+                types=fm.types[rows].copy(),
+                values=np.zeros(rows.size, np.float64),
+                metric_type=mt,
+            ))
+        return out
 
 
 @dataclasses.dataclass

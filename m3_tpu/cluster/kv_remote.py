@@ -290,8 +290,17 @@ class RemoteKVStore:
         seen = self._watch_seen.get(key)
         if seen is None or cur.version > seen:
             self._watch_seen[key] = cur.version
+            # Every watcher (including any parked pending) receives
+            # this delivery — clear the owed re-deliveries or the next
+            # poll tick would double-fire them with the same version.
+            self._watch_pending.pop(key, None)
             return list(self._watchers[key])
         if cur.version == seen:
+            pend = self._watch_pending.get(key)
+            if pend is not None:
+                pend.discard(fn)
+                if not pend:
+                    del self._watch_pending[key]
             return [fn]  # initial fire for the new watcher only
         return None
 

@@ -101,9 +101,14 @@ def increase(prev: Datapoint, curr: Datapoint) -> Datapoint:
     return Datapoint(curr.time_nanos, diff)
 
 
-def reset(dp: Datapoint) -> Tuple[Datapoint, Datapoint]:
-    """Reference unary_multi.go:28-46: the datapoint plus a zero 1s later."""
-    return dp, Datapoint(dp.time_nanos + _NANOS_PER_SECOND, 0.0)
+def reset(dp: Datapoint,
+          resolution_nanos: int = _NANOS_PER_SECOND) -> Tuple[Datapoint, Datapoint]:
+    """Reference unary_multi.go transformReset: the datapoint unchanged
+    plus a zero datapoint half a resolution period later (min 1ns) —
+    equal spacing between the value and its forced reset, so PromQL
+    graphs the delta as the rate value during aggregator HA failover."""
+    gap = max(resolution_nanos // 2, 1)
+    return dp, Datapoint(dp.time_nanos + gap, 0.0)
 
 
 # ---------------------------------------------------------------------------

@@ -31,8 +31,12 @@ from m3_tpu.persist.digest import digest, digest_file, pack_digest, unpack_diges
 
 INFO_MAGIC = b"M3TI"
 INDEX_MAGIC = b"M3TX"
-VERSION = 1
+# v2: summaries entries carry the index-file byte offset (was the entry
+# ordinal, which nothing could seek with) — the reader's lookup ladder
+# depends on it, so v1 filesets are rejected rather than mis-probed.
+VERSION = 2
 SUMMARY_EVERY = 64
+INDEX_HEADER_LEN = 12  # INDEX_MAGIC + uint64 entry count
 
 FILE_TYPES = ("info", "index", "data", "summaries", "bloom")
 
@@ -112,17 +116,24 @@ class DataFileSetWriter:
         index_parts: list[bytes] = [INDEX_MAGIC + struct.pack("<Q", len(series))]
         summary_parts: list[bytes] = []
         off = 0
+        index_off = INDEX_HEADER_LEN
         for i, (sid, stream) in enumerate(series):
             entry = struct.pack("<I", len(sid)) + sid + struct.pack(
                 "<QII", off, len(stream), digest(stream)
             )
             if i % SUMMARY_EVERY == 0:
+                # Each summary carries the entry's BYTE OFFSET in the
+                # index file, so the reader can seek straight to it and
+                # scan at most SUMMARY_EVERY entries — the reference's
+                # index_lookup.go ladder (open cost O(summaries)).
                 summary_parts.append(
-                    struct.pack("<I", len(sid)) + sid + struct.pack("<Q", i)
+                    struct.pack("<I", len(sid)) + sid
+                    + struct.pack("<Q", index_off)
                 )
             index_parts.append(entry)
             data_parts.append(stream)
             off += len(stream)
+            index_off += len(entry)
 
         bloom = BloomFilter.from_estimate(len(series))
         bloom.add_batch([sid for sid, _ in series])
@@ -146,11 +157,20 @@ class DataFileSetWriter:
 
 class DataFileSetReader:
     """Reader with the reference's lookup ladder: bloom filter →
-    summaries → binary-searched index → data segment + checksum verify
-    (persist/fs/read.go, index_lookup.go, seek.go).  Data segments come
-    from an mmap of the data file (`persist/fs/mmap_util.go` role):
-    page-cache backed, no per-read seek state, so concurrent reads on a
-    shared reader are safe without a lock."""
+    summaries (every ``SUMMARY_EVERY``-th id + its byte offset in the
+    index file) → forward scan of at most ``SUMMARY_EVERY`` raw index
+    entries → data segment + checksum verify (persist/fs/read.go,
+    index_lookup.go, seek.go).
+
+    The index is mmap'd and parsed LAZILY around the probe point: open
+    cost is O(summaries) object work (the per-file adler32 verification
+    still streams each file once, C-speed, no heap), and a long-lived
+    reader holds no per-entry Python objects — at 100K+ series per
+    (shard, block) the eager parse this replaces was exactly the cost
+    the reference's summaries exist to avoid.  Data and index segments
+    come from mmaps (`persist/fs/mmap_util.go` role): page-cache
+    backed, stateless slices, so concurrent reads on a shared reader
+    are safe without a lock."""
 
     def __init__(self, root, namespace: str, shard: int, block_start: int, volume: int):
         self.root = root
@@ -168,45 +188,65 @@ class DataFileSetReader:
             if digest_file(p(t)) != unpack_digest(digests_raw[i * 4 :]):
                 raise ValueError(f"digest mismatch for {t} file")
         self.info = FileSetInfo.from_bytes(p("info").read_bytes())
-        self._index = self._parse_index(p("index").read_bytes())
-        self._ids = [e.id for e in self._index]
-        # Data segments are served from a lazily-created mmap of the
-        # data file: the page cache owns residency (a long-lived reader
-        # pins address space, not RSS), lookups are stateless slices
-        # (thread-safe), and the hot path pays no open/seek per segment
-        # — the properties the reference gets from mmap'd seekers.
         self._data_path = p("data")
+        self._index_path = p("index")
         self._data_f = None
         self._data_mm = None
-        self._data_init = threading.Lock()
+        self._index_f = None
+        self._index_mm = None
+        self._mm_init = threading.Lock()
+        # Summaries: parallel sorted (ids, index-file byte offsets).
+        self._sum_ids: list[bytes] = []
+        self._sum_offs: list[int] = []
+        raw = p("summaries").read_bytes()
+        pos = 0
+        while pos < len(raw):
+            (idlen,) = struct.unpack_from("<I", raw, pos)
+            pos += 4
+            self._sum_ids.append(raw[pos : pos + idlen])
+            pos += idlen
+            self._sum_offs.append(struct.unpack_from("<Q", raw, pos)[0])
+            pos += 8
         self.bloom = BloomFilter.from_bytes(p("bloom").read_bytes())
 
-    def _data(self):
-        if self._data_mm is None:
+    def _mm(self, path: Path, attr_f: str, attr_mm: str):
+        if getattr(self, attr_mm) is None:
             import mmap as _mmap
 
             # Initialization is the only mutation; reads thereafter are
             # lock-free slices.  Without the lock a first-read race
             # leaks the loser's fd + mmap.
-            with self._data_init:
-                if self._data_mm is None:
-                    self._data_f = open(self._data_path, "rb")
+            with self._mm_init:
+                if getattr(self, attr_mm) is None:
+                    f = open(path, "rb")
+                    setattr(self, attr_f, f)
                     try:
-                        self._data_mm = _mmap.mmap(
-                            self._data_f.fileno(), 0,
-                            access=_mmap.ACCESS_READ,
-                        )
+                        setattr(self, attr_mm, _mmap.mmap(
+                            f.fileno(), 0, access=_mmap.ACCESS_READ))
                     except ValueError:  # zero-length file (empty fileset)
-                        self._data_mm = b""
-        return self._data_mm
+                        setattr(self, attr_mm, b"")
+        return getattr(self, attr_mm)
+
+    def _data(self):
+        return self._mm(self._data_path, "_data_f", "_data_mm")
+
+    def _index_raw(self):
+        mm = self._mm(self._index_path, "_index_f", "_index_mm")
+        if len(mm) and bytes(mm[:4]) != INDEX_MAGIC:
+            raise ValueError("bad index magic")
+        return mm
 
     def close(self) -> None:
-        if self._data_mm is not None and not isinstance(self._data_mm, bytes):
-            self._data_mm.close()
-        self._data_mm = None
-        if self._data_f is not None:
-            self._data_f.close()
-            self._data_f = None
+        for attr_mm, attr_f in (("_data_mm", "_data_f"),
+                                ("_index_mm", "_index_f")):
+            mm = getattr(self, attr_mm)
+            if mm is not None and not isinstance(mm, bytes):
+                mm.close()
+            setattr(self, attr_mm, None)
+            f = getattr(self, attr_f)
+            if f is not None:
+                f.close()
+                setattr(self, attr_f, None)
 
     def __del__(self):  # belt-and-braces for transient readers
         try:
@@ -215,28 +255,52 @@ class DataFileSetReader:
             pass
 
     @staticmethod
-    def _parse_index(raw: bytes) -> list[IndexEntry]:
-        if raw[:4] != INDEX_MAGIC:
-            raise ValueError("bad index magic")
+    def _entry_at(raw, pos: int) -> tuple[IndexEntry, int]:
+        """Parse one index entry at byte ``pos``; returns (entry, next_pos)."""
+        (idlen,) = struct.unpack_from("<I", raw, pos)
+        pos += 4
+        sid = bytes(raw[pos : pos + idlen])
+        pos += idlen
+        off, length, csum = struct.unpack_from("<QII", raw, pos)
+        return IndexEntry(sid, off, length, csum), pos + 16
+
+    def entries(self) -> Iterator[IndexEntry]:
+        """Stream every index entry in id order without materializing
+        the index (repair/verify tooling path)."""
+        raw = self._index_raw()
+        if not len(raw):
+            return
         (n,) = struct.unpack_from("<Q", raw, 4)
-        out, pos = [], 12
+        pos = INDEX_HEADER_LEN
         for _ in range(n):
-            (idlen,) = struct.unpack_from("<I", raw, pos)
-            pos += 4
-            sid = raw[pos : pos + idlen]
-            pos += idlen
-            off, length, csum = struct.unpack_from("<QII", raw, pos)
-            pos += 16
-            out.append(IndexEntry(sid, off, length, csum))
-        return out
+            e, pos = self._entry_at(raw, pos)
+            yield e
+
+    def _lookup(self, sid: bytes) -> IndexEntry | None:
+        """Summaries-guided probe: binary-search the in-memory summary
+        ids, then scan forward over raw index bytes — at most
+        SUMMARY_EVERY entries parsed per miss (index_lookup.go)."""
+        j = bisect_right(self._sum_ids, sid) - 1
+        if j < 0:
+            return None
+        raw = self._index_raw()
+        pos = self._sum_offs[j]
+        end = (self._sum_offs[j + 1] if j + 1 < len(self._sum_offs)
+               else len(raw))
+        while pos < end:
+            e, pos = self._entry_at(raw, pos)
+            if e.id == sid:
+                return e
+            if e.id > sid:  # sorted: gone past
+                return None
+        return None
 
     def read(self, sid: bytes) -> bytes | None:
         if not self.bloom.contains(sid):
             return None
-        i = bisect_right(self._ids, sid) - 1
-        if i < 0 or self._ids[i] != sid:
+        e = self._lookup(sid)
+        if e is None:
             return None
-        e = self._index[i]
         seg = bytes(self._data()[e.offset : e.offset + e.length])
         if digest(seg) != e.checksum:
             raise ValueError(f"segment checksum mismatch for {sid!r}")
@@ -244,14 +308,14 @@ class DataFileSetReader:
 
     def read_all(self) -> Iterator[tuple[bytes, bytes]]:
         mm = self._data()
-        for e in self._index:  # index entries are offset-ordered
+        for e in self.entries():  # index entries are offset-ordered
             seg = bytes(mm[e.offset : e.offset + e.length])
             if digest(seg) != e.checksum:
                 raise ValueError(f"segment checksum mismatch for {e.id!r}")
             yield e.id, seg
 
     def __len__(self) -> int:
-        return len(self._index)
+        return self.info.num_series
 
 
 def list_fileset_volumes(root, namespace: str, shard: int) -> list[tuple[int, int]]:

@@ -592,7 +592,7 @@ class Database:
         r = DataFileSetReader(
             self.opts.root, namespace, shard, block_start, filesets[block_start]
         )
-        return {e.id: e.checksum for e in r._index}
+        return {e.id: e.checksum for e in r.entries()}
 
     def read_block(self, namespace: str, shard: int, block_start: int):
         """All (series id, encoded stream) pairs of one flushed block;

@@ -102,10 +102,16 @@ class TestScalarTransforms:
         out = tf.increase(Datapoint(0, math.nan), Datapoint(10**9, 7.0))
         assert out.value == 7.0
 
-    def test_reset_emits_zero_one_second_later(self):
+    def test_reset_emits_zero_half_resolution_later(self):
+        # default resolution 1s -> gap 0.5s (unary_multi.go: resolution/2)
         dp, zero = tf.reset(Datapoint(10**9, 5.0))
         assert dp.value == 5.0
-        assert zero.time_nanos == 2 * 10**9 and zero.value == 0.0
+        assert zero.time_nanos == 10**9 + 5 * 10**8 and zero.value == 0.0
+        # explicit resolution; minimum 1ns gap
+        _, zero = tf.reset(Datapoint(10**9, 5.0), 60 * 10**9)
+        assert zero.time_nanos == 10**9 + 30 * 10**9
+        _, zero = tf.reset(Datapoint(10**9, 5.0), 1)
+        assert zero.time_nanos == 10**9 + 1
 
 
 class TestBatchedTransforms:

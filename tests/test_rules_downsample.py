@@ -298,18 +298,60 @@ class TestPipelineTransformTails:
                        (START + 3 * R, 11.0)]
         db.close()
 
-    def test_unsupported_reset_tail_errors_loudly(self, tmp_path):
+    def test_reset_tail_emits_forced_zero(self, tmp_path):
+        """RESET (unary_multi.go transformReset): each window aggregate
+        flushes unchanged PLUS a forced zero half a resolution later,
+        so PromQL rate() sees the delta instead of a cumulative counter
+        during aggregator HA failover."""
         from m3_tpu.metrics.transformation import TransformationType as TT
 
         db = self._db(tmp_path)
         ds = Downsampler(db, _rollup_rule_with_tail(TT.RESET),
                          opts=DownsamplerOptions(capacity=1 << 10,
                                                  timer_sample_capacity=1 << 12))
+        self._write_windows(ds, [[1, 2, 3], [10]])
+        ds.flush(START + 3 * R)
+        pts = db.read(str(SP_10S), b"req.count.by_dc{dc=us}",
+                      START, START + BLOCK)
+        gap = R // 2
+        assert pts == [(START + 1 * R, 6.0), (START + 1 * R + gap, 0.0),
+                       (START + 2 * R, 10.0), (START + 2 * R + gap, 0.0)]
+        db.close()
+
+    def test_reset_must_be_terminal(self, tmp_path):
+        """RESET's forced zero bypasses later transforms, so a
+        non-terminal RESET is rejected at registration, not mis-emitted."""
+        from m3_tpu.metrics.transformation import TransformationType as TT
+
+        db = self._db(tmp_path)
+        ds = Downsampler(db, _rollup_rule_with_tail(TT.RESET, TT.ADD),
+                         opts=DownsamplerOptions(capacity=1 << 10,
+                                                 timer_sample_capacity=1 << 12))
         docs = [Document.from_tags(
             b"req:h0", {b"__name__": b"req.count", b"dc": b"us"})]
-        with pytest.raises(ValueError, match="unsupported pipeline"):
+        with pytest.raises(ValueError, match="RESET must be the last"):
             ds.write_batch(docs, np.full(1, START + 1, np.int64),
                            np.ones(1), metric_type=MetricType.COUNTER)
+        db.close()
+
+    def test_reset_after_add_chain(self, tmp_path):
+        """ADD then RESET — the running sum emits with a forced zero
+        after each point; the zero does not feed back into the ADD
+        state (value passes through RESET unchanged)."""
+        from m3_tpu.metrics.transformation import TransformationType as TT
+
+        db = self._db(tmp_path)
+        ds = Downsampler(db, _rollup_rule_with_tail(TT.ADD, TT.RESET),
+                         opts=DownsamplerOptions(capacity=1 << 10,
+                                                 timer_sample_capacity=1 << 12))
+        self._write_windows(ds, [[6], [2], [3]])
+        ds.flush(START + 4 * R)
+        pts = db.read(str(SP_10S), b"req.count.by_dc{dc=us}",
+                      START, START + BLOCK)
+        gap = R // 2
+        assert pts == [(START + 1 * R, 6.0), (START + 1 * R + gap, 0.0),
+                       (START + 2 * R, 8.0), (START + 2 * R + gap, 0.0),
+                       (START + 3 * R, 11.0), (START + 3 * R + gap, 0.0)]
         db.close()
 
     def test_tail_matches_scalar_oracle(self, tmp_path):
