@@ -29,6 +29,7 @@ lanes by each slot's compressed AggregationID.
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple
 
 import jax
@@ -58,6 +59,67 @@ def raw(jitted):
     """The traceable python function behind a jitted arena op, for
     composing arena ops inside larger jit/shard_map programs."""
     return getattr(jitted, "__wrapped__", jitted)
+
+
+# ---------------------------------------------------------------------------
+# Ingest implementation selection: XLA scatter (default, validated) vs
+# the Pallas binned segment reduction (parallel/pallas_ingest.py).
+# Pallas wins only when slot collisions serialize the scatter AND the
+# flat arena (W*C) is moderate — it streams the batch once per 1024-slot
+# tile; callers flip per deployment after measuring (the TPU bench child
+# records both).  Selected via M3_ARENA_INGEST=pallas|scatter or
+# set_ingest_impl(); the choice binds at TRACE time, so set_ingest_impl
+# clears the arena jit caches — jits composed elsewhere via raw() keep
+# whatever impl they traced with.
+# ---------------------------------------------------------------------------
+
+_INGEST_IMPL = os.environ.get("M3_ARENA_INGEST", "scatter")
+
+
+def ingest_impl() -> str:
+    return _INGEST_IMPL
+
+
+# Jitted programs that COMPOSE raw(ingest) ops and must be re-traced
+# when the impl flips (e.g. parallel/sharded_agg's sharded programs).
+# Modules register theirs via register_ingest_consumer at import time.
+_INGEST_CONSUMERS: list = []
+
+
+def register_ingest_consumer(jitted) -> None:
+    _INGEST_CONSUMERS.append(jitted)
+
+
+def set_ingest_impl(impl: str) -> None:
+    global _INGEST_IMPL
+    if impl not in ("scatter", "pallas"):
+        raise ValueError(f"unknown ingest impl {impl!r}")
+    _INGEST_IMPL = impl
+    for f in (counter_ingest, gauge_ingest, timer_ingest,
+              *_INGEST_CONSUMERS):
+        try:
+            f.clear_cache()
+        except AttributeError:  # raw function or older jax
+            pass
+
+
+def _seg3(sum_col, sq_col, cnt_col, idx, values):
+    """The sum / sum² / count accumulation every arena shares, routed
+    through the configured implementation.  ``idx`` >= len(sum_col)
+    drops (the sentinel contract) on both paths.  The pallas path
+    computes all three lanes in ONE batch sweep
+    (pallas_segment_moments: the hit mask is shared)."""
+    if _INGEST_IMPL == "pallas":
+        from m3_tpu.parallel import pallas_ingest as pi
+
+        n_out = sum_col.shape[0]
+        s, c, sq = pi.segment_moments_chunked(
+            idx.astype(jnp.int32), values, n_out)
+        return (sum_col + s, sq_col + sq,
+                cnt_col + c.astype(cnt_col.dtype))
+    return (sum_col.at[idx].add(values, mode="drop"),
+            sq_col.at[idx].add(values * values, mode="drop"),
+            cnt_col.at[idx].add(1, mode="drop"))
 
 
 def pad_slots(slots: np.ndarray, capacity: int) -> np.ndarray:
@@ -122,10 +184,11 @@ def counter_ingest(
     times: jnp.ndarray,  # i64 (N,)
 ) -> CounterState:
     """Counter.Update for a batch (reference counter.go:53-76)."""
+    s, sq, c = _seg3(state.sum, state.sum_sq, state.count, idx, values)
     return CounterState(
-        sum=state.sum.at[idx].add(values, mode="drop"),
-        sum_sq=state.sum_sq.at[idx].add(values * values, mode="drop"),
-        count=state.count.at[idx].add(1, mode="drop"),
+        sum=s,
+        sum_sq=sq,
+        count=c,
         max=state.max.at[idx].max(values, mode="drop"),
         min=state.min.at[idx].min(values, mode="drop"),
         last_at=state.last_at.at[slots].max(times, mode="drop"),
@@ -265,12 +328,13 @@ def gauge_ingest(
     take = is_winner & (s_times > old_time)
     widx = jnp.where(take, s_idx, state.last.shape[0])  # OOB -> dropped
 
+    g_s, g_sq, g_c = _seg3(state.sum, state.sum_sq, state.count, idx, safe)
     return GaugeState(
         last=state.last.at[widx].set(s_val, mode="drop"),
         last_time=state.last_time.at[widx].set(s_times, mode="drop"),
-        sum=state.sum.at[idx].add(safe, mode="drop"),
-        sum_sq=state.sum_sq.at[idx].add(safe * safe, mode="drop"),
-        count=state.count.at[idx].add(1, mode="drop"),
+        sum=g_s,
+        sum_sq=g_sq,
+        count=g_c,
         max=state.max.at[idx].max(jnp.where(nan, -jnp.inf, values), mode="drop"),
         min=state.min.at[idx].min(jnp.where(nan, jnp.inf, values), mode="drop"),
         last_at=state.last_at.at[slots].max(times, mode="drop"),
@@ -413,10 +477,11 @@ def timer_ingest(
     )
     per_w_counts = jnp.bincount(order_key, length=num_w)
 
+    t_s, t_sq, t_c = _seg3(state.sum, state.sum_sq, state.count, idx, values)
     return TimerState(
-        sum=state.sum.at[idx].add(values, mode="drop"),
-        sum_sq=state.sum_sq.at[idx].add(values * values, mode="drop"),
-        count=state.count.at[idx].add(1, mode="drop"),
+        sum=t_s,
+        sum_sq=t_sq,
+        count=t_c,
         sample_slot=state.sample_slot.ravel()
         .at[flat]
         .set(s_slot, mode="drop")

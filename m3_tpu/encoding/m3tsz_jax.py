@@ -278,7 +278,15 @@ def _track_new_sig(num_sig_st, cur_hl, num_lower, sig):
     shrink = (~grow) & ((num_sig_st - sig) >= _c(3, I32))
     chl = jnp.where(shrink & (num_lower == _c(0, I32)), sig,
                     jnp.where(shrink & (sig > cur_hl), sig, cur_hl))
-    nl = jnp.where(shrink, num_lower + _c(1, I32), _c(0, I32))
+    # The lower-sig streak counter resets only on the NEITHER branch
+    # (within-threshold sig): a GROW step leaves it intact — Go keeps
+    # t.NumLowerSig untouched when numSig > t.NumSig
+    # (int_sig_bits_tracker.go:68-91).  Resetting on grow desynced the
+    # device encoder's shrink timing from the scalar oracle on
+    # grow-interleaved streams (caught by the round-5 bench's
+    # device-vs-native byte-identity stage, 22/2000 series).
+    nl = jnp.where(shrink, num_lower + _c(1, I32),
+                   jnp.where(grow, num_lower, _c(0, I32)))
     fire = shrink & (nl >= _c(5, I32))
     new_sig = jnp.where(fire, chl, new_sig)
     nl = jnp.where(fire, _c(0, I32), nl)

@@ -52,10 +52,16 @@ MAX_BATCH = 1 << 18  # both (npad,) inputs are VMEM-resident per grid step:
                      # arenas already ingest in bounded device batches)
 
 
-def _ingest_kernel(slots_ref, values_ref, out_sum_ref, out_cnt_ref):
+def _ingest_kernel(slots_ref, values_ref, out_sum_ref, out_cnt_ref,
+                   *out_sq_ref):
     """One grid step: accumulate the WHOLE batch into this step's
     1024-slot tile.  slots/values are (N,) in VMEM (same block every
-    step); outputs are (TILE,) blocks of the (C,) accumulators."""
+    step); outputs are (TILE,) blocks of the (C,) accumulators.  When
+    invoked with a third output ref (the moments form), the SAME hit
+    mask also accumulates the sum of squares — one batch sweep serves
+    all three lanes (the arena hot path would otherwise pay the
+    O(N x C/TILE) sweep twice)."""
+    with_sq = bool(out_sq_ref)
     step = pl.program_id(0)
     base = step * TILE
     slots = slots_ref[:]
@@ -69,35 +75,37 @@ def _ingest_kernel(slots_ref, values_ref, out_sum_ref, out_cnt_ref):
     lane_slots = base + jax.lax.broadcasted_iota(jnp.int32, (TILE, 1), 0)
 
     def slab_body(k, acc):
-        s_sum, s_cnt = acc
+        s_sum, s_cnt, s_sq = acc
         lo = k * SLAB
         sl = jax.lax.dynamic_slice(slots, (lo,), (SLAB,))
         va = jax.lax.dynamic_slice(values, (lo,), (SLAB,))
-        hit = sl[None, :] == lane_slots  # (TILE, SLAB) bool
-        s_sum = s_sum + jnp.sum(hit.astype(values.dtype) * va[None, :], axis=1)
+        hitf = (sl[None, :] == lane_slots).astype(values.dtype)  # (TILE, SLAB)
+        hv = hitf * va[None, :]
+        s_sum = s_sum + jnp.sum(hv, axis=1)
+        if with_sq:
+            s_sq = s_sq + jnp.sum(hv * va[None, :], axis=1)
         # counts accumulate in int32 regardless of value dtype: a
         # low-precision value dtype (bf16) would saturate its counts
         # (dtype pinned — x64 mode would promote the sum to int64)
-        s_cnt = s_cnt + jnp.sum(hit, axis=1, dtype=jnp.int32)
-        return s_sum, s_cnt
+        s_cnt = s_cnt + jnp.sum(sl[None, :] == lane_slots, axis=1,
+                                dtype=jnp.int32)
+        return s_sum, s_cnt, s_sq
 
     zero_v = jnp.zeros((TILE,), values.dtype)
     zero_c = jnp.zeros((TILE,), jnp.int32)
-    total, cnt = jax.lax.fori_loop(0, nslabs, slab_body, (zero_v, zero_c))
+    total, cnt, sq = jax.lax.fori_loop(
+        0, nslabs, slab_body, (zero_v, zero_c, zero_v))
     out_sum_ref[:] = total
     out_cnt_ref[:] = cnt
+    if with_sq:
+        out_sq_ref[0][:] = sq
 
 
-@functools.partial(jax.jit, static_argnames=("capacity", "interpret"))
-def pallas_segment_ingest(slots: jnp.ndarray, values: jnp.ndarray,
-                          capacity: int, interpret: bool = False):
-    """Sum + count ``values`` grouped by ``slots`` into (capacity,)
-    accumulators with a Pallas grid over slot tiles.
-
-    ``slots`` out of [0, capacity) are dropped (the arena drop-sentinel
-    contract).  The batch is padded to whole slabs with an
-    out-of-range slot so the kernel needs no tail masking.
-    """
+@functools.partial(jax.jit,
+                   static_argnames=("capacity", "interpret", "with_sq"))
+def _segment_call(slots, values, capacity: int, interpret: bool,
+                  with_sq: bool):
+    """Shared padding + pallas_call for the 2- and 3-output forms."""
     if not HAVE_PALLAS:  # pragma: no cover
         raise RuntimeError("pallas unavailable in this jax build")
     C = capacity
@@ -114,7 +122,18 @@ def pallas_segment_ingest(slots: jnp.ndarray, values: jnp.ndarray,
     values_p = jnp.zeros(npad, values.dtype).at[:n].set(values)
 
     grid = Cpad // TILE
-    out_sum, out_cnt = pl.pallas_call(
+    out_specs = [
+        pl.BlockSpec((TILE,), lambda i: (i,)),
+        pl.BlockSpec((TILE,), lambda i: (i,)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((Cpad,), values.dtype),
+        jax.ShapeDtypeStruct((Cpad,), jnp.int32),
+    ]
+    if with_sq:
+        out_specs.append(pl.BlockSpec((TILE,), lambda i: (i,)))
+        out_shape.append(jax.ShapeDtypeStruct((Cpad,), values.dtype))
+    outs = pl.pallas_call(
         _ingest_kernel,
         grid=(grid,),
         in_specs=[
@@ -122,17 +141,76 @@ def pallas_segment_ingest(slots: jnp.ndarray, values: jnp.ndarray,
             pl.BlockSpec((npad,), lambda i: (0,)),
             pl.BlockSpec((npad,), lambda i: (0,)),
         ],
-        out_specs=[
-            pl.BlockSpec((TILE,), lambda i: (i,)),
-            pl.BlockSpec((TILE,), lambda i: (i,)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((Cpad,), values.dtype),
-            jax.ShapeDtypeStruct((Cpad,), jnp.int32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         interpret=interpret,
     )(slots_p, values_p)
-    return out_sum[:C], out_cnt[:C]
+    return tuple(o[:C] for o in outs)
+
+
+def pallas_segment_ingest(slots: jnp.ndarray, values: jnp.ndarray,
+                          capacity: int, interpret: bool = False):
+    """Sum + count ``values`` grouped by ``slots`` into (capacity,)
+    accumulators with a Pallas grid over slot tiles.
+
+    ``slots`` out of [0, capacity) are dropped (the arena drop-sentinel
+    contract).  The batch is padded to whole slabs with an
+    out-of-range slot so the kernel needs no tail masking.
+    """
+    return _segment_call(slots, values, capacity, interpret, False)
+
+
+def pallas_segment_moments(slots: jnp.ndarray, values: jnp.ndarray,
+                           capacity: int, interpret: bool = False):
+    """(sum, count, sum of squares) in ONE batch sweep — the arena hot
+    path's shape (sum/sum²/count lanes share the hit mask)."""
+    s, c, sq = _segment_call(slots, values, capacity, interpret, True)
+    return s, c, sq
+
+
+def auto_interpret() -> bool:
+    """Pallas runs compiled (Mosaic) only on a real TPU backend;
+    everywhere else the kernel executes in interpret mode — identical
+    semantics (it is plain jnp), orders of magnitude slower, which is
+    why the arenas only flip to pallas by explicit config."""
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
+def segment_ingest_chunked(slots, values, capacity: int,
+                           interpret: bool | None = None):
+    """`pallas_segment_ingest` over arbitrarily large batches: static
+    MAX_BATCH chunks accumulated on device.  Shapes are static under
+    jit, so the chunk loop unrolls at trace time."""
+    if interpret is None:
+        interpret = auto_interpret()
+    n = values.shape[0]
+    s = c = None
+    for lo in range(0, max(n, 1), MAX_BATCH):
+        s1, c1 = pallas_segment_ingest(
+            slots[lo:lo + MAX_BATCH], values[lo:lo + MAX_BATCH],
+            capacity, interpret=interpret)
+        s = s1 if s is None else s + s1
+        c = c1 if c is None else c + c1
+    return s, c
+
+
+def segment_moments_chunked(slots, values, capacity: int,
+                            interpret: bool | None = None):
+    """`pallas_segment_moments` over arbitrarily large batches."""
+    if interpret is None:
+        interpret = auto_interpret()
+    n = values.shape[0]
+    s = c = sq = None
+    for lo in range(0, max(n, 1), MAX_BATCH):
+        s1, c1, q1 = pallas_segment_moments(
+            slots[lo:lo + MAX_BATCH], values[lo:lo + MAX_BATCH],
+            capacity, interpret=interpret)
+        s = s1 if s is None else s + s1
+        c = c1 if c is None else c + c1
+        sq = q1 if sq is None else sq + q1
+    return s, c, sq
 
 
 def xla_segment_ingest(slots, values, capacity: int):

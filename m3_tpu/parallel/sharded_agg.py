@@ -182,3 +182,9 @@ def sharded_ingest_consume(
         out_specs=(shard_spec, out_lane_spec),
         check_vma=False,
     )(state, batch, window)
+
+
+# The sharded program composes raw(ingest) ops, whose scatter-vs-pallas
+# choice binds at trace time — register so arena.set_ingest_impl can
+# invalidate this cache too.
+_arena.register_ingest_consumer(sharded_ingest_consume)

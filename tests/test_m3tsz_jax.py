@@ -40,6 +40,31 @@ def test_encode_batch_bit_exact_vs_oracle():
         assert streams[s] == want, f"series {s} not bit-exact"
 
 
+def test_encode_sig_tracker_grow_keeps_lower_streak():
+    """Regression (round-5 bench device-vs-native byte stage): the sig
+    hysteresis tracker must NOT reset its lower-sig streak counter on a
+    GROW step — Go's TrackNewSig (int_sig_bits_tracker.go:68-91) only
+    resets on the within-threshold branch.  Resetting on grow desynced
+    the shrink timing on grow-interleaved diff streams (22/2000 corpus
+    series encoded valid-but-different bytes)."""
+    # The start of the corpus series that exposed it: 2-decimal gauge
+    # jitter whose scaled diffs alternate 12/13-bit sigs with occasional
+    # small (shrink-eligible) diffs.
+    v = [788.5, 788.3, 781.61, 809.0, 772.39, 737.82, 818.48, 763.77,
+         791.88, 811.21, 780.2, 768.78, 804.75, 749.49, 793.32, 782.65,
+         776.91, 749.03, 772.37, 772.22, 781.1, 821.35, 796.27, 817.2,
+         761.17, 771.68, 795.72, 798.38, 801.82, 773.14, 819.55, 745.29]
+    T = len(v)
+    ts = (START + np.arange(1, T + 1) * 10 * 10**9)[None, :].astype(np.int64)
+    vals = np.asarray(v)[None, :]
+    streams, fb = encode_batch(ts, vals, np.full(1, START, np.int64),
+                               out_words=120)
+    assert not fb.any()
+    want = encode_series(list(zip(ts[0].tolist(), vals[0].tolist())),
+                         start=START)
+    assert streams[0] == want
+
+
 def test_encode_batch_hard_cases():
     T = 120
     rng = np.random.default_rng(3)

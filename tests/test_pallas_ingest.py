@@ -53,3 +53,80 @@ def test_empty_batch():
     ps, pc = pallas_segment_ingest(jnp.zeros(0, jnp.int32),
                                    jnp.zeros(0), 64, interpret=True)
     assert float(ps.sum()) == 0.0 and float(pc.sum()) == 0.0
+
+
+def test_chunked_matches_single(monkeypatch):
+    """Crosses REAL chunk boundaries: MAX_BATCH is shrunk so the 7000-
+    point batch spans 4 chunks (a cross-chunk accumulation bug would
+    otherwise only surface on >262144-point production ingests)."""
+    from m3_tpu.parallel import pallas_ingest as pi
+
+    monkeypatch.setattr(pi, "MAX_BATCH", 2048)
+    rng = np.random.default_rng(9)
+    N, C = 7000, 256
+    slots = rng.integers(0, C, N).astype(np.int32)
+    vals = rng.normal(0, 5, N)
+    cs, cc = pi.segment_ingest_chunked(jnp.asarray(slots),
+                                       jnp.asarray(vals), C, interpret=True)
+    xs, xc = xla_segment_ingest(jnp.asarray(slots), jnp.asarray(vals), C)
+    np.testing.assert_allclose(np.asarray(cs), np.asarray(xs), atol=1e-9)
+    np.testing.assert_array_equal(np.asarray(cc), np.asarray(xc))
+    ms, mc, msq = pi.segment_moments_chunked(
+        jnp.asarray(slots), jnp.asarray(vals), C, interpret=True)
+    xsq, _ = xla_segment_ingest(jnp.asarray(slots),
+                                jnp.asarray(vals) ** 2, C)
+    np.testing.assert_allclose(np.asarray(ms), np.asarray(xs), atol=1e-9)
+    np.testing.assert_array_equal(np.asarray(mc), np.asarray(xc))
+    np.testing.assert_allclose(np.asarray(msq), np.asarray(xsq), atol=1e-9)
+
+
+class TestArenaIngestImplFlip:
+    """The production hook: M3_ARENA_INGEST / arena.set_ingest_impl
+    flips the arenas' sum/sum²/count lanes to the Pallas kernel;
+    results must be identical to the scatter default (interpret mode
+    pins semantics on CPU; the TPU bench child measures both)."""
+
+    def _drive(self):
+        from m3_tpu.aggregator import arena
+
+        W, C, N = 2, 512, 4096
+        rng = np.random.default_rng(4)
+        windows = jnp.asarray(rng.integers(0, W, N).astype(np.int32))
+        slots = jnp.asarray(rng.integers(0, C, N).astype(np.int32))
+        idx = arena.flat_window_index(windows, slots, W, C)
+        times = jnp.asarray(1_000 + np.arange(N, dtype=np.int64))
+
+        cvals = jnp.asarray(rng.integers(-50, 1000, N, np.int64))
+        cs = arena.counter_ingest(arena.counter_init(W, C), idx, slots,
+                                  cvals, times)
+        gvals = np.round(rng.normal(0, 10, N), 4)
+        gvals[:7] = np.nan  # NaN: counted, not summed
+        gs = arena.gauge_ingest(arena.gauge_init(W, C), idx, slots,
+                                jnp.asarray(gvals), times)
+        tvals = jnp.asarray(np.round(rng.gamma(2.0, 5.0, N), 4))
+        ts = arena.timer_ingest(arena.timer_init(W, C, 1 << 13), windows,
+                                slots, tvals, times, C)
+        return cs, gs, ts
+
+    def test_pallas_impl_matches_scatter(self):
+        from m3_tpu.aggregator import arena
+
+        assert arena.ingest_impl() == "scatter"
+        base = self._drive()
+        arena.set_ingest_impl("pallas")
+        try:
+            flip = self._drive()
+        finally:
+            arena.set_ingest_impl("scatter")
+        for b, f in zip(base, flip):
+            for name in b._fields:
+                np.testing.assert_allclose(
+                    np.asarray(getattr(b, name)),
+                    np.asarray(getattr(f, name)),
+                    atol=1e-9, err_msg=f"{type(b).__name__}.{name}")
+
+    def test_unknown_impl_rejected(self):
+        from m3_tpu.aggregator import arena
+
+        with pytest.raises(ValueError, match="unknown ingest impl"):
+            arena.set_ingest_impl("magic")
