@@ -575,11 +575,12 @@ def _run_promql_bench(G: int, B: int, platform: str) -> dict:
     vals = vals.reshape(G, B, P).cumsum(axis=1).reshape(S, P)
     counts = np.full(S, P, np.int64)
 
-    ub_labels = [b"0.005", b"0.05", b"0.5", b"1", b"2.5", b"5", b"10",
-                 b"+Inf"][:B - 1] + [b"+Inf"]
-    ub_labels = ub_labels[:B]
-    if len(ub_labels) < B or ub_labels[-1] != b"+Inf":
-        raise ValueError("bucket label table too small")
+    finite_ubs = [b"0.005", b"0.05", b"0.5", b"1", b"2.5", b"5", b"10"]
+    if B - 1 > len(finite_ubs):
+        raise ValueError(
+            f"bucket count {B} needs {B - 1} finite bounds; table has "
+            f"{len(finite_ubs)}")
+    ub_labels = finite_ubs[:B - 1] + [b"+Inf"]
     series = [
         SeriesMeta(((b"__name__", b"m3_req_bucket"),
                     (b"group", b"g%06d" % g), (b"le", ub_labels[b])))

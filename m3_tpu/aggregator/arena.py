@@ -73,7 +73,12 @@ def raw(jitted):
 # whatever impl they traced with.
 # ---------------------------------------------------------------------------
 
-_INGEST_IMPL = os.environ.get("M3_ARENA_INGEST", "scatter")
+_INGEST_IMPL = os.environ.get("M3_ARENA_INGEST", "scatter").strip().lower()
+if _INGEST_IMPL not in ("scatter", "pallas"):
+    raise ValueError(
+        f"M3_ARENA_INGEST={_INGEST_IMPL!r}: must be 'scatter' or 'pallas' "
+        "(a typo silently running scatter would invalidate the very "
+        "measurement the flag exists to apply)")
 
 
 def ingest_impl() -> str:

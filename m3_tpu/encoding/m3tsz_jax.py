@@ -1043,20 +1043,33 @@ def _decode_step(carry, _, words3, nbits, default_unit: int):
         nxt = jnp.take_along_axis(
             words3, bnext[:, None, None].astype(jnp.int32), axis=1)[:, 0]
         shifted = jnp.concatenate([win[:, _BLK_WORDS:], nxt], axis=1)
-        # Jump path (annotation skip): reload [tb, tb+1] from scratch.
-        tb = new_cursor // _c(_BLK_WORDS * 64, I32)
-        lo = jnp.take_along_axis(
-            words3, jnp.clip(tb, 0, NB)[:, None, None].astype(jnp.int32),
-            axis=1)[:, 0]
-        hi = jnp.take_along_axis(
-            words3, jnp.clip(tb + 1, 0, NB)[:, None, None].astype(jnp.int32),
-            axis=1)[:, 0]
-        reload = jnp.concatenate([lo, hi], axis=1)
-        win = jnp.where(need_jump[:, None], reload,
-                        jnp.where(need_shift[:, None], shifted, win))
-        bk = jnp.where(need_jump, tb,
-                       jnp.where(need_shift, bk + _c(1, I32), bk))
-        return win, bk
+        win = jnp.where(need_shift[:, None], shifted, win)
+        bk = jnp.where(need_shift, bk + _c(1, I32), bk)
+
+        # Jump path (annotation skip may leave the window entirely):
+        # reload [tb, tb+1] from scratch.  Split behind its OWN scalar
+        # cond: at large S the outer cond fires nearly every step
+        # (P[any lane shifts] -> 1), but jumps exist only on
+        # annotation-carrying streams — the common corpus should not
+        # pay the two reload gathers and extra (S, WIN) select per
+        # step (profiling round 5: the refill layer dominates the
+        # decode scan on XLA-CPU at S=10K).
+        def _jump(ops2):
+            w2, b2 = ops2
+            tb = new_cursor // _c(_BLK_WORDS * 64, I32)
+            lo = jnp.take_along_axis(
+                words3, jnp.clip(tb, 0, NB)[:, None, None].astype(jnp.int32),
+                axis=1)[:, 0]
+            hi = jnp.take_along_axis(
+                words3,
+                jnp.clip(tb + 1, 0, NB)[:, None, None].astype(jnp.int32),
+                axis=1)[:, 0]
+            reload = jnp.concatenate([lo, hi], axis=1)
+            w2 = jnp.where(need_jump[:, None], reload, w2)
+            b2 = jnp.where(need_jump, tb, b2)
+            return w2, b2
+
+        return lax.cond(jnp.any(need_jump), _jump, lambda o: o, (win, bk))
 
     window, blk = lax.cond(jnp.any(need_shift | need_jump), _refill,
                            lambda ops: ops, (window, blk))

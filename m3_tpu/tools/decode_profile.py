@@ -61,13 +61,18 @@ U64 = mj.U64
 _BLKBITS = mj._BLK_WORDS * 64
 
 
-def _corpus(S: int, T: int, seed: int = 42):
-    rng = np.random.default_rng(seed)
-    start = 1_600_000_000 * 10**9
-    ts = np.tile(start + np.arange(1, T + 1) * 10 * 10**9, (S, 1)).astype(np.int64)
-    base = rng.uniform(10, 1000, (S, 1))
-    vals = np.round(base + rng.normal(0, base * 0.05, (S, T)), 2)
-    return ts, vals, np.full(S, start, np.int64)
+def _corpus(S: int, T: int):
+    """THE bench corpus: the attribution must decompose the exact
+    workload bench.py measures, so the generator is imported, not
+    copied (a drifted copy would explain a different dp/s number)."""
+    try:
+        import bench
+    except ImportError as exc:
+        raise RuntimeError(
+            "decode_profile must run with the repo root on sys.path "
+            "(e.g. `python -m m3_tpu.tools.decode_profile` from /root/repo) "
+            "so it can share bench.py's corpus generator") from exc
+    return bench._make_corpus(S, T)
 
 
 def _encode(S: int, T: int):
@@ -135,6 +140,11 @@ def _proxy_scan(words3, window0, advances, mode: str):
                 new_rel < mj._c(2 * _BLKBITS, I32))
             need_jump = new_rel >= mj._c(2 * _BLKBITS, I32)
 
+            # Mirrors the production decoder's refill EXACTLY, including
+            # the round-5 jump split: the jump reload sits behind its
+            # own scalar cond, so an annotation-free corpus (this
+            # tool's) never pays the reload gathers — a proxy that kept
+            # the pre-split combined refill would overstate the layer.
             def _refill(ops):
                 win, bk = ops
                 NB = words3.shape[1] - 1
@@ -144,22 +154,34 @@ def _proxy_scan(words3, window0, advances, mode: str):
                     axis=1)[:, 0]
                 shifted = jnp.concatenate([win[:, mj._BLK_WORDS:], nxt],
                                           axis=1)
-                tb = new_cursor // mj._c(_BLKBITS, I32)
-                lo = jnp.take_along_axis(
-                    words3, jnp.clip(tb, 0, NB)[:, None, None]
-                    .astype(jnp.int32), axis=1)[:, 0]
-                hi = jnp.take_along_axis(
-                    words3, jnp.clip(tb + 1, 0, NB)[:, None, None]
-                    .astype(jnp.int32), axis=1)[:, 0]
-                reload = jnp.concatenate([lo, hi], axis=1)
-                win = jnp.where(need_jump[:, None], reload,
-                                jnp.where(need_shift[:, None], shifted, win))
-                bk = jnp.where(need_jump, tb,
-                               jnp.where(need_shift, bk + mj._c(1, I32), bk))
-                return win, bk
+                win = jnp.where(need_shift[:, None], shifted, win)
+                bk = jnp.where(need_shift, bk + mj._c(1, I32), bk)
+
+                def _jump(ops2):
+                    w2, b2 = ops2
+                    tb = new_cursor // mj._c(_BLKBITS, I32)
+                    lo = jnp.take_along_axis(
+                        words3, jnp.clip(tb, 0, NB)[:, None, None]
+                        .astype(jnp.int32), axis=1)[:, 0]
+                    hi = jnp.take_along_axis(
+                        words3, jnp.clip(tb + 1, 0, NB)[:, None, None]
+                        .astype(jnp.int32), axis=1)[:, 0]
+                    reload = jnp.concatenate([lo, hi], axis=1)
+                    w2 = jnp.where(need_jump[:, None], reload, w2)
+                    b2 = jnp.where(need_jump, tb, b2)
+                    return w2, b2
+
+                return lax.cond(jnp.any(need_jump), _jump, lambda o: o,
+                                (win, bk))
 
             window, blk = lax.cond(jnp.any(need_shift | need_jump),
                                    _refill, lambda ops: ops, (window, blk))
+            # Keep the refill chain live through the carried
+            # accumulator (a per-step use, like the real decoder's
+            # reads) — WITHOUT adding the window to the scan outputs,
+            # which would break scan buffer reuse and overstate the
+            # refill layer.
+            acc = acc ^ window[:, 0]
         return (new_cursor, window, blk, acc), None
 
     carry, _ = lax.scan(body, carry0, advances)
@@ -249,6 +271,45 @@ def profile(S: int, T: int) -> dict:
         out["native_cpp_dps"] = round(S * T / (time.perf_counter() - t0))
     except Exception:
         pass
+
+    # Structural op counts: the formulation executes EVERY lane through
+    # EVERY branch (branchless SIMD), so ops-per-datapoint × lanes is
+    # the compute the backend must sustain — the C++ decoder takes only
+    # the ~100 ops of the branch each point actually needs.
+    def _count(j):
+        n = 0
+        for e in j.eqns:
+            n += 1
+            for v in e.params.values():
+                if hasattr(v, "jaxpr"):
+                    n += _count(v.jaxpr)
+        return n
+
+    try:
+        Wp = words.shape[1]
+        NB = -(-Wp // mj._BLK_WORDS)
+        w3 = jnp.zeros((S, NB + 1, mj._BLK_WORDS), U64)
+        carry0 = (
+            jnp.zeros(S, I32), jnp.zeros(S, jnp.bool_),
+            jnp.zeros(S, jnp.bool_), jnp.zeros(S, jnp.bool_),
+            jnp.ones(S, jnp.bool_), jnp.ones(S, jnp.bool_),
+            jnp.zeros(S, jnp.bool_), jnp.zeros(S, mj.I64),
+            jnp.zeros(S, mj.I64), jnp.zeros(S, I32), jnp.zeros(S, U64),
+            jnp.zeros(S, U64), jnp.zeros(S, mj.I64), jnp.zeros(S, I32),
+            jnp.zeros(S, I32), jnp.zeros(S, jnp.bool_),
+            jnp.zeros((S, mj._WIN_WORDS), U64), jnp.zeros(S, I32),
+        )
+        dstep = functools.partial(mj._decode_step, words3=w3,
+                                  nbits=nbits.astype(I32), default_unit=1)
+        jx = jax.make_jaxpr(dstep)(carry0, None)
+        ops = _count(jx.jaxpr)
+        out["step_ops"] = ops
+        out["element_ops_per_datapoint"] = ops
+        t_full = out["seconds"]["full"]
+        out["sustained_element_ops_per_sec"] = round(
+            ops * S * max_points / t_full)
+    except Exception as exc:  # noqa: BLE001 — analysis is best-effort
+        out["step_ops_error"] = f"{type(exc).__name__}: {exc}"
     return out
 
 
