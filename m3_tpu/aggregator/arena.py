@@ -73,7 +73,8 @@ def raw(jitted):
 # whatever impl they traced with.
 # ---------------------------------------------------------------------------
 
-_INGEST_IMPL = os.environ.get("M3_ARENA_INGEST", "scatter").strip().lower()
+_INGEST_IMPL = (os.environ.get("M3_ARENA_INGEST", "").strip().lower()
+                or "scatter")
 if _INGEST_IMPL not in ("scatter", "pallas"):
     raise ValueError(
         f"M3_ARENA_INGEST={_INGEST_IMPL!r}: must be 'scatter' or 'pallas' "

@@ -956,12 +956,22 @@ class AggregatorShard:
                             ml.add_timed_batch(mt, ids2, values[sel2],
                                                times[sel2], agg_id)
         if not accepted.all():
-            # Count cross-policy rejects on every list that did not see
-            # them in its own add (pre-checked ones never reached it).
+            # Count each window-rejected sample exactly ONCE, on the
+            # first list that classifies it out-of-range (pre-checked
+            # samples never reached any list's own add) — counters()
+            # sums across lists, so per-list mirroring would report one
+            # reject per agreeing policy.
+            rej_times = times[~accepted]
+            remaining = np.ones(len(rej_times), bool)
             for ml in lists:
-                early, future = ml.timed_check(times[~accepted])
-                ml.timed_rejects["too_early"] += int(early.sum())
-                ml.timed_rejects["too_far_future"] += int(future.sum())
+                early, future = ml.timed_check(rej_times)
+                e = early & remaining
+                f = future & remaining & ~e
+                ml.timed_rejects["too_early"] += int(e.sum())
+                ml.timed_rejects["too_far_future"] += int(f.sum())
+                remaining &= ~(early | future)
+                if not remaining.any():
+                    break
         return accepted
 
     def consume(self, target_nanos: int, flush_handler=None,
@@ -1098,5 +1108,49 @@ class Aggregator:
             out.extend(sh.consume(target_nanos, flush_handler,
                                   forward_sink=self._route_forwards))
         return out
+
+    def counters(self) -> dict:
+        """Operational-counter snapshot summed across every shard's
+        lists (reference aggregator metrics scope, aggregator.go:101 /
+        entry.go reject counters).  ``forward_errors`` is the
+        forwarded-tail conflict / undeliverable count — silent-loss
+        edges must be visible on /metrics and the admin status API, not
+        only as in-process ints."""
+        out = {
+            "drops": 0,
+            "forward_errors": 0,
+            "timed_rejects_too_early": 0,
+            "timed_rejects_too_far_future": 0,
+            "new_series_rejected": 0,
+            "passthrough_samples": self.passthrough_samples,
+        }
+        for sh in self.shards:
+            for ml in sh.lists.values():
+                out["drops"] += ml.drops
+                out["forward_errors"] += ml.forward_errors
+                out["timed_rejects_too_early"] += (
+                    ml.timed_rejects["too_early"])
+                out["timed_rejects_too_far_future"] += (
+                    ml.timed_rejects["too_far_future"])
+                out["new_series_rejected"] += ml.new_series_rejected
+        return out
+
+
+def instrument_aggregator(instrument, aggregator: "Aggregator"):
+    """Mirror the aggregator's counters into gauges under
+    ``<scope>.aggregator.*`` at every registry scrape (snapshot /
+    render_prometheus), via the registry's collector hook — so the
+    forwarded-tail conflict counter and friends land on /metrics
+    without a polling thread.  Returns the collector fn; pass it to
+    ``registry.unregister_collector`` at shutdown (the registry holds
+    a strong reference to the aggregator through it)."""
+    scope = instrument.scope("aggregator")
+
+    def collect():
+        for name, v in aggregator.counters().items():
+            scope.gauge(name).update(v)
+
+    scope.registry.register_collector(collect)
+    return collect
 
 

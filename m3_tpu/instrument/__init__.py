@@ -132,6 +132,37 @@ class Registry:
         self._counters: Dict[Tuple[str, _TagKey], Counter] = {}
         self._gauges: Dict[Tuple[str, _TagKey], Gauge] = {}
         self._timers: Dict[Tuple[str, _TagKey], Timer] = {}
+        # Scrape-time collectors: callables invoked before every
+        # snapshot/exposition so components whose counters live outside
+        # the registry (e.g. the aggregator engine's plain-int reject /
+        # forward-error counts) can mirror fresh values into gauges —
+        # the role of tally's cached-gauge Collect hooks.
+        self._collectors: list = []
+
+    def register_collector(self, fn) -> None:
+        """Register fn() to run at the top of snapshot()/
+        render_prometheus().  A raising collector is dropped from the
+        scrape (never poisons /metrics) but re-tried next time.
+        Components with a shutdown path must unregister_collector —
+        the registry holds a strong reference."""
+        with self._lock:
+            self._collectors.append(fn)
+
+    def unregister_collector(self, fn) -> None:
+        with self._lock:
+            try:
+                self._collectors.remove(fn)
+            except ValueError:
+                pass
+
+    def _collect(self) -> None:
+        with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                fn()
+            except Exception:
+                pass
 
     def _get(self, store: dict, name: str, tags: _TagKey, factory):
         with self._lock:
@@ -144,6 +175,7 @@ class Registry:
 
     def snapshot(self) -> dict:
         """{metric_name: value-or-summary} with tags rendered inline."""
+        self._collect()
         out = {}
         with self._lock:
             counters = dict(self._counters)
@@ -159,6 +191,7 @@ class Registry:
 
     def render_prometheus(self) -> str:
         """Prometheus text exposition (the /metrics payload)."""
+        self._collect()
         lines = []
         with self._lock:
             counters = dict(self._counters)

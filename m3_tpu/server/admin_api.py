@@ -52,12 +52,13 @@ def _parse_dur_nanos(s) -> int:
 
 
 class AdminContext:
-    def __init__(self, kv: KVStore, db=None):
+    def __init__(self, kv: KVStore, db=None, aggregator=None):
         self.kv = kv
         self.namespaces = NamespaceRegistry(kv)
         self.placements = PlacementService(kv)
         self.topics = TopicService(kv)
         self.runtime = RuntimeOptionsManager(kv)
+        self.aggregator = aggregator
         if db is not None:
             self.namespaces.attach(db)
 
@@ -106,6 +107,16 @@ class _AdminHandler(BaseHTTPRequestHandler):
                 return self._json(200, json.loads(t.to_json()))
             if path == "/api/v1/runtime":
                 return self._json(200, self.ctx.runtime.snapshot())
+            if path == "/api/v1/aggregator/status":
+                # Engine operational counters incl. forwarded-tail
+                # conflicts (the reference aggregator httpd's /status
+                # role) — a silent-drop edge must be auditable from
+                # outside the process.
+                if self.ctx.aggregator is None:
+                    return self._json(
+                        404, {"error": "no aggregator in this process"})
+                return self._json(
+                    200, {"counters": self.ctx.aggregator.counters()})
             return self._json(404, {"error": f"unknown path {path}"})
         except Exception as e:  # noqa: BLE001 — API boundary
             return self._json(400, {"error": str(e)})

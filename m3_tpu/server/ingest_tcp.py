@@ -129,12 +129,40 @@ class IngestServer(socketserver.ThreadingTCPServer):
     daemon_threads = True
 
     def __init__(self, sink, host: str = "127.0.0.1", port: int = 0,
-                 instrument=None):
+                 instrument=None, aggregator=None):
         self.sink = sink
         self.scope = (
             instrument.scope("ingest_tcp") if instrument is not None else None
         )
+        self._agg_collector = None
+        self._registry = (
+            instrument.registry if instrument is not None else None)
         super().__init__((host, port), _IngestHandler)
+        if instrument is not None and aggregator is not None:
+            # Surface the engine's plain-int counters (forwarded-tail
+            # conflicts, timed rejects, series-limit rejects) on this
+            # process's /metrics at scrape time.  After bind — a
+            # failed construction must not leak the collector.
+            from m3_tpu.aggregator.engine import instrument_aggregator
+
+            self._agg_collector = instrument_aggregator(
+                instrument, aggregator)
+
+    def _drop_collector(self):
+        if self._agg_collector is not None and self._registry is not None:
+            self._registry.unregister_collector(self._agg_collector)
+            self._agg_collector = None
+
+    def shutdown(self):
+        # Every call site stops via shutdown() (server_close is rarer):
+        # drop the collector on either path, or the registry pins this
+        # server's aggregator and scrapes it forever.
+        self._drop_collector()
+        super().shutdown()
+
+    def server_close(self):
+        self._drop_collector()
+        super().server_close()
 
     @property
     def port(self) -> int:
@@ -142,8 +170,8 @@ class IngestServer(socketserver.ThreadingTCPServer):
 
 
 def serve_ingest_background(sink, host: str = "127.0.0.1", port: int = 0,
-                            instrument=None) -> IngestServer:
-    srv = IngestServer(sink, host, port, instrument)
+                            instrument=None, aggregator=None) -> IngestServer:
+    srv = IngestServer(sink, host, port, instrument, aggregator)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     return srv
