@@ -50,6 +50,13 @@ RELAY_PORT = int(os.environ.get("M3_AXON_RELAY_PORT", "8113"))
 
 _DEADLINE = time.monotonic() + float(os.environ.get("M3_BENCH_DEADLINE_SEC", "780"))
 
+# Persistent XLA compilation cache, shared by parent + children across
+# runs on this machine: the TPU PromQL stage alone compiles for ~7min
+# cold, which is most of the default deadline.  A warmed cache turns the
+# budgeted driver run into measurement, not compilation.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/m3_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
 
 def _log(*a) -> None:
     print("[bench]", *a, file=sys.stderr, flush=True)
@@ -395,16 +402,31 @@ def _run_agg_bench(kind: str, C: int, N: int, NT: int, platform: str) -> dict:
         cstate, gstate = step(cstate, gstate, *args)  # compile + warm
         drain_out = drain(cstate, gstate)
         jax.block_until_ready(drain_out)
+        done = 1  # ingests already applied to the live state
         t0 = time.perf_counter()
         for _ in range(reps):
             cstate, gstate = step(cstate, gstate, *args)
         checks = drain(cstate, gstate)
         jax.block_until_ready(checks)
         dev_s = time.perf_counter() - t0
-        # Counts must equal exactly: (reps+1) ingests of N samples x 2
-        # metric types; integer lanes are exact on device.
+        done += reps
+        if dev_s < 0.5 and _left() > 60:
+            # Steps this fast are dominated by per-dispatch latency at
+            # reps=4 (the relay round-trip alone can be ~ms); re-time
+            # over enough reps to fill ~2s of device work.
+            reps = min(2000, max(reps, int(reps * 2.0 / max(dev_s, 1e-4))))
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                cstate, gstate = step(cstate, gstate, *args)
+            checks = drain(cstate, gstate)
+            jax.block_until_ready(checks)
+            dev_s = time.perf_counter() - t0
+            done += reps
+        # Counts must equal exactly: every ingest applied to the live
+        # state x N samples x 2 metric types; integer lanes are exact
+        # on device.
         total_counts = float(checks[2]) + float(checks[3])
-        count_ok = total_counts == 2.0 * (reps + 1) * N
+        count_ok = total_counts == 2.0 * done * N
         dev_rate = reps * 2 * N / dev_s
 
         out = {"samples_per_sec": round(dev_rate), "C": C, "N": N,

@@ -466,22 +466,23 @@ def timer_ingest(
     oob = (windows < 0) | (windows >= num_w)
     idx = jnp.where(oob, num_w * capacity, idx)
 
-    # Rank of each sample within its window for this batch: sort by
-    # window, rank = position - first-position-of-window.
-    n = values.shape[0]
+    # Rank of each sample within its window for this batch.  Buffer
+    # order is irrelevant (consume lex-sorts the whole window at
+    # drain), so ranks come from one exclusive cumsum per window over
+    # the membership mask — W is small and static, and this avoids
+    # carrying the f64 value column through a device sort (f64 compute
+    # is software-emulated on TPU; the sort was the ingest hot spot).
     order_key = jnp.where(oob, num_w, windows)
-    s_w, s_slot, s_val = jax.lax.sort(
-        (order_key, slots, values), num_keys=1
-    )
-    pos = jnp.arange(n, dtype=jnp.int64)
-    first_of_w = jnp.searchsorted(s_w, s_w, side="left")
-    rank = pos - first_of_w
-    base = state.sample_n[jnp.clip(s_w, 0, num_w - 1)]
+    onehot = order_key[None, :] == jnp.arange(num_w, dtype=order_key.dtype)[:, None]
+    ranks_all = jnp.cumsum(onehot.astype(jnp.int64), axis=1) - 1  # (W, N)
+    w_clip = jnp.clip(order_key, 0, num_w - 1)
+    rank = jnp.take_along_axis(ranks_all, w_clip[None, :], axis=0)[0]
+    base = state.sample_n[w_clip]
     dst = base + rank
     flat = jnp.where(
-        (s_w < num_w) & (dst < scap), s_w.astype(jnp.int64) * scap + dst, num_w * scap
+        ~oob & (dst < scap), w_clip.astype(jnp.int64) * scap + dst, num_w * scap
     )
-    per_w_counts = jnp.bincount(order_key, length=num_w)
+    per_w_counts = onehot.sum(axis=1, dtype=state.sample_n.dtype)
 
     t_s, t_sq, t_c = _seg3(state.sum, state.sum_sq, state.count, idx, values)
     return TimerState(
@@ -490,11 +491,11 @@ def timer_ingest(
         count=t_c,
         sample_slot=state.sample_slot.ravel()
         .at[flat]
-        .set(s_slot, mode="drop")
+        .set(slots, mode="drop")
         .reshape(num_w, scap),
         sample_val=state.sample_val.ravel()
         .at[flat]
-        .set(s_val, mode="drop")
+        .set(values, mode="drop")
         .reshape(num_w, scap),
         sample_n=state.sample_n + per_w_counts,
         last_at=state.last_at.at[slots].max(times, mode="drop"),

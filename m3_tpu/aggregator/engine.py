@@ -933,6 +933,7 @@ class AggregatorShard:
         for ml in lists:
             early, future = ml.timed_check(times)
             accepted &= ~(early | future)
+        pre_rejected = ~accepted  # rejected before any list's own add
         sel = np.nonzero(accepted)[0]
         if sel.size:
             ids_sel = [ids[i] for i in sel]
@@ -955,13 +956,15 @@ class AggregatorShard:
                         for ml in lists[1:]:
                             ml.add_timed_batch(mt, ids2, values[sel2],
                                                times[sel2], agg_id)
-        if not accepted.all():
-            # Count each window-rejected sample exactly ONCE, on the
-            # first list that classifies it out-of-range (pre-checked
-            # samples never reached any list's own add) — counters()
+        if pre_rejected.any():
+            # Count each PRE-CHECK-rejected sample exactly ONCE, on the
+            # first list that classifies it out-of-range — counters()
             # sums across lists, so per-list mirroring would report one
-            # reject per agreeing policy.
-            rej_times = times[~accepted]
+            # reject per agreeing policy.  Samples the first list
+            # rejected in its own add (ring seeded from the batch when
+            # now_nanos is None, or series-limited) were already
+            # counted there and never reached the followers.
+            rej_times = times[pre_rejected]
             remaining = np.ones(len(rej_times), bool)
             for ml in lists:
                 early, future = ml.timed_check(rej_times)

@@ -490,3 +490,24 @@ class TestForwardedWire:
             np.asarray([T0 - 3600 * 10**9]), now_nanos=now)
         assert not acc[0]
         assert agg.counters()["timed_rejects_too_early"] == 1
+
+    def test_timed_reject_counts_once_when_ring_seeds_from_batch(self):
+        """With now_nanos=None the first list seeds its ring from the
+        batch and rejects out-of-range samples in its OWN add — the
+        shard-level mirror loop must not count those a second time."""
+        from m3_tpu.aggregator.engine import Aggregator, AggregatorOptions
+        from m3_tpu.metrics.policy import StoragePolicy
+        from m3_tpu.metrics.types import MetricType
+
+        agg = Aggregator(opts=AggregatorOptions(
+            capacity=64, num_windows=4, timer_sample_capacity=1 << 10,
+            storage_policies=(StoragePolicy.parse("10s:2d"),)))
+        acc = agg.add_timed_batch(
+            MetricType.GAUGE, [b"a", b"b"], np.asarray([1.0, 2.0]),
+            np.asarray([T0, T0 - 3600 * 10**9]))
+        # The batch minimum seeds the ring: the ancient sample anchors
+        # it and is accepted; T0 lands an hour past the ring.
+        assert not acc[0] and acc[1]
+        c = agg.counters()
+        assert (c["timed_rejects_too_early"]
+                + c["timed_rejects_too_far_future"]) == 1
