@@ -61,24 +61,27 @@ def _group_quantile_kernel(values, idx, mask, q):
     s = jnp.sort(big, axis=1)  # present values first, inf after
     n = present.sum(axis=1)  # (G, T)
     # rank into the sorted axis: h = q*(n-1); linear interp between floor/ceil
-    h = q * (n - 1).astype(jnp.float64)
+    h = q * (n - 1).astype(values.dtype)
     lo = jnp.clip(jnp.floor(h).astype(jnp.int32), 0, s.shape[1] - 1)
     hi = jnp.clip(jnp.ceil(h).astype(jnp.int32), 0, s.shape[1] - 1)
     v_lo = jnp.take_along_axis(s, lo[:, None, :], axis=1)[:, 0, :]
     v_hi = jnp.take_along_axis(s, hi[:, None, :], axis=1)[:, 0, :]
     frac = h - jnp.floor(h)
     out = v_lo + (v_hi - v_lo) * frac
-    return jnp.where(n > 0, out, jnp.float64(NAN))
+    return jnp.where(n > 0, out, jnp.nan)
 
 
 def group_quantile(values: np.ndarray, gids: np.ndarray, num_groups: int,
                    q: float) -> np.ndarray:
+    from m3_tpu.query import precision
+
+    dt = precision.compute_dtype()
     idx, mask = group_plan(gids, num_groups)
     return np.asarray(
         _group_quantile_kernel(
-            jnp.asarray(values), jnp.asarray(idx), jnp.asarray(mask),
-            jnp.float64(q),
-        )
+            jnp.asarray(values, dt), jnp.asarray(idx), jnp.asarray(mask),
+            jnp.asarray(q, dt),
+        ), np.float64
     )
 
 
@@ -166,7 +169,7 @@ def _histogram_quantile_kernel(values, idx, nbuckets, ubs, q):
     )
     val = jnp.where(jnp.isinf(b_hi), highest_finite, val)
     bad = (total == 0) | jnp.isnan(total)
-    return jnp.where(bad, jnp.float64(NAN), val)
+    return jnp.where(bad, jnp.nan, val)
 
 
 def histogram_quantile_groups(values: np.ndarray, group_rows: list,
@@ -182,11 +185,14 @@ def histogram_quantile_groups(values: np.ndarray, group_rows: list,
         idx[g, : len(rows)] = rows
         ubs[g, : len(u)] = u
         nb[g] = len(rows)
+    from m3_tpu.query import precision
+
+    dt = precision.compute_dtype()
     return np.asarray(
         _histogram_quantile_kernel(
-            jnp.asarray(values), jnp.asarray(idx), jnp.asarray(nb),
-            jnp.asarray(ubs), jnp.float64(q),
-        )
+            jnp.asarray(values, dt), jnp.asarray(idx), jnp.asarray(nb),
+            jnp.asarray(ubs, dt), jnp.asarray(q, dt),
+        ), np.float64
     )
 
 
@@ -205,17 +211,26 @@ def _vector_binary_kernel(lv, rv, op: str, bool_mode: bool):
         "==": jnp.equal, "!=": jnp.not_equal, ">": jnp.greater,
         "<": jnp.less, ">=": jnp.greater_equal, "<=": jnp.less_equal,
     }
-    out = ops[op](lv, rv).astype(jnp.float64)
+    out = ops[op](lv, rv).astype(lv.dtype)
     if op in COMPARISONS and not bool_mode:
-        out = jnp.where(out != 0, lv, jnp.float64(NAN))
+        out = jnp.where(out != 0, lv, jnp.nan)
     miss = jnp.isnan(lv) | jnp.isnan(rv)
-    return jnp.where(miss, jnp.float64(NAN), out)
+    return jnp.where(miss, jnp.nan, out)
 
 
 def vector_binary_matched(l_values: np.ndarray, r_values: np.ndarray,
                           rows_l, rows_r, op: str,
                           bool_mode: bool) -> np.ndarray:
-    """Gather matched rows on device and apply the op in one kernel."""
-    lv = jnp.asarray(l_values)[jnp.asarray(np.asarray(rows_l, np.int32))]
-    rv = jnp.asarray(r_values)[jnp.asarray(np.asarray(rows_r, np.int32))]
-    return np.asarray(_vector_binary_kernel(lv, rv, op=op, bool_mode=bool_mode))
+    """Gather matched rows on device and apply the op in one kernel.
+
+    Comparisons are EXEMPT from the f32 policy: narrowing before ==/>/<
+    discretely flips results for f64-distinct operands (16777217.0 vs
+    16777216.0 collide in f32) — a boolean error no relative-error
+    envelope covers.  Only the arithmetic ops narrow."""
+    from m3_tpu.query import precision
+
+    dt = np.float64 if op in COMPARISONS else precision.compute_dtype()
+    lv = jnp.asarray(l_values, dt)[jnp.asarray(np.asarray(rows_l, np.int32))]
+    rv = jnp.asarray(r_values, dt)[jnp.asarray(np.asarray(rows_r, np.int32))]
+    return np.asarray(
+        _vector_binary_kernel(lv, rv, op=op, bool_mode=bool_mode), np.float64)

@@ -230,8 +230,20 @@ class Engine:
             else:
                 raw, eval_steps = self._fetch(sel_arg, steps,
                                               sel_arg.range_nanos)
+            from m3_tpu.query import precision
+
+            narrow = precision.compute_dtype() == np.float32
             ts_j = jnp.asarray(raw.ts)
-            vals_j = jnp.asarray(np.nan_to_num(raw.values))
+            # The policy dtype rides the value array: jitted stencils
+            # follow vals.dtype, so f32 selection re-specializes every
+            # kernel without any static plumbing (query/precision.py).
+            # The rate family is the exception — it must difference
+            # cumulative counters in f64 and narrows internally via its
+            # static `narrow` flag — as is regression (f64-pinned).
+            narrow_vals = f not in _TEMPORAL_RATE and f not in _TEMPORAL_REG
+            vals_j = jnp.asarray(
+                np.nan_to_num(raw.values),
+                precision.compute_dtype() if narrow_vals else np.float64)
             st_j = jnp.asarray(eval_steps)
             rng = sel_arg.range_nanos
             if f in _TEMPORAL_SUM:
@@ -240,7 +252,8 @@ class Engine:
                 W = tp.window_pad_for(raw.counts, raw.ts, rng)
                 out = tp.minmax_quantile_family(ts_j, vals_j, st_j, rng, f, W, q)
             elif f in _TEMPORAL_RATE:
-                out = tp.rate_family(ts_j, vals_j, st_j, rng, f)
+                out = tp.rate_family(ts_j, vals_j, st_j, rng, f,
+                                     narrow=narrow)
             elif f in _TEMPORAL_REG:
                 out = tp.regression_family(ts_j, vals_j, st_j, rng, f, extra)
             elif f in _TEMPORAL_TRANS:
@@ -277,7 +290,9 @@ class Engine:
                 out = tp.sum_count_family(ts_j, vals_j, st_j, rng, "count_over_time")
                 out = jnp.where(jnp.isnan(out), out, jnp.minimum(out, 1.0))
             metas = [m.drop_name() for m in raw.series]
-            out = np.asarray(out)
+            # Blocks stay f64 at the API surface whatever the compute
+            # policy — downstream numpy code and callers see one dtype.
+            out = np.asarray(out, np.float64)
             if out.ndim == 2 and out.shape[1] != len(steps):
                 # @-pinned: one computed column broadcast across steps
                 out = np.broadcast_to(out, (out.shape[0], len(steps)))

@@ -1,0 +1,60 @@
+"""Query compute-precision policy.
+
+Prometheus evaluates in float64 and so does this engine by default.  On
+TPU that default is expensive: v5e-class chips have no native f64 ALU,
+so XLA software-emulates every f64 elementwise op at ~10-20x the f32
+cost — measured here as the PromQL north star (BASELINE config #5)
+running 8x SLOWER on a TPU v5 lite than on the host CPU (47.9s vs 5.7s
+per eval; TPU_RESULTS_r05.json).
+
+The policy narrows the BULK stencil math (temporal kernels, the
+histogram-quantile kernel) to f32 when selected, keeping:
+- window *bounds* exact (i64 searchsorted, unaffected);
+- times recentered at the first step before narrowing, so f32 holds
+  window-relative nanos (<=hours, ~0.4ms resolution) instead of epoch
+  nanos;
+- regression stencils (deriv/predict_linear) in f64 always — their
+  t^2 prefix sums exceed f32's 2^24 integer range;
+- the f64 API surface: blocks upcast on exit, so callers never see the
+  narrow dtype.
+
+Accuracy envelope (validated by tests/test_query_precision.py and the
+bench promql stage's scalar oracle): ~1e-6 relative per op; through the
+rate+histogram_quantile chain the interpolation step AMPLIFIES by the
+rank-to-bucket-width ratio — observed ~2e-4, bench-bounded at 5e-3.
+Comparison operators are exempt (always f64): narrowing before ==/>/<
+flips booleans for f64-distinct operands, which no relative envelope
+covers.  Counter values above 2^24 lose integer exactness in f32 —
+reset detection on such counters can misfire; deployments with
+billion-count counters should stay on f64.
+
+Selection: ``set_compute_dtype("f32"|"f64")`` or env
+``M3_QUERY_DTYPE`` at import.  The dtype rides the ARRAYS (engine casts
+at the fetch boundary; kernels follow ``vals.dtype``), so jitted
+kernels re-specialize per dtype automatically — no stale-trace hazard.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+_VALID = {"f32": np.float32, "f64": np.float64}
+_env = os.environ.get("M3_QUERY_DTYPE", "").strip().lower() or "f64"
+if _env not in _VALID:
+    raise ValueError(
+        f"M3_QUERY_DTYPE={_env!r}: must be 'f32' or 'f64' (a typo "
+        "silently running f64 would invalidate a perf comparison)")
+_dtype = _VALID[_env]
+
+
+def set_compute_dtype(name: str) -> None:
+    global _dtype
+    if name not in _VALID:
+        raise ValueError(f"query compute dtype must be f32|f64, got {name!r}")
+    _dtype = _VALID[name]
+
+
+def compute_dtype() -> np.dtype:
+    return np.dtype(_dtype)

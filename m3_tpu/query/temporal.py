@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-NAN = jnp.float64(jnp.nan)
+NAN = jnp.nan  # weak-typed: jnp.where keeps the value operand dtype
 
 
 def _window_bounds(ts, step_times, range_nanos):
@@ -56,7 +56,7 @@ def _gather_rows(a, idx):
 def sum_count_family(ts, vals, step_times, range_nanos, func: str):
     """sum/count/avg/stddev/stdvar_over_time via prefix sums."""
     lo, hi = _window_bounds(ts, step_times, range_nanos)
-    n = (hi - lo).astype(jnp.float64)
+    n = (hi - lo).astype(vals.dtype)
     c1 = _prefix(vals)
     c2 = _prefix(vals * vals)
     s1 = _gather_rows(c1, hi) - _gather_rows(c1, lo)
@@ -104,23 +104,35 @@ def minmax_quantile_family(ts, vals, step_times, range_nanos, func: str,
         out = jnp.max(jnp.where(valid, g, -jnp.inf), axis=2)
     else:  # quantile_over_time (Prometheus: linear interpolation)
         gs = jnp.sort(jnp.where(valid, g, jnp.inf), axis=2)
-        rank = q * (n.astype(jnp.float64) - 1.0)
+        rank = q * (n.astype(vals.dtype) - 1.0)
         lo_r = jnp.clip(
             jnp.minimum(jnp.floor(rank).astype(jnp.int32), n - 1), 0, W - 1
         )
         hi_r = jnp.clip(jnp.minimum(lo_r + 1, n - 1), 0, W - 1)
-        frac = rank - lo_r.astype(jnp.float64)
+        frac = rank - lo_r.astype(vals.dtype)
         v_lo = jnp.take_along_axis(gs, lo_r[:, :, None], axis=2)[:, :, 0]
         v_hi = jnp.take_along_axis(gs, hi_r[:, :, None], axis=2)[:, :, 0]
         out = v_lo + (v_hi - v_lo) * frac
     return jnp.where(empty, NAN, out)
 
 
-@functools.partial(jax.jit, static_argnames=("func",))
-def rate_family(ts, vals, step_times, range_nanos, func: str):
+@functools.partial(jax.jit, static_argnames=("func", "narrow"))
+def rate_family(ts, vals, step_times, range_nanos, func: str,
+                narrow: bool = False):
     """rate/increase/delta with Prometheus extrapolation
     (reference rate.go:99-102 standardRateFunc); counter funcs apply
-    cumulative-reset correction."""
+    cumulative-reset correction.
+
+    ``narrow`` is the f32 policy's entry point (query/precision.py).
+    Unlike the other stencils, rate CANNOT take f32 values: cumulative
+    counters are large and window deltas small, so narrowing before the
+    difference cancels catastrophically (a 1e6-count counter with a
+    30-count window delta loses ~2e-3 of the delta).  Instead ``vals``
+    stays f64 through the reset correction and the v_last - v_first
+    difference, and only the DIFFERENCES — delta, durations — narrow
+    for the extrapolation arithmetic, where error is relative to the
+    delta itself (~1e-7)."""
+    dt_ = jnp.float32 if narrow else vals.dtype
     lo, hi = _window_bounds(ts, step_times, range_nanos)
     n = hi - lo
     has2 = n >= 2
@@ -140,29 +152,34 @@ def rate_family(ts, vals, step_times, range_nanos, func: str):
     else:
         adj = vals
 
+    # All DURATION math happens in i64 nanos first and narrows only the
+    # differences: sampled / dur_start / dur_end are bounded by the
+    # range window, so they fit any float dtype regardless of where the
+    # query sits on the epoch axis or how long its span is (epoch nanos
+    # themselves fit neither f32 nor even f64 exactly).  Gathered pad
+    # entries (i64 max) wrap to garbage — every lane that can read one
+    # is masked below (has2 / sampled>0 / dt>0).
     v_first = _gather_rows(adj, first_i)
     v_last = _gather_rows(adj, last_i)
-    t_first = _gather_rows(ts, first_i).astype(jnp.float64)
-    t_last = _gather_rows(ts, last_i).astype(jnp.float64)
+    ti_first = _gather_rows(ts, first_i)  # i64 (S, T)
+    ti_last = _gather_rows(ts, last_i)
 
     if func in ("irate", "idelta"):
         prev_i = jnp.clip(hi - 2, 0, P - 1)
         v_prev = _gather_rows(adj, prev_i)
-        t_prev = _gather_rows(ts, prev_i).astype(jnp.float64)
-        dv = v_last - v_prev
-        dt = (t_last - t_prev) / 1e9
+        dv = (v_last - v_prev).astype(dt_)  # difference, then narrow
+        dt = (ti_last - _gather_rows(ts, prev_i)).astype(dt_) / 1e9
         out = jnp.where(dt > 0, dv / dt if func == "irate" else dv, NAN)
         return jnp.where(has2, out, NAN)
 
-    range_f = jnp.float64(range_nanos)
-    window_start = step_times.astype(jnp.float64) - range_f  # (T,)
-    window_end = step_times.astype(jnp.float64)
+    range_f = jnp.asarray(range_nanos, dt_)
+    window_start = step_times - range_nanos  # i64 (T,)
 
-    delta_v = v_last - v_first
-    sampled = t_last - t_first  # nanos
-    avg_dur = sampled / jnp.maximum(n.astype(jnp.float64) - 1.0, 1.0)
-    dur_start = t_first - window_start[None, :]
-    dur_end = window_end[None, :] - t_last
+    delta_v = (v_last - v_first).astype(dt_)  # difference, then narrow
+    sampled = (ti_last - ti_first).astype(dt_)  # nanos, <= range
+    avg_dur = sampled / jnp.maximum(n.astype(dt_) - 1.0, 1.0)
+    dur_start = (ti_first - window_start[None, :]).astype(dt_)
+    dur_end = (step_times[None, :] - ti_last).astype(dt_)
 
     # Prometheus extrapolation: extend to the window edge unless the gap
     # exceeds 1.1× the average sample spacing, then cap at avg/2.
@@ -173,9 +190,14 @@ def rate_family(ts, vals, step_times, range_nanos, func: str):
         # extension at the time it would take to reach zero.  Prometheus
         # uses the RAW first sample here (pre reset-adjustment).
         v_first_raw = _gather_rows(vals, first_i)
+        # Ratio of two f64 quantities (large raw value / small delta):
+        # divide in f64, then narrow the bounded result.
+        delta64 = v_last - v_first
+        ratio = (v_first_raw
+                 / jnp.where(delta64 == 0, 1.0, delta64)).astype(dt_)
         zero_dur = jnp.where(
-            (delta_v > 0) & (v_first_raw >= 0),
-            sampled * (v_first_raw / jnp.where(delta_v == 0, 1.0, delta_v)),
+            (delta_v > 0) & (v_first_raw.astype(dt_) >= 0),
+            sampled * ratio,
             jnp.inf,
         )
         extrap_start = jnp.minimum(extrap_start, zero_dur)
@@ -194,7 +216,11 @@ def regression_family(ts, vals, step_times, range_nanos, func: str,
                       predict_offset_s: float = 0.0):
     """deriv / predict_linear: least-squares slope over each window
     (reference linear_regression.go), via prefix sums of (t, v, t·v, t²)
-    with per-window re-centering at the window end for stability."""
+    with per-window re-centering at the window end for stability.
+
+    Always f64 regardless of the precision policy: the t² prefix sums
+    span ~3e9 for an hour window, past f32's 2^24 integer range."""
+    vals = vals.astype(jnp.float64)
     lo, hi = _window_bounds(ts, step_times, range_nanos)
     n = (hi - lo).astype(jnp.float64)
     # Center on the first step BEFORE the prefix sums: epoch-scale t²
@@ -235,9 +261,9 @@ def transitions_family(ts, vals, step_times, range_nanos, func: str):
     lo, hi = _window_bounds(ts, step_times, range_nanos)
     prev = jnp.concatenate([vals[:, :1], vals[:, :-1]], axis=1)
     if func == "resets":
-        ind = (vals < prev).astype(jnp.float64)
+        ind = (vals < prev).astype(vals.dtype)
     else:  # changes
-        ind = (vals != prev).astype(jnp.float64)
+        ind = (vals != prev).astype(vals.dtype)
     c = _prefix(ind)
     P = vals.shape[1]
     count = (_gather_rows(c, hi) -

@@ -1,0 +1,162 @@
+"""Query compute-precision policy (m3_tpu/query/precision.py).
+
+The engine defaults to Prometheus's f64; `set_compute_dtype("f32")`
+narrows the bulk stencil math for TPU (no native f64 ALU on v5e-class
+chips).  These tests pin the accuracy envelope the policy documents:
+f32 results within ~1e-4 relative of the f64 evaluation for the
+north-star query shape, regression stencils exempt (always f64).
+"""
+
+import numpy as np
+import pytest
+
+from m3_tpu.query import precision
+from m3_tpu.query.block import RawBlock, SeriesMeta
+from m3_tpu.query.engine import Engine
+
+T0 = 1_700_000_000 * 10**9
+STEP = 15 * 10**9
+
+
+class _ArrayStorage:
+    def __init__(self, raw, name=b"m"):
+        self.raw = raw
+        self.name = name
+
+    def fetch_raw(self, name, matchers, start_nanos, end_nanos):
+        assert name == self.name
+        return self.raw
+
+
+def _bucket_block(G=40, B=4, P=261, seed=5, resets=False):
+    """Realistic histogram series: per-bucket increments accumulate over
+    time AND cumulate across the le axis (c_b = sum of buckets <= b), so
+    quantile ranks sit strictly inside buckets — the shape real
+    histogram counters have."""
+    rng = np.random.default_rng(seed)
+    ubs = [b"0.1", b"1", b"5", b"+Inf"]
+    ts = np.tile(T0 + np.arange(P, dtype=np.int64) * STEP, (G * B, 1))
+    incr = rng.poisson(3.0, (G, B, P)).astype(np.float64)
+    if resets:
+        # A counter reset zeroes every bucket of the group at once.
+        r = rng.random((G, 1, P)) < 0.01
+        incr = np.where(r, 0.0, incr)
+    cum_t = np.cumsum(incr, axis=2)
+    if resets:
+        # Restart accumulation after each reset point.
+        keep = np.maximum.accumulate(
+            np.where(r, np.arange(P)[None, None, :], 0), axis=2)
+        base = np.take_along_axis(cum_t, np.maximum(keep - 1, 0), axis=2)
+        cum_t = np.where(keep > 0, cum_t - base, cum_t)
+    vals = np.cumsum(cum_t, axis=1).reshape(G * B, P)  # le-cumulative
+    counts = np.full(G * B, P, np.int64)
+    series = [
+        SeriesMeta(((b"__name__", b"m"), (b"g", b"g%03d" % g),
+                    (b"le", ubs[b])))
+        for g in range(G) for b in range(B)
+    ]
+    return RawBlock(np.ascontiguousarray(ts), vals, counts, series)
+
+
+@pytest.fixture
+def restore_policy():
+    yield
+    precision.set_compute_dtype("f64")
+
+
+class TestPrecisionPolicy:
+    def test_invalid_dtype_rejected(self):
+        with pytest.raises(ValueError, match="f32"):
+            precision.set_compute_dtype("f16")
+
+    def test_f32_matches_f64_on_north_star_query(self, restore_policy):
+        raw = _bucket_block()
+        q = "histogram_quantile(0.9, rate(m[5m]))"
+        start, end = T0 + 3600 * 10**9, T0 + 2 * 3600 * 10**9
+        eng = Engine(_ArrayStorage(raw))
+        precision.set_compute_dtype("f64")
+        b64 = eng.execute_range(q, start, end, STEP)
+        precision.set_compute_dtype("f32")
+        b32 = eng.execute_range(q, start, end, STEP)
+        assert b32.values.dtype == np.float64  # API surface stays f64
+        v64, v32 = b64.values, b32.values
+        assert v64.shape == v32.shape
+        both = ~(np.isnan(v64) | np.isnan(v32))
+        assert np.array_equal(np.isnan(v64), np.isnan(v32))
+        denom = np.maximum(np.abs(v64[both]), 1e-6)
+        assert np.max(np.abs(v64[both] - v32[both]) / denom) < 1e-4
+
+    def test_f32_rate_only(self, restore_policy):
+        raw = _bucket_block(G=10, B=4, resets=True)
+        eng = Engine(_ArrayStorage(raw))
+        start, end = T0 + 3600 * 10**9, T0 + 2 * 3600 * 10**9
+        precision.set_compute_dtype("f64")
+        b64 = eng.execute_range("rate(m[5m])", start, end, STEP)
+        precision.set_compute_dtype("f32")
+        b32 = eng.execute_range("rate(m[5m])", start, end, STEP)
+        both = ~(np.isnan(b64.values) | np.isnan(b32.values))
+        denom = np.maximum(np.abs(b64.values[both]), 1e-6)
+        err = np.max(np.abs(b64.values[both] - b32.values[both]) / denom)
+        assert err < 1e-4, err
+
+    def test_f32_rate_long_span_large_counters(self, restore_policy):
+        """The two cancellation traps: (a) a 30-day query span (times
+        must not narrow against the epoch), (b) cumulative counters in
+        the millions with small window deltas (values must difference
+        in f64 before narrowing).  The rate kernel's i64-first duration
+        math and internal `narrow` flag keep f32 error at the delta's
+        own scale (~1e-7), independent of span or counter magnitude."""
+        rng = np.random.default_rng(9)
+        P = 30 * 24 * 12  # 5m samples for 30 days
+        ts = np.tile(T0 + np.arange(P, dtype=np.int64) * 300 * 10**9,
+                     (4, 1))
+        vals = np.cumsum(rng.gamma(2.0, 5.0, (4, P)), axis=1)  # to ~1e6
+        raw = RawBlock(np.ascontiguousarray(ts), vals,
+                       np.full(4, P, np.int64),
+                       [SeriesMeta(((b"__name__", b"c"), (b"i", b"%d" % i)))
+                        for i in range(4)])
+        eng = Engine(_ArrayStorage(raw, name=b"c"))
+        q_start = T0 + 3600 * 10**9
+        q_end = T0 + 30 * 24 * 3600 * 10**9 - 3600 * 10**9
+        step = 3600 * 10**9
+        precision.set_compute_dtype("f64")
+        b64 = eng.execute_range("rate(c[15m])", q_start, q_end, step)
+        precision.set_compute_dtype("f32")
+        b32 = eng.execute_range("rate(c[15m])", q_start, q_end, step)
+        both = ~(np.isnan(b64.values) | np.isnan(b32.values))
+        err = np.max(np.abs(b64.values[both] - b32.values[both])
+                     / np.maximum(np.abs(b64.values[both]), 1e-9))
+        assert err < 1e-5, err
+
+    def test_comparison_ops_exempt_from_f32(self, restore_policy):
+        """f64-distinct operands that collide in f32 must still compare
+        correctly under the f32 policy (comparisons never narrow)."""
+        P = 8
+        ts = np.tile(T0 + np.arange(P, dtype=np.int64) * STEP, (1, 1))
+        raw_a = RawBlock(np.ascontiguousarray(ts),
+                         np.full((1, P), 16777217.0),
+                         np.full(1, P, np.int64),
+                         [SeriesMeta(((b"__name__", b"a"),))])
+        eng = Engine(_ArrayStorage(raw_a, name=b"a"))
+        start, end = T0 + STEP, T0 + 6 * STEP
+        precision.set_compute_dtype("f32")
+        blk = eng.execute_range("a > 16777216.5", start, end, STEP)
+        # 16777217.0 > 16777216.5 is true in f64; both round to
+        # 16777216.0 in f32, which would drop the series.
+        assert len(blk.series) == 1
+        assert not np.isnan(blk.values).all()
+
+    def test_regression_family_stays_f64(self, restore_policy):
+        """deriv is exempt from the policy: its t² prefix sums overflow
+        f32's integer range, so f32 and f64 policies must agree to f64
+        accuracy (they run the same f64 kernel)."""
+        raw = _bucket_block(G=4, B=4)
+        eng = Engine(_ArrayStorage(raw))
+        start, end = T0 + 3600 * 10**9, T0 + 2 * 3600 * 10**9
+        precision.set_compute_dtype("f64")
+        b64 = eng.execute_range("deriv(m[10m])", start, end, STEP)
+        precision.set_compute_dtype("f32")
+        b32 = eng.execute_range("deriv(m[10m])", start, end, STEP)
+        both = ~(np.isnan(b64.values) | np.isnan(b32.values))
+        assert np.allclose(b64.values[both], b32.values[both],
+                           rtol=1e-12, atol=0)
