@@ -467,26 +467,50 @@ def _run_agg_bench(kind: str, C: int, N: int, NT: int, platform: str) -> dict:
     def tstep(ts, win, slots, values, times):
         return arena.raw(arena.timer_ingest)(ts, win, slots, values, times, C)
 
-    @jax.jit
-    def tdrain(ts):
-        lanes, cnt = arena.raw(arena.timer_consume)(ts, jnp.int32(0), C, qs)
+    @functools.partial(jax.jit, static_argnames=("packed",))
+    def tdrain(ts, packed=False):
+        lanes, cnt = arena.raw(arena.timer_consume)(ts, jnp.int32(0), C, qs,
+                                                    packed)
         return lanes[:, 8:], cnt
 
     # Warm BOTH kernels on a throwaway arena so neither compile lands in
     # the timed region.
     warm = tstep(arena.timer_init(1, C, NTpad), *batches[0], jt)
     jax.block_until_ready(tdrain(warm))
+    jax.block_until_ready(tdrain(warm, packed=True))
     del warm
     t0 = time.perf_counter()
     for win, slots, values in batches:
         tstate = tstep(tstate, win, slots, values, jt)
+    jax.block_until_ready(tstate.sum)  # else drain_s absorbs queued ingest
+    ingest_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
     qlanes, cnt = tdrain(tstate)
     jax.block_until_ready((qlanes, cnt))
-    dev_s = time.perf_counter() - t0
+    drain_s = time.perf_counter() - t0
+    dev_s = ingest_s + drain_s
     count_ok = int(jnp.sum(cnt)) == NT
     dev_rate = NT / dev_s
 
+    # The packed32 drain (one i64 key sort, f32-precision quantile
+    # lanes — AggregatorOptions.timer_packed32) timed + validated
+    # against the exact drain on the same state.
+    t0 = time.perf_counter()
+    qp, cp = tdrain(tstate, packed=True)
+    jax.block_until_ready((qp, cp))
+    p32_drain_s = time.perf_counter() - t0
+    qn, qpn = np.asarray(qlanes), np.asarray(qp)
+    nz = np.abs(qn) > 0
+    p32_err = float(np.max(np.abs(qn[nz] - qpn[nz]) / np.abs(qn[nz]))) if nz.any() else 0.0
+    p32_ok = np.array_equal(np.asarray(cnt), np.asarray(cp)) and p32_err < 1e-6
+
     out = {"samples_per_sec": round(dev_rate), "C": C, "NT": NT,
+           "ingest_s": round(ingest_s, 3), "drain_s": round(drain_s, 3),
+           "packed32_drain_s": round(p32_drain_s, 3),
+           "samples_per_sec_packed32": round(NT / (ingest_s + p32_drain_s)),
+           "packed32_validation":
+               ("ok" if p32_ok else f"packed32 mismatch: rel {p32_err:.2e}"),
+           "packed32_max_rel_err": p32_err,
            "platform": platform,
            "validation": "ok" if count_ok else
            f"sample count mismatch: {int(jnp.sum(cnt))} != {NT}"}

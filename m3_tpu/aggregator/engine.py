@@ -75,6 +75,14 @@ class AggregatorOptions:
     num_windows: int = 2  # ring of open resolution windows
     timer_sample_capacity: int = 1 << 24
     quantiles: tuple = (0.5, 0.95, 0.99)
+    # Timer drain sort mode: packed32 sorts ONE i64 (slot<<32 |
+    # orderable-f32) key instead of the (i32, f64) lex pair — ~4x
+    # faster drain on CPU, avoids software-emulated f64 compares on
+    # TPU; quantile/min/max lanes carry f32 precision (~1e-7 rel on
+    # f32's finite normal range — values beyond ±3.4e38 saturate,
+    # below ~1.2e-38 flush; see arena.timer_consume), moments stay
+    # f64-exact.
+    timer_packed32: bool = False
     storage_policies: tuple = (StoragePolicy.parse("10s:2d"),)
     # New-metric creation rate cap, entries/sec across the aggregator
     # (reference entry.go rate limits; 0 = unlimited).  Samples whose
@@ -314,7 +322,8 @@ class MetricList:
         self.new_series_rejected = 0
         self.counters = CounterArena(W, C)
         self.gauges = GaugeArena(W, C)
-        self.timers = TimerArena(W, C, opts.timer_sample_capacity, opts.quantiles)
+        self.timers = TimerArena(W, C, opts.timer_sample_capacity,
+                                 opts.quantiles, packed32=opts.timer_packed32)
         self.maps = {
             MetricType.COUNTER: MetricMap(C, limiter=new_series_limiter),
             MetricType.GAUGE: MetricMap(C, limiter=new_series_limiter),

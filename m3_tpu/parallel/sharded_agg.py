@@ -84,7 +84,8 @@ class ShardedBatch(NamedTuple):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("topo", "num_windows", "capacity", "quantiles"),
+    static_argnames=("topo", "num_windows", "capacity", "quantiles",
+                     "timer_packed32"),
     donate_argnums=(1,),
 )
 def sharded_ingest_consume(
@@ -95,6 +96,7 @@ def sharded_ingest_consume(
     num_windows: int,
     capacity: int,
     quantiles: tuple = (0.5, 0.95, 0.99),
+    timer_packed32: bool = False,
 ):
     """The framework's "training step": ingest a routed batch into every
     shard's arenas, drain one window (then reset its ring row, as the
@@ -131,7 +133,7 @@ def sharded_ingest_consume(
         c_lanes, c_cnt = _raw(_arena.counter_consume)(counters, window, capacity)
         g_lanes, g_cnt = _raw(_arena.gauge_consume)(gauges, window, capacity)
         t_lanes, t_cnt = _raw(_arena.timer_consume)(
-            timers, window, capacity, quantiles
+            timers, window, capacity, quantiles, timer_packed32
         )
 
         # The drained window's ring row resets for reuse (engine.py

@@ -282,6 +282,38 @@ class TestEngine:
         flushed = agg.consume(7 * R)
         assert flushed == []
 
+    def test_packed32_consume_matches_exact(self):
+        """The packed32 drain (one i64 slot<<32|orderable-f32 key) must
+        reproduce the exact f64 lex-sort drain: counts and moments
+        bit-equal, quantile/min/max lanes within f32 eps — including
+        negative values and the -0.0/+0.0 bit-order edge."""
+        a = TimerArena(num_windows=1, capacity=8, sample_capacity=1 << 12)
+        p = TimerArena(num_windows=1, capacity=8, sample_capacity=1 << 12,
+                       packed32=True)
+        rng = np.random.default_rng(21)
+        n = 4000
+        slots = rng.integers(0, 8, n).astype(np.int32)
+        vals = rng.normal(0.0, 50.0, n)  # both signs
+        vals[:8] = [0.0, -0.0, 1e-38, -1e-38, 3e8, -3e8, 0.5, -0.5]
+        times = np.arange(n, dtype=np.int64)
+        for arena_ in (a, p):
+            arena_.ingest(jnp.zeros(n, jnp.int32), jnp.asarray(slots),
+                          jnp.asarray(vals), jnp.asarray(times))
+        le, ce = a.consume(0)
+        lp, cp = p.consume(0)
+        assert np.array_equal(np.asarray(ce), np.asarray(cp))
+        le, lp = np.asarray(le), np.asarray(lp)
+        # moments lanes (mean/count/sum/sumsq/stdev) bit-equal
+        assert np.array_equal(le[:, 3:8], lp[:, 3:8])
+        # order-statistic lanes within f32 eps
+        sel = np.abs(le[:, 1:3]) > 0
+        rel = np.abs(le[:, 1:3] - lp[:, 1:3])[sel] / np.abs(le[:, 1:3][sel])
+        assert rel.size == 0 or rel.max() < 2e-7
+        qe, qp = le[:, 8:], lp[:, 8:]
+        sel = np.abs(qe) > 0
+        rel = np.abs(qe - qp)[sel] / np.abs(qe[sel])
+        assert rel.max() < 2e-7
+
     def test_timer_sample_buffer_grows_no_drops(self):
         opts = AggregatorOptions(
             capacity=8,
