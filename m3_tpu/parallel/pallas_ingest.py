@@ -17,17 +17,26 @@ fails; for this framework's hot ops the measured decisions are:
   scatter dominates the north-star bench.
 
 The kernel: ingest N (slot, value) pairs into C accumulator slots.
-Grid over slot tiles of 128×8; each grid step streams the whole batch
-through VMEM and accumulates `value * (slot == lane_slot)` partial sums
-with an 8×128-shaped reduction — no scatter, no atomics, deterministic.
-Cost is O(N × C / tile) vector work: wins over serialized scatter when
-the collision rate is high and C is moderate (the downsampler's rollup
-arenas), loses for huge sparse C — callers choose per shape.
+2-D grid over (slot tiles, batch slabs); each step loads one SLAB of
+the batch into VMEM (BlockSpec does the slicing — the first live-TPU
+run proved Mosaic rejects `lax.dynamic_slice` on VMEM values, so the
+slab walk lives in the grid, not in a fori_loop) and accumulates
+`value * (slot == lane_slot)` partial sums into its tile's output
+block, which Pallas keeps revisiting across the inner slab dimension.
+No scatter, no atomics, deterministic, and the slab copies pipeline
+against compute.  Cost is O(N × C / tile) vector work: wins over
+serialized scatter when the collision rate is high and C is moderate
+(the downsampler's rollup arenas), loses for huge sparse C — callers
+choose per shape.
 
 Correctness is pinned against the XLA scatter path in
-tests/test_pallas_ingest.py (interpret mode on CPU — semantics only;
-Mosaic lowering needs real-TPU validation, which is why the arena
-default remains XLA scatter until the bench can measure both).
+tests/test_pallas_ingest.py (interpret mode on CPU — semantics only).
+THIS 2-D formulation has not yet compiled on a live chip: the round-5
+relay died before the rewrite could be measured (TPU_RESULTS_r05.json
+note_window3 — the recorded Mosaic failure is the OLD 1-D form's).
+The bench's pallas stage re-validates sum/count equality on-chip
+before timing, and the arena default remains XLA scatter until that
+stage records a verdict for this form.
 """
 
 from __future__ import annotations
@@ -46,59 +55,62 @@ except Exception:  # pragma: no cover - environment without pallas
     HAVE_PALLAS = False
 
 TILE = 1024   # slots per grid step: 8 sublanes x 128 lanes of f32 work
-SLAB = 512    # batch points per inner step: (TILE, SLAB) must fit VMEM
-MAX_BATCH = 1 << 18  # both (npad,) inputs are VMEM-resident per grid step:
-                     # ~4MB at f64 — callers chunk bigger batches (the
-                     # arenas already ingest in bounded device batches)
+SLAB = 512    # batch points per grid step: the (TILE, SLAB) hit mask
+              # (2MB f32 / 4MB f64) is the kernel's VMEM high-water mark
+MAX_BATCH = 1 << 18  # bounds npad so index arithmetic stays i32-safe;
+                     # callers chunk bigger batches (the arenas already
+                     # ingest in bounded device batches)
 
 
 def _ingest_kernel(slots_ref, values_ref, out_sum_ref, out_cnt_ref,
                    *out_sq_ref):
-    """One grid step: accumulate the WHOLE batch into this step's
-    1024-slot tile.  slots/values are (N,) in VMEM (same block every
-    step); outputs are (TILE,) blocks of the (C,) accumulators.  When
-    invoked with a third output ref (the moments form), the SAME hit
-    mask also accumulates the sum of squares — one batch sweep serves
-    all three lanes (the arena hot path would otherwise pay the
+    """One (i, j) grid step: accumulate batch slab j into slot tile i.
+    slots/values arrive as (1, SLAB) VMEM blocks (BlockSpec slices the
+    batch — Mosaic has no dynamic_slice, so the slab walk IS the inner
+    grid dimension); outputs are (1, TILE) blocks of the (C/TILE, TILE)
+    accumulators, revisited across j with explicit first-step
+    initialization.  Everything is 2-D with the reduction over
+    SUBLANES: the hit mask is (SLAB, TILE) — slab points down the
+    sublane axis, slot lanes across — so the partial sums land
+    lane-shaped, exactly the layout of the (1, TILE) output block.
+    When invoked with a third output ref (the moments form), the SAME
+    hit mask also accumulates the sum of squares — one batch sweep
+    serves all three lanes (the arena hot path would otherwise pay the
     O(N x C/TILE) sweep twice)."""
     with_sq = bool(out_sq_ref)
-    step = pl.program_id(0)
-    base = step * TILE
-    slots = slots_ref[:]
-    values = values_ref[:]
-    n = slots.shape[0]
-    # A (TILE, n) one-hot membership matrix would blow VMEM, so the
-    # batch reduces in SLAB-point steps: each inner step materializes
-    # only a (TILE, SLAB) mask (4MB at f64) and accumulates into the
-    # tile's running sums.
-    nslabs = (n + SLAB - 1) // SLAB
-    lane_slots = base + jax.lax.broadcasted_iota(jnp.int32, (TILE, 1), 0)
+    base = pl.program_id(0) * TILE
+    j = pl.program_id(1)
+    lane_slots = base + jax.lax.broadcasted_iota(jnp.int32, (1, TILE), 1)
+    sl = slots_ref[0, :]
+    va = values_ref[0, :]
+    hit = sl[:, None] == lane_slots                    # (SLAB, TILE)
+    # select, not multiply-by-mask: `mask * NaN` would poison every
+    # slot in the tile, where the scatter oracle poisons only the hit
+    # slot (the arenas pre-mask NaNs, but the kernel's contract is
+    # exact equivalence with xla_segment_ingest on ANY input)
+    zero = jnp.zeros((), va.dtype)
+    hv = jnp.where(hit, va[:, None], zero)
+    p_sum = jnp.sum(hv, axis=0, keepdims=True)         # (1, TILE)
+    # counts accumulate in int32 regardless of value dtype: a
+    # low-precision value dtype (bf16) would saturate its counts
+    # (dtype pinned — x64 mode would promote the sum to int64)
+    p_cnt = jnp.sum(hit, axis=0, keepdims=True, dtype=jnp.int32)
+    # hv*hv is the already-masked value squared — NaN-safe for free
+    p_sq = jnp.sum(hv * hv, axis=0, keepdims=True) if with_sq else None
 
-    def slab_body(k, acc):
-        s_sum, s_cnt, s_sq = acc
-        lo = k * SLAB
-        sl = jax.lax.dynamic_slice(slots, (lo,), (SLAB,))
-        va = jax.lax.dynamic_slice(values, (lo,), (SLAB,))
-        hitf = (sl[None, :] == lane_slots).astype(values.dtype)  # (TILE, SLAB)
-        hv = hitf * va[None, :]
-        s_sum = s_sum + jnp.sum(hv, axis=1)
+    @pl.when(j == 0)
+    def _init():
+        out_sum_ref[:, :] = p_sum
+        out_cnt_ref[:, :] = p_cnt
         if with_sq:
-            s_sq = s_sq + jnp.sum(hv * va[None, :], axis=1)
-        # counts accumulate in int32 regardless of value dtype: a
-        # low-precision value dtype (bf16) would saturate its counts
-        # (dtype pinned — x64 mode would promote the sum to int64)
-        s_cnt = s_cnt + jnp.sum(sl[None, :] == lane_slots, axis=1,
-                                dtype=jnp.int32)
-        return s_sum, s_cnt, s_sq
+            out_sq_ref[0][:, :] = p_sq
 
-    zero_v = jnp.zeros((TILE,), values.dtype)
-    zero_c = jnp.zeros((TILE,), jnp.int32)
-    total, cnt, sq = jax.lax.fori_loop(
-        0, nslabs, slab_body, (zero_v, zero_c, zero_v))
-    out_sum_ref[:] = total
-    out_cnt_ref[:] = cnt
-    if with_sq:
-        out_sq_ref[0][:] = sq
+    @pl.when(j > 0)
+    def _accumulate():
+        out_sum_ref[:, :] = out_sum_ref[:, :] + p_sum
+        out_cnt_ref[:, :] = out_cnt_ref[:, :] + p_cnt
+        if with_sq:
+            out_sq_ref[0][:, :] = out_sq_ref[0][:, :] + p_sq
 
 
 @functools.partial(jax.jit,
@@ -113,39 +125,47 @@ def _segment_call(slots, values, capacity: int, interpret: bool,
     n = values.shape[0]
     if n > MAX_BATCH:
         raise ValueError(
-            f"batch of {n} exceeds MAX_BATCH={MAX_BATCH}: both input "
-            "arrays are VMEM-resident per grid step — chunk the batch")
+            f"batch of {n} exceeds MAX_BATCH={MAX_BATCH}: chunk the "
+            "batch (segment_ingest_chunked / segment_moments_chunked)")
     npad = max(SLAB, ((n + SLAB - 1) // SLAB) * SLAB)  # >=1 slab (empty ok)
     # pad with an impossible slot: contributes to no tile
     slots_p = jnp.full(npad, Cpad, jnp.int32).at[:n].set(
         jnp.where((slots < 0) | (slots >= C), Cpad, slots).astype(jnp.int32))
     values_p = jnp.zeros(npad, values.dtype).at[:n].set(values)
+    nslabs = npad // SLAB
+    ntiles = Cpad // TILE
+    # Everything 2-D: Mosaic's layout assignment wants (sublane, lane)
+    # shapes (the 1-D form died in tiling on the first live-TPU run).
+    slots_2d = slots_p.reshape(nslabs, SLAB)
+    values_2d = values_p.reshape(nslabs, SLAB)
 
-    grid = Cpad // TILE
+    # (slot tiles, batch slabs): j is the innermost (sequential)
+    # dimension, so each tile's output block stays resident while the
+    # whole batch streams past it slab by slab.
+    grid = (ntiles, nslabs)
     out_specs = [
-        pl.BlockSpec((TILE,), lambda i: (i,)),
-        pl.BlockSpec((TILE,), lambda i: (i,)),
+        pl.BlockSpec((1, TILE), lambda i, j: (i, 0)),
+        pl.BlockSpec((1, TILE), lambda i, j: (i, 0)),
     ]
     out_shape = [
-        jax.ShapeDtypeStruct((Cpad,), values.dtype),
-        jax.ShapeDtypeStruct((Cpad,), jnp.int32),
+        jax.ShapeDtypeStruct((ntiles, TILE), values.dtype),
+        jax.ShapeDtypeStruct((ntiles, TILE), jnp.int32),
     ]
     if with_sq:
-        out_specs.append(pl.BlockSpec((TILE,), lambda i: (i,)))
-        out_shape.append(jax.ShapeDtypeStruct((Cpad,), values.dtype))
+        out_specs.append(pl.BlockSpec((1, TILE), lambda i, j: (i, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((ntiles, TILE), values.dtype))
     outs = pl.pallas_call(
         _ingest_kernel,
-        grid=(grid,),
+        grid=grid,
         in_specs=[
-            # every grid step streams the whole batch
-            pl.BlockSpec((npad,), lambda i: (0,)),
-            pl.BlockSpec((npad,), lambda i: (0,)),
+            pl.BlockSpec((1, SLAB), lambda i, j: (j, 0)),
+            pl.BlockSpec((1, SLAB), lambda i, j: (j, 0)),
         ],
         out_specs=out_specs,
         out_shape=out_shape,
         interpret=interpret,
-    )(slots_p, values_p)
-    return tuple(o[:C] for o in outs)
+    )(slots_2d, values_2d)
+    return tuple(o.reshape(-1)[:C] for o in outs)
 
 
 def pallas_segment_ingest(slots: jnp.ndarray, values: jnp.ndarray,

@@ -23,6 +23,8 @@ def test_matches_xla_scatter(C, N, seed):
     rng = np.random.default_rng(seed)
     slots = rng.integers(-3, C + 3, N).astype(np.int32)  # incl. OOR drops
     vals = np.round(rng.normal(0, 10, N), 6)
+    vals[::97] = np.nan  # NaN must poison ONLY its own slot (select,
+    # not multiply-by-mask — a mask*value kernel would NaN whole tiles)
     ps, pc = pallas_segment_ingest(jnp.asarray(slots), jnp.asarray(vals),
                                    C, interpret=True)
     xs, xc = xla_segment_ingest(jnp.asarray(slots), jnp.asarray(vals), C)

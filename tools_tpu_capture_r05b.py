@@ -1,0 +1,73 @@
+"""Round-5 second-window TPU capture: priority-ordered bench stages.
+
+Runs the never-yet-captured TPU stages FIRST (pallas compare, device
+encode, 100K-series decode, promql f32), then re-captures the full-size
+north stars and exact promql on an uncontended host.  Writes the
+artifact incrementally after EVERY stage so a relay death mid-run
+loses only the stages not yet finished (the round-4 lesson).
+"""
+import json
+import os
+import sys
+import time
+
+os.environ["M3_BENCH_DEADLINE_SEC"] = "100000"  # stages self-manage here
+sys.path.insert(0, "/root/repo")
+
+import bench  # noqa: E402
+
+OUT = "/root/repo/TPU_CAPTURE_r05b.json"
+t0 = time.time()
+results: list = []
+
+
+def _flush(note: str = "") -> None:
+    with open(OUT, "w") as f:
+        json.dump({"note": note or _NOTE, "results": results}, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+_NOTE = ("Round-5 window #3 capture (priority order: never-captured "
+         "stages first). Uncontended host; incremental writes.")
+
+
+def record(tag: str, fn, *a, **kw) -> dict | None:
+    t = round(time.time() - t0, 1)
+    print(f"[{t:8.1f}s] start {tag}", flush=True)
+    try:
+        r = fn(*a, **kw)
+        results.append({tag: r, "t_offset_s": t})
+        print(f"[{time.time()-t0:8.1f}s] done  {tag}: {json.dumps(r)[:200]}",
+              flush=True)
+    except Exception as e:  # noqa: BLE001 — capture everything, keep going
+        r = None
+        results.append({tag: {"error": f"{type(e).__name__}: {e}"},
+                        "t_offset_s": t})
+        print(f"[{time.time()-t0:8.1f}s] FAIL  {tag}: {type(e).__name__}: {e}",
+              flush=True)
+    _flush()
+    return r
+
+
+import jax  # noqa: E402
+
+dev = jax.devices()[0]
+results.append({"backend": {"platform": dev.platform,
+                            "kind": dev.device_kind},
+                "t_offset_s": round(time.time() - t0, 1)})
+_flush()
+print("backend:", dev.platform, dev.device_kind, flush=True)
+
+T = bench.T_POINTS
+record("pallas", bench._run_pallas_compare, "tpu")
+record("encode_device", bench._run_device_encode_stage, 8_192, T, "tpu")
+record("decode_big", bench._run_decode_stage, 100_000, T, "tpu")
+record("promql_f32", bench._run_promql_bench, 12_500, 8, "tpu", "f32")
+record("agg_rollup_full", bench._run_agg_bench, "rollup",
+       C=1_000_000, N=2_000_000, NT=10_000_000, platform="tpu")
+record("agg_timer_full", bench._run_agg_bench, "timer",
+       C=1_000_000, N=2_000_000, NT=10_000_000, platform="tpu")
+record("decode_small", bench._run_decode_stage, 2_000, T, "tpu")
+record("promql_f64", bench._run_promql_bench, 12_500, 8, "tpu")
+print(f"[{time.time()-t0:8.1f}s] ALL STAGES DONE", flush=True)
