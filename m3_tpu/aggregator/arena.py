@@ -73,11 +73,12 @@ def raw(jitted):
 # whatever impl they traced with.
 # ---------------------------------------------------------------------------
 
+_INGEST_IMPLS = ("scatter", "pallas", "sorted")
 _INGEST_IMPL = (os.environ.get("M3_ARENA_INGEST", "").strip().lower()
                 or "scatter")
-if _INGEST_IMPL not in ("scatter", "pallas"):
+if _INGEST_IMPL not in _INGEST_IMPLS:
     raise ValueError(
-        f"M3_ARENA_INGEST={_INGEST_IMPL!r}: must be 'scatter' or 'pallas' "
+        f"M3_ARENA_INGEST={_INGEST_IMPL!r}: must be one of {_INGEST_IMPLS} "
         "(a typo silently running scatter would invalidate the very "
         "measurement the flag exists to apply)")
 
@@ -98,7 +99,7 @@ def register_ingest_consumer(jitted) -> None:
 
 def set_ingest_impl(impl: str) -> None:
     global _INGEST_IMPL
-    if impl not in ("scatter", "pallas"):
+    if impl not in _INGEST_IMPLS:
         raise ValueError(f"unknown ingest impl {impl!r}")
     _INGEST_IMPL = impl
     for f in (counter_ingest, gauge_ingest, timer_ingest,
@@ -107,6 +108,87 @@ def set_ingest_impl(impl: str) -> None:
             f.clear_cache()
         except AttributeError:  # raw function or older jax
             pass
+
+
+def _sorted_prep(state_cols_n: int, cap: int, idx, slots):
+    """Shared head of the sorted impl: ring geometry + composite key.
+    Contract (same as the scatter path's implicit one): for valid idx,
+    ``slots == idx % capacity`` — flat_window_index builds idx from
+    these very slots."""
+    from m3_tpu.parallel import sorted_ingest as so
+
+    W = state_cols_n // cap
+    k = so.composite_key(idx, slots, W, cap)
+    return so, W, k
+
+
+def _counter_ingest_sorted(state: "CounterState", idx, slots, values,
+                           times) -> "CounterState":
+    """Sort/scan/gather form of Counter.Update — no scatters (see
+    parallel/sorted_ingest.py for the measured rationale)."""
+    if values.shape[0] == 0:
+        return state
+    cap = state.last_at.shape[0]
+    so, W, k = _sorted_prep(state.sum.shape[0], cap, idx, slots)
+    s_k, s_val, s_tim = jax.lax.sort((k, values, times), num_keys=1)
+    is_start = jnp.concatenate(
+        [jnp.ones(1, bool), s_k[1:] != s_k[:-1]])
+    ones = jnp.ones_like(s_val)
+    (ssum, ssq, scnt), (smn,), (smx,) = so.head_flag_scan(
+        is_start, adds=(s_val, s_val * s_val, ones),
+        mins=(s_val,), maxs=(s_val,))
+    pos, found = so.last_occurrence(s_k, so.arena_queries(W, cap))
+    zero = jnp.zeros((), jnp.int64)
+    return CounterState(
+        sum=state.sum + jnp.where(found, ssum[pos], zero),
+        sum_sq=state.sum_sq + jnp.where(found, ssq[pos], zero),
+        count=state.count + jnp.where(found, scnt[pos], zero),
+        max=jnp.maximum(state.max, jnp.where(found, smx[pos], I64_MIN)),
+        min=jnp.minimum(state.min, jnp.where(found, smn[pos], I64_MAX)),
+        last_at=so.merged_slot_last_at(state.last_at, s_k, s_tim, W, cap),
+    )
+
+
+def _gauge_ingest_sorted(state: "GaugeState", idx, slots, values,
+                         times) -> "GaugeState":
+    """Sort/scan/gather form of Gauge.Update.  The one sort also serves
+    the last-value winner rule: within a (slot, window) segment the
+    order is (time asc, arrival desc), so the segment's final element
+    is (max time, first arrival) — gathered, not scattered."""
+    if values.shape[0] == 0:
+        return state
+    cap = state.last_at.shape[0]
+    so, W, k = _sorted_prep(state.sum.shape[0], cap, idx, slots)
+    n = values.shape[0]
+    arrival_desc = jnp.arange(n - 1, -1, -1, dtype=jnp.int32)
+    s_k, s_tim, _s_arr, s_val = jax.lax.sort(
+        (k, times, arrival_desc, values), num_keys=3)
+    s_nan = jnp.isnan(s_val)
+    s_safe = jnp.where(s_nan, 0.0, s_val)
+    is_start = jnp.concatenate(
+        [jnp.ones(1, bool), s_k[1:] != s_k[:-1]])
+    ones = jnp.ones(n, state.count.dtype)
+    (ssum, ssq, scnt), (smn,), (smx,) = so.head_flag_scan(
+        is_start, adds=(s_safe, s_safe * s_safe, ones),
+        mins=(jnp.where(s_nan, jnp.inf, s_val),),
+        maxs=(jnp.where(s_nan, -jnp.inf, s_val),))
+    pos, found = so.last_occurrence(s_k, so.arena_queries(W, cap))
+    wtime, wval = s_tim[pos], s_val[pos]
+    take = found & (wtime > state.last_time)
+    zero_f = jnp.zeros((), state.sum.dtype)
+    zero_i = jnp.zeros((), state.count.dtype)
+    return GaugeState(
+        last=jnp.where(take, wval, state.last),
+        last_time=jnp.where(take, wtime, state.last_time),
+        sum=state.sum + jnp.where(found, ssum[pos], zero_f),
+        sum_sq=state.sum_sq + jnp.where(found, ssq[pos], zero_f),
+        count=state.count + jnp.where(found, scnt[pos], zero_i),
+        max=jnp.maximum(state.max,
+                        jnp.where(found, smx[pos], -jnp.inf)),
+        min=jnp.minimum(state.min,
+                        jnp.where(found, smn[pos], jnp.inf)),
+        last_at=so.merged_slot_last_at(state.last_at, s_k, s_tim, W, cap),
+    )
 
 
 def _seg3(sum_col, sq_col, cnt_col, idx, values):
@@ -190,6 +272,8 @@ def counter_ingest(
     times: jnp.ndarray,  # i64 (N,)
 ) -> CounterState:
     """Counter.Update for a batch (reference counter.go:53-76)."""
+    if _INGEST_IMPL == "sorted":
+        return _counter_ingest_sorted(state, idx, slots, values, times)
     s, sq, c = _seg3(state.sum, state.sum_sq, state.count, idx, values)
     return CounterState(
         sum=s,
@@ -317,6 +401,8 @@ def gauge_ingest(
     when strictly after); count includes NaN values but sum/min/max
     ignore them (gauge.go:57-63,95-103).
     """
+    if _INGEST_IMPL == "sorted":
+        return _gauge_ingest_sorted(state, idx, slots, values, times)
     n = values.shape[0]
     nan = jnp.isnan(values)
     safe = jnp.where(nan, 0.0, values)

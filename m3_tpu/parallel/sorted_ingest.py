@@ -1,0 +1,148 @@
+"""Sort + segmented-scan + gather-merge ingest for the aggregation arenas.
+
+Round-5 live-TPU measurements (TPU_RESULTS_r05.json window #3) showed
+XLA scatter is the arena bottleneck on the flagship hardware: the C=1M
+rollup ingests at ~1.07M samples/s uncontended, and even the timer's
+COLLISION-FREE append scatters run ~1.4M samples/s — TPU scatter costs
+~1us/element regardless of collisions.  The reference hot loop this
+replaces is a hash-map walk with per-entry locks
+(src/aggregator/aggregator/generic_elem.go:181-196, aggregation/
+counter.go:53-76, gauge.go:53-104); the TPU-shaped answer is to use the
+ops the hardware is actually fast at — sort, scan, gather:
+
+1. ONE lexicographic sort per batch, slot-major composite key
+   ``k = slot*(W+1) + window`` (the sentinel window W keeps
+   window-dropped samples inside their slot's block, so per-slot
+   last-write times still see them, exactly like the scatter path).
+2. A head-flag segmented ``associative_scan`` computes every
+   per-(window, slot) statistic — sum / sum-of-squares / count / min /
+   max — in a single pass; a second single-lane scan reduces per-slot
+   last-write times.
+3. ``searchsorted`` GATHERS each arena cell's segment total (the last
+   occurrence of its key carries the inclusive-scan segment result).
+   No scatter anywhere: the merge is dense, deterministic elementwise
+   work over the (W*C,) columns the ingest was going to rewrite anyway.
+
+Semantics are pinned equal to the scatter path (tests/
+test_sorted_ingest.py): OOB drops, NaN handling (counted, not summed),
+gauge last-value winner rules (max time, first arrival on ties, only
+strictly-newer beats the stored winner), and per-slot expiry times.
+Float sums may differ from scatter order by normal reassociation
+rounding; integer lanes are bit-equal.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def head_flag_scan(is_start, adds=(), mins=(), maxs=()):
+    """Inclusive segmented reduction via one associative scan.
+
+    ``is_start`` (N,) bool marks segment heads of the already-sorted
+    batch.  Each array in ``adds``/``mins``/``maxs`` is reduced with
+    +/min/max within segments; position i of a result holds the
+    reduction of its segment's prefix up to i, so the LAST position of
+    a segment holds the full segment total.  Returns (adds, mins, maxs)
+    tuples in the caller's order.
+    """
+    n_adds, n_mins = len(adds), len(mins)
+
+    def comb(a, b):
+        fa, fb = a[0], b[0]
+        out = [fa | fb]
+        j = 1
+        for _ in range(n_adds):
+            out.append(jnp.where(fb, b[j], a[j] + b[j]))
+            j += 1
+        for _ in range(n_mins):
+            out.append(jnp.where(fb, b[j], jnp.minimum(a[j], b[j])))
+            j += 1
+        for _ in range(len(maxs)):
+            out.append(jnp.where(fb, b[j], jnp.maximum(a[j], b[j])))
+            j += 1
+        return tuple(out)
+
+    res = jax.lax.associative_scan(
+        comb, (is_start,) + tuple(adds) + tuple(mins) + tuple(maxs))
+    return (res[1:1 + n_adds], res[1 + n_adds:1 + n_adds + n_mins],
+            res[1 + n_adds + n_mins:])
+
+
+def last_occurrence(sorted_keys, queries):
+    """(position, found) of the last occurrence of each query in
+    ``sorted_keys`` — the gather side of the merge.  Positions are
+    clamped valid so callers can gather unconditionally and mask with
+    ``found``."""
+    n = sorted_keys.shape[0]
+    pos = jnp.searchsorted(sorted_keys, queries, side="right") - 1
+    pos_c = jnp.clip(pos, 0, max(n - 1, 0))
+    found = (pos >= 0) & (sorted_keys[pos_c] == queries)
+    return pos_c, found
+
+
+def composite_key(idx, slots, num_windows: int, capacity: int):
+    """Slot-major sort key ``slot*(W+1) + window``.
+
+    Valid samples (0 <= idx < W*C) keep their window; dropped samples
+    (negative or sentinel idx) map to the sentinel window W so they
+    stay inside their own slot's block — visible to per-slot reductions
+    (scatter's last_at semantics), invisible to per-(window, slot)
+    queries (nothing queries window W).  Out-of-range slots (negative
+    or >= C) map to the sentinel slot C, which nothing queries either.
+    (The raw scatter path would WRAP a negative slot numpy-style even
+    under mode='drop' — a lowering artifact, not a contract; the
+    package-wide sentinel contract, already pinned by
+    xla_segment_ingest and the pallas kernel, is that invalid indices
+    DROP, and the sorted impl follows it.)
+    """
+    window = jnp.where((idx < 0) | (idx >= num_windows * capacity),
+                       num_windows, idx // capacity)
+    slot_c = jnp.where((slots < 0) | (slots > capacity),
+                       capacity, slots).astype(jnp.int64)
+    return slot_c * (num_windows + 1) + window
+
+
+def arena_queries(num_windows: int, capacity: int):
+    """Composite keys for every (window, slot) arena cell, in flat
+    ``window*C + slot`` order (the arenas' column layout)."""
+    o = jnp.arange(num_windows * capacity, dtype=jnp.int64)
+    w, c = o // capacity, o % capacity
+    return c * (num_windows + 1) + w
+
+
+def slot_tail_queries(num_windows: int, capacity: int):
+    """For per-slot reductions: the largest possible key in each slot's
+    block (window sentinel W), so last_occurrence(side=right) lands on
+    the block's final element even when only dropped samples exist."""
+    c = jnp.arange(capacity, dtype=jnp.int64)
+    return c * (num_windows + 1) + num_windows
+
+
+def slot_block_end(sorted_keys, num_windows: int, capacity: int):
+    """(position, nonempty) of the final element of each slot's block
+    in the slot-major sorted batch."""
+    tail_q = slot_tail_queries(num_windows, capacity)
+    n = sorted_keys.shape[0]
+    pos = jnp.searchsorted(sorted_keys, tail_q, side="right") - 1
+    pos_c = jnp.clip(pos, 0, max(n - 1, 0))
+    # The block is non-empty iff the element at pos belongs to this slot.
+    blk = sorted_keys[pos_c] // (num_windows + 1)
+    nonempty = (pos >= 0) & (blk == jnp.arange(capacity, dtype=jnp.int64))
+    return pos_c, nonempty
+
+
+def merged_slot_last_at(last_at, s_k, s_tim, num_windows: int,
+                        capacity: int):
+    """The per-slot last-write-time merge both arenas share: segmented
+    max of sorted times over slot blocks (window-dropped samples
+    included, matching the scatter path's unconditional last_at bump),
+    gathered at each block's end and maxed into the existing column."""
+    i64_min = jnp.iinfo(jnp.int64).min
+    slot_start = jnp.concatenate(
+        [jnp.ones(1, bool),
+         (s_k[1:] // (num_windows + 1)) != (s_k[:-1] // (num_windows + 1))])
+    _, _, (stmax,) = head_flag_scan(slot_start, maxs=(s_tim,))
+    spos, sfound = slot_block_end(s_k, num_windows, capacity)
+    return jnp.maximum(last_at, jnp.where(sfound, stmax[spos], i64_min))

@@ -1,0 +1,202 @@
+"""Sorted (sort/scan/gather) arena ingest vs the scatter oracle.
+
+The sorted impl exists because live-TPU round-5 measurement showed XLA
+scatter costs ~1us/element on the chip (TPU_RESULTS_r05.json window #3:
+C=1M rollup at 1.07M samples/s).  Its semantics must be EXACTLY the
+scatter path's: OOB drops, NaN counted-not-summed, last-value winner
+rules, per-slot expiry bumps from window-dropped samples.  Integer
+lanes must be bit-equal; float sums may reassociate (atol pins them).
+"""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from m3_tpu.aggregator import arena  # noqa: E402
+
+
+@pytest.fixture
+def sorted_impl():
+    arena.set_ingest_impl("sorted")
+    yield
+    arena.set_ingest_impl("scatter")
+
+
+def _random_batch(rng, W, C, N, oob_windows=True, oob_slots=True,
+                  time_ties=False):
+    windows = rng.integers(-1 if oob_windows else 0,
+                           W + (2 if oob_windows else 0), N).astype(np.int32)
+    hi = C + (3 if oob_slots else 0)
+    slots = rng.integers(0, hi, N).astype(np.int32)
+    times = 1_000 + rng.integers(0, 50 if time_ties else 1_000_000,
+                                 N).astype(np.int64)
+    widx = arena.flat_window_index(jnp.asarray(windows), jnp.asarray(slots),
+                                   W, C)
+    # Samples whose SLOT is padded must carry the sentinel index too
+    # (pad_slots + flat_window_index always travel together in callers).
+    widx = jnp.where(jnp.asarray(slots) >= C, W * C, widx)
+    return widx, jnp.asarray(slots), jnp.asarray(times)
+
+
+def _assert_state_equal(base, flip, float_fields=(), atol=1e-9):
+    for name in base._fields:
+        b = np.asarray(getattr(base, name))
+        f = np.asarray(getattr(flip, name))
+        if name in float_fields:
+            np.testing.assert_allclose(f, b, atol=atol, err_msg=name)
+        else:
+            np.testing.assert_array_equal(f, b, err_msg=name)
+
+
+class TestCounterSorted:
+    def _drive(self, seed=0, W=3, C=257, N=5000, **kw):
+        rng = np.random.default_rng(seed)
+        idx, slots, times = _random_batch(rng, W, C, N, **kw)
+        values = jnp.asarray(rng.integers(-1000, 1000, N, np.int64))
+        return arena.counter_ingest(arena.counter_init(W, C), idx, slots,
+                                    values, times)
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_matches_scatter_bit_exact(self, seed, sorted_impl):
+        arena.set_ingest_impl("scatter")
+        base = self._drive(seed)
+        arena.set_ingest_impl("sorted")
+        flip = self._drive(seed)
+        _assert_state_equal(base, flip)  # all-integer: bit equality
+
+    def test_two_batches_accumulate(self, sorted_impl):
+        rng = np.random.default_rng(5)
+        W, C, N = 2, 64, 2000
+        states = []
+        for impl in ("scatter", "sorted"):
+            arena.set_ingest_impl(impl)
+            st = arena.counter_init(W, C)
+            for b in range(2):
+                idx, slots, times = _random_batch(rng := np.random.default_rng(b), W, C, N)
+                vals = jnp.asarray(np.random.default_rng(b + 9).integers(
+                    -50, 50, N, np.int64))
+                st = arena.counter_ingest(st, idx, slots, vals, times)
+            states.append(st)
+        _assert_state_equal(states[0], states[1])
+
+    def test_empty_batch_is_noop(self, sorted_impl):
+        # counter_ingest donates its state arg: compare the result
+        # against a FRESH init, not the (now-invalidated) input.
+        W, C = 2, 16
+        st = arena.counter_ingest(arena.counter_init(W, C),
+                                  jnp.zeros(0, jnp.int64),
+                                  jnp.zeros(0, jnp.int32),
+                                  jnp.zeros(0, jnp.int64),
+                                  jnp.zeros(0, jnp.int64))
+        _assert_state_equal(arena.counter_init(W, C), st)
+
+    def test_negative_slot_drops_not_wraps(self, sorted_impl):
+        """The package sentinel contract (xla_segment_ingest, pallas):
+        invalid indices DROP.  (Raw scatter would wrap slot -1 to C-1
+        numpy-style — a lowering artifact the sorted impl does not
+        copy; see sorted_ingest.composite_key.)"""
+        W, C = 1, 8
+        st = arena.counter_ingest(
+            arena.counter_init(W, C),
+            jnp.asarray([W * C], jnp.int64), jnp.asarray([-1], jnp.int32),
+            jnp.asarray([5], jnp.int64), jnp.asarray([123], jnp.int64))
+        assert int(st.count.sum()) == 0
+        assert int(st.last_at.sum()) == 0  # no slot bumped
+
+    def test_window_dropped_still_bumps_last_at(self, sorted_impl):
+        """A sample with an out-of-ring window is dropped from the
+        arena lanes but must still advance its slot's last-write time
+        (the scatter path updates last_at by slot, unconditionally)."""
+        W, C = 2, 16
+        idx = jnp.asarray([W * C], jnp.int64)  # sentinel: window-dropped
+        slots = jnp.asarray([7], jnp.int32)
+        vals = jnp.asarray([123], jnp.int64)
+        times = jnp.asarray([999_999], jnp.int64)
+        st = arena.counter_ingest(arena.counter_init(W, C), idx, slots,
+                                  vals, times)
+        assert int(st.count.sum()) == 0
+        assert int(st.last_at[7]) == 999_999
+
+
+class TestGaugeSorted:
+    def _drive(self, seed=0, W=3, C=257, N=5000, nan_frac=0.01, **kw):
+        rng = np.random.default_rng(seed)
+        idx, slots, times = _random_batch(rng, W, C, N, **kw)
+        vals = np.round(rng.normal(0, 10, N), 6)
+        vals[rng.random(N) < nan_frac] = np.nan
+        return arena.gauge_ingest(arena.gauge_init(W, C), idx, slots,
+                                  jnp.asarray(vals), times)
+
+    @pytest.mark.parametrize("seed,kw", [
+        (0, {}), (1, {"time_ties": True}), (2, {"nan_frac": 0.3}),
+        (3, {"oob_windows": False, "oob_slots": False}),
+    ])
+    def test_matches_scatter(self, seed, kw, sorted_impl):
+        arena.set_ingest_impl("scatter")
+        base = self._drive(seed, **kw)
+        arena.set_ingest_impl("sorted")
+        flip = self._drive(seed, **kw)
+        _assert_state_equal(base, flip,
+                            float_fields=("sum", "sum_sq"), atol=1e-8)
+        # last/min/max select existing values -> must be bit-equal
+        np.testing.assert_array_equal(np.asarray(base.last),
+                                      np.asarray(flip.last))
+
+    def test_last_winner_tie_first_arrival(self, sorted_impl):
+        """Equal (slot, window, time): the FIRST-ARRIVED value wins,
+        matching gauge.go:82-91 (only strictly-newer replaces)."""
+        W, C = 1, 8
+        slots = jnp.asarray([3, 3, 3], jnp.int32)
+        idx = arena.flat_window_index(jnp.zeros(3, jnp.int32), slots, W, C)
+        vals = jnp.asarray([1.0, 2.0, 3.0])
+        times = jnp.asarray([50, 50, 50], jnp.int64)
+        st = arena.gauge_ingest(arena.gauge_init(W, C), idx, slots, vals,
+                                times)
+        assert float(st.last[3]) == 1.0
+
+    def test_stored_winner_beats_equal_time(self, sorted_impl):
+        """A second batch at the SAME time must not displace the stored
+        winner (strictly-after rule)."""
+        W, C = 1, 8
+        slots = jnp.asarray([2], jnp.int32)
+        idx = arena.flat_window_index(jnp.zeros(1, jnp.int32), slots, W, C)
+        st = arena.gauge_init(W, C)
+        st = arena.gauge_ingest(st, idx, slots, jnp.asarray([7.0]),
+                                jnp.asarray([100], jnp.int64))
+        st = arena.gauge_ingest(st, idx, slots, jnp.asarray([9.0]),
+                                jnp.asarray([100], jnp.int64))
+        assert float(st.last[2]) == 7.0
+
+    def test_all_nan_slot_min_max_stay_identity(self, sorted_impl):
+        W, C = 1, 4
+        slots = jnp.asarray([1, 1], jnp.int32)
+        idx = arena.flat_window_index(jnp.zeros(2, jnp.int32), slots, W, C)
+        st = arena.gauge_ingest(arena.gauge_init(W, C), idx, slots,
+                                jnp.asarray([np.nan, np.nan]),
+                                jnp.asarray([5, 6], jnp.int64))
+        assert np.isinf(float(st.min[1])) and np.isinf(float(st.max[1]))
+        assert int(st.count[1]) == 2  # NaN counted, not summed
+        assert float(st.sum[1]) == 0.0
+
+
+class TestSortedConsumeParity:
+    """End-to-end: consume lanes after sorted ingest == after scatter."""
+
+    def test_consume_lanes_match(self, sorted_impl):
+        rng = np.random.default_rng(11)
+        W, C, N = 2, 128, 4096
+        windows = jnp.asarray(rng.integers(0, W, N).astype(np.int32))
+        slots = jnp.asarray(rng.integers(0, C, N).astype(np.int32))
+        idx = arena.flat_window_index(windows, slots, W, C)
+        times = jnp.asarray(1000 + np.arange(N, dtype=np.int64))
+        gvals = jnp.asarray(np.round(rng.normal(0, 10, N), 4))
+        lanes = {}
+        for impl in ("scatter", "sorted"):
+            arena.set_ingest_impl(impl)
+            st = arena.gauge_ingest(arena.gauge_init(W, C), idx, slots,
+                                    gvals, times)
+            lanes[impl], _ = arena.gauge_consume(st, jnp.int32(0), C)
+        np.testing.assert_allclose(np.asarray(lanes["sorted"]),
+                                   np.asarray(lanes["scatter"]),
+                                   atol=1e-8, equal_nan=True)
