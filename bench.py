@@ -551,6 +551,33 @@ def _run_agg_bench(kind: str, C: int, N: int, NT: int, platform: str) -> dict:
         if not np.allclose(dq[sample], host_out[sample, :3], rtol=1e-9,
                            atol=1e-9):
             out["validation"] = "quantile mismatch vs host proxy"
+
+    # Sorted-impl ingest comparison (same drain; sample buffers are
+    # bit-identical across impls, so only the ingest is re-timed).
+    if _left() > 90 + NT // 200_000:
+        prior_impl = arena.ingest_impl()
+        try:
+            arena.set_ingest_impl("sorted")
+            tstep.clear_cache()
+            ts2 = tstep(arena.timer_init(1, C, NTpad), *batches[0], jt)
+            jax.block_until_ready(ts2.sum)  # compile+warm, then discard
+            ts2 = arena.timer_init(1, C, NTpad)
+            t0 = time.perf_counter()
+            for win, slots, values in batches:
+                ts2 = tstep(ts2, win, slots, values, jt)
+            jax.block_until_ready(ts2.sum)
+            s_ingest = time.perf_counter() - t0
+            sok = int(jnp.sum(tdrain(ts2)[1])) == NT
+            out.update(
+                ingest_s_sorted=round(s_ingest, 3),
+                samples_per_sec_sorted=round(NT / (s_ingest + drain_s)),
+                sorted_validation="ok" if sok else "count mismatch",
+                sorted_vs_scatter_ingest=round(ingest_s / s_ingest, 3))
+        except Exception as e:  # record, keep the scatter result
+            out["sorted_validation"] = f"{type(e).__name__}: {e}"[:200]
+        finally:
+            arena.set_ingest_impl(prior_impl)
+            tstep.clear_cache()
     return out
 
 

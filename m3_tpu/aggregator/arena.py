@@ -191,6 +191,86 @@ def _gauge_ingest_sorted(state: "GaugeState", idx, slots, values,
     )
 
 
+def _timer_ingest_sorted(state: "TimerState", windows, slots, values,
+                         times, capacity: int) -> "TimerState":
+    """Sort/scan/gather form of Timer.AddBatch: moments and per-slot
+    expiry ride the shared slot-major machinery; the sample append
+    keeps the scatter path's exact buffer layout (batch order at
+    ``sample_n[w] + rank``), with a contiguous dynamic_update_slice
+    fast path when a single-window batch has no drops and fits — the
+    common shape, and a memcpy instead of a ~1us/element scatter."""
+    if values.shape[0] == 0:
+        return state
+    num_w, scap = state.sample_slot.shape
+    n = values.shape[0]
+    idx = windows * capacity + slots
+    oob = (windows < 0) | (windows >= num_w)
+    idx = jnp.where(oob, num_w * capacity, idx)
+
+    so, W, k = _sorted_prep(state.sum.shape[0], capacity, idx, slots)
+    s_k, s_val, s_tim = jax.lax.sort((k, values, times), num_keys=1)
+    is_start = jnp.concatenate(
+        [jnp.ones(1, bool), s_k[1:] != s_k[:-1]])
+    ones = jnp.ones(n, state.count.dtype)
+    (ssum, ssq, scnt), _, _ = so.head_flag_scan(
+        is_start, adds=(s_val, s_val * s_val, ones))
+    pos, found = so.last_occurrence(s_k, so.arena_queries(W, capacity))
+    zero_f = jnp.zeros((), state.sum.dtype)
+    zero_i = jnp.zeros((), state.count.dtype)
+
+    # Append ranks: identical to the scatter path (batch order), so the
+    # buffers come out bit-identical under either impl.
+    order_key = jnp.where(oob, num_w, windows)
+    onehot = order_key[None, :] == jnp.arange(
+        num_w, dtype=order_key.dtype)[:, None]
+    ranks_all = jnp.cumsum(onehot.astype(jnp.int64), axis=1) - 1
+    w_clip = jnp.clip(order_key, 0, num_w - 1)
+    rank = jnp.take_along_axis(ranks_all, w_clip[None, :], axis=0)[0]
+    base = state.sample_n[w_clip]
+    dst = base + rank
+    flat = jnp.where(~oob & (dst < scap),
+                     w_clip.astype(jnp.int64) * scap + dst, num_w * scap)
+    per_w_counts = onehot.sum(axis=1, dtype=state.sample_n.dtype)
+
+    def _append_scatter(ops):
+        fslot, fval = ops
+        return (fslot.at[flat].set(slots, mode="drop"),
+                fval.at[flat].set(values, mode="drop"))
+
+    flat_slot = state.sample_slot.ravel()
+    flat_val = state.sample_val.ravel()
+    # The dus update operand must be no larger than the buffer, a
+    # TRACE-time constraint: a batch bigger than the whole buffer can
+    # never fit anyway, so it is statically pinned to the scatter form.
+    if num_w == 1 and n <= scap:
+        fits = jnp.logical_not(oob.any()) & (state.sample_n[0] + n <= scap)
+
+        def _append_dus(ops):
+            fslot, fval = ops
+            start = state.sample_n[0]
+            return (
+                jax.lax.dynamic_update_slice_in_dim(
+                    fslot, slots.astype(fslot.dtype), start, 0),
+                jax.lax.dynamic_update_slice_in_dim(fval, values, start, 0),
+            )
+
+        new_slot, new_val = jax.lax.cond(
+            fits, _append_dus, _append_scatter, (flat_slot, flat_val))
+    else:
+        new_slot, new_val = _append_scatter((flat_slot, flat_val))
+
+    return TimerState(
+        sum=state.sum + jnp.where(found, ssum[pos], zero_f),
+        sum_sq=state.sum_sq + jnp.where(found, ssq[pos], zero_f),
+        count=state.count + jnp.where(found, scnt[pos], zero_i),
+        sample_slot=new_slot.reshape(num_w, scap),
+        sample_val=new_val.reshape(num_w, scap),
+        sample_n=state.sample_n + per_w_counts,
+        last_at=so.merged_slot_last_at(state.last_at, s_k, s_tim, W,
+                                       capacity),
+    )
+
+
 def _seg3(sum_col, sq_col, cnt_col, idx, values):
     """The sum / sum² / count accumulation every arena shares, routed
     through the configured implementation.  ``idx`` >= len(sum_col)
@@ -547,6 +627,9 @@ def timer_ingest(
     moment stats stay exact; quantiles degrade — counted by the caller
     via sample_n overflow).
     """
+    if _INGEST_IMPL == "sorted":
+        return _timer_ingest_sorted(state, windows, slots, values, times,
+                                    capacity)
     num_w, scap = state.sample_slot.shape
     idx = windows * capacity + slots
     oob = (windows < 0) | (windows >= num_w)

@@ -180,6 +180,50 @@ class TestGaugeSorted:
         assert float(st.sum[1]) == 0.0
 
 
+class TestTimerSorted:
+    def _drive(self, seed=0, W=2, C=129, N=4000, S=1 << 13, oob=True):
+        rng = np.random.default_rng(seed)
+        windows = rng.integers(-1 if oob else 0, W + (2 if oob else 0),
+                               N).astype(np.int32)
+        slots = jnp.asarray(rng.integers(0, C, N).astype(np.int32))
+        vals = jnp.asarray(np.round(rng.gamma(2.0, 5.0, N), 4))
+        times = jnp.asarray(1000 + rng.integers(0, 10**6, N).astype(np.int64))
+        return arena.timer_ingest(arena.timer_init(W, C, S),
+                                  jnp.asarray(windows), slots, vals, times,
+                                  C)
+
+    @pytest.mark.parametrize("seed,kw", [
+        (0, {}), (1, {"W": 1, "oob": False}),  # dus fast path shape
+        (2, {"W": 1, "oob": True}),            # W=1 but drops: cond false
+        (3, {"W": 1, "oob": False, "N": 4000, "S": 1024}),  # overflow
+    ])
+    def test_matches_scatter(self, seed, kw, sorted_impl):
+        arena.set_ingest_impl("scatter")
+        base = self._drive(seed, **kw)
+        arena.set_ingest_impl("sorted")
+        flip = self._drive(seed, **kw)
+        # Sample buffers and counts must be BIT-identical (same batch
+        # order, same positions); float moments within reassociation.
+        _assert_state_equal(base, flip, float_fields=("sum", "sum_sq"),
+                            atol=1e-8)
+
+    def test_two_batches_fast_path_appends(self, sorted_impl):
+        """Consecutive fitting single-window batches must append at the
+        moving sample_n offset (the dus start is dynamic)."""
+        W, C, S = 1, 8, 64
+        st = arena.timer_init(W, C, S)
+        for b in range(3):
+            st = arena.timer_ingest(
+                st, jnp.zeros(4, jnp.int32),
+                jnp.asarray([1, 2, 3, 1], jnp.int32),
+                jnp.asarray([float(b * 10 + i) for i in range(4)]),
+                jnp.asarray([100 + b] * 4, jnp.int64), C)
+        assert int(st.sample_n[0]) == 12
+        np.testing.assert_array_equal(
+            np.asarray(st.sample_val[0][:12]),
+            [0., 1., 2., 3., 10., 11., 12., 13., 20., 21., 22., 23.])
+
+
 class TestSortedConsumeParity:
     """End-to-end: consume lanes after sorted ingest == after scatter."""
 
