@@ -1,0 +1,142 @@
+"""Relay watcher: catch the next live-TPU window automatically.
+
+The round-5 relay comes and goes in ~25-50 min windows with hours of
+downtime between them.  This watcher TCP-probes the relay port from a
+JAX-free parent; when the port answers it runs the queued measurement
+stages, each in its own subprocess with a hard timeout (a relay death
+mid-stage hangs JAX forever — the parent must be able to kill and
+resume probing).  Every stage writes its fragment to the capture file
+the moment it finishes.
+
+Stops when: all stages done, /root/repo/.stop_watcher exists (touched
+before the round's driver bench runs — the device is single-client and
+the watcher must never collide with it), or the lifetime cap expires.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+REPO = "/root/repo"
+OUT = os.path.join(REPO, "TPU_CAPTURE_r05c.json")
+STOP = os.path.join(REPO, ".stop_watcher")
+PORT = int(os.environ.get("M3_AXON_RELAY_PORT", "8113"))
+LIFETIME_S = 8 * 3600
+
+CHILD_TPL = r"""
+import os, sys, json, time
+os.environ["M3_BENCH_DEADLINE_SEC"] = "100000"
+sys.path.insert(0, {repo!r})
+import bench
+t0 = time.time()
+stage = {stage!r}
+if stage == "pallas":
+    r = bench._run_pallas_compare("tpu")
+elif stage == "rollup_full":
+    r = bench._run_agg_bench("rollup", C=1_000_000, N=2_000_000,
+                             NT=10_000_000, platform="tpu")
+elif stage == "timer_full":
+    r = bench._run_agg_bench("timer", C=1_000_000, N=0, NT=10_000_000,
+                             platform="tpu")
+else:
+    raise SystemExit(f"unknown stage {{stage}}")
+r["wall_s"] = round(time.time() - t0, 1)
+with open({frag!r}, "w") as f:
+    json.dump(r, f)
+print("STAGE_OK", flush=True)
+"""
+
+STAGES = [  # (name, timeout_s, max_attempts)
+    ("pallas", 900, 3),
+    ("rollup_full", 2400, 2),
+    ("timer_full", 2400, 2),
+]
+
+
+def log(*a):
+    print(f"[{time.strftime('%H:%M:%S')}]", *a, flush=True)
+
+
+def relay_open() -> bool:
+    s = socket.socket()
+    s.settimeout(3)
+    try:
+        s.connect(("127.0.0.1", PORT))
+        return True
+    except OSError:
+        return False
+    finally:
+        s.close()
+
+
+def flush(results: dict) -> None:
+    with open(OUT, "w") as f:
+        json.dump({"note": "Watcher-captured round-5 TPU stages "
+                           "(tools_tpu_watch.py): fixed-pallas verdict, "
+                           "C=1M rollup scatter-vs-sorted, NT=10M timer "
+                           "with sorted ingest comparison.",
+                   "results": results}, f, indent=1)
+
+
+def main() -> None:
+    t_end = time.time() + LIFETIME_S
+    attempts = {name: 0 for name, _, _ in STAGES}
+    results: dict = {}
+    if os.path.exists(OUT):  # resume a prior watcher's progress
+        try:
+            results = json.load(open(OUT))["results"]
+        except Exception:
+            results = {}
+    while time.time() < t_end:
+        if os.path.exists(STOP):
+            log("stop file present; exiting")
+            return
+        pending = [(n, t, m) for n, t, m in STAGES
+                   if n not in results and attempts[n] < m]
+        if not pending:
+            log("all stages resolved; exiting")
+            return
+        if not relay_open():
+            time.sleep(60)
+            continue
+        log("relay OPEN; pending:", [n for n, _, _ in pending])
+        for name, budget, _max in pending:
+            if os.path.exists(STOP):
+                return
+            if not relay_open():
+                log("relay lost before", name)
+                break
+            attempts[name] += 1
+            frag = f"/tmp/tpu_stage_{name}.json"
+            if os.path.exists(frag):
+                os.remove(frag)
+            log(f"stage {name} attempt {attempts[name]} (budget {budget}s)")
+            code = CHILD_TPL.format(repo=REPO, stage=name, frag=frag)
+            try:
+                p = subprocess.run([sys.executable, "-c", code],
+                                   timeout=budget, capture_output=True,
+                                   text=True)
+                tail = (p.stdout + p.stderr)[-2000:]
+            except subprocess.TimeoutExpired:
+                log(f"stage {name}: TIMEOUT after {budget}s")
+                results_note = f"timeout after {budget}s"
+                if attempts[name] >= _max:
+                    results[name] = {"error": results_note}
+                    flush(results)
+                continue
+            if os.path.exists(frag):
+                results[name] = json.load(open(frag))
+                log(f"stage {name}: OK -> {json.dumps(results[name])[:160]}")
+            else:
+                log(f"stage {name}: FAILED rc={p.returncode}: {tail[-400:]}")
+                if attempts[name] >= _max:
+                    results[name] = {"error": f"rc={p.returncode}: "
+                                              f"{tail[-400:]}"}
+            flush(results)
+    log("lifetime cap reached; exiting")
+
+
+if __name__ == "__main__":
+    main()
