@@ -46,10 +46,19 @@ class SeriesMeta:
 
 @dataclasses.dataclass
 class Block:
-    """Step-aligned block: values[s, t] at step_times[t] (NaN = no sample)."""
+    """Step-aligned block: values[s, t] at step_times[t] (NaN = no sample).
+
+    ``values`` may be a numpy array OR a device (JAX) array: the engine
+    keeps blocks device-resident between pipeline stages — a
+    rate→histogram_quantile chain at 100K series moves ~200MB per hop,
+    which must not round-trip through the host — and materializes ONCE
+    at the query boundary (`Engine._execute_range`).  Host-side
+    consumers inside the engine simply use numpy ops (a device array
+    converts implicitly); anything outside the engine only ever sees
+    numpy."""
 
     step_times: np.ndarray  # (T,) int64 UnixNanos
-    values: np.ndarray  # (S, T) float64
+    values: np.ndarray  # (S, T) float64 (numpy or device array)
     series: list[SeriesMeta]
 
     @property
@@ -61,8 +70,13 @@ class Block:
         return self.values.shape[1]
 
     def with_values(self, values, series: list[SeriesMeta] | None = None) -> "Block":
-        return Block(self.step_times, np.asarray(values),
+        return Block(self.step_times, values,
                      series if series is not None else self.series)
+
+    def materialized(self) -> "Block":
+        """Force values to host float64 (the query-boundary sync)."""
+        return Block(self.step_times, np.asarray(self.values, np.float64),
+                     self.series)
 
 
 @dataclasses.dataclass

@@ -77,12 +77,10 @@ def group_quantile(values: np.ndarray, gids: np.ndarray, num_groups: int,
 
     dt = precision.compute_dtype()
     idx, mask = group_plan(gids, num_groups)
-    return np.asarray(
-        _group_quantile_kernel(
-            jnp.asarray(values, dt), jnp.asarray(idx), jnp.asarray(mask),
-            jnp.asarray(q, dt),
-        ), np.float64
-    )
+    return _group_quantile_kernel(
+        jnp.asarray(values, dt), jnp.asarray(idx), jnp.asarray(mask),
+        jnp.asarray(q, dt),
+    ).astype(jnp.float64)  # device-resident (Block contract)
 
 
 # ---------------------------------------------------------------------------
@@ -116,10 +114,8 @@ def _topk_mask_kernel(values, idx, mask, k: int, top: bool):
 def topk_mask(values: np.ndarray, gids: np.ndarray, num_groups: int,
               k: int, top: bool) -> np.ndarray:
     idx, mask = group_plan(gids, num_groups)
-    return np.asarray(
-        _topk_mask_kernel(jnp.asarray(values), jnp.asarray(idx),
-                          jnp.asarray(mask), k=int(k), top=bool(top))
-    )
+    return _topk_mask_kernel(jnp.asarray(values), jnp.asarray(idx),
+                             jnp.asarray(mask), k=int(k), top=bool(top))
 
 
 # ---------------------------------------------------------------------------
@@ -188,12 +184,10 @@ def histogram_quantile_groups(values: np.ndarray, group_rows: list,
     from m3_tpu.query import precision
 
     dt = precision.compute_dtype()
-    return np.asarray(
-        _histogram_quantile_kernel(
-            jnp.asarray(values, dt), jnp.asarray(idx), jnp.asarray(nb),
-            jnp.asarray(ubs, dt), jnp.asarray(q, dt),
-        ), np.float64
-    )
+    return _histogram_quantile_kernel(
+        jnp.asarray(values, dt), jnp.asarray(idx), jnp.asarray(nb),
+        jnp.asarray(ubs, dt), jnp.asarray(q, dt),
+    ).astype(jnp.float64)  # device-resident (Block contract)
 
 
 # ---------------------------------------------------------------------------
@@ -232,5 +226,5 @@ def vector_binary_matched(l_values: np.ndarray, r_values: np.ndarray,
     dt = np.float64 if op in COMPARISONS else precision.compute_dtype()
     lv = jnp.asarray(l_values, dt)[jnp.asarray(np.asarray(rows_l, np.int32))]
     rv = jnp.asarray(r_values, dt)[jnp.asarray(np.asarray(rows_r, np.int32))]
-    return np.asarray(
-        _vector_binary_kernel(lv, rv, op=op, bool_mode=bool_mode), np.float64)
+    return _vector_binary_kernel(
+        lv, rv, op=op, bool_mode=bool_mode).astype(jnp.float64)

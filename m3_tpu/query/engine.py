@@ -97,7 +97,10 @@ class Engine:
                 np.asarray(out.value, np.float64), (1, len(steps))
             ).copy()
             return Block(steps, vals, [SeriesMeta(())])
-        return out
+        # The ONE device->host sync: blocks stay device-resident between
+        # pipeline stages (see Block docstring) and leave the engine as
+        # host float64.
+        return out.materialized()
 
     def execute_instant(self, query: str, time_nanos: int) -> Block:
         return self.execute_range(query, time_nanos, time_nanos, 10**9)
@@ -189,22 +192,22 @@ class Engine:
             vals = np.broadcast_to(
                 np.asarray(b.value, np.float64), (len(inner),))
             b = Block(inner, vals[None, :].copy(), [SeriesMeta(())])
+        bvals = np.asarray(b.values)  # one sync, not one per row
         pts = [
             [(int(t), float(v)) for t, v in zip(inner, row)
              if not math.isnan(v)]
-            for row in b.values
+            for row in bvals
         ]
         raw = RawBlock.from_lists(pts, b.series)
         return raw, steps - sub.offset_nanos
 
     def _eval_instant_selector(self, sel: VectorSelector, steps: np.ndarray) -> Block:
         raw, eval_steps = self._fetch(sel, steps, self.lookback)
-        vals = np.asarray(
-            tp.last_over_time(jnp.asarray(raw.ts), jnp.asarray(raw.values),
-                              jnp.asarray(eval_steps), self.lookback)
-        )
+        vals = tp.last_over_time(jnp.asarray(raw.ts),
+                                 jnp.asarray(raw.values),
+                                 jnp.asarray(eval_steps), self.lookback)
         if vals.shape[1] != len(steps):  # @-pinned single column
-            vals = np.broadcast_to(vals, (vals.shape[0], len(steps))).copy()
+            vals = jnp.broadcast_to(vals, (vals.shape[0], len(steps)))
         return Block(steps, vals, raw.series)
 
     def _eval_call(self, call: Call, steps: np.ndarray):
@@ -290,12 +293,15 @@ class Engine:
                 out = tp.sum_count_family(ts_j, vals_j, st_j, rng, "count_over_time")
                 out = jnp.where(jnp.isnan(out), out, jnp.minimum(out, 1.0))
             metas = [m.drop_name() for m in raw.series]
-            # Blocks stay f64 at the API surface whatever the compute
-            # policy — downstream numpy code and callers see one dtype.
-            out = np.asarray(out, np.float64)
+            # Blocks stay f64 whatever the compute policy — downstream
+            # code sees one dtype.  The cast happens ON DEVICE; the
+            # block leaves the engine device-resident so a following
+            # stage (histogram_quantile, aggregation) consumes it
+            # without a host round-trip.
+            out = out.astype(jnp.float64)
             if out.ndim == 2 and out.shape[1] != len(steps):
                 # @-pinned: one computed column broadcast across steps
-                out = np.broadcast_to(out, (out.shape[0], len(steps)))
+                out = jnp.broadcast_to(out, (out.shape[0], len(steps)))
             return Block(steps, out, metas)
 
         if f == "histogram_quantile":
@@ -457,26 +463,31 @@ class Engine:
     def _set_op(self, b: BinaryOp, lhs: Block, rhs: Block) -> Block:
         on = set(b.on) if b.on is not None else None
         ig = set(b.ignoring) if b.ignoring is not None else None
+        # Host row-matching path: materialize both sides once up front
+        # (device arrays reject list indexing, and the per-row loop
+        # below would otherwise sync repeatedly).
+        lvals = np.asarray(lhs.values)
+        rvals = np.asarray(rhs.values)
         rkeys = {fn._match_key(m, on, ig): i for i, m in enumerate(rhs.series)}
         if b.op == "or":
             extra_rows = [i for i, m in enumerate(rhs.series)
                           if fn._match_key(m, on, ig) not in
                           {fn._match_key(x, on, ig) for x in lhs.series}]
-            vals = np.concatenate([lhs.values, rhs.values[extra_rows]]) if extra_rows \
-                else lhs.values
+            vals = np.concatenate([lvals, rvals[extra_rows]]) if extra_rows \
+                else lvals
             metas = lhs.series + [rhs.series[i] for i in extra_rows]
             return Block(lhs.step_times, vals, metas)
-        out = np.full_like(lhs.values, np.nan)
+        out = np.full_like(lvals, np.nan)
         for i, m in enumerate(lhs.series):
             j = rkeys.get(fn._match_key(m, on, ig))
             if b.op == "and":
                 if j is not None:
-                    out[i] = np.where(~np.isnan(rhs.values[j]), lhs.values[i], np.nan)
+                    out[i] = np.where(~np.isnan(rvals[j]), lvals[i], np.nan)
             else:  # unless
                 if j is None:
-                    out[i] = lhs.values[i]
+                    out[i] = lvals[i]
                 else:
-                    out[i] = np.where(np.isnan(rhs.values[j]), lhs.values[i], np.nan)
+                    out[i] = np.where(np.isnan(rvals[j]), lvals[i], np.nan)
         return lhs.with_values(out)
 
     # -- helpers -----------------------------------------------------------

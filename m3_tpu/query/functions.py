@@ -100,7 +100,7 @@ def _segment_reduce(values: np.ndarray, gids: np.ndarray, num_groups: int,
         return group_quantile(values, gids, num_groups, q)
     else:
         raise ValueError(f"unknown aggregation {func}")
-    return np.asarray(jnp.where(empty, NAN, out))
+    return jnp.where(empty, NAN, out)  # device-resident (Block contract)
 
 
 def aggregate(block: Block, func: str, by: set[bytes] | None = None,
@@ -118,9 +118,11 @@ def topk_bottomk(block: Block, k: int, func: str,
     from m3_tpu.query.device_fns import topk_mask
 
     gids, metas = group_series(block.series, by, without)
-    v = block.values
+    import jax.numpy as jnp
+
+    v = jnp.asarray(block.values)
     keep = topk_mask(v, gids, len(metas), int(k), func == "topk")
-    out = np.where(keep, v, NAN)
+    out = jnp.where(jnp.asarray(keep), v, NAN)
     return block.with_values(out)
 
 
@@ -163,15 +165,21 @@ def histogram_quantile(block: Block, q: float) -> Block:
         metas.append(SeriesMeta(key))
         group_rows.append([b[1] for b in buckets])
         group_ubs.append(ubs)
-    out_rows = []
+    vals = None
     if group_rows:
-        vals = histogram_quantile_groups(block.values, group_rows, group_ubs, q)
-        out_rows = list(vals)
-    out_rows += [np.full(T, NAN)] * len(nan_metas)
+        # Stays device-resident — iterating rows here would sync each
+        # of the G rows separately (Block contract: one boundary sync).
+        vals = histogram_quantile_groups(block.values, group_rows,
+                                         group_ubs, q)
     metas += nan_metas
-    if not out_rows:
+    if vals is None and not nan_metas:
         return Block(block.step_times, np.zeros((0, T)), [])
-    return Block(block.step_times, np.stack(out_rows), metas)
+    if nan_metas:
+        import jax.numpy as jnp
+
+        nan_blk = jnp.full((len(nan_metas), T), NAN, jnp.float64)
+        vals = nan_blk if vals is None else jnp.concatenate([vals, nan_blk])
+    return Block(block.step_times, vals, metas)
 
 
 # ---------------------------------------------------------------------------

@@ -75,6 +75,12 @@ elif stage == "rollup_full":
 elif stage == "timer_full":
     r = bench._run_agg_bench("timer", C=1_000_000, N=0, NT=10_000_000,
                              platform="tpu")
+elif stage == "promql":
+    # Re-measure BASELINE config #5 after the device-resident pipeline
+    # change (blocks no longer round-trip the tunnel between stages).
+    r = bench._run_promql_bench(12_500, 8, "tpu")
+elif stage == "promql_f32":
+    r = bench._run_promql_bench(12_500, 8, "tpu", "f32")
 else:
     raise SystemExit(f"unknown stage {{stage}}")
 r["wall_s"] = round(time.time() - t0, 1)
@@ -86,8 +92,10 @@ print("STAGE_OK", flush=True)
 STAGES = [  # (name, timeout_s, max_attempts)
     ("latency", 300, 3),
     ("pallas", 900, 3),
+    ("promql", 1200, 2),
     ("rollup_full", 2400, 2),
     ("timer_full", 2400, 2),
+    ("promql_f32", 1200, 2),
 ]
 
 
