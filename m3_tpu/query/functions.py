@@ -21,6 +21,7 @@ from __future__ import annotations
 import math
 from collections import defaultdict
 
+import jax.numpy as jnp
 import numpy as np
 
 from m3_tpu.query.block import Block, SeriesMeta
@@ -240,22 +241,34 @@ def date_fn(block: Block, func: str) -> Block:
     return block.with_values(out, [m.drop_name() for m in block.series])
 
 
+_J_UNARY = {  # device-resident forms (Block contract)
+    "abs": jnp.abs, "ceil": jnp.ceil, "floor": jnp.floor, "exp": jnp.exp,
+    "ln": jnp.log, "log2": jnp.log2, "log10": jnp.log10, "sqrt": jnp.sqrt,
+    "sgn": jnp.sign,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "asin": jnp.arcsin, "acos": jnp.arccos, "atan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "asinh": jnp.arcsinh, "acosh": jnp.arccosh, "atanh": jnp.arctanh,
+    "deg": jnp.degrees, "rad": jnp.radians,
+}
+
+
 def unary_math(block: Block, func: str) -> Block:
-    with np.errstate(all="ignore"):
-        out = _UNARY[func](block.values)
+    out = _J_UNARY[func](jnp.asarray(block.values, jnp.float64))
     return block.with_values(out, [m.drop_name() for m in block.series])
 
 
 def round_fn(block: Block, to_nearest: float = 1.0) -> Block:
-    with np.errstate(all="ignore"):
-        # Prometheus round(): half away from... actually half UP (floor(v+0.5)).
-        out = np.floor(block.values / to_nearest + 0.5) * to_nearest
+    # Prometheus round(): half UP (floor(v+0.5)); device-resident.
+    v = jnp.asarray(block.values, jnp.float64)
+    out = jnp.floor(v / to_nearest + 0.5) * to_nearest
     return block.with_values(out, [m.drop_name() for m in block.series])
 
 
 def clamp(block: Block, lo: float = -math.inf, hi: float = math.inf) -> Block:
     return block.with_values(
-        np.clip(block.values, lo, hi), [m.drop_name() for m in block.series]
+        jnp.clip(jnp.asarray(block.values, jnp.float64), lo, hi),
+        [m.drop_name() for m in block.series]
     )
 
 
@@ -277,18 +290,24 @@ _BINOPS = {
 from m3_tpu.query.device_fns import COMPARISONS as _COMPARISONS
 
 
+_J_BINOPS = {  # device-resident forms (Block contract)
+    "+": jnp.add, "-": jnp.subtract, "*": jnp.multiply, "/": jnp.divide,
+    "%": jnp.mod, "^": jnp.power,
+    "==": jnp.equal, "!=": jnp.not_equal, ">": jnp.greater,
+    "<": jnp.less, ">=": jnp.greater_equal, "<=": jnp.less_equal,
+}
+
+
 def scalar_binary(block: Block, op: str, scalar: float,
                   scalar_left: bool = False, bool_mode: bool = False) -> Block:
-    f = _BINOPS[op]
-    with np.errstate(all="ignore"):
-        out = (
-            f(scalar, block.values) if scalar_left else f(block.values, scalar)
-        ).astype(np.float64)
+    f = _J_BINOPS[op]
+    v = jnp.asarray(block.values, jnp.float64)  # comparisons stay f64
+    out = (f(scalar, v) if scalar_left else f(v, scalar)).astype(jnp.float64)
     if op in _COMPARISONS:
         if bool_mode:
-            out = out  # already 0/1
+            out = jnp.where(jnp.isnan(v), NAN, out)  # NaN stays missing
         else:
-            out = np.where(out != 0, block.values, NAN)  # filter semantics
+            out = jnp.where(out != 0, v, NAN)  # filter semantics
     series = block.series if op in _COMPARISONS and not bool_mode else [
         m.drop_name() for m in block.series
     ]

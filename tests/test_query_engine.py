@@ -188,6 +188,18 @@ class TestEngine:
         )
         assert b2.num_series == 8
 
+    def test_bool_comparison_missing_stays_missing(self, engine):
+        """`v > bool s` on a MISSING sample (NaN in the block model)
+        must stay missing, not fabricate a 0.0 (Prometheus emits no
+        sample where the input has none).  The rate() head drops the
+        first window, so early steps are genuinely missing."""
+        b = engine.execute_range(
+            'rate(http_requests_total{host="h0", job="api"}[5m]) > bool 0',
+            QSTART - 10 * 60 * 10**9, QEND, STEP)
+        v = np.asarray(b.values)
+        assert np.isnan(v[:, 0]).all()  # before data: missing, not 0.0
+        assert (v[~np.isnan(v)] == 1.0).all()
+
     def test_label_replace(self, engine):
         b = engine.execute_range(
             'label_replace(rate(http_requests_total{job="api"}[5m]), '
