@@ -28,10 +28,12 @@ LIFETIME_S = 8 * 3600
 CHILD_TPL = r"""
 import os, sys, json, time
 os.environ["M3_BENCH_DEADLINE_SEC"] = "100000"
+stage = {stage!r}
+if stage.startswith("decode_u"):
+    os.environ["M3_SCAN_UNROLL"] = stage[len("decode_u"):]
 sys.path.insert(0, {repo!r})
 import bench
 t0 = time.time()
-stage = {stage!r}
 if stage == "latency":
     # Attribute the TPU promql gap: if per-dispatch round-trips through
     # the relay tunnel are ~ms, a 38.6s eval is dispatch-bound in THIS
@@ -63,10 +65,12 @@ if stage == "latency":
     for _ in range(20):
         _ = np.asarray(d)
     get_ms = (time.time() - t0) / 20 * 1e3
-    r = {"tiny_dispatch_ms": round(tiny_ms, 3),
-         "elementwise_2m_ms": round(big_ms, 3),
-         "device_put_4mb_ms": round(put_ms, 3),
-         "device_get_4mb_ms": round(get_ms, 3)}
+    # dict(...) constructor, not a dict literal: this source is a
+    # str.format template, where literal braces would be eaten.
+    r = dict(tiny_dispatch_ms=round(tiny_ms, 3),
+             elementwise_2m_ms=round(big_ms, 3),
+             device_put_4mb_ms=round(put_ms, 3),
+             device_get_4mb_ms=round(get_ms, 3))
 elif stage == "pallas":
     r = bench._run_pallas_compare("tpu")
 elif stage == "rollup_full":
@@ -81,6 +85,13 @@ elif stage == "promql":
     r = bench._run_promql_bench(12_500, 8, "tpu")
 elif stage == "promql_f32":
     r = bench._run_promql_bench(12_500, 8, "tpu", "f32")
+elif stage.startswith("decode_u"):
+    # M3_SCAN_UNROLL was read at import (env set before bench import in
+    # this template when the stage name carries a k); same-size control
+    # runs at k=1.  S=10K keeps corpus prep short while amortizing
+    # dispatch like the production shape.
+    r = bench._run_decode_stage(10_000, bench.T_POINTS, "tpu")
+    r["scan_unroll"] = int(os.environ.get("M3_SCAN_UNROLL", "1"))
 else:
     raise SystemExit(f"unknown stage {{stage}}")
 r["wall_s"] = round(time.time() - t0, 1)
@@ -96,6 +107,9 @@ STAGES = [  # (name, timeout_s, max_attempts)
     ("rollup_full", 2400, 2),
     ("timer_full", 2400, 2),
     ("promql_f32", 1200, 2),
+    ("decode_u1", 900, 2),
+    ("decode_u2", 900, 2),
+    ("decode_u4", 900, 2),
 ]
 
 
