@@ -888,15 +888,20 @@ def child_main(platform: str) -> None:
                     _run_agg_bench, akind, platform=platform, **sizes)
 
     # Stage order = evidence priority: (1) small decode for the
-    # bit-exactness verdict, (2) full-size north stars, (3) the
-    # never-before-benched promql config #5, (4) smoke aggs for
-    # round-over-round continuity, (5) big decode, (6) device encode.
+    # bit-exactness verdict, (2) the FULL-scale decode — the headline
+    # number (window #3 measured 18.75M dp/s at S=100K; larger batches
+    # amortize dispatch, so the headline must not die to the deadline
+    # behind slower stages), (3) full-size north stars (the rollup
+    # stage times scatter AND sorted — the flip decision), (4) promql
+    # config #5, (5) smoke aggs for round-over-round continuity.
     res = guarded("decode", 90, _run_decode_stage, stages[0], T_POINTS,
                   platform)
     if res is not None and res["validation"] != "ok" and is_tpu:
         # A numerically-diverging TPU backend must not produce
         # full-size numbers as if it were correct — record and stop.
         return
+    guarded("decode", 60 + stages[1] // 1_500, _run_decode_stage,
+            stages[1], T_POINTS, platform)
     run_aggs(FULL, "_full")
     guarded("promql", 120, _run_promql_bench, 12_500, 8, platform)
     if is_tpu:
@@ -906,8 +911,6 @@ def child_main(platform: str) -> None:
                 "f32")
     if not is_tpu:
         run_aggs(SMOKE, "")
-    guarded("decode", 60 + stages[1] // 1_500, _run_decode_stage,
-            stages[1], T_POINTS, platform)
     # CPU size kept small: the XLA-CPU encode scan runs ~13K dp/s (the
     # step is ~7.8K element-ops/dp of u64 emulation — see
     # PROFILE_decode_r05.json), and the stage's CPU value is its
