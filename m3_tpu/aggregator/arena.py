@@ -62,15 +62,19 @@ def raw(jitted):
 
 
 # ---------------------------------------------------------------------------
-# Ingest implementation selection: XLA scatter (default, validated) vs
-# the Pallas binned segment reduction (parallel/pallas_ingest.py).
-# Pallas wins only when slot collisions serialize the scatter AND the
-# flat arena (W*C) is moderate — it streams the batch once per 1024-slot
-# tile; callers flip per deployment after measuring (the TPU bench child
-# records both).  Selected via M3_ARENA_INGEST=pallas|scatter or
-# set_ingest_impl(); the choice binds at TRACE time, so set_ingest_impl
-# clears the arena jit caches — jits composed elsewhere via raw() keep
-# whatever impl they traced with.
+# Ingest implementation selection, M3_ARENA_INGEST=scatter|pallas|sorted
+# or set_ingest_impl():
+#   scatter — XLA scatter ops (default; fastest on XLA-CPU).
+#   pallas  — binned segment reduction kernel (parallel/pallas_ingest.py):
+#             wins when slot collisions serialize the scatter AND the
+#             flat arena (W*C) is moderate.
+#   sorted  — sort/scan/gather with NO scatters (parallel/
+#             sorted_ingest.py): built for TPU, where scatter measured
+#             ~1us/element at C=1M (TPU_RESULTS_r05.json window #3).
+# The bench's rollup/timer stages time the candidates side by side.
+# The choice binds at TRACE time, so set_ingest_impl clears the arena
+# jit caches — jits composed elsewhere via raw() keep whatever impl
+# they traced with.
 # ---------------------------------------------------------------------------
 
 _INGEST_IMPLS = ("scatter", "pallas", "sorted")

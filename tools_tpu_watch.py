@@ -100,12 +100,17 @@ with open({frag!r}, "w") as f:
 print("STAGE_OK", flush=True)
 """
 
-STAGES = [  # (name, timeout_s, max_attempts)
+STAGES = [  # (name, timeout_s, max_attempts) — decision-priority order:
+    # latency attributes the promql gap in one minute; rollup/timer
+    # decide the sorted-impl flip; pallas records the rewritten
+    # kernel's Mosaic verdict; promql measures the device-resident
+    # pipeline (cold compile ~7min — must not starve the others);
+    # decode unroll sweep last (nice-to-have tuning data).
     ("latency", 300, 3),
-    ("pallas", 900, 3),
-    ("promql", 1200, 2),
     ("rollup_full", 2400, 2),
     ("timer_full", 2400, 2),
+    ("pallas", 900, 3),
+    ("promql", 1200, 2),
     ("promql_f32", 1200, 2),
     ("decode_u1", 900, 2),
     ("decode_u2", 900, 2),
