@@ -85,6 +85,12 @@ elif stage == "promql":
     r = bench._run_promql_bench(12_500, 8, "tpu")
 elif stage == "promql_f32":
     r = bench._run_promql_bench(12_500, 8, "tpu", "f32")
+elif stage == "decode_profile":
+    # Layer attribution (carry/refill/reads/full) ON DEVICE — decides
+    # whether the TPU decode is read-funnel-bound or arithmetic-bound,
+    # the datum every further decode optimization needs.
+    from m3_tpu.tools import decode_profile as dp
+    r = dp.profile(10_000, bench.T_POINTS)
 elif stage.startswith("decode_u"):
     # M3_SCAN_UNROLL was read at import (env set before bench import in
     # this template when the stage name carries a k); same-size control
@@ -112,6 +118,7 @@ STAGES = [  # (name, timeout_s, max_attempts) — decision-priority order:
     ("pallas", 900, 3),
     ("promql", 1200, 2),
     ("promql_f32", 1200, 2),
+    ("decode_profile", 1500, 2),
     ("decode_u1", 900, 2),
     ("decode_u2", 900, 2),
     ("decode_u4", 900, 2),
