@@ -77,7 +77,7 @@ def raw(jitted):
 # they traced with.
 # ---------------------------------------------------------------------------
 
-_INGEST_IMPLS = ("scatter", "pallas", "sorted")
+_INGEST_IMPLS = ("scatter", "pallas", "sorted", "auto")
 _INGEST_IMPL = (os.environ.get("M3_ARENA_INGEST", "").strip().lower()
                 or "scatter")
 if _INGEST_IMPL not in _INGEST_IMPLS:
@@ -88,7 +88,20 @@ if _INGEST_IMPL not in _INGEST_IMPLS:
 
 
 def ingest_impl() -> str:
+    """The CONFIGURED impl (may be 'auto'); see resolved_ingest_impl."""
     return _INGEST_IMPL
+
+
+def resolved_ingest_impl() -> str:
+    """'auto' resolves per backend: scatter where XLA's scatter is fast
+    (CPU), sorted where scatter measured ~1us/element (TPU —
+    TPU_RESULTS_r05.json window #3).  Resolution happens at trace
+    time, so a backend can't change under an already-compiled arena."""
+    if _INGEST_IMPL != "auto":
+        return _INGEST_IMPL
+    import jax
+
+    return "sorted" if jax.default_backend() == "tpu" else "scatter"
 
 
 # Jitted programs that COMPOSE raw(ingest) ops and must be re-traced
@@ -281,7 +294,7 @@ def _seg3(sum_col, sq_col, cnt_col, idx, values):
     drops (the sentinel contract) on both paths.  The pallas path
     computes all three lanes in ONE batch sweep
     (pallas_segment_moments: the hit mask is shared)."""
-    if _INGEST_IMPL == "pallas":
+    if resolved_ingest_impl() == "pallas":
         from m3_tpu.parallel import pallas_ingest as pi
 
         n_out = sum_col.shape[0]
@@ -356,7 +369,7 @@ def counter_ingest(
     times: jnp.ndarray,  # i64 (N,)
 ) -> CounterState:
     """Counter.Update for a batch (reference counter.go:53-76)."""
-    if _INGEST_IMPL == "sorted":
+    if resolved_ingest_impl() == "sorted":
         return _counter_ingest_sorted(state, idx, slots, values, times)
     s, sq, c = _seg3(state.sum, state.sum_sq, state.count, idx, values)
     return CounterState(
@@ -485,7 +498,7 @@ def gauge_ingest(
     when strictly after); count includes NaN values but sum/min/max
     ignore them (gauge.go:57-63,95-103).
     """
-    if _INGEST_IMPL == "sorted":
+    if resolved_ingest_impl() == "sorted":
         return _gauge_ingest_sorted(state, idx, slots, values, times)
     n = values.shape[0]
     nan = jnp.isnan(values)
@@ -631,7 +644,7 @@ def timer_ingest(
     moment stats stay exact; quantiles degrade — counted by the caller
     via sample_n overflow).
     """
-    if _INGEST_IMPL == "sorted":
+    if resolved_ingest_impl() == "sorted":
         return _timer_ingest_sorted(state, windows, slots, values, times,
                                     capacity)
     num_w, scap = state.sample_slot.shape

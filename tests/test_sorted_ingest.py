@@ -224,6 +224,22 @@ class TestTimerSorted:
             [0., 1., 2., 3., 10., 11., 12., 13., 20., 21., 22., 23.])
 
 
+class TestAutoImpl:
+    def test_auto_resolves_scatter_on_cpu(self):
+        arena.set_ingest_impl("auto")
+        try:
+            assert arena.ingest_impl() == "auto"
+            assert arena.resolved_ingest_impl() == "scatter"  # CPU tier
+            # and the arenas still work end to end under auto
+            st = arena.counter_ingest(
+                arena.counter_init(1, 8),
+                jnp.asarray([3], jnp.int64), jnp.asarray([3], jnp.int32),
+                jnp.asarray([5], jnp.int64), jnp.asarray([9], jnp.int64))
+            assert int(st.sum[3]) == 5
+        finally:
+            arena.set_ingest_impl("scatter")
+
+
 class TestSortedConsumeParity:
     """End-to-end: consume lanes after sorted ingest == after scatter."""
 
