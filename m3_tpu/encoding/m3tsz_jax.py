@@ -60,8 +60,11 @@ STAGE_WORDS = 4  # 256 bits of staging per datapoint (worst case ~227)
 # larger amortizes per-step overhead and keeps the carry fused between
 # chained bodies, but MULTIPLIES compile time of the already-large step
 # body (unroll=4 took the S=2000 decode compile from ~40s to 9+ minutes
-# on XLA-CPU — measured round 4).  Default 1; a tuning knob for
-# hardware/XLA versions where the tradeoff flips.
+# on XLA-CPU — measured round 4).  Round-5 measurement: on XLA-CPU
+# unroll=2 DECODES 13x SLOWER than unroll=1 (161K vs 2.09M dp/s at
+# S=10K — the duplicated step body spills the carry out of registers);
+# do not raise this on CPU.  Default 1; the TPU tradeoff is separately
+# measured by the watcher's decode_u* stages.
 try:
     _SCAN_UNROLL = max(1, int(os.environ.get("M3_SCAN_UNROLL", "1")))
 except ValueError:
