@@ -173,6 +173,11 @@ class CoordinatorConfig:
     carbon_listen_port: Optional[int] = None  # None = no carbon listener
     admin_listen_port: Optional[int] = None   # None = no admin API
     tracing: bool = False
+    # Aggregation-arena ingest implementation for this process:
+    # "" = leave the global default (M3_ARENA_INGEST env / scatter);
+    # scatter | pallas | sorted | auto select explicitly (auto resolves
+    # scatter on CPU, sorted on TPU — see aggregator/arena.py).
+    arena_ingest: str = ""
 
     def validate(self, errs: list) -> None:
         if not (0 <= self.listen_port < 65536):
@@ -181,6 +186,13 @@ class CoordinatorConfig:
             v = getattr(self, f)
             if v is not None and not (0 <= v < 65536):
                 errs.append(f"coordinator.{f}: out of range")
+        if self.arena_ingest:
+            from m3_tpu.aggregator import arena
+
+            if self.arena_ingest not in arena._INGEST_IMPLS:
+                errs.append(
+                    f"coordinator.arena_ingest: {self.arena_ingest!r} not "
+                    f"one of {arena._INGEST_IMPLS}")
 
 
 @dataclasses.dataclass

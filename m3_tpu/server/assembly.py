@@ -105,6 +105,10 @@ def run_node(source, start_mediator: bool | None = None,
         raise ConfigError(
             "coordinator.downsample=true requires run_node(..., ruleset=...)"
         )
+    if cfg.coordinator is not None and cfg.coordinator.arena_ingest:
+        from m3_tpu.aggregator import arena
+
+        arena.set_ingest_impl(cfg.coordinator.arena_ingest)
     registry = instrument.new_registry()
     scope = registry.scope(cfg.metrics_prefix)
     tracer = None

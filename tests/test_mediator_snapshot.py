@@ -388,6 +388,31 @@ coordinator: {{downsample: true}}
 mediator: {{enabled: false}}
 """)
 
+    def test_arena_ingest_validated(self):
+        with pytest.raises(ConfigError, match="arena_ingest"):
+            load_config(
+                "db: {root: /tmp/x}\n"
+                "coordinator: {arena_ingest: scattter}\n").validate()
+        cfg = load_config(
+            "db: {root: /tmp/x}\ncoordinator: {arena_ingest: auto}\n")
+        cfg.validate()
+        assert cfg.coordinator.arena_ingest == "auto"
+
+    def test_arena_ingest_applied_at_boot(self, tmp_path):
+        from m3_tpu.aggregator import arena
+
+        assert arena.ingest_impl() == "scatter"
+        asm = run_node(f"""
+db: {{root: {tmp_path}}}
+coordinator: {{listen_port: 0, arena_ingest: sorted}}
+mediator: {{enabled: false}}
+""")
+        try:
+            assert arena.ingest_impl() == "sorted"
+        finally:
+            asm.close()
+            arena.set_ingest_impl("scatter")
+
 
 class TestAssembly:
     def test_run_node_end_to_end(self, tmp_path):
