@@ -41,11 +41,12 @@ def head_flag_scan(is_start, adds=(), mins=(), maxs=()):
     """Inclusive segmented reduction via one associative scan.
 
     ``is_start`` (N,) bool marks segment heads of the already-sorted
-    batch.  Each array in ``adds``/``mins``/``maxs`` is reduced with
-    +/min/max within segments; position i of a result holds the
-    reduction of its segment's prefix up to i, so the LAST position of
-    a segment holds the full segment total.  Returns (adds, mins, maxs)
-    tuples in the caller's order.
+    batch.  Each array in ``adds``/``mins``/``maxs`` — shape (N,) or
+    (N, ...) with any trailing lane dims — is reduced with +/min/max
+    within segments; position i of a result holds the reduction of its
+    segment's prefix up to i, so the LAST position of a segment holds
+    the full segment total.  Returns (adds, mins, maxs) tuples in the
+    caller's order.
     """
     n_adds, n_mins = len(adds), len(mins)
 
@@ -53,14 +54,20 @@ def head_flag_scan(is_start, adds=(), mins=(), maxs=()):
         fa, fb = a[0], b[0]
         out = [fa | fb]
         j = 1
+
+        def sel(flag, yes, no):
+            # broadcast the (k,) head flag across any trailing lane dims
+            return jnp.where(
+                flag.reshape(flag.shape + (1,) * (yes.ndim - 1)), yes, no)
+
         for _ in range(n_adds):
-            out.append(jnp.where(fb, b[j], a[j] + b[j]))
+            out.append(sel(fb, b[j], a[j] + b[j]))
             j += 1
         for _ in range(n_mins):
-            out.append(jnp.where(fb, b[j], jnp.minimum(a[j], b[j])))
+            out.append(sel(fb, b[j], jnp.minimum(a[j], b[j])))
             j += 1
         for _ in range(len(maxs)):
-            out.append(jnp.where(fb, b[j], jnp.maximum(a[j], b[j])))
+            out.append(sel(fb, b[j], jnp.maximum(a[j], b[j])))
             j += 1
         return tuple(out)
 

@@ -188,6 +188,30 @@ class TestEngine:
         )
         assert b2.num_series == 8
 
+    @pytest.mark.parametrize(
+        "func,q", [("sum", 0.0), ("count", 0.0), ("avg", 0.0),
+                   ("stddev", 0.0), ("stdvar", 0.0), ("min", 0.0),
+                   ("max", 0.0)])
+    def test_segment_reduce_sorted_matches_scatter(self, monkeypatch,
+                                                   func, q):
+        """The TPU (sort/scan/gather) aggregation form must equal the
+        XLA segment_* form — forced on CPU by faking the backend."""
+        import jax
+
+        from m3_tpu.query import functions as fn_mod
+
+        rng = np.random.default_rng(17)
+        S, T, G = 200, 13, 23
+        vals = np.round(rng.normal(0, 10, (S, T)), 5)
+        vals[rng.random((S, T)) < 0.15] = np.nan
+        vals[0, :] = np.nan  # one fully-NaN row
+        gids = rng.integers(0, G, S).astype(np.int32)
+        gids[gids == G - 1] = 0  # leave group G-1 EMPTY
+        base = np.asarray(fn_mod._segment_reduce(vals, gids, G, func, q))
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        flip = np.asarray(fn_mod._segment_reduce(vals, gids, G, func, q))
+        np.testing.assert_allclose(flip, base, atol=1e-9, equal_nan=True)
+
     def test_bool_comparison_missing_stays_missing(self, engine):
         """`v > bool s` on a MISSING sample (NaN in the block model)
         must stay missing, not fabricate a 0.0 (Prometheus emits no
