@@ -102,10 +102,19 @@ def sharded_decode_rate_hq(
             ts_p, vals_p, step_times, range_nanos, "rate"
         )  # (S, T)
         # Partial sum-by-bucket, then one all-reduce over the shard axis.
-        part = jnp.zeros((num_buckets, step_times.shape[0]))
-        part = part.at[jnp.clip(bid, 0, num_buckets - 1)].add(
-            jnp.nan_to_num(rates)
-        )
+        # Bucket counts are small and static, so the by-bucket sum is an
+        # unrolled masked reduction — exact f64 adds, no scatter (TPU
+        # scatter measured ~1us/element; see parallel/sorted_ingest.py).
+        r0 = jnp.nan_to_num(rates)
+        bidc = jnp.clip(bid, 0, num_buckets - 1)
+        if num_buckets <= 64:
+            part = jnp.stack([
+                jnp.sum(jnp.where((bidc == b)[:, None], r0, 0.0), axis=0)
+                for b in range(num_buckets)
+            ])
+        else:  # degenerate many-bucket case: keep the scatter form
+            part = jnp.zeros((num_buckets, step_times.shape[0]))
+            part = part.at[bidc].add(r0)
         total = jax.lax.psum(part, SHARD_AXIS)
         hq = device_fns._histogram_quantile_kernel(
             total,
