@@ -91,6 +91,17 @@ elif stage == "decode_profile":
     # the datum every further decode optimization needs.
     from m3_tpu.tools import decode_profile as dp
     r = dp.profile(10_000, bench.T_POINTS)
+elif stage == "benchpy":
+    # Full driver-format bench run during a live window: if the relay
+    # is dead when the round's driver runs, this pre-captured artifact
+    # is the complete official-format record.
+    import subprocess
+    p = subprocess.run([sys.executable, os.path.join({repo!r}, "bench.py")],
+                       capture_output=True, text=True, timeout=1500)
+    line = [l for l in p.stdout.splitlines() if l.startswith("{{")]
+    r = json.loads(line[-1]) if line else dict(error=p.stderr[-400:])
+    with open(os.path.join({repo!r}, "BENCH_r05_precapture.json"), "w") as f:
+        json.dump(r, f, indent=1)
 elif stage.startswith("decode_u"):
     # M3_SCAN_UNROLL was read at import (env set before bench import in
     # this template when the stage name carries a k); same-size control
@@ -122,6 +133,7 @@ STAGES = [  # (name, timeout_s, max_attempts) — decision-priority order:
     ("decode_u1", 900, 2),
     ("decode_u2", 900, 2),
     ("decode_u4", 900, 2),
+    ("benchpy", 1560, 2),
 ]
 
 
