@@ -77,7 +77,8 @@ def raw(jitted):
 # they traced with.
 # ---------------------------------------------------------------------------
 
-_INGEST_IMPLS = ("scatter", "pallas", "sorted", "auto")
+INGEST_IMPLS = ("scatter", "pallas", "sorted", "auto")
+_INGEST_IMPLS = INGEST_IMPLS  # back-compat alias
 _INGEST_IMPL = (os.environ.get("M3_ARENA_INGEST", "").strip().lower()
                 or "scatter")
 if _INGEST_IMPL not in _INGEST_IMPLS:

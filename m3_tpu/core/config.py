@@ -189,10 +189,10 @@ class CoordinatorConfig:
         if self.arena_ingest:
             from m3_tpu.aggregator import arena
 
-            if self.arena_ingest not in arena._INGEST_IMPLS:
+            if self.arena_ingest not in arena.INGEST_IMPLS:
                 errs.append(
                     f"coordinator.arena_ingest: {self.arena_ingest!r} not "
-                    f"one of {arena._INGEST_IMPLS}")
+                    f"one of {arena.INGEST_IMPLS}")
 
 
 @dataclasses.dataclass

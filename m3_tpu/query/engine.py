@@ -347,8 +347,15 @@ class Engine:
                 return _Scalar(b.values[0].copy())
             return _Scalar(np.full(len(steps), np.nan))
         if f == "vector":
-            v = self._scalar_arg(call.args[0], steps)
-            return Block(steps, np.full((1, len(steps)), v), [SeriesMeta(())])
+            v = self._eval(call.args[0], steps)
+            if not isinstance(v, _Scalar):
+                raise ValueError("vector() expects a scalar argument")
+            # Per-step scalars stay per-step (Prometheus vector(time())
+            # is the canonical example), device or host.
+            val = np.asarray(v.value, np.float64)
+            row = (np.broadcast_to(val, (len(steps),)) if val.ndim
+                   else np.full(len(steps), float(val)))
+            return Block(steps, row[None, :].copy(), [SeriesMeta(())])
         if f == "absent":
             b = self._eval(call.args[0], steps)
             present = (~np.isnan(b.values)).any(axis=0) if b.num_series else (
@@ -497,8 +504,13 @@ class Engine:
         Per-step scalars collapse to their first finite value."""
         v = self._eval(e, steps)
         if isinstance(v, _Scalar):
-            if isinstance(v.value, np.ndarray):
-                finite = v.value[np.isfinite(v.value)]
+            # scalar() rows may be numpy OR device arrays now that
+            # blocks stay device-resident: normalize through numpy
+            # before collapsing (a device (T,) array must not escape
+            # into int(k)/float() call sites).
+            if getattr(v.value, "ndim", 0):
+                arr = np.asarray(v.value)
+                finite = arr[np.isfinite(arr)]
                 return float(finite[0]) if len(finite) else float("nan")
             return v.value
         raise ValueError("expected scalar argument")

@@ -300,16 +300,10 @@ def date_fn(block: Block, func: str) -> Block:
     return block.with_values(out, [m.drop_name() for m in block.series])
 
 
-_J_UNARY = {  # device-resident forms (Block contract)
-    "abs": jnp.abs, "ceil": jnp.ceil, "floor": jnp.floor, "exp": jnp.exp,
-    "ln": jnp.log, "log2": jnp.log2, "log10": jnp.log10, "sqrt": jnp.sqrt,
-    "sgn": jnp.sign,
-    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
-    "asin": jnp.arcsin, "acos": jnp.arccos, "atan": jnp.arctan,
-    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
-    "asinh": jnp.arcsinh, "acosh": jnp.arccosh, "atanh": jnp.arctanh,
-    "deg": jnp.degrees, "rad": jnp.radians,
-}
+# Device-resident forms (Block contract), derived key-for-key from the
+# numpy table so engine dispatch (`f in _UNARY`) can never drift from
+# execution: every numpy ufunc here has a same-named jnp equivalent.
+_J_UNARY = {name: getattr(jnp, f.__name__) for name, f in _UNARY.items()}
 
 
 def unary_math(block: Block, func: str) -> Block:
@@ -349,12 +343,8 @@ _BINOPS = {
 from m3_tpu.query.device_fns import COMPARISONS as _COMPARISONS
 
 
-_J_BINOPS = {  # device-resident forms (Block contract)
-    "+": jnp.add, "-": jnp.subtract, "*": jnp.multiply, "/": jnp.divide,
-    "%": jnp.mod, "^": jnp.power,
-    "==": jnp.equal, "!=": jnp.not_equal, ">": jnp.greater,
-    "<": jnp.less, ">=": jnp.greater_equal, "<=": jnp.less_equal,
-}
+# Derived key-for-key from _BINOPS (same drift guard as _J_UNARY).
+_J_BINOPS = {op: getattr(jnp, f.__name__) for op, f in _BINOPS.items()}
 
 
 def scalar_binary(block: Block, op: str, scalar: float,

@@ -212,6 +212,21 @@ class TestEngine:
         flip = np.asarray(fn_mod._segment_reduce(vals, gids, G, func, q))
         np.testing.assert_allclose(flip, base, atol=1e-9, equal_nan=True)
 
+    def test_scalar_derived_parameter_collapses(self, engine):
+        """scalar()-derived parameters must collapse to a float even
+        when blocks are device-resident (topk's k reaches int())."""
+        b = engine.execute_range(
+            'topk(scalar(count(http_requests_total) > bool 0),'
+            ' http_requests_total)',
+            QSTART, QEND, STEP)
+        assert b.num_series == 8  # k=1: all series kept, non-top masked
+        top = (~np.isnan(np.asarray(b.values)[:, -1])).sum()
+        assert 1 <= top <= 2  # k=1 plus the fixture's exact-tie twin
+        v = engine.execute_range('vector(time())', QSTART, QEND, STEP)
+        # vector(time()) keeps per-step values (Prometheus semantics)
+        tv = np.asarray(v.values)[0]
+        assert tv[0] != tv[-1]
+
     def test_bool_comparison_missing_stays_missing(self, engine):
         """`v > bool s` on a MISSING sample (NaN in the block model)
         must stay missing, not fabricate a 0.0 (Prometheus emits no

@@ -154,12 +154,18 @@ def relay_open() -> bool:
 
 
 def flush(results: dict) -> None:
-    with open(OUT, "w") as f:
+    # Atomic: stage fragments were earned during scarce relay windows —
+    # a crash mid-write must never truncate the capture file.
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
         json.dump({"note": "Watcher-captured round-5 TPU stages "
                            "(tools_tpu_watch.py): fixed-pallas verdict, "
                            "C=1M rollup scatter-vs-sorted, NT=10M timer "
                            "with sorted ingest comparison.",
                    "results": results}, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, OUT)
 
 
 def main() -> None:
@@ -170,6 +176,10 @@ def main() -> None:
         try:
             results = json.load(open(OUT))["results"]
         except Exception:
+            # Never silently discard captured artifacts: preserve the
+            # unreadable file before starting over.
+            os.replace(OUT, OUT + ".corrupt")
+            log(f"WARNING: {OUT} unreadable; moved to .corrupt")
             results = {}
     while time.time() < t_end:
         if os.path.exists(STOP):
