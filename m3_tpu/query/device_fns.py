@@ -89,10 +89,9 @@ def group_quantile(values: np.ndarray, gids: np.ndarray, num_groups: int,
 
 
 @functools.partial(jax.jit, static_argnames=("k", "top"))
-def _topk_mask_kernel(values, idx, mask, k: int, top: bool):
+def _topk_mask_kernel(values, idx, mask, inv_g, inv_r, k: int, top: bool):
     """Keep-mask (S, T): True where the row is among the k extreme in its
     group at that step."""
-    S, T = values.shape
     rows = values[idx]  # (G, R, T)
     # Present = in-group and not NaN; ±Inf are real sample values and
     # compete for rank slots (Prometheus topk keeps Inf).
@@ -104,18 +103,25 @@ def _topk_mask_kernel(values, idx, mask, k: int, top: bool):
     kth = s[:, max(R - k, 0), :] if top else s[:, min(k - 1, R - 1), :]
     keep_g = (key >= kth[:, None, :]) if top else (key <= kth[:, None, :])
     keep_g = keep_g & present
-    # scatter (G, R, T) back to (S, T)
-    flat_idx = idx.reshape(-1)
-    keep_flat = keep_g.reshape(-1, T)
-    out = jnp.zeros((S, T), bool)
-    return out.at[flat_idx].max(keep_flat, mode="drop")
+    # (G, R, T) back to (S, T) by GATHER through the inverse mapping —
+    # not scatter (~1us/element on TPU; TPU_RESULTS_r05.json window #3)
+    return keep_g[inv_g, inv_r, :]
 
 
 def topk_mask(values: np.ndarray, gids: np.ndarray, num_groups: int,
               k: int, top: bool) -> np.ndarray:
     idx, mask = group_plan(gids, num_groups)
+    # Inverse of group_plan: series s sits at (gids[s], inv_r[s]).
+    S = len(gids)
+    order = np.argsort(gids, kind="stable")
+    sorted_g = gids[order]
+    starts = np.searchsorted(sorted_g, np.arange(num_groups))
+    inv_r = np.empty(S, np.int32)
+    inv_r[order] = np.arange(S, dtype=np.int32) - starts[sorted_g]
     return _topk_mask_kernel(jnp.asarray(values), jnp.asarray(idx),
-                             jnp.asarray(mask), k=int(k), top=bool(top))
+                             jnp.asarray(mask),
+                             jnp.asarray(gids.astype(np.int32)),
+                             jnp.asarray(inv_r), k=int(k), top=bool(top))
 
 
 # ---------------------------------------------------------------------------
