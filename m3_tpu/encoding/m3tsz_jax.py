@@ -559,19 +559,56 @@ def encode_batch_device(timestamps, value_bits, start, valid, unit: int = 1,
     # start word first
     out = out.at[:, 0].set(start.astype(U64))
 
-    series_idx = jnp.broadcast_to(jnp.arange(S)[:, None], (S, T))
-    for j, wj in enumerate((w0, w1, w2, w3)):
-        pos = offsets + j * 64
-        gw = (pos >> 6).astype(I32)
-        sh = (pos & 63).astype(U64)
-        in_range = (j * 64) < lens  # word j carries bits only if len > 64j
-        hi = jnp.where(in_range, _shr(wj, sh), _c(0))
-        lo_shift = _c(64) - sh
-        lo = jnp.where(in_range & (sh > _c(0)), _shl(wj, lo_shift), _c(0))
-        out = out.at[series_idx, jnp.clip(gw, 0, out_words - 1)].add(
-            jnp.where(gw < out_words, hi, _c(0)))
-        out = out.at[series_idx, jnp.clip(gw + 1, 0, out_words - 1)].add(
-            jnp.where(gw + 1 < out_words, lo, _c(0)))
+    # Word placement: every step contributes (hi, lo) word fragments at
+    # per-series word indices gw / gw+1.  Two formulations:
+    #   scatter — 8 scatter-adds over (S, T); fine on XLA-CPU.
+    #   gather  — per-series word indices are NON-DECREASING along T
+    #             (offsets are cumulative), so for each output word the
+    #             contributing step range is a searchsorted interval and
+    #             its sum a cumsum difference — exact even with u64
+    #             wraparound ((A+B)-A == B mod 2^64).  No scatter; built
+    #             for TPU (~1us/element scatter, TPU_RESULTS_r05.json).
+    # M3_ENCODE_PLACE overrides for parity tests.
+    place = os.environ.get("M3_ENCODE_PLACE", "").strip() or (
+        "gather" if jax.default_backend() == "tpu" else "scatter")
+    if place == "gather":
+        w_queries = jnp.arange(out_words, dtype=jnp.int64)
+        zero_col = jnp.zeros((S, 1), U64)
+        for j, wj in enumerate((w0, w1, w2, w3)):
+            pos = offsets + j * 64
+            sh = (pos & 63).astype(U64)
+            in_range = (j * 64) < lens
+            hi = jnp.where(in_range, _shr(wj, sh), _c(0))
+            lo_shift = _c(64) - sh
+            lo = jnp.where(in_range & (sh > _c(0)), _shl(wj, lo_shift),
+                           _c(0))
+            for delta, frag in ((0, hi), (1, lo)):
+                keys = (pos >> 6) + delta  # (S, T) non-decreasing rows
+                cum = jnp.concatenate(
+                    [zero_col, jnp.cumsum(frag, axis=1)], axis=1)
+                p_lo = jax.vmap(
+                    lambda row: jnp.searchsorted(row, w_queries,
+                                                 side="left"))(keys)
+                p_hi = jax.vmap(
+                    lambda row: jnp.searchsorted(row, w_queries,
+                                                 side="right"))(keys)
+                out = out + (jnp.take_along_axis(cum, p_hi, axis=1)
+                             - jnp.take_along_axis(cum, p_lo, axis=1))
+    else:
+        series_idx = jnp.broadcast_to(jnp.arange(S)[:, None], (S, T))
+        for j, wj in enumerate((w0, w1, w2, w3)):
+            pos = offsets + j * 64
+            gw = (pos >> 6).astype(I32)
+            sh = (pos & 63).astype(U64)
+            in_range = (j * 64) < lens  # word j carries bits iff len > 64j
+            hi = jnp.where(in_range, _shr(wj, sh), _c(0))
+            lo_shift = _c(64) - sh
+            lo = jnp.where(in_range & (sh > _c(0)), _shl(wj, lo_shift),
+                           _c(0))
+            out = out.at[series_idx, jnp.clip(gw, 0, out_words - 1)].add(
+                jnp.where(gw < out_words, hi, _c(0)))
+            out = out.at[series_idx, jnp.clip(gw + 1, 0, out_words - 1)].add(
+                jnp.where(gw + 1 < out_words, lo, _c(0)))
 
     fallback = carry[12] | (total_bits > (out_words * 64))
     return {"words": out, "total_bits": total_bits, "fallback": fallback}

@@ -276,3 +276,39 @@ def test_batched_decode_mixed_unit_streams():
     assert not fb[0] and counts[0] == 4
     got = list(zip(ts[0, :4].tolist(), vals[0, :4].tolist()))
     assert got == pts
+
+
+def test_encode_gather_placement_byte_identical():
+    """The TPU (gather/cumsum) word-placement form must produce the
+    SAME bytes as the scatter form — forced via M3_ENCODE_PLACE in a
+    subprocess (the choice binds at trace time).  u64 cumsum-diff is
+    exact under wraparound, so identity must hold bit for bit."""
+    import subprocess
+    import sys
+
+    code = """
+import sys; sys.path.insert(0, %r)
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from m3_tpu.encoding.m3tsz import encode_series
+from m3_tpu.encoding.m3tsz_jax import encode_batch
+rng = np.random.default_rng(2)
+S, T = 16, 360
+start = 1_700_000_000 * 10**9
+ts = start + np.cumsum(rng.integers(1, 3, (S, T)), axis=1) * 10**10
+vals = np.round(rng.normal(50, 20, (S, T)), 2)
+streams, fb = encode_batch(ts, vals, np.full(S, start, np.int64),
+                           out_words=T * 40 // 64 + 8)
+assert not fb.any()
+for i in range(S):
+    oracle = encode_series(list(zip(ts[i].tolist(), vals[i].tolist())),
+                           start=start)
+    assert streams[i] == oracle, f"series {i} diverged"
+print("PLACEMENT_OK")
+""" % (str(__import__("pathlib").Path(__file__).resolve().parents[1]),)
+    import os
+
+    env = dict(os.environ, M3_ENCODE_PLACE="gather", JAX_PLATFORMS="cpu")
+    p = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=420)
+    assert "PLACEMENT_OK" in p.stdout, p.stderr[-1500:]
