@@ -257,15 +257,19 @@ def _timer_ingest_sorted(state: "TimerState", windows, slots, values,
 
     flat_slot = state.sample_slot.ravel()
     flat_val = state.sample_val.ravel()
-    # The dus update operand must be no larger than the buffer, a
-    # TRACE-time constraint: a batch bigger than the whole buffer can
+    # The dus update operand must be no larger than one window's
+    # buffer, a TRACE-time constraint: a batch bigger than that can
     # never fit anyway, so it is statically pinned to the scatter form.
-    if num_w == 1 and n <= scap:
-        fits = jnp.logical_not(oob.any()) & (state.sample_n[0] + n <= scap)
+    # At runtime the gate is on the BATCH: all samples targeting ONE
+    # valid window (the common ingest shape on a multi-window ring).
+    if 0 < n <= scap:
+        row = jnp.clip(windows[0], 0, num_w - 1).astype(jnp.int64)
+        same = jnp.logical_not(oob.any()) & (windows == windows[0]).all()
+        fits = same & (state.sample_n[row] + n <= scap)
 
         def _append_dus(ops):
             fslot, fval = ops
-            start = state.sample_n[0]
+            start = row * scap + state.sample_n[row]
             return (
                 jax.lax.dynamic_update_slice_in_dim(
                     fslot, slots.astype(fslot.dtype), start, 0),

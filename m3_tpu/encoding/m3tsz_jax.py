@@ -586,12 +586,14 @@ def encode_batch_device(timestamps, value_bits, start, valid, unit: int = 1,
                 keys = (pos >> 6) + delta  # (S, T) non-decreasing rows
                 cum = jnp.concatenate(
                     [zero_col, jnp.cumsum(frag, axis=1)], axis=1)
-                p_lo = jax.vmap(
-                    lambda row: jnp.searchsorted(row, w_queries,
-                                                 side="left"))(keys)
                 p_hi = jax.vmap(
                     lambda row: jnp.searchsorted(row, w_queries,
                                                  side="right"))(keys)
+                # For contiguous integer queries, left(w) == right(w-1):
+                # one sweep serves both interval bounds.  Keys are >= 1
+                # (offsets start at base >= 64), so left(0) == 0.
+                p_lo = jnp.concatenate(
+                    [jnp.zeros((S, 1), p_hi.dtype), p_hi[:, :-1]], axis=1)
                 out = out + (jnp.take_along_axis(cum, p_hi, axis=1)
                              - jnp.take_along_axis(cum, p_lo, axis=1))
     else:

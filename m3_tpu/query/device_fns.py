@@ -111,16 +111,15 @@ def _topk_mask_kernel(values, idx, mask, inv_g, inv_r, k: int, top: bool):
 def topk_mask(values: np.ndarray, gids: np.ndarray, num_groups: int,
               k: int, top: bool) -> np.ndarray:
     idx, mask = group_plan(gids, num_groups)
-    # Inverse of group_plan: series s sits at (gids[s], inv_r[s]).
+    # Inverse of group_plan — derived from its OWN output so the two
+    # can never drift: series idx[g, r] sits at rank r of group g.
     S = len(gids)
-    order = np.argsort(gids, kind="stable")
-    sorted_g = gids[order]
-    starts = np.searchsorted(sorted_g, np.arange(num_groups))
+    rows, cols = np.nonzero(mask)
     inv_r = np.empty(S, np.int32)
-    inv_r[order] = np.arange(S, dtype=np.int32) - starts[sorted_g]
+    inv_r[idx[rows, cols]] = cols.astype(np.int32)
     return _topk_mask_kernel(jnp.asarray(values), jnp.asarray(idx),
                              jnp.asarray(mask),
-                             jnp.asarray(gids.astype(np.int32)),
+                             jnp.asarray(np.asarray(gids, np.int32)),
                              jnp.asarray(inv_r), k=int(k), top=bool(top))
 
 

@@ -223,6 +223,20 @@ class TestTimerSorted:
             np.asarray(st.sample_val[0][:12]),
             [0., 1., 2., 3., 10., 11., 12., 13., 20., 21., 22., 23.])
 
+    def test_multiwindow_uniform_batch_fast_path(self, sorted_impl):
+        """The production shape: one batch, all samples in window 1 of
+        a W=2 ring — the fast path must land them in ROW 1's buffer."""
+        W, C, S = 2, 8, 64
+        st = arena.timer_ingest(
+            arena.timer_init(W, C, S), jnp.ones(4, jnp.int32),
+            jnp.asarray([1, 2, 3, 1], jnp.int32),
+            jnp.asarray([1.0, 2.0, 3.0, 4.0]),
+            jnp.asarray([100] * 4, jnp.int64), C)
+        assert int(st.sample_n[1]) == 4 and int(st.sample_n[0]) == 0
+        np.testing.assert_array_equal(np.asarray(st.sample_val[1][:4]),
+                                      [1.0, 2.0, 3.0, 4.0])
+        assert float(np.asarray(st.sample_val[0]).sum()) == 0.0
+
 
 class TestAutoImpl:
     def test_auto_resolves_scatter_on_cpu(self):

@@ -328,3 +328,43 @@ class TestBufferAppendFastPath:
         st = self._drive(1, 16, [(windows, slots, ts, vals)])
         assert int(st.n[0]) == 32  # n counts past capacity (overflow signal)
         np.testing.assert_array_equal(np.asarray(st.val[0]), vals[:16])
+
+    def test_multiwindow_uniform_batch_fast_path(self):
+        """The production shape: a batch targeting ONE window of a
+        MULTI-window ring appends contiguously at that row's head."""
+        rng = np.random.default_rng(7)
+        batches = [
+            (np.full(30, 2, np.int32), rng.integers(0, 64, 30),
+             START + np.arange(30) * 10**9 + b * 10**12,
+             np.round(rng.normal(0, 5, 30), 4))
+            for b in range(2)
+        ]
+        st = self._drive(4, 128, batches)
+        assert int(st.n[2]) == 60 and int(st.n[0]) == 0
+        np.testing.assert_array_equal(
+            np.asarray(st.slot[2][:30]), batches[0][1].astype(np.int32))
+        np.testing.assert_array_equal(
+            np.asarray(st.val[2][30:60]), batches[1][3])
+
+    def test_multiwindow_mixed_batch_scatter_parity(self):
+        """A batch spanning windows must land identically to per-window
+        sub-batches (the scatter path)."""
+        rng = np.random.default_rng(9)
+        W, S, N = 3, 64, 48
+        windows = rng.integers(0, W, N).astype(np.int32)
+        slots = rng.integers(0, 64, N)
+        ts = START + np.arange(N) * 10**9
+        vals = np.round(rng.normal(0, 5, N), 4)
+        st_mixed = self._drive(W, S, [(windows, slots, ts, vals)])
+        # equivalent: one uniform batch per window, in window order of
+        # arrival (the mixed path's stable sort preserves arrival order
+        # within each window)
+        batches = []
+        for w in range(W):
+            sel = windows == w
+            batches.append((windows[sel], slots[sel], ts[sel], vals[sel]))
+        st_split = self._drive(W, S, batches)
+        for f in ("slot", "ts", "val", "n"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(st_mixed, f)),
+                np.asarray(getattr(st_split, f)), err_msg=f)
