@@ -655,7 +655,11 @@ def timer_ingest(
     num_w, scap = state.sample_slot.shape
     idx = windows * capacity + slots
     oob = (windows < 0) | (windows >= num_w)
-    idx = jnp.where(oob, num_w * capacity, idx)
+    # Out-of-range SLOTS must drop too: w*C + slot with slot >= C would
+    # otherwise land in window w+1's region (fuzz-caught; the sorted
+    # impl already drops them via its composite-key sentinel).
+    idx = jnp.where(oob | (slots < 0) | (slots >= capacity),
+                    num_w * capacity, idx)
 
     # Rank of each sample within its window for this batch.  Buffer
     # order is irrelevant (consume lex-sorts the whole window at

@@ -223,6 +223,24 @@ class TestTimerSorted:
             np.asarray(st.sample_val[0][:12]),
             [0., 1., 2., 3., 10., 11., 12., 13., 20., 21., 22., 23.])
 
+    @pytest.mark.parametrize("impl", ["scatter", "sorted"])
+    def test_out_of_range_slot_drops_not_next_window(self, impl):
+        """slot >= C with a VALID window must DROP, not land in window
+        w+1's region (w*C + slot aliasing — fuzz-caught in the scatter
+        path; both impls must agree)."""
+        arena.set_ingest_impl(impl)
+        try:
+            W, C, S = 3, 8, 64
+            st = arena.timer_ingest(
+                arena.timer_init(W, C, S), jnp.zeros(2, jnp.int32),
+                jnp.asarray([C + 2, -1], jnp.int32),
+                jnp.asarray([5.0, 7.0]),
+                jnp.asarray([100, 101], jnp.int64), C)
+            assert int(np.asarray(st.count).sum()) == 0
+            assert float(np.asarray(st.sum).sum()) == 0.0
+        finally:
+            arena.set_ingest_impl("scatter")
+
     def test_multiwindow_uniform_batch_fast_path(self, sorted_impl):
         """The production shape: one batch, all samples in window 1 of
         a W=2 ring — the fast path must land them in ROW 1's buffer."""
