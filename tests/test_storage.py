@@ -368,3 +368,50 @@ class TestBufferAppendFastPath:
             np.testing.assert_array_equal(
                 np.asarray(getattr(st_mixed, f)),
                 np.asarray(getattr(st_split, f)), err_msg=f)
+
+    def test_randomized_oracle_fuzz(self):
+        """Random rings x random batch mixes (uniform/mixed windows,
+        drops, overflow) vs a pure-Python append oracle — the trimmed
+        in-tree version of the 40-config fuzz that validated the
+        batch-gated fast path (round 5)."""
+        import jax.numpy as jnp
+
+        from m3_tpu.storage.buffer import buffer_append, buffer_init
+
+        rng = np.random.default_rng(77)
+        for _ in range(5):
+            W = int(rng.integers(1, 4))
+            S = int(rng.integers(8, 200))
+            batches = []
+            for _b in range(int(rng.integers(1, 4))):
+                N = int(rng.integers(1, S + 20))
+                if rng.random() < 0.5:
+                    windows = np.full(N, int(rng.integers(0, W)), np.int32)
+                else:
+                    windows = rng.integers(-1, W + 1, N).astype(np.int32)
+                batches.append((windows,
+                                rng.integers(0, 64, N).astype(np.int32),
+                                (1000 + rng.integers(0, 10**6, N)).astype(np.int64),
+                                np.round(rng.normal(0, 5, N), 4)))
+            st = buffer_init(W, S, 64)
+            for wd, sl, ts, vl in batches:
+                st = buffer_append(st, jnp.asarray(wd), jnp.asarray(sl),
+                                   jnp.asarray(ts), jnp.asarray(vl))
+            o_slot = np.full((W, S), 64, np.int32)
+            o_ts = np.full((W, S), np.iinfo(np.int64).max, np.int64)
+            o_val = np.zeros((W, S))
+            o_n = np.zeros(W, np.int64)
+            for wd, sl, ts, vl in batches:
+                for k in range(len(wd)):
+                    w = wd[k]
+                    if 0 <= w < W:
+                        d = o_n[w]
+                        if d < S:
+                            o_slot[w, d] = sl[k]
+                            o_ts[w, d] = ts[k]
+                            o_val[w, d] = vl[k]
+                        o_n[w] += 1
+            np.testing.assert_array_equal(np.asarray(st.slot), o_slot)
+            np.testing.assert_array_equal(np.asarray(st.ts), o_ts)
+            np.testing.assert_array_equal(np.asarray(st.val), o_val)
+            np.testing.assert_array_equal(np.asarray(st.n), o_n)
