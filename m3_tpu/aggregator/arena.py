@@ -223,7 +223,11 @@ def _timer_ingest_sorted(state: "TimerState", windows, slots, values,
     n = values.shape[0]
     idx = windows * capacity + slots
     oob = (windows < 0) | (windows >= num_w)
-    idx = jnp.where(oob, num_w * capacity, idx)
+    # Same combined drop mask as the scatter path: out-of-range slots
+    # must neither alias window w+1's moment region nor consume sample
+    # buffer capacity/sample_n (the impls stay bit-identical).
+    drop = oob | (slots < 0) | (slots >= capacity)
+    idx = jnp.where(drop, num_w * capacity, idx)
 
     so, W, k = _sorted_prep(state.sum.shape[0], capacity, idx, slots)
     s_k, s_val, s_tim = jax.lax.sort((k, values, times), num_keys=1)
@@ -238,7 +242,7 @@ def _timer_ingest_sorted(state: "TimerState", windows, slots, values,
 
     # Append ranks: identical to the scatter path (batch order), so the
     # buffers come out bit-identical under either impl.
-    order_key = jnp.where(oob, num_w, windows)
+    order_key = jnp.where(drop, num_w, windows)
     onehot = order_key[None, :] == jnp.arange(
         num_w, dtype=order_key.dtype)[:, None]
     ranks_all = jnp.cumsum(onehot.astype(jnp.int64), axis=1) - 1
@@ -246,7 +250,7 @@ def _timer_ingest_sorted(state: "TimerState", windows, slots, values,
     rank = jnp.take_along_axis(ranks_all, w_clip[None, :], axis=0)[0]
     base = state.sample_n[w_clip]
     dst = base + rank
-    flat = jnp.where(~oob & (dst < scap),
+    flat = jnp.where(~drop & (dst < scap),
                      w_clip.astype(jnp.int64) * scap + dst, num_w * scap)
     per_w_counts = onehot.sum(axis=1, dtype=state.sample_n.dtype)
 
@@ -264,7 +268,7 @@ def _timer_ingest_sorted(state: "TimerState", windows, slots, values,
     # valid window (the common ingest shape on a multi-window ring).
     if 0 < n <= scap:
         row = jnp.clip(windows[0], 0, num_w - 1).astype(jnp.int64)
-        same = jnp.logical_not(oob.any()) & (windows == windows[0]).all()
+        same = jnp.logical_not(drop.any()) & (windows == windows[0]).all()
         fits = same & (state.sample_n[row] + n <= scap)
 
         def _append_dus(ops):
@@ -325,11 +329,28 @@ def pad_slots(slots: np.ndarray, capacity: int) -> np.ndarray:
 
 def flat_window_index(windows, slots, num_windows: int, capacity: int):
     """Flatten (window ring index, slot) to the arena's (W*C,) index;
-    out-of-ring windows map to the drop sentinel W*C."""
-    oob = (windows < 0) | (windows >= num_windows)
+    out-of-ring windows AND out-of-range slots map to the drop sentinel
+    W*C.  Without the slot check, a valid window with slot >= C would
+    compute w*C + slot inside window w+1's region — the exact aliasing
+    timer_ingest was fixed for; the sorted impl already drops such
+    samples via its composite-key sentinel, so sentineling here keeps
+    the two impls parity on ANY input (including pad_slots sentinels
+    and negative slots)."""
+    oob = ((windows < 0) | (windows >= num_windows)
+           | (slots < 0) | (slots >= capacity))
     return jnp.where(
         oob, num_windows * capacity, windows * capacity + slots
     ).astype(jnp.int64)
+
+
+def _sanitize_slots(slots, capacity: int):
+    """Slots for the last_at scatter: a NEGATIVE slot would numpy-wrap
+    under mode='drop' (a lowering artifact — it would bump slot C+s's
+    expiry), so map it to the drop sentinel C; slots >= C already fall
+    out of the (C,) column's range and drop.  Keeps the scatter paths
+    on the package-wide contract the sorted impl pins (invalid indices
+    DROP — sorted_ingest.composite_key)."""
+    return jnp.where(slots < 0, capacity, slots)
 
 
 def _stdev(count, sum_sq, sum_):
@@ -377,13 +398,14 @@ def counter_ingest(
     if resolved_ingest_impl() == "sorted":
         return _counter_ingest_sorted(state, idx, slots, values, times)
     s, sq, c = _seg3(state.sum, state.sum_sq, state.count, idx, values)
+    slot_safe = _sanitize_slots(slots, state.last_at.shape[0])
     return CounterState(
         sum=s,
         sum_sq=sq,
         count=c,
         max=state.max.at[idx].max(values, mode="drop"),
         min=state.min.at[idx].min(values, mode="drop"),
-        last_at=state.last_at.at[slots].max(times, mode="drop"),
+        last_at=state.last_at.at[slot_safe].max(times, mode="drop"),
     )
 
 
@@ -523,6 +545,7 @@ def gauge_ingest(
     widx = jnp.where(take, s_idx, state.last.shape[0])  # OOB -> dropped
 
     g_s, g_sq, g_c = _seg3(state.sum, state.sum_sq, state.count, idx, safe)
+    slot_safe = _sanitize_slots(slots, state.last_at.shape[0])
     return GaugeState(
         last=state.last.at[widx].set(s_val, mode="drop"),
         last_time=state.last_time.at[widx].set(s_times, mode="drop"),
@@ -531,7 +554,7 @@ def gauge_ingest(
         count=g_c,
         max=state.max.at[idx].max(jnp.where(nan, -jnp.inf, values), mode="drop"),
         min=state.min.at[idx].min(jnp.where(nan, jnp.inf, values), mode="drop"),
-        last_at=state.last_at.at[slots].max(times, mode="drop"),
+        last_at=state.last_at.at[slot_safe].max(times, mode="drop"),
     )
 
 
@@ -657,9 +680,12 @@ def timer_ingest(
     oob = (windows < 0) | (windows >= num_w)
     # Out-of-range SLOTS must drop too: w*C + slot with slot >= C would
     # otherwise land in window w+1's region (fuzz-caught; the sorted
-    # impl already drops them via its composite-key sentinel).
-    idx = jnp.where(oob | (slots < 0) | (slots >= capacity),
-                    num_w * capacity, idx)
+    # impl already drops them via its composite-key sentinel).  The
+    # combined mask also gates the sample APPEND below — a dropped
+    # sample must not consume quantile-buffer capacity or inflate
+    # sample_n's overflow accounting.
+    drop = oob | (slots < 0) | (slots >= capacity)
+    idx = jnp.where(drop, num_w * capacity, idx)
 
     # Rank of each sample within its window for this batch.  Buffer
     # order is irrelevant (consume lex-sorts the whole window at
@@ -667,7 +693,7 @@ def timer_ingest(
     # the membership mask — W is small and static, and this avoids
     # carrying the f64 value column through a device sort (f64 compute
     # is software-emulated on TPU; the sort was the ingest hot spot).
-    order_key = jnp.where(oob, num_w, windows)
+    order_key = jnp.where(drop, num_w, windows)
     onehot = order_key[None, :] == jnp.arange(num_w, dtype=order_key.dtype)[:, None]
     ranks_all = jnp.cumsum(onehot.astype(jnp.int64), axis=1) - 1  # (W, N)
     w_clip = jnp.clip(order_key, 0, num_w - 1)
@@ -675,11 +701,12 @@ def timer_ingest(
     base = state.sample_n[w_clip]
     dst = base + rank
     flat = jnp.where(
-        ~oob & (dst < scap), w_clip.astype(jnp.int64) * scap + dst, num_w * scap
+        ~drop & (dst < scap), w_clip.astype(jnp.int64) * scap + dst, num_w * scap
     )
     per_w_counts = onehot.sum(axis=1, dtype=state.sample_n.dtype)
 
     t_s, t_sq, t_c = _seg3(state.sum, state.sum_sq, state.count, idx, values)
+    slot_safe = _sanitize_slots(slots, capacity)
     return TimerState(
         sum=t_s,
         sum_sq=t_sq,
@@ -693,7 +720,7 @@ def timer_ingest(
         .set(values, mode="drop")
         .reshape(num_w, scap),
         sample_n=state.sample_n + per_w_counts,
-        last_at=state.last_at.at[slots].max(times, mode="drop"),
+        last_at=state.last_at.at[slot_safe].max(times, mode="drop"),
     )
 
 
@@ -944,7 +971,12 @@ class TimerArena:
         samples — stream.go AddBatch — so neither do we; growth is
         geometric to amortize the re-jit)."""
         windows_np = np.asarray(windows)
-        in_range = (windows_np >= 0) & (windows_np < self.num_windows)
+        slots_np = np.asarray(slots)
+        # Mirror the device-side drop mask exactly: samples dropped for
+        # an out-of-range slot never reach the buffer, so they must not
+        # count toward growth/overflow either.
+        in_range = ((windows_np >= 0) & (windows_np < self.num_windows)
+                    & (slots_np >= 0) & (slots_np < self.capacity))
         per_w = np.bincount(
             windows_np[in_range], minlength=self.num_windows
         )

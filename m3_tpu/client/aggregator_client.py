@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import socket
 import threading
+import time
 from collections import defaultdict
 from typing import Callable, Dict, Tuple
 
@@ -24,26 +25,92 @@ import numpy as np
 from m3_tpu.cluster.placement import Placement, ShardState
 from m3_tpu.core.hash import shard_for
 from m3_tpu.msg import protocol as wire
+from m3_tpu.x import fault
+from m3_tpu.x.retry import Retrier, RetryOptions
+
+
+class _Backoff(Exception):
+    """Server shed the frame (INGEST_BACKOFF): not a transport failure,
+    so it must NOT be retried on the spot — the client parks the batch
+    and honors the retry-after hint."""
+
+    def __init__(self, retry_after_ms: int):
+        super().__init__(f"server backoff {retry_after_ms}ms")
+        self.retry_after_ms = retry_after_ms
 
 
 class InstanceQueue:
     """Buffered samples + a lazily-connected socket for one instance
     (reference client/queue.go).  Connection errors park the buffer for
-    the next flush (bounded by max_queue_size, drop-oldest)."""
+    the next flush (bounded by max_queue_size, drop-oldest).
+
+    With ``want_acks`` (default), the connection opts into per-frame
+    acknowledgements (INGEST_HELLO): a flush counts samples as ``sent``
+    only after the server's INGEST_ACK — i.e. after the frame was fully
+    ingested — so an acknowledged sample can never be silently shed
+    server-side.  An INGEST_BACKOFF reply parks the batch and pauses
+    flushing for the server's retry-after hint; transport failures
+    retry on the x/retry schedule before parking.
+
+    Delivery semantics are AT-LEAST-ONCE: when the connection dies
+    after the server ingested a frame but before its ack was read, the
+    retry resends the batch and the server ingests it again (the
+    reference client's reconnect-and-replay queues make the same
+    trade; losing acknowledged samples would be the worse failure).
+    Acks also serialize the flush path — one frame in flight per
+    queue, and since ``AggregatorClient.flush`` walks its queues on one
+    thread, a cold/stalled instance head-of-line blocks the OTHER
+    queues' flushes for up to ``ack_timeout_s`` too.  Pass
+    ``want_acks=False`` (or a small ``ack_timeout_s``) where delivery
+    latency matters more than the durability signal."""
 
     def __init__(self, address: Tuple[str, int], max_queue_size: int = 1 << 16,
-                 frame_type: int = wire.METRIC_BATCH):
+                 frame_type: int = wire.METRIC_BATCH,
+                 want_acks: bool = True, ack_timeout_s: float = 180.0,
+                 retry_options: RetryOptions | None = None):
         self.address = address
         self.max_queue_size = max_queue_size
         self.frame_type = frame_type
+        self.want_acks = want_acks
+        # Generous ack default: the server's FIRST ingest pays one-time
+        # JAX compiles; a short timeout here would resend and duplicate.
+        self.ack_timeout_s = ack_timeout_s
+        self.retrier = Retrier(
+            retry_options or RetryOptions(
+                initial_backoff_s=0.05, max_backoff_s=1.0, max_attempts=3),
+            name="ingest_client")
         self._mts: list[int] = []
         self._ids: list[bytes] = []
         self._values: list[float] = []
         self._times: list[int] = []
         self._sock: socket.socket | None = None
         self._lock = threading.Lock()
+        # Serializes socket I/O: flush() (user thread AND auto-flush
+        # thread) and send_raw() share one connection; interleaved
+        # send/recv from two threads would steal each other's acks.
+        self._io_lock = threading.Lock()
+        self._backoff_until = 0.0
         self.dropped = 0
         self.sent = 0
+        self.backoffs = 0
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection(self.address, timeout=5.0)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if self.want_acks:
+                s.settimeout(self.ack_timeout_s)
+                wire.send_frame(s, wire.INGEST_HELLO,
+                                wire.encode_ingest_hello())
+            self._sock = s
+        return self._sock
+
+    def _drop_sock(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
 
     def enqueue(self, mt: int, mid: bytes, value: float, t: int) -> None:
         with self._lock:
@@ -59,14 +126,35 @@ class InstanceQueue:
             self._values.append(value)
             self._times.append(t)
 
-    def _connect(self) -> socket.socket:
-        if self._sock is None:
-            s = socket.create_connection(self.address, timeout=5.0)
-            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._sock = s
-        return self._sock
+    def _send_one(self, ftype: int, payload: bytes) -> None:
+        """One framed send (+ ack wait when enabled); raises _Backoff on
+        a shed, ConnectionError/OSError on transport failure.  Holds
+        the I/O lock for the whole send→ack exchange (retry backoffs
+        happen outside, in the retrier)."""
+        with self._io_lock:
+            if fault.fire("ingest_tcp.send") == "drop":
+                self._drop_sock()
+                raise fault.FaultInjected("ingest_tcp.send: frame dropped")
+            sock = self._connect()
+            try:
+                wire.send_frame(sock, ftype, payload)
+                if self.want_acks:
+                    resp = wire.recv_frame(sock)
+                    if resp is None:
+                        raise wire.ProtocolError("closed awaiting ingest ack")
+                    rtype, rpayload = resp
+                    if rtype == wire.INGEST_BACKOFF:
+                        raise _Backoff(wire.decode_ingest_backoff(rpayload))
+                    if rtype != wire.INGEST_ACK:
+                        raise wire.ProtocolError(
+                            f"unexpected frame {rtype} awaiting ingest ack")
+            except (OSError, wire.ProtocolError):
+                self._drop_sock()
+                raise
 
     def flush(self) -> int:
+        if time.monotonic() < self._backoff_until:
+            return 0  # honoring the server's load-shed hint
         with self._lock:
             if not self._ids:
                 return 0
@@ -78,40 +166,46 @@ class InstanceQueue:
             self._mts, self._ids, self._values, self._times = [], [], [], []
         payload = wire.encode_metric_batch(batch)
         try:
-            sock = self._connect()
-            wire.send_frame(sock, self.frame_type, payload)
-        except OSError:
+            self.retrier.run(
+                lambda: self._send_one(self.frame_type, payload))
+        except _Backoff as b:
+            self.backoffs += 1
+            self._backoff_until = (
+                time.monotonic() + b.retry_after_ms / 1000.0)
+            self._park(batch)
+            return 0
+        except (OSError, wire.ProtocolError):
             # park the batch back for the next flush (retry)
-            with self._lock:
-                self._mts = list(batch.metric_types) + self._mts
-                self._ids = list(batch.ids) + self._ids
-                self._values = list(batch.values) + self._values
-                self._times = list(batch.times) + self._times
-            if self._sock is not None:
-                try:
-                    self._sock.close()
-                finally:
-                    self._sock = None
+            self._park(batch)
             return 0
         self.sent += len(batch.ids)
         return len(batch.ids)
+
+    def _park(self, batch) -> None:
+        with self._lock:
+            self._mts = list(batch.metric_types) + self._mts
+            self._ids = list(batch.ids) + self._ids
+            self._values = list(batch.values) + self._values
+            self._times = list(batch.times) + self._times
 
     def send_raw(self, ftype: int, payload: bytes) -> bool:
         """Send one pre-encoded frame immediately (passthrough traffic
         is not queued: it is already aggregated and latency-sensitive).
         Socket I/O happens OUTSIDE the queue lock, like flush(), so a
         slow/down instance cannot stall the flush thread behind a
-        blocking connect.  Returns False on a connection error."""
+        blocking connect.  Returns False on a connection error or when
+        the server (or its earlier backoff hint) sheds the frame."""
+        if time.monotonic() < self._backoff_until:
+            return False
         try:
-            sock = self._connect()
-            wire.send_frame(sock, ftype, payload)
+            self._send_one(ftype, payload)
             return True
-        except OSError:
-            if self._sock is not None:
-                try:
-                    self._sock.close()
-                finally:
-                    self._sock = None
+        except _Backoff as b:
+            self.backoffs += 1
+            self._backoff_until = (
+                time.monotonic() + b.retry_after_ms / 1000.0)
+            return False
+        except (OSError, wire.ProtocolError):
             return False
 
     def close(self) -> None:
@@ -131,9 +225,15 @@ class AggregatorClient:
     def __init__(self, placement: Placement,
                  resolve: Callable[[str], Tuple[str, int]],
                  flush_interval_s: float = 0.1,
-                 auto_flush: bool = False):
+                 auto_flush: bool = False,
+                 want_acks: bool = True,
+                 ack_timeout_s: float = 180.0,
+                 retry_options: RetryOptions | None = None):
         self.placement = placement
         self.resolve = resolve
+        self.want_acks = want_acks
+        self.ack_timeout_s = ack_timeout_s
+        self.retry_options = retry_options
         self.queues: Dict[str, InstanceQueue] = {}
         self._flush_interval = flush_interval_s
         self._stop = threading.Event()
@@ -148,7 +248,10 @@ class AggregatorClient:
         q = self.queues.get(key)
         if q is None:
             q = self.queues[key] = InstanceQueue(
-                self.resolve(instance_id), frame_type=frame_type
+                self.resolve(instance_id), frame_type=frame_type,
+                want_acks=self.want_acks,
+                ack_timeout_s=self.ack_timeout_s,
+                retry_options=self.retry_options,
             )
         return q
 

@@ -27,6 +27,7 @@ import numpy as np
 from m3_tpu.cluster.placement import Placement, ShardState
 from m3_tpu.core.hash import shard_for
 from m3_tpu.storage.series_merge import merge_point_sources
+from m3_tpu.x.retry import Retrier, RetryOptions
 
 
 class ConsistencyLevel(enum.Enum):
@@ -77,6 +78,7 @@ class ReplicatedSession:
         connections: Dict[str, object],
         write_level: ConsistencyLevel = ConsistencyLevel.MAJORITY,
         read_level: ConsistencyLevel = ConsistencyLevel.UNSTRICT_MAJORITY,
+        retry_options: RetryOptions | None = None,
     ):
         # (placement, connections) swap together in ONE attribute so a
         # topology change mid-fan-out can never pair a new placement
@@ -85,6 +87,15 @@ class ReplicatedSession:
         self._topo = (placement, dict(connections))
         self.write_level = write_level
         self.read_level = read_level
+        # Per-replica transport retries (x/retry adoption for the
+        # replication send path): a replica mid-bounce heals within one
+        # fan-out instead of burning a consistency slot.  Application
+        # errors (RemoteError etc.) are not retryable and still count
+        # as that replica's failure immediately.
+        self.retrier = Retrier(
+            retry_options or RetryOptions(
+                initial_backoff_s=0.05, max_backoff_s=0.5, max_attempts=3),
+            name="replication")
         self.topology_version = 0
         self._closed = False
         self._retired: List[object] = []
@@ -245,7 +256,7 @@ class ReplicatedSession:
                 errors.append(f"{iid}: down")
                 continue
             try:
-                results.append(fn(conn))
+                results.append(self.retrier.run(lambda: fn(conn)))
             except Exception as e:  # per-replica failure, keep fanning
                 errors.append(f"{iid}: {e}")
         if len(results) < need and level.strict:

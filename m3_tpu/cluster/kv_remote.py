@@ -34,6 +34,8 @@ from typing import Callable, Tuple
 
 from m3_tpu.cluster.kv import KVStore, VersionedValue
 from m3_tpu.msg.protocol import ProtocolError, recv_frame, send_frame
+from m3_tpu.x import fault
+from m3_tpu.x.retry import Retrier, RetryOptions
 
 KV_REQ = 24
 KV_OK = 25
@@ -145,12 +147,24 @@ class RemoteKVStore:
     _RERAISE = {"ValueError": ValueError, "KeyError": KeyError}
 
     def __init__(self, address: Tuple[str, int], timeout_s: float = 30.0,
-                 watch_poll_s: float = 2.0):
+                 watch_poll_s: float = 2.0,
+                 retry_options: RetryOptions | None = None):
         # watch_poll_s: control-plane objects change rarely; every
         # watched key costs one round-trip per tick, so the default
         # favors low idle load (tests pass a small value).
         self.address = tuple(address)
         self.timeout_s = timeout_s
+        # Every control-plane call retries transport failures (x/retry
+        # adoption): a flapping KV server heals inside one call instead
+        # of surfacing ConnectionError to every placement/election
+        # caller.  Application errors (CAS ValueError etc.) never retry.
+        self.retrier = Retrier(
+            retry_options or RetryOptions(
+                initial_backoff_s=0.05, max_backoff_s=2.0, max_attempts=4),
+            name="kv_remote",
+            # Interruptible backoff: close() wakes every sleeper.
+            sleep=lambda s: self._closed.wait(s),
+        )
         self._sock: socket.socket | None = None
         self._mu = threading.Lock()       # connection
         self._wmu = threading.Lock()      # watcher registry
@@ -167,10 +181,23 @@ class RemoteKVStore:
         self._closed = threading.Event()
 
     def _call(self, method: int, body: bytes) -> bytes:
+        # abort: a deliberately closed client must not wait out the
+        # backoff schedule against a server that is gone on purpose.
+        return self.retrier.run(
+            lambda: self._call_once(method, body),
+            abort=self._closed.is_set)
+
+    def _call_once(self, method: int, body: bytes) -> bytes:
         if self._closed.is_set():
             raise ConnectionError(f"kv {self.address}: store closed")
         with self._mu:
             try:
+                # Socket-boundary faultpoint: drop (request lost on the
+                # wire) and error both surface as the transport failure
+                # the retrier exists for; delay models a slow peer.
+                if fault.fire("kv_remote.call") == "drop":
+                    raise fault.FaultInjected(
+                        "kv_remote.call: request dropped")
                 if self._sock is None:
                     self._sock = socket.create_connection(
                         self.address, timeout=self.timeout_s)
@@ -196,12 +223,22 @@ class RemoteKVStore:
 
     # -- KVStore surface --
 
-    def get(self, key: str) -> VersionedValue | None:
-        raw = self._call(M_GET, _pack(key.encode()))
+    @staticmethod
+    def _parse_get(raw: bytes) -> VersionedValue | None:
         if raw[0] == 0:
             return None
         (version,) = struct.unpack_from("<q", raw, 1)
         return VersionedValue(version, raw[9:])
+
+    def get(self, key: str) -> VersionedValue | None:
+        return self._parse_get(self._call(M_GET, _pack(key.encode())))
+
+    def _get_once(self, key: str) -> VersionedValue | None:
+        """Single-attempt get for the watch poll loop: the loop has its
+        OWN backoff-between-rounds schedule, so running the full
+        in-call retry ladder per key would multiply a dead server's
+        stall time by max_attempts for every watched key."""
+        return self._parse_get(self._call_once(M_GET, _pack(key.encode())))
 
     def set(self, key: str, data: bytes) -> int:
         raw = self._call(M_SET, _pack(key.encode()) + _pack(data))
@@ -317,13 +354,25 @@ class RemoteKVStore:
                 "kv watch callback raised")
 
     def _watch_loop(self) -> None:
-        while not self._closed.wait(self._watch_poll_s):
+        # Reconnect loop with backoff: a dead KV server must not be
+        # hammered at the poll cadence forever — consecutive failed
+        # rounds stretch the wait along the retrier's schedule (capped
+        # at its max backoff), and one healthy round snaps it back.
+        failed_rounds = 0
+        while True:
+            wait_s = self._watch_poll_s
+            if failed_rounds:
+                wait_s = max(wait_s, self.retrier.backoff_for(failed_rounds))
+            if self._closed.wait(wait_s):
+                return
+            round_failed = False
             with self._wmu:
                 keys = list(self._watchers)
             for key in keys:
                 try:
-                    cur = self.get(key)
+                    cur = self._get_once(key)
                 except (ConnectionError, RuntimeError):
+                    round_failed = True
                     continue
                 if cur is None:
                     continue
@@ -340,6 +389,7 @@ class RemoteKVStore:
                         fns = [f for f in pend if f in live] if pend else []
                 for fn in fns:
                     self._fire(fn, cur)
+            failed_rounds = failed_rounds + 1 if round_failed else 0
 
     def close(self) -> None:
         self._closed.set()

@@ -45,6 +45,9 @@ ERROR = 7
 TIMED_BATCH = 11        # MetricBatch payload; samples land by own time
 PASSTHROUGH_BATCH = 12  # pre-aggregated, carries a storage policy
 FORWARDED_BATCH = 13    # stage-N pipeline outputs for the next stage
+INGEST_HELLO = 10       # client opts into per-frame acks (flags u32)
+INGEST_ACK = 14         # server: frame fully ingested (sample count u32)
+INGEST_BACKOFF = 15     # server shed the frame: retry after (ms u32)
 
 
 class ProtocolError(ConnectionError):
@@ -244,6 +247,35 @@ def decode_forwarded_batch(raw: bytes):
     if pos != len(raw):
         raise ProtocolError("forwarded batch trailing bytes")
     return policy, entries
+
+
+# -- ingest ack / load-shed payloads ----------------------------------------
+
+HELLO_WANT_ACKS = 1  # INGEST_HELLO flag: reply ACK/BACKOFF per frame
+
+
+def encode_ingest_hello(flags: int = HELLO_WANT_ACKS) -> bytes:
+    return struct.pack("<I", flags)
+
+
+def decode_ingest_hello(raw: bytes) -> int:
+    return struct.unpack_from("<I", raw, 0)[0]
+
+
+def encode_ingest_ack(n_samples: int) -> bytes:
+    return struct.pack("<I", n_samples)
+
+
+def decode_ingest_ack(raw: bytes) -> int:
+    return struct.unpack_from("<I", raw, 0)[0]
+
+
+def encode_ingest_backoff(retry_after_ms: int) -> bytes:
+    return struct.pack("<I", retry_after_ms)
+
+
+def decode_ingest_backoff(raw: bytes) -> int:
+    return struct.unpack_from("<I", raw, 0)[0]
 
 
 # -- bus transport payloads -------------------------------------------------

@@ -34,6 +34,25 @@ SHARD_AXIS = "shard"
 REPLICA_AXIS = "replica"
 
 
+def shard_map_compat(f, mesh, in_specs, out_specs):
+    """``jax.shard_map`` across jax versions, replica-check disabled.
+
+    Newer jax exposes ``jax.shard_map(..., check_vma=...)``; 0.4.x has
+    only ``jax.experimental.shard_map.shard_map(..., check_rep=...)``.
+    Every shard_map program in the tree goes through this one seam so
+    the suite runs on both."""
+    if hasattr(jax, "shard_map"):
+        try:
+            return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=False)
+        except TypeError:  # pre-check_vma keyword era
+            pass
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
+
+
 @dataclasses.dataclass(frozen=True)
 class MeshTopology:
     """A (shard × replica) device mesh plus its canonical shardings."""

@@ -27,7 +27,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from m3_tpu.parallel.mesh import REPLICA_AXIS, SHARD_AXIS, MeshTopology
+from m3_tpu.parallel.mesh import (
+    REPLICA_AXIS, SHARD_AXIS, MeshTopology, shard_map_compat,
+)
+from m3_tpu.x import fault
 
 _MIX = jnp.uint64(0x9E3779B97F4A7C15)  # Fibonacci hashing constant
 
@@ -55,8 +58,17 @@ def fingerprint_tree(tree) -> jnp.ndarray:
     return fp
 
 
-@functools.partial(jax.jit, static_argnames=("topo",))
 def replica_divergence(topo: MeshTopology, state) -> jnp.ndarray:
+    """Host entry: the ``replication.collective`` faultpoint sits at
+    the host→device boundary (delay = a stalled collective round,
+    error = an aborted one; a fault here can never corrupt device
+    state because the program has not launched yet)."""
+    fault.fire("replication.collective")
+    return _replica_divergence(topo, state)
+
+
+@functools.partial(jax.jit, static_argnames=("topo",))
+def _replica_divergence(topo: MeshTopology, state) -> jnp.ndarray:
     """(num_shards, num_replicas) bool: True where a replica's state
     fingerprint differs from its ring-neighbor's.
 
@@ -80,17 +92,23 @@ def replica_divergence(topo: MeshTopology, state) -> jnp.ndarray:
         return (fp != neighbor)[None, None]
 
     spec = jax.tree.map(lambda _: P(SHARD_AXIS, REPLICA_AXIS), state)
-    return jax.shard_map(
+    return shard_map_compat(
         local,
-        mesh=mesh,
+        mesh,
         in_specs=(spec,),
         out_specs=P(SHARD_AXIS, REPLICA_AXIS),
-        check_vma=False,
     )(state)
 
 
-@functools.partial(jax.jit, static_argnames=("topo", "required"))
 def quorum_ack(topo: MeshTopology, acks: jnp.ndarray, required: int):
+    """Host entry for the quorum collective; same faultpoint contract
+    as :func:`replica_divergence`."""
+    fault.fire("replication.collective")
+    return _quorum_ack(topo, acks, required)
+
+
+@functools.partial(jax.jit, static_argnames=("topo", "required"))
+def _quorum_ack(topo: MeshTopology, acks: jnp.ndarray, required: int):
     """Device-side consistency accumulation (session.go:1213-1400).
 
     ``acks``: (num_shards, num_replicas) bool/int — per-replica success
@@ -104,11 +122,10 @@ def quorum_ack(topo: MeshTopology, acks: jnp.ndarray, required: int):
         got = jax.lax.psum(a.astype(jnp.int32), REPLICA_AXIS)
         return (got >= required), got
 
-    ok, got = jax.shard_map(
+    ok, got = shard_map_compat(
         local,
-        mesh=mesh,
+        mesh,
         in_specs=(P(SHARD_AXIS, REPLICA_AXIS),),
         out_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS, None)),
-        check_vma=False,
     )(acks)
     return ok[:, 0], got[:, 0]

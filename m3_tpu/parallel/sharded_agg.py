@@ -34,7 +34,9 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from m3_tpu.aggregator import arena as _arena
-from m3_tpu.parallel.mesh import REPLICA_AXIS, SHARD_AXIS, MeshTopology
+from m3_tpu.parallel.mesh import (
+    REPLICA_AXIS, SHARD_AXIS, MeshTopology, shard_map_compat,
+)
 
 
 _raw = _arena.raw
@@ -177,12 +179,11 @@ def sharded_ingest_consume(
         "timer": (P(SHARD_AXIS), P(SHARD_AXIS)),
         "rollup": P(),
     }
-    return jax.shard_map(
+    return shard_map_compat(
         local_step,
-        mesh=mesh,
+        mesh,
         in_specs=(shard_spec, batch_spec, P()),
         out_specs=(shard_spec, out_lane_spec),
-        check_vma=False,
     )(state, batch, window)
 
 

@@ -37,7 +37,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from m3_tpu.encoding import m3tsz_jax as codec
-from m3_tpu.parallel.mesh import SHARD_AXIS, MeshTopology
+from m3_tpu.parallel.mesh import SHARD_AXIS, MeshTopology, shard_map_compat
 from m3_tpu.query import device_fns
 from m3_tpu.query import temporal
 
@@ -125,12 +125,11 @@ def sharded_decode_rate_hq(
         )[0]
         return rates[None], hq, errs[None]
 
-    return jax.shard_map(
+    return shard_map_compat(
         local,
-        mesh=mesh,
+        mesh,
         in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P(), P()),
         out_specs=(P(SHARD_AXIS), P(), P(SHARD_AXIS)),
-        check_vma=False,
     )(words, nbits, bucket_ids, step_times, ubs)
 
 

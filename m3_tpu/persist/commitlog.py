@@ -32,6 +32,7 @@ from typing import Iterator
 import numpy as np
 
 from m3_tpu.persist.digest import digest
+from m3_tpu.x import fault
 
 _CHUNK_HDR = struct.Struct("<III")
 
@@ -114,19 +115,26 @@ class CommitLogWriter:
         chunk = hdr_body + struct.pack("<I", digest(hdr_body)) + payload
         self._f.write(chunk)
         if self.fsync == FsyncPolicy.EVERY_WRITE:
-            self._f.flush()
-            os.fsync(self._f.fileno())
+            self._flush_fsync()
         elif self.fsync == FsyncPolicy.INTERVAL:
             now = time.monotonic()
             if now - self._last_fsync >= self.fsync_interval_s:
-                self._f.flush()
-                os.fsync(self._f.fileno())
+                self._flush_fsync()
                 self._last_fsync = now
+
+    def _flush_fsync(self) -> None:
+        """Disk-boundary faultpoint ``commitlog.flush``: delay models a
+        slow device, error a failing one, and drop SKIPS the fsync —
+        the durability hole a later SIGKILL turns into a torn tail the
+        reader's checksum contract must absorb."""
+        if fault.fire("commitlog.flush") == "drop":
+            return
+        self._f.flush()
+        os.fsync(self._f.fileno())
 
     def close(self) -> None:
         if self._f:
-            self._f.flush()
-            os.fsync(self._f.fileno())
+            self._flush_fsync()
             self._f.close()
             self._f = None
 

@@ -111,6 +111,12 @@ def run_node(source, start_mediator: bool | None = None,
         arena.set_ingest_impl(cfg.coordinator.arena_ingest)
     registry = instrument.new_registry()
     scope = registry.scope(cfg.metrics_prefix)
+    # Mirror the process-global fault/retry counters onto this node's
+    # /metrics so dtest scenarios can assert injected faults and retry
+    # activity from outside the process.
+    from m3_tpu.x import register_metrics
+
+    register_metrics(registry)
     tracer = None
     if cfg.coordinator is not None and cfg.coordinator.tracing:
         from m3_tpu.instrument.tracing import Tracer

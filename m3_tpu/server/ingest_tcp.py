@@ -6,14 +6,38 @@ accept connections, iterate framed metric batches off each socket, and
 feed them to the aggregator.  The reference's per-message protobuf
 decode loop becomes one frame = one already-batched array payload — the
 batching the reference does in its client queues happens in the wire
-format itself, so the server's hot loop is decode → add_untimed_batch.
+format itself.
 
-A decode/protocol error closes the connection (rawtcp's error handling);
-the client reconnects and retries its queue.
+Robustness (reference rawtcp sheds load on slow consumers): decoded
+frames no longer run the sink inline on the handler thread — they land
+in ONE bounded global ingest queue drained by a worker, and two budgets
+guard it:
+
+* a global high-watermark (``max_queue_frames``) — total decoded
+  frames in flight across every connection;
+* a per-connection inflight budget (``per_conn_inflight``) — one
+  flooding client cannot own the whole queue.
+
+A frame arriving over budget is REJECTED with an explicit
+``INGEST_BACKOFF`` frame (retry-after hint) instead of silently
+stalling the socket or dropping the connection; the connection stays
+up and the shed is counted.  Clients that sent ``INGEST_HELLO`` with
+the want-acks flag additionally receive ``INGEST_ACK`` after each
+frame is FULLY ingested — the ack is the durability boundary, so a
+well-behaved client never counts a sample as delivered that the server
+then loses.  Legacy clients (no HELLO) see no reply traffic except
+BACKOFF under overload — the pre-existing fire-and-forget contract.
+
+A decode/protocol error still closes the connection (rawtcp's error
+handling); the ``ingest_tcp.frame`` faultpoint (m3_tpu.x.fault) sits
+between recv and decode so dtest can inject drop/delay/corrupt/error
+at the exact socket boundary.
 """
 
 from __future__ import annotations
 
+import queue
+import select
 import socket
 import socketserver
 import threading
@@ -24,6 +48,7 @@ import numpy as np
 from m3_tpu.metrics.policy import StoragePolicy
 from m3_tpu.metrics.types import MetricType
 from m3_tpu.msg import protocol as wire
+from m3_tpu.x import fault
 
 
 def aggregator_sink(aggregator, lock: threading.Lock | None = None,
@@ -69,11 +94,29 @@ def aggregator_sink(aggregator, lock: threading.Lock | None = None,
     return sink
 
 
+_BATCH_FRAMES = (wire.METRIC_BATCH, wire.TIMED_BATCH,
+                 wire.PASSTHROUGH_BATCH, wire.FORWARDED_BATCH)
+
+
+class _ConnState:
+    """Per-connection book-keeping shared by the handler thread (recv,
+    shed replies) and the ingest worker (acks): the write lock keeps a
+    BACKOFF and an ACK from interleaving mid-frame on the socket."""
+
+    __slots__ = ("want_acks", "inflight", "wlock")
+
+    def __init__(self):
+        self.want_acks = False
+        self.inflight = 0  # frames queued; guarded by server._q_lock
+        self.wlock = threading.Lock()
+
+
 class _IngestHandler(socketserver.BaseRequestHandler):
     def handle(self):
         srv = self.server
         sock = self.request
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        conn = _ConnState()
         while True:
             try:
                 frame = wire.recv_frame(sock)
@@ -84,10 +127,31 @@ class _IngestHandler(socketserver.BaseRequestHandler):
             if frame is None:
                 break
             ftype, payload = frame
-            if ftype not in (wire.METRIC_BATCH, wire.TIMED_BATCH,
-                             wire.PASSTHROUGH_BATCH, wire.FORWARDED_BATCH):
+            if ftype == wire.INGEST_HELLO:
+                try:
+                    conn.want_acks = bool(
+                        wire.decode_ingest_hello(payload)
+                        & wire.HELLO_WANT_ACKS)
+                except Exception:  # noqa: BLE001
+                    if srv.scope is not None:
+                        srv.scope.counter("decode_errors").inc()
+                    break
+                continue
+            if ftype not in _BATCH_FRAMES:
                 if srv.scope is not None:
                     srv.scope.counter("unknown_frames").inc()
+                break
+            # Socket-boundary faultpoint: drop kills the connection
+            # (the lost-frame case rawtcp clients must survive), error
+            # acts like a transport failure, corrupt feeds the decode
+            # path a flipped byte, delay models a slow server.
+            try:
+                act, payload = fault.mangle("ingest_tcp.frame", payload)
+            except fault.FaultInjected:
+                if srv.scope is not None:
+                    srv.scope.counter("fault_errors").inc()
+                break
+            if act == "drop":
                 break
             try:
                 if ftype == wire.PASSTHROUGH_BATCH:
@@ -103,41 +167,65 @@ class _IngestHandler(socketserver.BaseRequestHandler):
                 if srv.scope is not None:
                     srv.scope.counter("decode_errors").inc()
                 break
-            try:
-                if ftype == wire.METRIC_BATCH:
-                    srv.sink(batch)  # one-arg call: custom sinks keep working
-                else:
-                    srv.sink(batch, ftype)
-            except Exception:  # noqa: BLE001 — a sink fault (e.g. no
-                # passthrough handler configured, or a one-arg custom
-                # sink receiving a timed frame) must close THIS
-                # connection with a counter, not kill the handler
-                # thread with an unrecorded traceback.
+            if not srv._try_enqueue(conn, sock, ftype, batch, n):
+                # Load shed: explicit BACKOFF, connection stays up.
+                # Writability-probed: a fire-and-forget client that
+                # never reads its socket eventually closes the TCP
+                # window, and a blocking send here would wedge this
+                # handler (it must keep reading) — such a client gets
+                # dropped instead.
                 if srv.scope is not None:
-                    srv.scope.counter("sink_errors").inc()
-                break
-            if srv.scope is not None:
-                srv.scope.counter("samples").inc(n)
+                    srv.scope.counter("shed_frames").inc()
+                    srv.scope.counter("shed_samples").inc(n)
+                with conn.wlock:
+                    try:
+                        _, writable, _ = select.select(
+                            [], [sock], [], srv.ack_send_timeout_s)
+                        if not writable:
+                            break
+                        wire.send_frame(
+                            sock, wire.INGEST_BACKOFF,
+                            wire.encode_ingest_backoff(srv.backoff_hint_ms))
+                    except OSError:
+                        break
+                continue
 
 
 class IngestServer(socketserver.ThreadingTCPServer):
     """sink(MetricBatch) is called per decoded frame — typically
     `lambda b: aggregator.add_untimed_batch(b.metric_types, b.ids,
-    b.values, b.times)` behind a lock."""
+    b.values, b.times)` behind a lock.
+
+    Decoded frames flow through a bounded global queue drained by one
+    worker thread (frame order per connection is preserved); acks are
+    sent only after the sink call returns, so an acked frame is an
+    ingested frame."""
 
     allow_reuse_address = True
     daemon_threads = True
 
     def __init__(self, sink, host: str = "127.0.0.1", port: int = 0,
-                 instrument=None, aggregator=None):
+                 instrument=None, aggregator=None,
+                 max_queue_frames: int = 256, per_conn_inflight: int = 64,
+                 backoff_hint_ms: int = 50, ack_send_timeout_s: float = 5.0):
         self.sink = sink
+        self.ack_send_timeout_s = ack_send_timeout_s
+        self._closing = False
         self.scope = (
             instrument.scope("ingest_tcp") if instrument is not None else None
         )
+        self.max_queue_frames = max_queue_frames
+        self.per_conn_inflight = per_conn_inflight
+        self.backoff_hint_ms = backoff_hint_ms
+        self._queue: "queue.Queue" = queue.Queue()
+        self._q_lock = threading.Lock()
+        self._inflight = 0
         self._agg_collector = None
         self._registry = (
             instrument.registry if instrument is not None else None)
         super().__init__((host, port), _IngestHandler)
+        self._worker = threading.Thread(target=self._drain, daemon=True)
+        self._worker.start()
         if instrument is not None and aggregator is not None:
             # Surface the engine's plain-int counters (forwarded-tail
             # conflicts, timed rejects, series-limit rejects) on this
@@ -148,20 +236,115 @@ class IngestServer(socketserver.ThreadingTCPServer):
             self._agg_collector = instrument_aggregator(
                 instrument, aggregator)
 
+    # -- ingest queue ------------------------------------------------------
+
+    def _try_enqueue(self, conn, sock, ftype, batch, n) -> bool:
+        with self._q_lock:
+            # A server mid-shutdown sheds (explicit BACKOFF) rather
+            # than enqueueing onto a queue whose worker is stopping —
+            # clients get a prompt signal instead of an ack that never
+            # comes.
+            if (self._closing
+                    or self._inflight >= self.max_queue_frames
+                    or conn.inflight >= self.per_conn_inflight):
+                return False
+            self._inflight += 1
+            conn.inflight += 1
+            if self.scope is not None:
+                self.scope.gauge("queue_depth").update(self._inflight)
+            # put() under the lock (never blocks: the Queue is
+            # unbounded; the watermark above is the real bound) so an
+            # accepted frame can never land AFTER the shutdown
+            # sentinel, which is enqueued under this same lock.
+            self._queue.put((conn, sock, ftype, batch, n))
+        return True
+
+    def _drain(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            conn, sock, ftype, batch, n = item
+            try:
+                if ftype == wire.METRIC_BATCH:
+                    # one-arg call: custom sinks keep working
+                    self.sink(batch)
+                else:
+                    self.sink(batch, ftype)
+            except Exception:  # noqa: BLE001 — a sink fault (e.g. no
+                # passthrough handler configured, or a one-arg custom
+                # sink receiving a timed frame) must close THIS
+                # connection with a counter, not kill the worker
+                # thread with an unrecorded traceback.
+                self._dec_inflight(conn)
+                if self.scope is not None:
+                    self.scope.counter("sink_errors").inc()
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                continue
+            self._dec_inflight(conn)
+            if self.scope is not None:
+                self.scope.counter("samples").inc(n)
+            if conn.want_acks:
+                with conn.wlock:
+                    # The lone drain worker must never wedge on one
+                    # stalled client's full send buffer (it serves
+                    # EVERY connection): probe writability first and
+                    # drop the stalled connection instead of blocking.
+                    try:
+                        _, writable, _ = select.select(
+                            [], [sock], [], self.ack_send_timeout_s)
+                        if writable:
+                            wire.send_frame(sock, wire.INGEST_ACK,
+                                            wire.encode_ingest_ack(n))
+                        else:
+                            sock.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass  # client went away; its loss is counted
+                        # client-side by the missing ack
+
+    def _dec_inflight(self, conn) -> None:
+        with self._q_lock:
+            self._inflight -= 1
+            conn.inflight -= 1
+            if self.scope is not None:
+                self.scope.gauge("queue_depth").update(self._inflight)
+
+    # -- lifecycle ---------------------------------------------------------
+
     def _drop_collector(self):
         if self._agg_collector is not None and self._registry is not None:
             self._registry.unregister_collector(self._agg_collector)
             self._agg_collector = None
 
+    def _stop_worker(self):
+        if self._worker is not None:
+            with self._q_lock:
+                # _closing is already observed by the gate under this
+                # lock, so the sentinel lands strictly after every
+                # accepted frame: the worker drains the backlog (acks
+                # included) before exiting.
+                self._queue.put(None)
+            self._worker.join(timeout=30)
+            self._worker = None
+
     def shutdown(self):
         # Every call site stops via shutdown() (server_close is rarer):
         # drop the collector on either path, or the registry pins this
-        # server's aggregator and scrapes it forever.
+        # server's aggregator and scrapes it forever.  Order: flag
+        # closing (handlers shed new frames), stop the accept loop,
+        # then the worker drains the backlog (acks included) and exits.
         self._drop_collector()
+        self._closing = True
         super().shutdown()
+        self._stop_worker()
 
     def server_close(self):
         self._drop_collector()
+        self._closing = True
+        self._stop_worker()
         super().server_close()
 
     @property
@@ -170,8 +353,9 @@ class IngestServer(socketserver.ThreadingTCPServer):
 
 
 def serve_ingest_background(sink, host: str = "127.0.0.1", port: int = 0,
-                            instrument=None, aggregator=None) -> IngestServer:
-    srv = IngestServer(sink, host, port, instrument, aggregator)
+                            instrument=None, aggregator=None,
+                            **kw) -> IngestServer:
+    srv = IngestServer(sink, host, port, instrument, aggregator, **kw)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     return srv

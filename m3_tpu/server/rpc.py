@@ -43,6 +43,7 @@ import numpy as np
 from m3_tpu.index import search
 from m3_tpu.index.doc import Document, Field
 from m3_tpu.msg.protocol import ProtocolError, recv_frame, send_frame
+from m3_tpu.x import fault
 
 # frame types (disjoint from the bus's so a misdirected client fails fast)
 RPC_REQ = 16
@@ -220,6 +221,12 @@ class _RpcHandler(socketserver.BaseRequestHandler):
                 return
             payload = frame[1]
             try:
+                # Socket-boundary faultpoint: drop closes the conn (a
+                # crashed-mid-request peer), error returns a typed
+                # RPC_ERR via the handler below, delay stalls dispatch.
+                act, payload = fault.mangle("rpc.server", payload)
+                if act == "drop":
+                    return
                 if not payload:
                     raise ProtocolError("empty rpc request")
                 resp = self._dispatch(srv.db, payload[0], payload[1:])
@@ -369,6 +376,11 @@ class RemoteDatabase:
     def _call(self, method: int, body: bytes) -> bytes:
         with self._mu:
             try:
+                # Socket-boundary faultpoint: drop/error surface as the
+                # ConnectionError quorum layers count per replica (and
+                # the session's retrier absorbs); delay = slow peer.
+                if fault.fire("rpc.call") == "drop":
+                    raise fault.FaultInjected("rpc.call: request dropped")
                 if self._sock is None:
                     self._sock = self._connect()
                 send_frame(self._sock, RPC_REQ, bytes([method]) + body)

@@ -1,0 +1,39 @@
+"""Cross-cutting substrate (the reference's ``src/x`` tree).
+
+Currently two members, both born for the robustness tier:
+
+* ``m3_tpu.x.fault`` — process-global fault-injection registry: named
+  faultpoints at every socket/disk boundary, armed via code or the
+  ``M3_FAULTPOINTS`` env var, with deterministic seeding and per-point
+  trigger counters.
+* ``m3_tpu.x.retry`` — the reference ``src/x/retry`` equivalent:
+  exponential backoff + jitter + attempt caps + a shared retry budget,
+  adopted by every wire client in the tree.
+
+``register_metrics(registry)`` mirrors both modules' counters into an
+instrument registry at scrape time, so a node's ``/metrics`` exposes
+``fault_*`` and ``retry_*`` series dtest scenarios can assert on.
+"""
+
+from __future__ import annotations
+
+from m3_tpu.x import fault, retry
+
+
+def register_metrics(registry, prefix: str = "") -> object:
+    """Register a scrape-time collector mirroring the fault and retry
+    counters into ``registry`` gauges (tagged by point/retrier name).
+    Returns the collector so callers with a shutdown path can
+    ``registry.unregister_collector`` it."""
+    scope = registry.scope(prefix)
+
+    def collect() -> None:
+        for name, value in fault.counters().items():
+            point, _, key = name.rpartition(".")
+            scope.tagged({"point": point}).gauge(f"fault.{key}").update(value)
+        for name, value in retry.counters().items():
+            rname, _, key = name.rpartition(".")
+            scope.tagged({"retrier": rname}).gauge(f"retry.{key}").update(value)
+
+    registry.register_collector(collect)
+    return collect

@@ -1,0 +1,271 @@
+"""Process-global fault-injection registry.
+
+The reference hardens its wire layer with dtests that kill processes;
+what it cannot do from outside is exercise the *partial* failures —
+a dropped request, a slow fsync, a corrupt frame — deterministically.
+This module plants named **faultpoints** at the socket and disk
+boundaries (``kv_remote.call``, ``rpc.call``, ``rpc.server``,
+``ingest_tcp.frame``, ``replication.collective``, ``commitlog.flush``)
+and lets tests/dtest arm them with one of four modes:
+
+* ``drop``    — the operation is lost: socket sites close the
+  connection and raise; the commitlog flush site silently skips the
+  fsync (the torn-write crash case).
+* ``delay``   — sleep ``ms`` before proceeding (slow peer / slow disk).
+* ``error``   — raise :class:`FaultInjected` (an ``OSError`` /
+  ``ConnectionError`` subclass, so transport-level handlers and retry
+  classifiers treat it exactly like a real failure).
+* ``corrupt`` — flip one byte of the payload passing through
+  :func:`mangle` (checksum/torn-frame paths).
+
+Determinism: every armed spec owns a :class:`random.Random` seeded by
+``(seed, point name, mode)`` as a *string* (string seeding is stable
+across processes — no hash randomization), so a scenario replays
+identically.  Each spec fires at most ``n`` times (default unlimited),
+with probability ``p``, skipping the first ``after`` passes.
+
+Arming:
+* code — ``arm("kv_remote.call", "drop", p=0.3, seed=7)`` or the
+  ``with armed(...):`` context manager (tests);
+* env — ``M3_FAULTPOINTS="kv_remote.call=drop:p=0.3;kv_remote.call=
+  delay:ms=20"`` parsed at import, so dtest node subprocesses inherit
+  faults through their environment.
+
+Call sites pay one dict lookup when nothing is armed — the registry is
+free in production.  Per-point counters (passes/triggers per mode) are
+exported through ``m3_tpu.x.register_metrics`` and asserted by the
+dtest scenarios.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Callable, Dict, List
+
+__all__ = [
+    "FaultInjected", "FaultSpec", "arm", "armed", "arm_from_env",
+    "disarm", "fire", "mangle", "counters", "reset_counters", "points",
+]
+
+
+class FaultInjected(ConnectionError):
+    """Raised by error-mode faultpoints.  ``ConnectionError`` (hence
+    ``OSError``) so socket sites' existing handlers and the retry
+    classifier treat it as a genuine transport/disk failure."""
+
+
+MODES = ("drop", "delay", "error", "corrupt")
+
+
+class FaultSpec:
+    """One armed behavior on one point; a point may hold several."""
+
+    __slots__ = ("point", "mode", "p", "n", "after", "delay_s", "_rng",
+                 "_passes", "triggers", "_lock")
+
+    def __init__(self, point: str, mode: str, p: float = 1.0,
+                 n: int | None = None, after: int = 0,
+                 delay_ms: float = 0.0, seed: int = 0):
+        if mode not in MODES:
+            raise ValueError(f"fault mode {mode!r}: must be one of {MODES}")
+        self.point = point
+        self.mode = mode
+        self.p = float(p)
+        self.n = n
+        self.after = int(after)
+        self.delay_s = float(delay_ms) / 1000.0
+        # String seeding is deterministic across processes (sha512 of
+        # the string, no PYTHONHASHSEED involvement).
+        self._rng = random.Random(f"{seed}:{point}:{mode}")
+        self._passes = 0
+        self.triggers = 0
+        self._lock = threading.Lock()
+
+    def should_trigger(self) -> bool:
+        with self._lock:
+            self._passes += 1
+            if self._passes <= self.after:
+                return False
+            if self.n is not None and self.triggers >= self.n:
+                return False
+            if self.p < 1.0 and self._rng.random() >= self.p:
+                return False
+            self.triggers += 1
+        # Trigger totals outlive the spec: scenarios disarm (the
+        # `armed` context exits) BEFORE asserting counters.
+        key = f"{self.point}.{self.mode}_triggers"
+        with _lock:
+            _trigger_totals[key] = _trigger_totals.get(key, 0) + 1
+        return True
+
+
+_lock = threading.Lock()
+_points: Dict[str, List[FaultSpec]] = {}
+_passes: Dict[str, int] = {}
+_trigger_totals: Dict[str, int] = {}
+
+
+def arm(point: str, mode: str, **kw) -> FaultSpec:
+    """Arm one fault spec on ``point``; returns it (for its counter)."""
+    spec = FaultSpec(point, mode, **kw)
+    with _lock:
+        _points.setdefault(point, []).append(spec)
+    return spec
+
+
+def disarm(point: str | None = None) -> None:
+    """Disarm one point, or every point when ``point`` is None."""
+    with _lock:
+        if point is None:
+            _points.clear()
+        else:
+            _points.pop(point, None)
+
+
+class armed:
+    """``with fault.armed("p", "drop", p=0.5):`` — arm for a scope and
+    ALWAYS disarm that point on exit (test hygiene)."""
+
+    def __init__(self, point: str, mode: str, **kw):
+        self._args = (point, mode, kw)
+        self.spec: FaultSpec | None = None
+
+    def __enter__(self) -> FaultSpec:
+        point, mode, kw = self._args
+        self.spec = arm(point, mode, **kw)
+        return self.spec
+
+    def __exit__(self, *exc) -> None:
+        point = self._args[0]
+        with _lock:
+            specs = _points.get(point)
+            if specs is not None:
+                try:
+                    specs.remove(self.spec)
+                except ValueError:
+                    pass
+                if not specs:
+                    del _points[point]
+
+
+def fire(point: str, sleep: Callable[[float], None] = time.sleep) -> str | None:
+    """Evaluate the armed specs at ``point``.
+
+    Returns ``"drop"`` when a drop-mode spec triggers (the SITE decides
+    what a drop means at its boundary), ``None`` otherwise.  Delay-mode
+    sleeps inline; error-mode raises :class:`FaultInjected`.  Corrupt
+    specs are ignored here — byte-carrying sites use :func:`mangle`.
+    """
+    specs = _points.get(point)
+    if not specs:
+        return None
+    with _lock:
+        _passes[point] = _passes.get(point, 0) + 1
+        snapshot = list(specs)
+    action = None
+    for spec in snapshot:
+        if spec.mode == "corrupt" or not spec.should_trigger():
+            continue
+        if spec.mode == "delay":
+            sleep(spec.delay_s)
+        elif spec.mode == "error":
+            raise FaultInjected(f"injected fault at {point}")
+        elif spec.mode == "drop":
+            action = "drop"
+    return action
+
+
+def mangle(point: str, data: bytes,
+           sleep: Callable[[float], None] = time.sleep) -> tuple:
+    """:func:`fire` for byte-carrying boundaries: evaluates corrupt
+    specs too.  Returns ``(action, data)`` where a triggered corrupt
+    spec has one byte flipped at a deterministic (seeded) offset."""
+    specs = _points.get(point)
+    if not specs:
+        return None, data
+    action = fire(point, sleep=sleep)
+    with _lock:
+        snapshot = list(specs)
+    for spec in snapshot:
+        if spec.mode != "corrupt" or not spec.should_trigger():
+            continue
+        if data:
+            i = spec._rng.randrange(len(data))
+            data = data[:i] + bytes([data[i] ^ 0xFF]) + data[i + 1:]
+    return action, data
+
+
+def arm_from_env(env: str | None = None) -> int:
+    """Parse ``M3_FAULTPOINTS`` (or ``env``) and arm the result.
+
+    Grammar: ``point=mode[:key=value]*`` joined by ``;``.  Keys:
+    ``p`` (probability), ``n`` (max triggers), ``ms`` (delay),
+    ``after`` (skip first k passes), ``seed``.  Returns the number of
+    specs armed.  A malformed entry raises ValueError — a typo silently
+    arming nothing would invalidate the scenario the flag exists for.
+    """
+    raw = os.environ.get("M3_FAULTPOINTS", "") if env is None else env
+    count = 0
+    for entry in raw.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        head, *opts = entry.split(":")
+        point, sep, mode = head.partition("=")
+        if not sep or not point or not mode:
+            raise ValueError(f"M3_FAULTPOINTS entry {entry!r}: "
+                             "expected point=mode[:key=value]*")
+        kw: dict = {}
+        for opt in opts:
+            k, sep, v = opt.partition("=")
+            if not sep:
+                raise ValueError(f"M3_FAULTPOINTS option {opt!r} in {entry!r}")
+            if k == "p":
+                kw["p"] = float(v)
+            elif k == "n":
+                kw["n"] = int(v)
+            elif k == "ms":
+                kw["delay_ms"] = float(v)
+            elif k == "after":
+                kw["after"] = int(v)
+            elif k == "seed":
+                kw["seed"] = int(v)
+            else:
+                raise ValueError(f"M3_FAULTPOINTS key {k!r} in {entry!r}")
+        arm(point, mode, **kw)
+        count += 1
+    return count
+
+
+def counters() -> Dict[str, int]:
+    """Flat ``{"<point>.passes": n, "<point>.<mode>_triggers": n}``.
+    Trigger totals survive disarm — scenarios assert them after their
+    ``armed`` context has exited."""
+    with _lock:
+        out: Dict[str, int] = dict(_trigger_totals)
+        for point, n in _passes.items():
+            out[f"{point}.passes"] = n
+    return out
+
+
+def reset_counters() -> None:
+    with _lock:
+        _passes.clear()
+        _trigger_totals.clear()
+        for specs in _points.values():
+            for spec in specs:
+                spec.triggers = 0
+                spec._passes = 0
+
+
+def points() -> List[str]:
+    with _lock:
+        return sorted(_points)
+
+
+# Node subprocesses inherit faults through the environment (the dtest
+# harness passes env= through NodeProcess).
+arm_from_env()

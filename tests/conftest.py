@@ -18,7 +18,12 @@ if "xla_force_host_platform_device_count" not in prev:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # Older jax: the XLA_FLAGS fallback above provides the 8 virtual
+    # CPU devices (jax_num_cpu_devices landed after 0.4.x).
+    pass
 
 import pathlib
 import sys

@@ -27,15 +27,16 @@ def _random_batch(rng, W, C, N, oob_windows=True, oob_slots=True,
                   time_ties=False):
     windows = rng.integers(-1 if oob_windows else 0,
                            W + (2 if oob_windows else 0), N).astype(np.int32)
+    lo = -2 if oob_slots else 0
     hi = C + (3 if oob_slots else 0)
-    slots = rng.integers(0, hi, N).astype(np.int32)
+    slots = rng.integers(lo, hi, N).astype(np.int32)
     times = 1_000 + rng.integers(0, 50 if time_ties else 1_000_000,
                                  N).astype(np.int64)
+    # flat_window_index itself sentinels out-of-range slots (negative
+    # or >= C) — no manual sentinel step, so the fuzz exercises the
+    # production call shape.
     widx = arena.flat_window_index(jnp.asarray(windows), jnp.asarray(slots),
                                    W, C)
-    # Samples whose SLOT is padded must carry the sentinel index too
-    # (pad_slots + flat_window_index always travel together in callers).
-    widx = jnp.where(jnp.asarray(slots) >= C, W * C, widx)
     return widx, jnp.asarray(slots), jnp.asarray(times)
 
 
@@ -103,6 +104,27 @@ class TestCounterSorted:
             jnp.asarray([5], jnp.int64), jnp.asarray([123], jnp.int64))
         assert int(st.count.sum()) == 0
         assert int(st.last_at.sum()) == 0  # no slot bumped
+
+    @pytest.mark.parametrize("impl", ["scatter", "sorted"])
+    def test_negative_slot_parity_via_flat_window_index(self, impl):
+        """Production call shape: negative and >=C slots through
+        flat_window_index must DROP on BOTH impls — including the
+        last_at expiry column, where the raw scatter used to numpy-wrap
+        slot -1 onto slot C-1."""
+        arena.set_ingest_impl(impl)
+        try:
+            W, C = 2, 8
+            windows = jnp.asarray([0, 1, 0, 1], jnp.int32)
+            slots = jnp.asarray([-1, -2, C, C + 2], jnp.int32)
+            idx = arena.flat_window_index(windows, slots, W, C)
+            st = arena.counter_ingest(
+                arena.counter_init(W, C), idx, slots,
+                jnp.asarray([5, 6, 7, 8], jnp.int64),
+                jnp.asarray([100, 200, 300, 400], jnp.int64))
+            assert int(np.asarray(st.count).sum()) == 0
+            assert int(np.asarray(st.last_at).sum()) == 0
+        finally:
+            arena.set_ingest_impl("scatter")
 
     def test_window_dropped_still_bumps_last_at(self, sorted_impl):
         """A sample with an out-of-ring window is dropped from the
@@ -185,7 +207,9 @@ class TestTimerSorted:
         rng = np.random.default_rng(seed)
         windows = rng.integers(-1 if oob else 0, W + (2 if oob else 0),
                                N).astype(np.int32)
-        slots = jnp.asarray(rng.integers(0, C, N).astype(np.int32))
+        slots = jnp.asarray(rng.integers(-2 if oob else 0,
+                                         C + (3 if oob else 0),
+                                         N).astype(np.int32))
         vals = jnp.asarray(np.round(rng.gamma(2.0, 5.0, N), 4))
         times = jnp.asarray(1000 + rng.integers(0, 10**6, N).astype(np.int64))
         return arena.timer_ingest(arena.timer_init(W, C, S),
@@ -222,6 +246,33 @@ class TestTimerSorted:
         np.testing.assert_array_equal(
             np.asarray(st.sample_val[0][:12]),
             [0., 1., 2., 3., 10., 11., 12., 13., 20., 21., 22., 23.])
+
+    @pytest.mark.parametrize("impl", ["scatter", "sorted"])
+    def test_dropped_samples_do_not_leak_into_buffer(self, impl):
+        """A slot-dropped sample must not consume quantile-buffer
+        capacity or inflate sample_n: valid samples pack densely and
+        counts reflect only what was appended (both impls)."""
+        arena.set_ingest_impl(impl)
+        try:
+            W, C, S = 2, 8, 64
+            st = arena.timer_ingest(
+                arena.timer_init(W, C, S),
+                jnp.asarray([0, 0, 0, 0], jnp.int32),
+                jnp.asarray([C + 1, 3, -1, 5], jnp.int32),
+                jnp.asarray([9.0, 1.0, 9.0, 2.0]),
+                jnp.asarray([100] * 4, jnp.int64), C)
+            assert int(st.sample_n[0]) == 2  # only the two valid slots
+            np.testing.assert_array_equal(
+                np.asarray(st.sample_slot[0][:2]), [3, 5])
+            np.testing.assert_array_equal(
+                np.asarray(st.sample_val[0][:2]), [1.0, 2.0])
+            # moment lanes agree with the buffer: nothing from drops
+            assert float(np.asarray(st.sum).sum()) == 3.0
+            assert int(np.asarray(st.count).sum()) == 2
+            assert int(st.last_at[3]) == 100 and int(st.last_at[5]) == 100
+            assert int(np.asarray(st.last_at).sum()) == 200
+        finally:
+            arena.set_ingest_impl("scatter")
 
     @pytest.mark.parametrize("impl", ["scatter", "sorted"])
     def test_out_of_range_slot_drops_not_next_window(self, impl):

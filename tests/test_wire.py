@@ -174,6 +174,113 @@ class TestIngestPath:
             srv.shutdown()
 
 
+class TestIngestLoadShed:
+    """Overload sheds with an explicit INGEST_BACKOFF (never a silent
+    stall or disconnect), acks fire only after full ingest, and a
+    well-behaved client's acknowledged samples are never lost."""
+
+    def _batch_payload(self, ids):
+        return wire.encode_metric_batch(wire.MetricBatch(
+            np.full(len(ids), 1, np.uint8), list(ids),
+            np.ones(len(ids), np.float64),
+            np.full(len(ids), T0, np.int64)))
+
+    def test_backoff_frame_on_overload_conn_survives(self):
+        from m3_tpu import instrument
+
+        gate = threading.Event()
+        got = []
+
+        def slow_sink(batch, kind=wire.METRIC_BATCH):
+            gate.wait(30)
+            got.extend(batch.ids)
+
+        reg = instrument.new_registry()
+        from m3_tpu.server.ingest_tcp import serve_ingest_background as sib
+        srv = sib(slow_sink, instrument=reg.scope(""),
+                  max_queue_frames=8, per_conn_inflight=1,
+                  backoff_hint_ms=30)
+        s = socket.create_connection(("127.0.0.1", srv.port))
+        s.settimeout(10)
+        try:
+            wire.send_frame(s, wire.INGEST_HELLO, wire.encode_ingest_hello())
+            # frame 1 occupies the connection's inflight budget (the
+            # worker is parked in the slow sink)...
+            wire.send_frame(s, wire.METRIC_BATCH, self._batch_payload([b"a"]))
+            # ...so frame 2 must be shed with an explicit BACKOFF.
+            wire.send_frame(s, wire.METRIC_BATCH, self._batch_payload([b"b"]))
+            ftype, payload = wire.recv_frame(s)
+            assert ftype == wire.INGEST_BACKOFF
+            assert wire.decode_ingest_backoff(payload) == 30
+            snap = reg.snapshot()
+            assert snap.get("ingest_tcp.shed_frames", 0) == 1
+            assert snap.get("ingest_tcp.shed_samples", 0) == 1
+            # Unblock the sink: frame 1 completes and is ACKed — the
+            # connection survived the shed.
+            gate.set()
+            ftype, payload = wire.recv_frame(s)
+            assert ftype == wire.INGEST_ACK
+            assert wire.decode_ingest_ack(payload) == 1
+            # The well-behaved client resends the shed frame.
+            wire.send_frame(s, wire.METRIC_BATCH, self._batch_payload([b"b"]))
+            ftype, _ = wire.recv_frame(s)
+            assert ftype == wire.INGEST_ACK
+            assert got == [b"a", b"b"]  # acked == ingested, in order
+        finally:
+            s.close()
+            srv.shutdown()
+
+    def test_instance_queue_parks_on_backoff_no_acked_loss(self):
+        """InstanceQueue under a shedding server: samples count as
+        `sent` ONLY once acked (= ingested); a BACKOFF parks the batch
+        and it is delivered after the hint expires — nothing
+        acknowledged is ever lost, nothing is double-counted."""
+        from m3_tpu import instrument
+        from m3_tpu.client.aggregator_client import InstanceQueue
+
+        gate = threading.Event()
+        got = []
+
+        def slow_sink(batch, kind=wire.METRIC_BATCH):
+            gate.wait(30)
+            got.extend(batch.ids)
+
+        reg = instrument.new_registry()
+        from m3_tpu.server.ingest_tcp import serve_ingest_background as sib
+        srv = sib(slow_sink, instrument=reg.scope(""),
+                  max_queue_frames=1, per_conn_inflight=1,
+                  backoff_hint_ms=500)
+        # A raw connection fills the GLOBAL queue watermark...
+        s = socket.create_connection(("127.0.0.1", srv.port))
+        q = None
+        try:
+            wire.send_frame(s, wire.METRIC_BATCH, self._batch_payload([b"x"]))
+            deadline = time.monotonic() + 5
+            while (reg.snapshot().get("ingest_tcp.queue_depth", 0) < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            # ...so the instance queue's flush is shed and parks.
+            q = InstanceQueue(("127.0.0.1", srv.port))
+            q.enqueue(1, b"q1", 1.0, T0)
+            q.enqueue(1, b"q2", 2.0, T0)
+            assert q.flush() == 0
+            assert q.backoffs == 1 and q.sent == 0
+            assert q.flush() == 0  # still inside the backoff window
+            gate.set()  # drain the server
+            deadline = time.monotonic() + 10
+            n = 0
+            while n == 0 and time.monotonic() < deadline:
+                n = q.flush()  # no-ops until the hint expires
+                time.sleep(0.01)
+            assert n == 2 and q.sent == 2
+            assert b"q1" in got and b"q2" in got  # acked == ingested
+        finally:
+            if q is not None:
+                q.close()
+            s.close()
+            srv.shutdown()
+
+
 class TestBusTransport:
     def _topic(self):
         return Topic("agg_out", 4, (

@@ -1,0 +1,277 @@
+"""x/ substrate: retry backoff/jitter/budget math and the faultpoint
+registry, plus a fault-injected RemoteKVStore round-trip.
+
+All deterministic: seeded rngs, injectable clocks/sleeps, zero real
+sleeping in the math tests (TESTING.md conventions)."""
+
+import pytest
+
+from m3_tpu.x import fault
+from m3_tpu.x.retry import (
+    Retrier, RetryBudget, RetryOptions, counters, reset_counters,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals():
+    fault.disarm()
+    fault.reset_counters()
+    reset_counters()
+    yield
+    fault.disarm()
+    fault.reset_counters()
+    reset_counters()
+
+
+class TestBackoffMath:
+    def test_exponential_schedule_no_jitter(self):
+        r = Retrier(RetryOptions(initial_backoff_s=0.1, backoff_factor=2.0,
+                                 max_backoff_s=1.0, jitter=False))
+        assert r.backoff_for(0) == 0.0
+        assert r.backoff_for(1) == pytest.approx(0.1)
+        assert r.backoff_for(2) == pytest.approx(0.2)
+        assert r.backoff_for(3) == pytest.approx(0.4)
+        # cap: 0.1 * 2**5 = 3.2 -> 1.0
+        assert r.backoff_for(6) == pytest.approx(1.0)
+        assert r.backoff_for(50) == pytest.approx(1.0)
+
+    def test_jitter_stays_in_half_open_band(self):
+        r = Retrier(RetryOptions(initial_backoff_s=0.2, backoff_factor=2.0,
+                                 max_backoff_s=10.0, jitter=True), seed=7)
+        for i in range(1, 8):
+            base = min(0.2 * 2 ** (i - 1), 10.0)
+            for _ in range(20):
+                b = r.backoff_for(i)
+                assert base / 2 <= b <= base
+
+    def test_jitter_deterministic_with_seed(self):
+        a = Retrier(RetryOptions(), seed=13)
+        b = Retrier(RetryOptions(), seed=13)
+        assert [a.backoff_for(i) for i in (1, 2, 3)] == \
+               [b.backoff_for(i) for i in (1, 2, 3)]
+
+
+class TestRetrierRun:
+    def _retrier(self, sleeps, **opt_kw):
+        opts = RetryOptions(initial_backoff_s=0.01, jitter=False, **opt_kw)
+        return Retrier(opts, name="t", sleep=sleeps.append)
+
+    def test_retries_then_succeeds(self):
+        sleeps = []
+        calls = {"n": 0}
+
+        def fn():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ConnectionError("transient")
+            return "ok"
+
+        assert self._retrier(sleeps).run(fn) == "ok"
+        assert calls["n"] == 3
+        assert sleeps == [pytest.approx(0.01), pytest.approx(0.02)]
+        c = counters()
+        assert c["t.retries"] == 2
+        assert c["t.successes"] == 1
+        assert c["t.recovered"] == 1
+
+    def test_non_retryable_raises_immediately(self):
+        sleeps = []
+        with pytest.raises(ValueError):
+            self._retrier(sleeps).run(
+                lambda: (_ for _ in ()).throw(ValueError("app error")))
+        assert sleeps == []
+        assert counters()["t.not_retryable"] == 1
+
+    def test_exhausted_reraises_last_error(self):
+        sleeps = []
+
+        def fn():
+            raise ConnectionError("always")
+
+        with pytest.raises(ConnectionError, match="always"):
+            self._retrier(sleeps, max_attempts=3).run(fn)
+        assert len(sleeps) == 2  # attempts-1 backoffs
+        assert counters()["t.exhausted"] == 1
+
+    def test_abort_stops_the_schedule(self):
+        sleeps = []
+        with pytest.raises(ConnectionError):
+            self._retrier(sleeps).run(
+                lambda: (_ for _ in ()).throw(ConnectionError("x")),
+                abort=lambda: True)
+        assert sleeps == []  # no backoff burned against a closed client
+        assert counters()["t.aborted"] == 1
+
+    def test_budget_denies_when_empty(self):
+        clock = {"t": 0.0}
+        budget = RetryBudget(capacity=2, refill_per_s=1.0,
+                             clock=lambda: clock["t"])
+        sleeps = []
+        r = Retrier(RetryOptions(initial_backoff_s=0.01, jitter=False,
+                                 max_attempts=10),
+                    name="t", sleep=sleeps.append, budget=budget)
+
+        def fn():
+            raise ConnectionError("down")
+
+        with pytest.raises(ConnectionError):
+            r.run(fn)
+        # 2 tokens -> 2 retries allowed, 3rd denied
+        assert len(sleeps) == 2
+        assert counters()["t.budget_exhausted"] == 1
+        # time refills the bucket
+        clock["t"] += 5.0
+        assert budget.allow()
+
+
+class TestFaultpoints:
+    def test_unarmed_is_free_and_none(self):
+        assert fault.fire("nothing.here") is None
+        act, data = fault.mangle("nothing.here", b"abc")
+        assert act is None and data == b"abc"
+
+    def test_error_mode_raises_fault_injected(self):
+        fault.arm("p.err", "error")
+        with pytest.raises(fault.FaultInjected):
+            fault.fire("p.err")
+        # FaultInjected is transport-shaped for the retry classifier
+        assert issubclass(fault.FaultInjected, ConnectionError)
+
+    def test_drop_and_delay_modes(self):
+        slept = []
+        fault.arm("p.drop", "drop")
+        fault.arm("p.delay", "delay", delay_ms=25)
+        assert fault.fire("p.drop") == "drop"
+        assert fault.fire("p.delay", sleep=slept.append) is None
+        assert slept == [pytest.approx(0.025)]
+
+    def test_corrupt_flips_one_byte_deterministically(self):
+        fault.arm("p.c", "corrupt", seed=3)
+        _, d1 = fault.mangle("p.c", b"hello world")
+        assert d1 != b"hello world" and len(d1) == 11
+        assert sum(a != b for a, b in zip(d1, b"hello world")) == 1
+        fault.disarm("p.c")
+        fault.arm("p.c", "corrupt", seed=3)
+        _, d2 = fault.mangle("p.c", b"hello world")
+        assert d2 == d1  # same seed, same flip
+
+    def test_probability_is_seeded_deterministic(self):
+        def pattern():
+            fault.disarm("p.p")
+            spec = fault.arm("p.p", "drop", p=0.5, seed=42)
+            fires = [fault.fire("p.p") == "drop" for _ in range(50)]
+            return fires, spec.triggers
+
+        f1, t1 = pattern()
+        f2, t2 = pattern()
+        assert f1 == f2 and t1 == t2
+        assert 0 < t1 < 50  # actually probabilistic
+
+    def test_n_cap_and_after_skip(self):
+        fault.arm("p.n", "drop", n=2)
+        assert [fault.fire("p.n") for _ in range(4)] == \
+               ["drop", "drop", None, None]
+        fault.arm("p.a", "drop", after=2)
+        assert [fault.fire("p.a") for _ in range(4)] == \
+               [None, None, "drop", "drop"]
+
+    def test_counters_and_reset(self):
+        fault.arm("p.k", "drop", n=1)
+        fault.fire("p.k")
+        fault.fire("p.k")
+        c = fault.counters()
+        assert c["p.k.passes"] == 2
+        assert c["p.k.drop_triggers"] == 1
+        fault.reset_counters()
+        assert fault.counters().get("p.k.drop_triggers", 0) == 0
+
+    def test_armed_context_manager_cleans_up(self):
+        with fault.armed("p.ctx", "drop") as spec:
+            assert fault.fire("p.ctx") == "drop"
+            assert spec.triggers == 1
+        assert fault.fire("p.ctx") is None
+        assert "p.ctx" not in fault.points()
+
+    def test_env_grammar(self):
+        n = fault.arm_from_env(
+            "a.b=drop:p=0.5:seed=9 ; c.d=delay:ms=10:n=3")
+        assert n == 2
+        assert fault.points() == ["a.b", "c.d"]
+        with pytest.raises(ValueError):
+            fault.arm_from_env("missing-mode")
+        with pytest.raises(ValueError):
+            fault.arm_from_env("a.b=drop:bogus=1")
+        with pytest.raises(ValueError):
+            fault.arm_from_env("a.b=notamode")
+
+
+class TestFaultedRemoteKV:
+    """The substrate end-to-end: injected faults at the kv_remote
+    socket boundary are healed by the client's retrier."""
+
+    @pytest.fixture
+    def kv_pair(self, tmp_path):
+        from m3_tpu.cluster.kv_remote import (
+            RemoteKVStore, serve_kv_background,
+        )
+
+        srv = serve_kv_background(root=str(tmp_path))
+        client = RemoteKVStore(
+            ("127.0.0.1", srv.port),
+            retry_options=RetryOptions(
+                initial_backoff_s=0.01, max_backoff_s=0.05, max_attempts=5))
+        yield srv, client
+        client.close()
+        srv.shutdown()
+        srv.server_close()
+
+    def test_roundtrip_through_dropped_requests(self, kv_pair):
+        _, kv = kv_pair
+        with fault.armed("kv_remote.call", "drop", n=2) as spec:
+            assert kv.set("k", b"v") == 1
+            v = kv.get("k")
+        assert (v.version, v.data) == (1, b"v")
+        assert spec.triggers == 2
+        c = counters()
+        assert c["kv_remote.retries"] >= 2
+        assert c["kv_remote.successes"] >= 2
+
+    def test_error_faults_heal_too(self, kv_pair):
+        _, kv = kv_pair
+        with fault.armed("kv_remote.call", "error", n=3):
+            assert kv.set("e", b"1") == 1
+        assert kv.get("e").data == b"1"
+
+    def test_application_errors_never_retry(self, kv_pair):
+        _, kv = kv_pair
+        kv.set("cas", b"x")
+        before = counters().get("kv_remote.retries", 0)
+        with pytest.raises(ValueError):
+            kv.check_and_set("cas", 99, b"y")
+        assert counters().get("kv_remote.retries", 0) == before
+        assert counters()["kv_remote.not_retryable"] >= 1
+
+    def test_exhausted_faults_surface_as_connection_error(self, kv_pair):
+        _, kv = kv_pair
+        with fault.armed("kv_remote.call", "drop"):  # every call
+            with pytest.raises(ConnectionError):
+                kv.set("never", b"v")
+        assert counters()["kv_remote.exhausted"] >= 1
+
+
+class TestRegisterMetrics:
+    def test_counters_mirrored_into_registry(self):
+        from m3_tpu import instrument
+        from m3_tpu.x import register_metrics
+
+        fault.arm("m.pt", "drop")
+        fault.fire("m.pt")
+        Retrier(RetryOptions(jitter=False, initial_backoff_s=0),
+                name="m_ret", sleep=lambda s: None).run(lambda: 1)
+        reg = instrument.new_registry()
+        register_metrics(reg)
+        snap = reg.snapshot()
+        assert snap.get("fault.drop_triggers{point=m.pt}") == 1
+        assert snap.get("retry.successes{retrier=m_ret}") == 1
+        prom = reg.render_prometheus()
+        assert 'fault_drop_triggers{point="m.pt"} 1' in prom
