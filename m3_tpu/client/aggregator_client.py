@@ -96,12 +96,17 @@ class InstanceQueue:
 
     def _connect(self) -> socket.socket:
         if self._sock is None:
-            s = socket.create_connection(self.address, timeout=5.0)
-            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            if self.want_acks:
-                s.settimeout(self.ack_timeout_s)
-                wire.send_frame(s, wire.INGEST_HELLO,
-                                wire.encode_ingest_hello())
+            s = wire.connect(self.address)
+            try:
+                if self.want_acks:
+                    s.settimeout(self.ack_timeout_s)
+                    wire.send_frame(s, wire.INGEST_HELLO,
+                                    wire.encode_ingest_hello())
+            except BaseException:
+                # a failed HELLO must not leak the half-set-up socket
+                # (m3lint resource-hygiene)
+                s.close()
+                raise
             self._sock = s
         return self._sock
 
@@ -169,17 +174,26 @@ class InstanceQueue:
             self.retrier.run(
                 lambda: self._send_one(self.frame_type, payload))
         except _Backoff as b:
-            self.backoffs += 1
-            self._backoff_until = (
-                time.monotonic() + b.retry_after_ms / 1000.0)
+            self._note_backoff(b)
             self._park(batch)
             return 0
         except (OSError, wire.ProtocolError):
             # park the batch back for the next flush (retry)
             self._park(batch)
             return 0
-        self.sent += len(batch.ids)
+        # Stats mutate under the queue lock: flush() runs on both the
+        # user thread and the auto-flush thread, and a bare += is a
+        # load/op/store race that loses increments (m3lint
+        # lock-discipline).
+        with self._lock:
+            self.sent += len(batch.ids)
         return len(batch.ids)
+
+    def _note_backoff(self, b: "_Backoff") -> None:
+        with self._lock:
+            self.backoffs += 1
+            self._backoff_until = (
+                time.monotonic() + b.retry_after_ms / 1000.0)
 
     def _park(self, batch) -> None:
         with self._lock:
@@ -201,9 +215,7 @@ class InstanceQueue:
             self._send_one(ftype, payload)
             return True
         except _Backoff as b:
-            self.backoffs += 1
-            self._backoff_until = (
-                time.monotonic() + b.retry_after_ms / 1000.0)
+            self._note_backoff(b)
             return False
         except (OSError, wire.ProtocolError):
             return False

@@ -33,7 +33,9 @@ import threading
 from typing import Callable, Tuple
 
 from m3_tpu.cluster.kv import KVStore, VersionedValue
-from m3_tpu.msg.protocol import ProtocolError, recv_frame, send_frame
+from m3_tpu.msg.protocol import (
+    ProtocolError, connect as wire_connect, recv_frame, send_frame,
+)
 from m3_tpu.x import fault
 from m3_tpu.x.retry import Retrier, RetryOptions
 
@@ -187,6 +189,15 @@ class RemoteKVStore:
             lambda: self._call_once(method, body),
             abort=self._closed.is_set)
 
+    def _drop_sock(self) -> None:
+        # Caller holds self._mu (rpc.py's RemoteDatabase._drop shape).
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None  # m3lint: disable=lock-discipline
+
     def _call_once(self, method: int, body: bytes) -> bytes:
         if self._closed.is_set():
             raise ConnectionError(f"kv {self.address}: store closed")
@@ -199,19 +210,12 @@ class RemoteKVStore:
                     raise fault.FaultInjected(
                         "kv_remote.call: request dropped")
                 if self._sock is None:
-                    self._sock = socket.create_connection(
+                    self._sock = wire_connect(
                         self.address, timeout=self.timeout_s)
-                    self._sock.setsockopt(
-                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 send_frame(self._sock, KV_REQ, bytes([method]) + body)
                 frame = recv_frame(self._sock)
             except (OSError, ProtocolError) as e:
-                if self._sock is not None:
-                    try:
-                        self._sock.close()
-                    except OSError:
-                        pass
-                    self._sock = None
+                self._drop_sock()
                 raise ConnectionError(f"kv {self.address}: {e}") from e
         if frame is None:
             raise ConnectionError(f"kv {self.address}: closed")
@@ -219,6 +223,13 @@ class RemoteKVStore:
         if ftype == KV_ERR:
             tname, _, msg = payload.decode(errors="replace").partition("\x00")
             raise self._RERAISE.get(tname, RuntimeError)(msg)
+        if ftype != KV_OK:
+            # Protocol confusion: the reply stream is desynced — drop
+            # the connection rather than treating an arbitrary frame as
+            # a success payload (m3lint wire-exhaustive).
+            with self._mu:
+                self._drop_sock()
+            raise ConnectionError(f"kv {self.address}: bad frame {ftype}")
         return payload
 
     # -- KVStore surface --
@@ -394,9 +405,4 @@ class RemoteKVStore:
     def close(self) -> None:
         self._closed.set()
         with self._mu:
-            if self._sock is not None:
-                try:
-                    self._sock.close()
-                except OSError:
-                    pass
-                self._sock = None
+            self._drop_sock()

@@ -597,7 +597,8 @@ def encode_batch_device(timestamps, value_bits, start, valid, unit: int = 1,
                 out = out + (jnp.take_along_axis(cum, p_hi, axis=1)
                              - jnp.take_along_axis(cum, p_lo, axis=1))
     else:
-        series_idx = jnp.broadcast_to(jnp.arange(S)[:, None], (S, T))
+        series_idx = jnp.broadcast_to(jnp.arange(S, dtype=I64)[:, None],
+                                      (S, T))
         for j, wj in enumerate((w0, w1, w2, w3)):
             pos = offsets + j * 64
             gw = (pos >> 6).astype(I32)
@@ -689,7 +690,7 @@ def encode_batch(timestamps, values, start, counts=None, unit: Unit = Unit.SECON
     S, T = timestamps.shape
     if counts is None:
         counts = np.full(S, T, dtype=np.int64)
-    valid = np.arange(T)[None, :] < np.asarray(counts)[:, None]
+    valid = np.arange(T, dtype=np.int64)[None, :] < np.asarray(counts)[:, None]
     vb = values.view(np.uint64)
 
     prefix_bits = None

@@ -44,6 +44,12 @@ class _BusConnHandler(socketserver.BaseRequestHandler):
         elif ftype == wire.BUS_HELLO:
             service, instance = wire.decode_bus_hello(payload)
             self._consumer_loop(srv, sock, service, instance)
+        else:
+            # Explicit default (m3lint wire-exhaustive): a connection
+            # may only open with PUBLISH (producer) or HELLO (consumer).
+            # BUS_DELIVER/BUS_ACK as a FIRST frame is a confused peer —
+            # drop the connection rather than silently ignoring it.
+            return
 
     def _producer_loop(self, srv, sock, first_payload):
         payload = first_payload
@@ -72,10 +78,14 @@ class _BusConnHandler(socketserver.BaseRequestHandler):
                     break
                 if frame is None:
                     break
-                if frame[0] == wire.BUS_ACK:
-                    mid = wire.decode_bus_ack(frame[1])
-                    with srv.lock:
-                        srv.bus._ack(service, mid)
+                if frame[0] != wire.BUS_ACK:
+                    # Explicit default (m3lint wire-exhaustive): the
+                    # consumer edge only ever sends acks; anything else
+                    # is protocol confusion — kill the connection.
+                    break
+                mid = wire.decode_bus_ack(frame[1])
+                with srv.lock:
+                    srv.bus._ack(service, mid)
             stop.set()
 
         t = threading.Thread(target=read_acks, daemon=True)
@@ -139,9 +149,8 @@ class RemoteBusProducer:
     """Producer edge: publish(shard, payload) over one connection."""
 
     def __init__(self, address):
-        self._sock = socket.create_connection(address, timeout=5.0)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._lock = threading.Lock()
+        self._sock = wire.connect(address)
 
     def publish(self, shard: int, payload: bytes) -> None:
         with self._lock:
@@ -158,13 +167,18 @@ class RemoteBusConsumer:
     """Consumer edge: hello, then poll deliveries / send acks."""
 
     def __init__(self, address, service: str, instance_id: str):
-        self._sock = socket.create_connection(address, timeout=5.0)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        wire.send_frame(
-            self._sock, wire.BUS_HELLO,
-            wire.encode_bus_hello(service, instance_id),
-        )
         self._lock = threading.Lock()
+        self._sock = wire.connect(address)
+        try:
+            wire.send_frame(
+                self._sock, wire.BUS_HELLO,
+                wire.encode_bus_hello(service, instance_id),
+            )
+        except BaseException:
+            # a failed HELLO discards the object — close the socket it
+            # half-owns (m3lint resource-hygiene)
+            self._sock.close()
+            raise
 
     def poll(self, timeout_s: float = 1.0, max_messages: int = 128):
         """Blocking read of up to max_messages deliveries within

@@ -113,7 +113,8 @@ def sharded_decode_rate_hq(
                 for b in range(num_buckets)
             ])
         else:  # degenerate many-bucket case: keep the scatter form
-            part = jnp.zeros((num_buckets, step_times.shape[0]))
+            part = jnp.zeros((num_buckets, step_times.shape[0]),
+                             dtype=jnp.float64)
             part = part.at[bidc].add(r0)
         total = jax.lax.psum(part, SHARD_AXIS)
         hq = device_fns._histogram_quantile_kernel(
@@ -146,7 +147,7 @@ def single_device_reference(words, nbits, bucket_ids, step_times, ubs,
     )
     rates = temporal.rate_family(ts_p, vals_p, jnp.asarray(step_times),
                                  range_nanos, "rate")
-    total = np.zeros((num_buckets, len(step_times)))
+    total = np.zeros((num_buckets, len(step_times)), dtype=np.float64)
     r = np.nan_to_num(np.asarray(rates))
     np.add.at(total, np.clip(flat_bid, 0, num_buckets - 1), r)
     hq = device_fns._histogram_quantile_kernel(

@@ -88,8 +88,10 @@ class CommitLogWriter:
         old = None
         if self._f:
             old = self.path
-            self._f.flush()
-            os.fsync(self._f.fileno())
+            # through the commitlog.flush faultpoint (m3lint
+            # fault-coverage): a rotation fsync is as injectable a
+            # boundary as a write fsync
+            self._flush_fsync()
             self._f.close()
             self._seq += 1
         self._f = open(self.path, "ab")

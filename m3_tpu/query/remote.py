@@ -179,9 +179,7 @@ class RemoteStorage:
 
     def _connect(self) -> socket.socket:
         if self._sock is None:
-            s = socket.create_connection(self.address, timeout=self.timeout_s)
-            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._sock = s
+            self._sock = wire.connect(self.address, timeout=self.timeout_s)
         return self._sock
 
     def fetch_raw(self, name, matchers, start_nanos, end_nanos) -> RawBlock:

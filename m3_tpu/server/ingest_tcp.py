@@ -336,14 +336,19 @@ class IngestServer(socketserver.ThreadingTCPServer):
         # server's aggregator and scrapes it forever.  Order: flag
         # closing (handlers shed new frames), stop the accept loop,
         # then the worker drains the backlog (acks included) and exits.
+        # _closing flips under _q_lock: the shed gate reads it under
+        # that lock, so no handler can observe the pre-closing state
+        # after this releases (m3lint lock-discipline).
         self._drop_collector()
-        self._closing = True
+        with self._q_lock:
+            self._closing = True
         super().shutdown()
         self._stop_worker()
 
     def server_close(self):
         self._drop_collector()
-        self._closing = True
+        with self._q_lock:
+            self._closing = True
         self._stop_worker()
         super().server_close()
 

@@ -42,7 +42,9 @@ import numpy as np
 
 from m3_tpu.index import search
 from m3_tpu.index.doc import Document, Field
-from m3_tpu.msg.protocol import ProtocolError, recv_frame, send_frame
+from m3_tpu.msg.protocol import (
+    ProtocolError, connect as wire_connect, recv_frame, send_frame,
+)
 from m3_tpu.x import fault
 
 # frame types (disjoint from the bus's so a misdirected client fails fast)
@@ -369,9 +371,7 @@ class RemoteDatabase:
     # -- transport --
 
     def _connect(self) -> socket.socket:
-        s = socket.create_connection(self.address, timeout=self.timeout_s)
-        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        return s
+        return wire_connect(self.address, timeout=self.timeout_s)
 
     def _call(self, method: int, body: bytes) -> bytes:
         with self._mu:
@@ -395,17 +395,22 @@ class RemoteDatabase:
         if ftype == RPC_ERR:
             raise RemoteError(payload.decode(errors="replace"))
         if ftype != RPC_OK:
-            self._drop()
+            # _drop mutates the connection — retake the lock (the frame
+            # was already read; another caller may be mid-_call).
+            with self._mu:
+                self._drop()
             raise ConnectionError(f"rpc {self.address}: bad frame {ftype}")
         return payload
 
     def _drop(self) -> None:
+        # All callers hold self._mu (the _call error paths run inside
+        # the with-block; close() and the bad-frame path retake it).
         if self._sock is not None:
             try:
                 self._sock.close()
             except OSError:
                 pass
-            self._sock = None
+            self._sock = None  # m3lint: disable=lock-discipline
 
     def close(self) -> None:
         with self._mu:

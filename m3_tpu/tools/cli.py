@@ -148,6 +148,50 @@ def query_index_segments(args) -> int:
     return 0
 
 
+def lint(args) -> int:
+    """Run m3lint over the package and gate against the committed
+    baseline (tools/lint_baseline.json).  Exit 0 only when the findings
+    match the baseline exactly: new findings fail the gate, and so do
+    stale baseline entries — a fixed finding must ratchet the baseline
+    down (--update-baseline)."""
+    from m3_tpu.x import lint as m3lint
+
+    root = Path(args.root).resolve() if args.root else (
+        Path(__file__).resolve().parent.parent)
+    # Walk up past __init__.py so a subdirectory --root still reports
+    # package-rooted paths ("m3_tpu/server/rpc.py") — otherwise the
+    # path-scoped rules (fault-coverage, explicit-dtype, the constant
+    # ratchet) silently never match and the run is a false green.
+    rel_root = root
+    while (rel_root / "__init__.py").exists() and rel_root.parent != rel_root:
+        rel_root = rel_root.parent
+    findings = m3lint.lint_tree(root, rel_root)
+    baseline_path = (Path(args.baseline) if args.baseline
+                     else m3lint.default_baseline_path())
+    if args.update_baseline:
+        m3lint.save_baseline(baseline_path, findings)
+        print(f"lint: baseline updated: {len(findings)} findings "
+              f"-> {baseline_path}", file=sys.stderr)
+        return 0
+    baseline = m3lint.load_baseline(baseline_path)
+    new, fixed = m3lint.diff_baseline(findings, baseline)
+    if args.json:
+        _out({
+            "findings": len(findings), "baseline": len(baseline),
+            "new": [f.render() for f in new],
+            "fixed": [f.render() for f in fixed],
+        })
+    else:
+        for f in new:
+            print(f"NEW     {f.render()}", file=sys.stderr)
+        for f in fixed:
+            print(f"FIXED   {f.render()} (stale baseline entry — run "
+                  f"lint --update-baseline)", file=sys.stderr)
+        print(f"lint: {len(findings)} findings, {len(baseline)} baselined, "
+              f"{len(new)} new, {len(fixed)} fixed", file=sys.stderr)
+    return 1 if (new or fixed) else 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="m3tpu-tools", description=__doc__)
     sub = p.add_subparsers(dest="tool", required=True)
@@ -192,6 +236,18 @@ def main(argv=None) -> int:
     qi.add_argument("--block-size", type=int, dest="block_size",
                     default=2 * 3600 * 10**9)
     qi.set_defaults(fn=query_index_segments)
+
+    li = sub.add_parser(
+        "lint", help="codebase-aware static analysis, baseline-gated")
+    li.add_argument("--root", help="package root to lint (default: m3_tpu)")
+    li.add_argument("--baseline",
+                    help="baseline path (default: tools/lint_baseline.json)")
+    li.add_argument("--update-baseline", action="store_true",
+                    dest="update_baseline",
+                    help="rewrite the baseline to the current findings")
+    li.add_argument("--json", action="store_true",
+                    help="machine-readable summary on stdout")
+    li.set_defaults(fn=lint)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -1,7 +1,5 @@
 """Cross-cutting substrate (the reference's ``src/x`` tree).
 
-Currently two members, both born for the robustness tier:
-
 * ``m3_tpu.x.fault`` — process-global fault-injection registry: named
   faultpoints at every socket/disk boundary, armed via code or the
   ``M3_FAULTPOINTS`` env var, with deterministic seeding and per-point
@@ -9,14 +7,26 @@ Currently two members, both born for the robustness tier:
 * ``m3_tpu.x.retry`` — the reference ``src/x/retry`` equivalent:
   exponential backoff + jitter + attempt caps + a shared retry budget,
   adopted by every wire client in the tree.
+* ``m3_tpu.x.lockcheck`` — runtime lock-order sanitizer: wraps
+  ``threading.Lock``/``RLock`` behind an env-armed seam
+  (``M3_LOCKCHECK``, like ``M3_FAULTPOINTS``) and fails fast on
+  acquisition-order cycles; armed by the race/dtest conftest fixture.
+* ``m3_tpu.x.lint`` — m3lint, the codebase-aware static analyzer
+  (``python -m m3_tpu.tools.cli lint``); its rule families are the
+  static mirror of what fault/retry/lockcheck enforce at runtime.
 
-``register_metrics(registry)`` mirrors both modules' counters into an
-instrument registry at scrape time, so a node's ``/metrics`` exposes
-``fault_*`` and ``retry_*`` series dtest scenarios can assert on.
+``register_metrics(registry)`` mirrors the fault and retry counters
+into an instrument registry at scrape time, so a node's ``/metrics``
+exposes ``fault_*`` and ``retry_*`` series dtest scenarios can assert
+on.
 """
 
 from __future__ import annotations
 
+# lockcheck first: importing it evaluates the M3_LOCKCHECK env seam, so
+# a node subprocess wraps its locks before fault/retry (or anything
+# else) constructs one.
+from m3_tpu.x import lockcheck  # noqa: F401  (env-armed seam)
 from m3_tpu.x import fault, retry
 
 

@@ -1,0 +1,48 @@
+"""m3lint: codebase-aware static analysis for the m3-tpu tree.
+
+Five rule families, each encoding a contract this repo already pays
+for at runtime (race tier, fault tier, bit-exactness goldens) as a
+static gate:
+
+* ``lock-discipline``  — mixed locked/unlocked access to ``self._*``
+  state (the race class ``tests/test_race.py`` stress-tests).
+* ``jit-purity``       — clocks/randomness/locks/sockets/file I/O in
+  functions reached from jit/shard_map callsites.
+* ``explicit-dtype``   — array constructors without ``dtype=`` in the
+  bit-exactness modules (``encoding/``, ``parallel/``).
+* ``wire-exhaustive``  — frame-type dispatchers missing family members
+  without an explicit default branch.
+* ``fault-coverage``   — raw socket/fsync primitives in wire modules
+  outside a faultpoint-wrapped helper (PR 1's invariant).
+* ``resource-hygiene`` — sockets/files opened with no owner on the
+  error path.
+
+Run: ``python -m m3_tpu.tools.cli lint`` (gates against
+``m3_tpu/tools/lint_baseline.json``; see TESTING.md "Static analysis &
+lock sanitizer" for the ratchet workflow and inline suppressions).
+"""
+
+from m3_tpu.x.lint.core import (
+    Context, Finding, default_baseline_path, default_rules, diff_baseline,
+    lint_file, lint_tree, load_baseline, save_baseline,
+)
+
+__all__ = [
+    "Context", "Finding", "default_baseline_path", "default_rules",
+    "diff_baseline", "lint_file", "lint_tree", "load_baseline",
+    "save_baseline", "run_repo",
+]
+
+
+def run_repo():
+    """(findings, new, fixed) for the checked-in package vs the
+    committed baseline — the exact computation the CI gate runs."""
+    from pathlib import Path
+
+    import m3_tpu
+
+    pkg = Path(m3_tpu.__file__).resolve().parent
+    findings = lint_tree(pkg, pkg.parent)
+    baseline = load_baseline(default_baseline_path())
+    new, fixed = diff_baseline(findings, baseline)
+    return findings, new, fixed

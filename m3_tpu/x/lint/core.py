@@ -1,0 +1,259 @@
+"""m3lint core: findings, suppressions, baseline ratchet, the driver.
+
+The analyzer is deliberately *codebase-aware*: its rules encode this
+repo's concurrency/wire/bit-exactness contracts (see the rule modules),
+not generic style.  Everything runs on stdlib ``ast`` — no third-party
+dependency, so the gate works in every environment the tests do.
+
+Baseline ratchet: findings are compared against a checked-in baseline
+(`m3_tpu/tools/lint_baseline.json`) as a MULTISET of
+``(rule, path, message)`` keys (line numbers are recorded for humans but
+ignored in comparison, so unrelated edits that shift lines do not churn
+the gate).  The gate fails on NEW findings *and* on stale baseline
+entries — a fixed finding must shrink the baseline (``--update-baseline``),
+so the debt curve only ratchets down.
+
+Suppression: a finding on line N is suppressed by a trailing comment on
+that line (or the line above):
+
+    self.hits += 1  # m3lint: disable=lock-discipline
+    # m3lint: disable=wire-exhaustive  (next line suppressed)
+
+``# m3lint: disable-file=<rule>`` within the first ten lines suppresses
+the rule for the whole file.  Suppressions are for *reviewed* false
+positives; new debt belongs in the baseline where it is counted.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from collections import Counter
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Iterable, List
+
+RULES = (
+    "lock-discipline",
+    "jit-purity",
+    "explicit-dtype",
+    "wire-exhaustive",
+    "fault-coverage",
+    "resource-hygiene",
+)
+
+_SUPPRESS_RE = re.compile(r"#\s*m3lint:\s*disable=([\w,-]+)")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*m3lint:\s*disable-file=([\w,-]+)")
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    rule: str
+    path: str      # posix path relative to the repo root (e.g. m3_tpu/x/fault.py)
+    line: int
+    message: str
+
+    @property
+    def key(self):
+        """Baseline identity: line numbers drift with unrelated edits,
+        (rule, path, message) survives them."""
+        return (self.rule, self.path, self.message)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class Context:
+    """Scope knobs the rules consult.  Paths are posix, relative to the
+    repo root; prefixes select rule applicability per file.  The corpus
+    tests pass permissive prefixes so every rule fires on the seeded
+    violations regardless of where the corpus lives."""
+
+    dtype_prefixes: tuple = ("m3_tpu/encoding/", "m3_tpu/parallel/")
+    wire_prefixes: tuple = ("m3_tpu/server/", "m3_tpu/client/",
+                            "m3_tpu/cluster/", "m3_tpu/msg/")
+    wire_files: tuple = ("m3_tpu/persist/commitlog.py",)
+    # The framing module IS the designated low-level seam: raw socket
+    # ops are legal only here (everything else reaches them through
+    # send_frame/recv_frame behind a named faultpoint).
+    fault_helper_files: tuple = ("m3_tpu/msg/protocol.py",)
+    # files whose module-level small-int constants must be registered
+    # in a wirecheck dispatch family (the family-table ratchet)
+    constant_files: tuple = ("m3_tpu/msg/protocol.py",
+                             "m3_tpu/server/rpc.py",
+                             "m3_tpu/server/ingest_tcp.py",
+                             "m3_tpu/cluster/kv_remote.py",
+                             "m3_tpu/query/remote.py")
+
+    def is_wire_module(self, path: str) -> bool:
+        return (path in self.wire_files
+                or any(path.startswith(p) for p in self.wire_prefixes))
+
+    def wants_dtype(self, path: str) -> bool:
+        return any(path.startswith(p) for p in self.dtype_prefixes)
+
+
+@dataclass
+class FileUnit:
+    """One parsed file handed to every rule."""
+
+    path: str            # repo-relative posix
+    tree: ast.AST
+    source: str
+    lines: List[str] = field(default_factory=list)
+
+    def __post_init__(self):
+        if not self.lines:
+            self.lines = self.source.splitlines()
+
+
+Rule = Callable[[FileUnit, Context], List[Finding]]
+
+
+def _suppressions(unit: FileUnit):
+    per_line: dict[int, set] = {}
+    file_wide: set = set()
+    for i, text in enumerate(unit.lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        if m:
+            rules = set(m.group(1).split(","))
+            per_line.setdefault(i, set()).update(rules)
+            # a comment-only line also suppresses the line below it
+            if text.lstrip().startswith("#"):
+                per_line.setdefault(i + 1, set()).update(rules)
+        if i <= 10:
+            mf = _SUPPRESS_FILE_RE.search(text)
+            if mf:
+                file_wide.update(mf.group(1).split(","))
+    return per_line, file_wide
+
+
+def apply_suppressions(unit: FileUnit, findings: Iterable[Finding]) -> List[Finding]:
+    per_line, file_wide = _suppressions(unit)
+    out = []
+    for f in findings:
+        if f.rule in file_wide or "all" in file_wide:
+            continue
+        rules = per_line.get(f.line, ())
+        if f.rule in rules or "all" in rules:
+            continue
+        out.append(f)
+    return out
+
+
+def default_rules() -> List[Rule]:
+    from m3_tpu.x.lint import faultcov, locks, purity, resources, wirecheck
+
+    return [
+        locks.check,
+        purity.check_jit_purity,
+        purity.check_explicit_dtype,
+        wirecheck.check,
+        faultcov.check,
+        resources.check,
+    ]
+
+
+def lint_file(path: Path, rel_root: Path, ctx: Context,
+              rules: List[Rule] | None = None) -> List[Finding]:
+    source = path.read_text(encoding="utf-8")
+    rel = path.relative_to(rel_root).as_posix()
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as e:
+        return [Finding("parse-error", rel, e.lineno or 0, str(e.msg))]
+    unit = FileUnit(rel, tree, source)
+    findings: List[Finding] = []
+    for rule in (rules if rules is not None else default_rules()):
+        findings.extend(rule(unit, ctx))
+    return apply_suppressions(unit, findings)
+
+
+def lint_tree(root: Path, rel_root: Path | None = None,
+              ctx: Context | None = None,
+              rules: List[Rule] | None = None) -> List[Finding]:
+    """Lint every ``*.py`` under ``root``; paths reported relative to
+    ``rel_root`` (default: root's parent, so scanning ``<repo>/m3_tpu``
+    yields ``m3_tpu/...`` paths matching the Context prefixes)."""
+    root = Path(root)
+    rel_root = Path(rel_root) if rel_root is not None else root.parent
+    ctx = ctx or Context()
+    findings: List[Finding] = []
+    for path in sorted(root.rglob("*.py")):
+        findings.extend(lint_file(path, rel_root, ctx, rules))
+    return sorted(findings)
+
+
+# -- baseline ratchet --------------------------------------------------------
+
+
+def default_baseline_path() -> Path:
+    import m3_tpu.tools as _tools
+
+    return Path(_tools.__file__).resolve().parent / "lint_baseline.json"
+
+
+def load_baseline(path: Path) -> List[Finding]:
+    if not Path(path).exists():
+        return []
+    raw = json.loads(Path(path).read_text())
+    return [Finding(f["rule"], f["path"], int(f.get("line", 0)), f["message"])
+            for f in raw.get("findings", [])]
+
+
+def save_baseline(path: Path, findings: Iterable[Finding]) -> None:
+    payload = {
+        "version": 1,
+        "findings": [
+            {"rule": f.rule, "path": f.path, "line": f.line,
+             "message": f.message}
+            for f in sorted(findings)
+        ],
+    }
+    Path(path).write_text(json.dumps(payload, indent=1) + "\n")
+
+
+def diff_baseline(findings: Iterable[Finding], baseline: Iterable[Finding]):
+    """Returns (new, fixed): findings not in the baseline, and baseline
+    entries that no longer fire.  Multiset semantics — two identical
+    findings in one file need two baseline entries."""
+    cur = Counter(f.key for f in findings)
+    base = Counter(f.key for f in baseline)
+    by_key: dict = {}
+    for f in findings:
+        by_key.setdefault(f.key, f)
+    for f in baseline:
+        by_key.setdefault(f.key, f)
+    new = []
+    fixed = []
+    for key in (cur - base):
+        for _ in range((cur - base)[key]):
+            new.append(by_key[key])
+    for key in (base - cur):
+        for _ in range((base - cur)[key]):
+            fixed.append(by_key[key])
+    return sorted(new), sorted(fixed)
+
+
+# -- shared AST helpers ------------------------------------------------------
+
+
+def dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def func_defs(tree: ast.AST):
+    """Every FunctionDef/AsyncFunctionDef in the tree (any nesting)."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node

@@ -1,0 +1,185 @@
+"""Rule family 2 — tracer/jit purity and dtype discipline.
+
+``jit-purity``: functions reached from ``jax.jit``/``pjit``/
+``shard_map`` callsites run under a tracer — once, at trace time, on an
+arbitrary host thread.  A ``time.time()`` there bakes one wall-clock
+into the compiled program forever; a lock or socket call runs at trace
+time and never again; ``np.random`` silently freezes one draw.  The
+rule seeds from jit decorators/callsites, propagates through the
+module-level call graph (a helper called only from jitted code is
+jitted code), and flags impure calls inside the reachable set.
+
+``explicit-dtype``: in ``encoding/`` and ``parallel/`` every
+``jnp/np.array|zeros|ones|full|empty|arange`` must pass an explicit
+dtype.  The M3TSZ contract is defined over float64/int64/uint64 BIT
+PATTERNS (DeXOR-style bit-exact float encoding); a constructor that
+silently follows ``jax_enable_x64``'s default — or a future change to
+it — is a bit-exactness bug waiting for a flag flip.  ``asarray`` and
+``*_like`` preserve their input dtype and are exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from m3_tpu.x.lint.core import Context, FileUnit, Finding, dotted
+
+# dotted-call prefixes that must not run under a tracer, with the why
+_IMPURE = {
+    "time.time": "wall clock frozen at trace time",
+    "time.time_ns": "wall clock frozen at trace time",
+    "time.monotonic": "wall clock frozen at trace time",
+    "time.perf_counter": "wall clock frozen at trace time",
+    "time.sleep": "host sleep inside a traced function",
+    "threading.Lock": "lock created at trace time, never at run time",
+    "threading.RLock": "lock created at trace time, never at run time",
+    "threading.Condition": "lock created at trace time, never at run time",
+    "socket.socket": "socket I/O inside a traced function",
+    "socket.create_connection": "socket I/O inside a traced function",
+    "os.fsync": "file I/O inside a traced function",
+    "os.urandom": "host randomness frozen at trace time",
+}
+_IMPURE_PREFIXES = {
+    "random.": "host randomness frozen at trace time",
+    "np.random.": "host randomness frozen at trace time",
+    "numpy.random.": "host randomness frozen at trace time",
+}
+_JIT_NAMES = ("jit", "pjit")
+_JIT_WRAPPERS = ("shard_map", "shard_map_compat", "pmap", "xmap")
+
+
+def _last_attr(name: str) -> str:
+    return name.rpartition(".")[2]
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """jax.jit / jit / functools.partial(jax.jit, ...) as a decorator or
+    a call target."""
+    d = dotted(node)
+    if d is not None and _last_attr(d) in _JIT_NAMES:
+        return True
+    if isinstance(node, ast.Call):
+        fn = dotted(node.func)
+        if fn is not None and _last_attr(fn) == "partial" and node.args:
+            return _is_jit_expr(node.args[0])
+        return _is_jit_expr(node.func)
+    return False
+
+
+def _jit_seeds(tree: ast.AST):
+    """(function name or def node) seeds: decorated defs and Name args
+    of jit/shard_map callsites."""
+    seed_defs = []
+    seed_names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_expr(d) for d in node.decorator_list):
+                seed_defs.append(node)
+        elif isinstance(node, ast.Call):
+            fn = dotted(node.func)
+            if fn is None:
+                continue
+            last = _last_attr(fn)
+            if last in _JIT_NAMES or last in _JIT_WRAPPERS:
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    if isinstance(arg, ast.Name):
+                        seed_names.add(arg.id)
+                    elif isinstance(arg, ast.Lambda):
+                        seed_defs.append(arg)
+    return seed_defs, seed_names
+
+
+def _called_names(fn: ast.AST) -> set:
+    out = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+            out.add(node.func.id)
+    return out
+
+
+def check_jit_purity(unit: FileUnit, ctx: Context) -> List[Finding]:
+    tree = unit.tree
+    module_defs = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            module_defs.setdefault(node.name, node)
+    seed_defs, seed_names = _jit_seeds(tree)
+    reachable = {id(d): d for d in seed_defs}
+    frontier = list(seed_defs)
+    for name in seed_names:
+        d = module_defs.get(name)
+        if d is not None and id(d) not in reachable:
+            reachable[id(d)] = d
+            frontier.append(d)
+    while frontier:
+        fn = frontier.pop()
+        for name in _called_names(fn):
+            d = module_defs.get(name)
+            if d is not None and id(d) not in reachable:
+                reachable[id(d)] = d
+                frontier.append(d)
+
+    findings: List[Finding] = []
+    seen = set()
+    for fn in reachable.values():
+        fname = getattr(fn, "name", "<lambda>")
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted(node.func)
+            if callee is None:
+                continue
+            why = _IMPURE.get(callee)
+            if why is None:
+                for prefix, pwhy in _IMPURE_PREFIXES.items():
+                    if callee.startswith(prefix):
+                        why = pwhy
+                        break
+            if why is None:
+                continue
+            key = (fname, callee, node.lineno)
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(Finding(
+                "jit-purity", unit.path, node.lineno,
+                f"{fname}() is reached from a jit/shard_map callsite but "
+                f"calls {callee} ({why})"))
+    return findings
+
+
+# -- explicit-dtype ----------------------------------------------------------
+
+# constructor -> index of the positional dtype slot (None: keyword-only
+# in practice — arange's 4th positional is legal but unused here)
+_CTORS = {"zeros": 1, "ones": 1, "empty": 1, "full": 2, "array": 1,
+          "arange": 3}
+_ARRAY_MODULES = {"jnp", "np", "numpy", "jax.numpy"}
+
+
+def check_explicit_dtype(unit: FileUnit, ctx: Context) -> List[Finding]:
+    if not ctx.wants_dtype(unit.path):
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(unit.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            continue
+        mod = dotted(node.func.value)
+        if mod not in _ARRAY_MODULES:
+            continue
+        ctor = node.func.attr
+        slot = _CTORS.get(ctor)
+        if slot is None:
+            continue
+        if any(k.arg == "dtype" for k in node.keywords):
+            continue
+        if len(node.args) > slot:
+            continue  # dtype passed positionally
+        findings.append(Finding(
+            "explicit-dtype", unit.path, node.lineno,
+            f"{mod}.{ctor}(...) without an explicit dtype= in a "
+            f"bit-exactness module (the x64 default is a flag, not a "
+            f"contract)"))
+    return findings

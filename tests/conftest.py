@@ -39,3 +39,45 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: multi-process dtest scenarios (fresh JAX per node)"
     )
+
+
+# -- lock-order sanitizer (race/dtest tiers) --------------------------------
+
+import pytest  # noqa: E402
+
+_LOCKCHECK_FILES = {"test_race.py", "test_dtest.py"}
+
+
+@pytest.fixture(autouse=True)
+def _lockcheck_race_tiers(request):
+    """Arm m3_tpu.x.lockcheck for the race and dtest tiers: every lock
+    the test constructs is order-checked, an inversion raises in the
+    acquiring thread, and any recorded finding fails the test even if
+    no thread happened to die.  The env var is set so dtest node
+    subprocesses inherit arming (NodeProcess snapshots os.environ).
+
+    A user who armed the WHOLE suite (``M3_LOCKCHECK=1 pytest ...``)
+    keeps their arming and mode: the fixture restores the prior env
+    value and leaves the sanitizer installed on exit, and honors
+    ``record`` mode instead of forcing raise mode."""
+    if request.node.path.name not in _LOCKCHECK_FILES:
+        yield
+        return
+    from m3_tpu.x import lockcheck
+
+    prev_env = os.environ.get("M3_LOCKCHECK")
+    was_installed = lockcheck.installed()
+    if prev_env is None:
+        os.environ["M3_LOCKCHECK"] = "1"
+    lockcheck.reset()
+    lockcheck.install(raise_on_cycle=prev_env != "record")
+    try:
+        yield
+        found = lockcheck.findings()
+        assert not found, "lock-order inversions detected:\n" + "\n".join(
+            str(f) for f in found)
+    finally:
+        if not was_installed:
+            lockcheck.uninstall()
+        if prev_env is None:
+            os.environ.pop("M3_LOCKCHECK", None)

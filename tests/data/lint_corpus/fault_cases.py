@@ -1,0 +1,24 @@
+"""Seeded fault-coverage violations (never imported).  The corpus run
+passes a Context whose wire prefixes match this directory."""
+
+import os
+
+from m3_tpu.x import fault
+
+
+def bare_send(sock, payload):
+    sock.sendall(payload)              # VIOLATION: fault-coverage (L10)
+
+
+def bare_fsync(f):
+    os.fsync(f.fileno())               # VIOLATION: fault-coverage (L14)
+
+
+def covered_send(sock, payload):       # ok: fires a faultpoint
+    if fault.fire("corpus.send") == "drop":
+        raise ConnectionError("dropped")
+    sock.sendall(payload)
+
+
+def bare_recv(sock):
+    return sock.recv(4096)             # VIOLATION: fault-coverage (L23)

@@ -1,0 +1,35 @@
+"""Seeded lock-discipline violations (never imported; parsed by
+tests/test_lint.py).  Expected findings are asserted by line number —
+keep the markers in sync."""
+
+import threading
+
+
+class MixedAccess:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._closing = False
+        self.count = 0
+
+    def gate(self):
+        with self._lock:
+            if self._closing:          # read under the lock
+                return False
+            self.count += 1
+        return True
+
+    def shutdown(self):
+        self._closing = True           # VIOLATION: mixed access (L22)
+
+    def bump_stats(self):
+        self.count += 1                # VIOLATION: unguarded += (L25)
+
+
+class CleanCounterpart:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.hits = 0
+
+    def bump(self):
+        with self._mu:
+            self.hits += 1

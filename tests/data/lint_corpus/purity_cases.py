@@ -1,0 +1,44 @@
+"""Seeded jit-purity and explicit-dtype violations (never imported)."""
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def traced_clock(x):
+    t = time.time()                    # VIOLATION: jit-purity (L13)
+    return x + t
+
+
+def _helper(x):
+    return x * np.random.random()      # VIOLATION: jit-purity via
+
+
+def transitive(x):                     # the call graph (L18)
+    return _helper(x) + 1
+
+
+def _kick():
+    return jax.jit(transitive)(jnp.zeros(3, dtype=jnp.float64))
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def partial_decorated(n, x):
+    import threading
+    lock = threading.Lock()            # VIOLATION: jit-purity (L31)
+    with lock:
+        return x * n
+
+
+def missing_dtypes(n):
+    a = jnp.zeros(n)                   # VIOLATION: explicit-dtype (L37)
+    b = np.arange(n)                   # VIOLATION: explicit-dtype (L38)
+    c = jnp.full((n,), 2.0)            # VIOLATION: explicit-dtype (L39)
+    good = jnp.zeros(n, jnp.int64)     # ok: positional dtype
+    also = np.arange(n, dtype=np.int64)  # ok: keyword dtype
+    like = jnp.zeros_like(a)           # ok: preserves dtype
+    return a, b, c, good, also, like

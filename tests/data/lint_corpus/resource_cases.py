@@ -1,0 +1,40 @@
+"""Seeded resource-hygiene violations (never imported)."""
+
+import socket
+
+
+def leaky_socket(address):
+    s = socket.create_connection(address)
+    s.setsockopt(1, 1, 1)              # VIOLATION: open at L7 leaks if
+    return s                           # setsockopt raises
+
+
+def leaky_file(path):
+    f = open(path, "rb")
+    header = f.read(8)                 # VIOLATION: open at L13 leaks if
+    f.close()                          # read raises
+    return header
+
+
+def guarded_file(path):                # ok: finally closes
+    f = open(path, "rb")
+    try:
+        return f.read(8)
+    finally:
+        f.close()
+
+
+def with_file(path):                   # ok: context manager
+    with open(path, "rb") as f:
+        return f.read(8)
+
+
+class Client:
+    def __init__(self, address):
+        self._sock = socket.create_connection(address)
+        self._sock.setsockopt(1, 1, 1)  # VIOLATION: __init__ store does
+        self.ready = True               # not transfer ownership (L34)
+
+    def reconnect(self, address):       # ok: member store outside init
+        self._sock = socket.create_connection(address)
+        self._sock.setsockopt(1, 1, 1)

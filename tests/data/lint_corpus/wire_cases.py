@@ -1,0 +1,38 @@
+"""Seeded wire-exhaustive violations (never imported).  References to
+``wire.*`` constants resolve by NAME in the analyzer — no import of the
+real protocol module is needed."""
+
+wire = None  # placeholder; the analyzer resolves constant names statically
+
+
+def half_wired_ingest(sock, frame):
+    ftype, payload = frame             # VIOLATION: wire-exhaustive (L8)
+    if ftype == wire.METRIC_BATCH:
+        return "metric"
+    if ftype == wire.TIMED_BATCH:
+        return "timed"
+    # silently ignores PASSTHROUGH/FORWARDED/HELLO/ACK/BACKOFF
+
+
+def half_wired_bus(frame):             # VIOLATION: wire-exhaustive (L16)
+    if frame[0] == wire.BUS_PUBLISH:
+        return "pub"
+    elif frame[0] == wire.BUS_DELIVER:
+        return "deliver"
+    # silently ignores BUS_HELLO / BUS_ACK
+
+
+def defaulted_bus(frame):              # ok: explicit terminal else
+    if frame[0] == wire.BUS_PUBLISH:
+        return "pub"
+    elif frame[0] == wire.BUS_DELIVER:
+        return "deliver"
+    else:
+        raise ValueError(frame[0])
+
+
+def defaulted_guard(frame):            # ok: != guard is the default
+    if frame[0] != wire.BUS_ACK:
+        return None
+    if frame[0] == wire.BUS_PUBLISH:
+        return "unreachable"

@@ -1,0 +1,163 @@
+"""m3lint tier: the analyzer is itself CI-enforced here.
+
+Two halves:
+
+* **Seeded-violation corpus** (`tests/data/lint_corpus/`): every rule
+  family must fire on its seeded cases (≥2 per family) at the exact
+  lines, and must NOT fire on the adjacent clean counterparts — the
+  corpus is the analyzer's own regression oracle.
+* **Repo gate**: the full analyzer run over `m3_tpu/` must match the
+  committed baseline (`m3_tpu/tools/lint_baseline.json`) exactly — new
+  findings fail, and stale baseline entries fail (the ratchet only
+  goes down).  This is the same computation
+  `python -m m3_tpu.tools.cli lint` exits on.
+"""
+
+from pathlib import Path
+
+import pytest
+
+from m3_tpu.x.lint import (
+    Context, Finding, default_baseline_path, diff_baseline, lint_file,
+    lint_tree, load_baseline, run_repo, save_baseline,
+)
+
+CORPUS = Path(__file__).resolve().parent / "data" / "lint_corpus"
+
+# permissive scope: every rule applies to the corpus wherever it lives
+PERMISSIVE = Context(dtype_prefixes=("",), wire_prefixes=("",),
+                     wire_files=(), fault_helper_files=(),
+                     constant_files=())
+
+EXPECTED = {
+    ("lock_cases.py", "lock-discipline", 22),
+    ("lock_cases.py", "lock-discipline", 25),
+    ("purity_cases.py", "jit-purity", 13),
+    ("purity_cases.py", "jit-purity", 18),      # via the call graph
+    ("purity_cases.py", "jit-purity", 32),
+    ("purity_cases.py", "explicit-dtype", 38),
+    ("purity_cases.py", "explicit-dtype", 39),
+    ("purity_cases.py", "explicit-dtype", 40),
+    ("wire_cases.py", "wire-exhaustive", 8),
+    ("wire_cases.py", "wire-exhaustive", 17),
+    ("fault_cases.py", "fault-coverage", 10),
+    ("fault_cases.py", "fault-coverage", 14),
+    ("fault_cases.py", "fault-coverage", 24),
+    ("resource_cases.py", "resource-hygiene", 7),
+    ("resource_cases.py", "resource-hygiene", 13),
+    ("resource_cases.py", "resource-hygiene", 34),
+}
+
+
+class TestCorpus:
+    @pytest.fixture(scope="class")
+    def corpus_findings(self):
+        return lint_tree(CORPUS, CORPUS, PERMISSIVE)
+
+    def test_every_seeded_violation_fires(self, corpus_findings):
+        got = {(f.path, f.rule, f.line) for f in corpus_findings}
+        missing = EXPECTED - got
+        assert not missing, f"seeded violations not detected: {missing}"
+
+    def test_no_findings_beyond_the_seeds(self, corpus_findings):
+        """The clean counterparts (positional dtype, zeros_like, default
+        branches, faultpoint-covered send, with/finally opens, member
+        reconnect) must stay clean — false-positive regression guard."""
+        got = {(f.path, f.rule, f.line) for f in corpus_findings}
+        extra = got - EXPECTED
+        assert not extra, f"unexpected findings (false positives): {extra}"
+
+    def test_two_or_more_cases_per_family(self, corpus_findings):
+        by_rule = {}
+        for f in corpus_findings:
+            by_rule.setdefault(f.rule, []).append(f)
+        for rule in ("lock-discipline", "jit-purity", "explicit-dtype",
+                     "wire-exhaustive", "fault-coverage",
+                     "resource-hygiene"):
+            assert len(by_rule.get(rule, [])) >= 2, rule
+
+
+class TestSuppression:
+    def test_inline_disable_comment(self, tmp_path):
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.n = 0\n"
+            "    def read(self):\n"
+            "        with self._lock:\n"
+            "            return self.n\n"
+            "    def bump(self):\n"
+            "        self.n = 1  # m3lint: disable=lock-discipline\n"
+            "    def bump2(self):\n"
+            "        self.n = 2\n"
+        )
+        p = tmp_path / "mod.py"
+        p.write_text(src)
+        findings = lint_file(p, tmp_path, PERMISSIVE)
+        lines = [f.line for f in findings if f.rule == "lock-discipline"]
+        assert 10 not in lines          # suppressed
+        assert 12 in lines              # sibling violation still fires
+
+    def test_file_wide_disable(self, tmp_path):
+        src = (
+            "# m3lint: disable-file=fault-coverage\n"
+            "import os\n"
+            "def f(fh):\n"
+            "    os.fsync(fh.fileno())\n"
+        )
+        p = tmp_path / "mod.py"
+        p.write_text(src)
+        assert lint_file(p, tmp_path, PERMISSIVE) == []
+
+
+class TestBaselineRatchet:
+    def test_roundtrip(self, tmp_path):
+        f1 = Finding("lock-discipline", "a.py", 3, "msg one")
+        f2 = Finding("jit-purity", "b.py", 9, "msg two")
+        path = tmp_path / "baseline.json"
+        save_baseline(path, [f1, f2])
+        assert sorted(load_baseline(path)) == sorted([f1, f2])
+
+    def test_diff_new_and_fixed(self):
+        base = [Finding("r", "a.py", 1, "old debt")]
+        cur = [Finding("r", "a.py", 5, "old debt"),   # line drift: same key
+               Finding("r", "b.py", 2, "fresh debt")]
+        new, fixed = diff_baseline(cur, base)
+        assert [f.message for f in new] == ["fresh debt"]
+        assert fixed == []
+        new, fixed = diff_baseline([], base)
+        assert new == [] and [f.message for f in fixed] == ["old debt"]
+
+    def test_multiset_semantics(self):
+        f = Finding("r", "a.py", 1, "dup")
+        new, fixed = diff_baseline([f, f], [f])
+        assert len(new) == 1 and not fixed
+
+
+class TestRepoGate:
+    def test_package_matches_committed_baseline(self):
+        """THE gate: `python -m m3_tpu.tools.cli lint` must exit 0.
+        New findings → fix them or (for reviewed debt) add to the
+        baseline; stale entries → shrink the baseline
+        (`--update-baseline`)."""
+        findings, new, fixed = run_repo()
+        assert not new, (
+            "new lint findings (fix, suppress inline with a reviewed "
+            "comment, or baseline):\n"
+            + "\n".join(f.render() for f in new))
+        assert not fixed, (
+            "stale baseline entries (ratchet down with "
+            "`python -m m3_tpu.tools.cli lint --update-baseline`):\n"
+            + "\n".join(f.render() for f in fixed))
+
+    def test_baseline_is_loadable(self):
+        # empty today (all real findings were fixed in the PR that
+        # introduced the gate); the load path must still work
+        load_baseline(default_baseline_path())
+
+    def test_cli_lint_exits_zero(self, capsys):
+        from m3_tpu.tools.cli import main
+
+        assert main(["lint"]) == 0

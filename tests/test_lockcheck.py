@@ -1,0 +1,229 @@
+"""Lock-order sanitizer tier: the sanitizer itself must catch seeded
+inversions deterministically (no scheduler luck involved — ordering is
+recorded per acquisition, so ONE thread reversing an established order
+is enough) and must stay silent on clean nesting, re-entrant RLocks and
+the stdlib primitives the codebase leans on (Condition, Event, Queue).
+
+The race/dtest tiers run with the sanitizer ARMED via the autouse
+conftest fixture; this file exercises the sanitizer explicitly and so
+manages install/uninstall itself.
+"""
+
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from m3_tpu.x import lockcheck
+
+
+@pytest.fixture()
+def armed():
+    lockcheck.reset()
+    lockcheck.install()
+    try:
+        yield lockcheck
+    finally:
+        lockcheck.uninstall()
+        lockcheck.reset()
+
+
+@pytest.fixture()
+def recording():
+    lockcheck.reset()
+    lockcheck.install(raise_on_cycle=False)
+    try:
+        yield lockcheck
+    finally:
+        lockcheck.uninstall()
+        lockcheck.reset()
+
+
+class TestInversionDetection:
+    def test_ab_ba_inversion_raises_with_both_stacks(self, armed):
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:        # establishes a -> b
+                pass
+        with pytest.raises(lockcheck.LockOrderError) as ei:
+            with b:
+                with a:    # reversal: b held while acquiring a
+                    pass
+        msg = str(ei.value)
+        # both stacks, each pointing at this test
+        assert "stack that established" in msg
+        assert "stack performing the reversal" in msg
+        assert msg.count("test_ab_ba_inversion_raises_with_both_stacks") >= 2
+        assert len(armed.findings()) == 1
+
+    def test_transitive_cycle_detected(self, armed):
+        a, b, c = (threading.Lock() for _ in range(3))
+        with a:
+            with b:        # a -> b
+                pass
+        with b:
+            with c:        # b -> c
+                pass
+        with pytest.raises(lockcheck.LockOrderError):
+            with c:
+                with a:    # c -> a closes a -> b -> c -> a
+                    pass
+
+    def test_record_mode_collects_instead_of_raising(self, recording):
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:
+                pass
+        with b:
+            with a:        # recorded, not raised
+                pass
+        found = recording.findings()
+        assert len(found) == 1
+        inv = found[0]
+        assert len(inv.cycle) >= 2
+        assert "Lock@" in inv.cycle[0]
+        assert inv.forward_stack and inv.reversal_stack
+
+    def test_inversion_across_threads(self, recording):
+        """The classic shape: thread 1 takes a->b, thread 2 takes b->a.
+        Serialized by events so both orderings ALWAYS execute (no
+        timing luck) — the sanitizer flags it even though this
+        particular interleaving didn't deadlock."""
+        a = threading.Lock()
+        b = threading.Lock()
+        first_done = threading.Event()
+
+        def t1():
+            with a:
+                with b:
+                    pass
+            first_done.set()
+
+        def t2():
+            first_done.wait(5)
+            with b:
+                with a:
+                    pass
+
+        th1 = threading.Thread(target=t1)
+        th2 = threading.Thread(target=t2)
+        th1.start(); th2.start()
+        th1.join(5); th2.join(5)
+        assert len(recording.findings()) == 1
+
+    def test_self_deadlock_on_plain_lock(self, armed):
+        a = threading.Lock()
+        with pytest.raises(lockcheck.LockOrderError):
+            with a:
+                a.acquire()
+
+    def test_self_deadlock_raises_even_in_record_mode(self, recording):
+        """An order inversion only deadlocks under the adverse
+        interleaving, so record mode may defer it — but a same-thread
+        re-acquire of a plain Lock hangs with CERTAINTY; proceeding
+        would turn the report into the deadlock.  Always raises."""
+        a = threading.Lock()
+        with pytest.raises(lockcheck.LockOrderError):
+            with a:
+                a.acquire()
+        assert len(recording.findings()) == 1
+
+
+class TestCleanPatterns:
+    def test_consistent_order_is_silent(self, armed):
+        a = threading.Lock()
+        b = threading.Lock()
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert armed.findings() == []
+
+    def test_rlock_reentrancy_is_silent(self, armed):
+        r = threading.RLock()
+        with r:
+            with r:
+                r.acquire()
+                r.release()
+        assert armed.findings() == []
+
+    def test_trylock_backoff_is_silent(self, armed):
+        """blocking=False / timeout-bounded acquires cannot deadlock —
+        they are the standard inversion-AVOIDANCE pattern and must
+        neither raise nor record edges that poison the graph."""
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:        # a -> b established
+                pass
+        with b:
+            assert a.acquire(blocking=False)   # trylock: no edge, no raise
+            a.release()
+            assert a.acquire(timeout=0.5)      # bounded: same
+            a.release()
+        # the trylocks recorded nothing, so the established order still
+        # passes cleanly
+        with a:
+            with b:
+                pass
+        assert armed.findings() == []
+
+    def test_stdlib_primitives_keep_working(self, armed):
+        import queue
+
+        ev = threading.Event()
+        t = threading.Thread(target=ev.set)
+        t.start()
+        assert ev.wait(5)
+        t.join(5)
+        q = queue.Queue()
+        q.put(42)
+        assert q.get(timeout=5) == 42
+        cond = threading.Condition()
+        with cond:
+            cond.notify_all()
+        assert armed.findings() == []
+
+    def test_uninstall_restores_factories(self):
+        lockcheck.reset()
+        lockcheck.install()
+        lockcheck.uninstall()
+        assert threading.Lock is lockcheck._ORIG_LOCK
+        assert threading.RLock is lockcheck._ORIG_RLOCK
+        # locks created while armed keep working unchecked
+        lockcheck.install()
+        lk = threading.Lock()
+        lockcheck.uninstall()
+        with lk:
+            pass
+
+
+class TestEnvSeam:
+    def test_m3_lockcheck_env_arms_subprocess(self):
+        """Node subprocesses inherit arming exactly like M3_FAULTPOINTS:
+        importing m3_tpu.x under M3_LOCKCHECK=1 wraps locks at import
+        time, and an inversion fails fast."""
+        code = (
+            "import threading\n"
+            "from m3_tpu.x import lockcheck\n"
+            "assert lockcheck.installed()\n"
+            "a, b = threading.Lock(), threading.Lock()\n"
+            "with a:\n"
+            "    with b: pass\n"
+            "try:\n"
+            "    with b:\n"
+            "        with a: pass\n"
+            "except lockcheck.LockOrderError:\n"
+            "    print('INVERSION-CAUGHT')\n"
+        )
+        import os
+
+        env = dict(os.environ, M3_LOCKCHECK="1", JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, "-c", code], env=env, cwd="/root/repo",
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        assert "INVERSION-CAUGHT" in out.stdout

@@ -312,3 +312,48 @@ print("PLACEMENT_OK")
     p = subprocess.run([sys.executable, "-c", code], env=env,
                        capture_output=True, text=True, timeout=420)
     assert "PLACEMENT_OK" in p.stdout, p.stderr[-1500:]
+
+
+def test_encoder_bytes_pinned_across_dtype_hardening():
+    """Bit-identity pin for the m3lint explicit-dtype hardening: this
+    fixed batch was verified byte-identical before/after dtype= was
+    made explicit in m3tsz_jax.py, and the digest pins it forever.
+    Any change to a constructor's effective dtype — including a future
+    x64-default flip the explicit dtypes now guard against — shows up
+    here as a byte diff, not as a silent re-encode.
+
+    Inputs are pure integer/dyadic arithmetic (no RNG, no libm): every
+    value is exactly representable, so the batch is bit-stable across
+    NumPy versions and platforms — the digest depends on the encoder
+    alone."""
+    import hashlib
+
+    from m3_tpu.encoding.m3tsz_jax import pack_streams
+
+    SEC = 10**9
+    S0 = 1_600_000_000 * SEC
+    S, T = 8, 64
+    i = np.arange(S, dtype=np.int64)[:, None]
+    j = np.arange(T, dtype=np.int64)[None, :]
+    deltas = ((i * 37 + j * 11) % 29 + 1) * SEC        # 1..29s steps
+    ts = S0 + np.cumsum(deltas, axis=1)
+    vals = ((i * 131 + j * 17) % 4001 - 2000) / 8.0    # dyadic: exact f64
+    vals[2] = np.float64((j[0] * 7) % 1000)            # int-optimized lane
+    vals[5, 10:] = vals[5, 9]                          # repeated-value lane
+    streams, fb = encode_batch(ts, vals, np.full(S, S0, np.int64),
+                               out_words=200)
+    assert not fb.any(), fb
+    words, nbits = pack_streams(streams)
+    assert words.dtype == np.uint64 and nbits.dtype == np.int64
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(words).tobytes())
+    h.update(np.ascontiguousarray(nbits).tobytes())
+    assert h.hexdigest() == PINNED_ENCODE_DIGEST
+
+
+# sha256 over (packed words || nbits) of the arithmetic batch above,
+# captured on BOTH the pre-dtype-hardening tree (HEAD file) and the
+# hardened tree — identical, proving the hardening was a no-op on the
+# bytes.
+PINNED_ENCODE_DIGEST = (
+    "27ea67c4b75585a1e2bffa6cfeae5e5faeefbaca75de4d5c4c559f15d89ccc18")
