@@ -156,12 +156,25 @@ class MediatorConfig:
     tick_interval: str = "10s"
     snapshot_every: int = 6
     cleanup_every: int = 6
+    # Corruption scrub cadence: every scrub_every-th tick verifies up
+    # to scrub_volumes fileset volumes (resumable cursor) and attempts
+    # peer repair of quarantined holes.  scrub_volumes 0 disables the
+    # background sweep (the admin endpoint still scrubs on demand).
+    # Default rides the cleanup cadence (one pass/minute at 10s ticks):
+    # verifying re-READS whole volumes, so an every-tick default would
+    # be a permanent background read load competing with query I/O.
+    scrub_every: int = 6
+    scrub_volumes: int = 4
 
     def validate(self, errs: list) -> None:
         try:
             parse_duration(self.tick_interval)
         except ConfigError as e:
             errs.append(f"mediator.tick_interval: {e}")
+        if self.scrub_every < 1:
+            errs.append("mediator.scrub_every: must be >= 1")
+        if self.scrub_volumes < 0:
+            errs.append("mediator.scrub_volumes: must be >= 0")
 
 
 @dataclasses.dataclass

@@ -16,6 +16,7 @@ block, de-duplicating by series ID and filtering tombstones.
 
 from __future__ import annotations
 
+import struct
 from pathlib import Path
 
 import numpy as np
@@ -23,6 +24,9 @@ import numpy as np
 from m3_tpu.index.doc import Document
 from m3_tpu.index.search import Query, execute_segment
 from m3_tpu.index.segment import MutableSegment, SealedSegment, merge_segments
+from m3_tpu.instrument import logger
+
+_LOG = logger("index.namespace_index")
 
 # Compaction targets: a block holding more than MAX_SEGMENTS sealed
 # segments gets merged down to at most TARGET_SEGMENTS (batching several
@@ -216,7 +220,15 @@ class NamespaceIndex:
         for f in sorted(d.glob("segment-*.db")):
             parts = f.stem.split("-")
             bs = int(parts[1])
-            seg = SealedSegment.from_bytes(f.read_bytes())
+            try:
+                seg = SealedSegment.from_bytes(f.read_bytes())
+            except (ValueError, struct.error) as e:
+                # A rotted sealed segment must not crash-loop node
+                # start (same contract as restore_snapshot below): the
+                # block's data still serves through filesets/WAL; only
+                # its reverse-index entries are lost until re-indexed.
+                _LOG.warning("skipping corrupt index segment %s: %s", f, e)
+                continue
             self.sealed.setdefault(bs, []).append(seg)
 
     def snapshot_mutable(self, snap_root: str) -> int:
@@ -249,7 +261,16 @@ class NamespaceIndex:
         n = 0
         for f in d.glob("segment-*.db"):
             bs = int(f.stem.split("-")[1])
-            seg = SealedSegment.from_bytes(f.read_bytes())
+            try:
+                seg = SealedSegment.from_bytes(f.read_bytes())
+            except (ValueError, struct.error) as e:
+                # A rotted snapshot index segment must not abort
+                # bootstrap: the data half replays through the WAL and
+                # tagged entries re-index themselves (database.py
+                # _replay_entries) — skip loudly, don't crash.
+                _LOG.warning(
+                    "skipping corrupt snapshot index segment %s: %s", f, e)
+                continue
             self.sealed.setdefault(bs, []).append(seg)
             self._persist_block(bs)
             n += 1

@@ -15,6 +15,8 @@ import struct
 
 import numpy as np
 
+from m3_tpu.persist.corruption import FormatCorruption
+
 _FNV_OFFSET = np.uint64(14695981039346656037)
 _FNV_PRIME = np.uint64(1099511628211)
 
@@ -95,7 +97,8 @@ class BloomFilter:
     @classmethod
     def from_bytes(cls, data: bytes) -> "BloomFilter":
         if data[:4] != cls.MAGIC:
-            raise ValueError("bad bloom filter magic")
+            raise FormatCorruption("bad bloom filter magic",
+                                   component="bloom", check="bloom-magic")
         m, k = struct.unpack_from("<QI", data, 4)
         bits = np.frombuffer(data[16:], np.uint64).copy()
         return cls(m, k, bits)

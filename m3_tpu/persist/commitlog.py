@@ -31,6 +31,7 @@ from typing import Iterator
 
 import numpy as np
 
+from m3_tpu.persist.corruption import ChecksumMismatch, FormatCorruption
 from m3_tpu.persist.digest import digest
 from m3_tpu.x import fault
 
@@ -141,37 +142,63 @@ class CommitLogWriter:
             self._f = None
 
 
-def read_commitlog(path) -> Iterator[CommitLogEntry]:
+def read_commitlog(path, strict: bool = False) -> Iterator[CommitLogEntry]:
     """Yields entries from one log file; stops (without raising) at the
-    first torn/corrupt chunk — the crash-recovery contract."""
-    raw = Path(path).read_bytes()
-    pos = 0
-    while pos + _CHUNK_HDR.size <= len(raw):
-        plen, pdig, hdig = _CHUNK_HDR.unpack_from(raw, pos)
-        if digest(raw[pos : pos + 8]) != hdig:
-            return
-        pos += _CHUNK_HDR.size
-        payload = raw[pos : pos + plen]
-        if len(payload) < plen or digest(payload) != pdig:
-            return
-        pos += plen
-        epos = 0
-        while epos < plen:
-            (nslen,) = struct.unpack_from("<B", payload, epos)
-            epos += 1
-            ns = payload[epos : epos + nslen]
-            epos += nslen
-            (idlen,) = struct.unpack_from("<H", payload, epos)
-            epos += 2
-            sid = payload[epos : epos + idlen]
-            epos += idlen
-            ts, val, unit = struct.unpack_from("<qdB", payload, epos)
-            epos += 17
-            (alen,) = struct.unpack_from("<H", payload, epos)
-            epos += 2
-            ann = payload[epos : epos + alen]
-            epos += alen
-            yield CommitLogEntry(sid, ts, val, unit, ann, ns)
+    first torn/corrupt chunk — the crash-recovery contract.
+
+    Streams CHUNK BY CHUNK: replay memory is bounded by the largest
+    chunk (one ingest batch), not the log size — the reference's WAL
+    reader is an iterator over the chunked writer's frames for the same
+    reason (`persist/fs/commitlog/reader.go`).  The truncation contract
+    is bit-for-bit the old whole-file reader's: a chunk is yielded only
+    when its header digest AND payload digest verify, and the first
+    failure ends iteration.
+
+    ``strict=True`` (integrity tooling, never recovery) raises a typed
+    :class:`CorruptionError` at the failure instead of truncating, so a
+    scrub can distinguish "clean end" from "torn tail".
+    """
+    path = Path(path)
+    with open(path, "rb") as f:
+        while True:
+            hdr = f.read(_CHUNK_HDR.size)
+            if len(hdr) < _CHUNK_HDR.size:
+                if hdr and strict:
+                    raise FormatCorruption(
+                        "torn chunk header", path=path,
+                        component="commitlog", check="chunk-header-torn")
+                return
+            plen, pdig, hdig = _CHUNK_HDR.unpack(hdr)
+            if digest(hdr[:8]) != hdig:
+                if strict:
+                    raise ChecksumMismatch(
+                        "chunk header checksum mismatch", path=path,
+                        component="commitlog", check="chunk-header")
+                return
+            payload = f.read(plen)
+            if len(payload) < plen or digest(payload) != pdig:
+                if strict:
+                    raise ChecksumMismatch(
+                        "chunk payload checksum mismatch", path=path,
+                        component="commitlog", check="chunk-payload")
+                return
+            epos = 0
+            while epos < plen:
+                (nslen,) = struct.unpack_from("<B", payload, epos)
+                epos += 1
+                ns = payload[epos : epos + nslen]
+                epos += nslen
+                (idlen,) = struct.unpack_from("<H", payload, epos)
+                epos += 2
+                sid = payload[epos : epos + idlen]
+                epos += idlen
+                ts, val, unit = struct.unpack_from("<qdB", payload, epos)
+                epos += 17
+                (alen,) = struct.unpack_from("<H", payload, epos)
+                epos += 2
+                ann = payload[epos : epos + alen]
+                epos += alen
+                yield CommitLogEntry(sid, ts, val, unit, ann, ns)
 
 
 def commitlog_seq(path) -> int:

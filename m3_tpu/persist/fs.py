@@ -27,7 +27,9 @@ from pathlib import Path
 from typing import Iterator
 
 from m3_tpu.persist.bloom import BloomFilter
+from m3_tpu.persist.corruption import ChecksumMismatch, FormatCorruption
 from m3_tpu.persist.digest import digest, digest_file, pack_digest, unpack_digest
+from m3_tpu.x import fault
 
 INFO_MAGIC = b"M3TI"
 INDEX_MAGIC = b"M3TX"
@@ -64,12 +66,19 @@ class FileSetInfo:
         )
 
     @classmethod
-    def from_bytes(cls, b: bytes) -> "FileSetInfo":
+    def from_bytes(cls, b: bytes, path=None) -> "FileSetInfo":
         if b[:4] != INFO_MAGIC:
-            raise ValueError("bad info magic")
-        ver, bs, bsz, vol, n = struct.unpack_from("<IqqIQ", b, 4)
+            raise FormatCorruption("bad info magic", path=path,
+                                   component="fileset", check="info-magic")
+        try:
+            ver, bs, bsz, vol, n = struct.unpack_from("<IqqIQ", b, 4)
+        except struct.error as e:
+            raise FormatCorruption(f"torn info file: {e}", path=path,
+                                   component="fileset", check="info-torn")
         if ver != VERSION:
-            raise ValueError(f"unsupported fileset version {ver}")
+            raise FormatCorruption(f"unsupported fileset version {ver}",
+                                   path=path, component="fileset",
+                                   check="info-version")
         return cls(bs, bsz, vol, n)
 
 
@@ -181,13 +190,38 @@ class DataFileSetReader:
         p = lambda t: fileset_path(root, namespace, shard, block_start, volume, t)
         if not p("checkpoint").exists():
             raise FileNotFoundError(f"no checkpoint for {p('checkpoint')}")
+        try:
+            self._open_verified(p)
+        except FileNotFoundError as e:
+            # Deletion removes the checkpoint FIRST (remove_fileset /
+            # quarantine_fileset), so checkpoint-present-but-file-
+            # missing is genuine damage, not a cleanup race — type it
+            # so scrub/read handlers quarantine instead of skipping.
+            if p("checkpoint").exists():
+                raise FormatCorruption(
+                    f"fileset file missing with checkpoint present: "
+                    f"{e.filename}", path=e.filename, component="fileset",
+                    check="missing-file")
+            raise  # checkpoint vanished since the check: a real race
+
+    def _open_verified(self, p) -> None:
         digests_raw = p("digest").read_bytes()
-        if unpack_digest(p("checkpoint").read_bytes()) != digest(digests_raw):
-            raise ValueError("checkpoint/digest mismatch")
+        checkpoint_raw = p("checkpoint").read_bytes()
+        if len(checkpoint_raw) < 4 or len(digests_raw) < 4 * len(FILE_TYPES):
+            raise FormatCorruption(
+                "torn checkpoint/digest file", path=p("checkpoint"),
+                component="fileset", check="checkpoint-torn")
+        if unpack_digest(checkpoint_raw) != digest(digests_raw):
+            raise ChecksumMismatch(
+                "checkpoint/digest mismatch", path=p("checkpoint"),
+                component="fileset", check="checkpoint")
         for i, t in enumerate(FILE_TYPES):
             if digest_file(p(t)) != unpack_digest(digests_raw[i * 4 :]):
-                raise ValueError(f"digest mismatch for {t} file")
-        self.info = FileSetInfo.from_bytes(p("info").read_bytes())
+                raise ChecksumMismatch(
+                    f"digest mismatch for {t} file", path=p(t),
+                    component="fileset", check=f"digest:{t}")
+        self.info = FileSetInfo.from_bytes(p("info").read_bytes(),
+                                           path=p("info"))
         self._data_path = p("data")
         self._index_path = p("index")
         self._data_f = None
@@ -233,7 +267,8 @@ class DataFileSetReader:
     def _index_raw(self):
         mm = self._mm(self._index_path, "_index_f", "_index_mm")
         if len(mm) and bytes(mm[:4]) != INDEX_MAGIC:
-            raise ValueError("bad index magic")
+            raise FormatCorruption("bad index magic", path=self._index_path,
+                                   component="fileset", check="index-magic")
         return mm
 
     def close(self) -> None:
@@ -302,16 +337,27 @@ class DataFileSetReader:
         if e is None:
             return None
         seg = bytes(self._data()[e.offset : e.offset + e.length])
+        # ``fileset.read`` faultpoint: corrupt mode flips one byte of
+        # the segment BEFORE the checksum verify, so dtest can exercise
+        # the detect→quarantine→repair loop without touching disk.
+        _, seg = fault.mangle("fileset.read", seg)
         if digest(seg) != e.checksum:
-            raise ValueError(f"segment checksum mismatch for {sid!r}")
+            raise ChecksumMismatch(
+                f"segment checksum mismatch for {sid!r}",
+                path=self._data_path, component="fileset",
+                check="segment-checksum")
         return seg
 
     def read_all(self) -> Iterator[tuple[bytes, bytes]]:
         mm = self._data()
         for e in self.entries():  # index entries are offset-ordered
             seg = bytes(mm[e.offset : e.offset + e.length])
+            _, seg = fault.mangle("fileset.read", seg)
             if digest(seg) != e.checksum:
-                raise ValueError(f"segment checksum mismatch for {e.id!r}")
+                raise ChecksumMismatch(
+                    f"segment checksum mismatch for {e.id!r}",
+                    path=self._data_path, component="fileset",
+                    check="segment-checksum")
             yield e.id, seg
 
     def __len__(self) -> int:

@@ -31,6 +31,7 @@ import struct
 from dataclasses import dataclass
 from pathlib import Path
 
+from m3_tpu.persist.corruption import ChecksumMismatch, FormatCorruption
 from m3_tpu.persist.digest import digest
 
 _META_MAGIC = b"M3TS"
@@ -56,13 +57,17 @@ class SnapshotMetadata:
         return body + struct.pack("<I", digest(body))
 
     @classmethod
-    def from_bytes(cls, b: bytes) -> "SnapshotMetadata":
+    def from_bytes(cls, b: bytes, path=None) -> "SnapshotMetadata":
         if len(b) != 24 or b[:4] != _META_MAGIC:
-            raise ValueError("bad snapshot metadata")
+            raise FormatCorruption("bad snapshot metadata", path=path,
+                                   component="snapshot.meta",
+                                   check="meta-magic")
         seq, clseq = struct.unpack_from("<Qq", b, 4)
         (csum,) = struct.unpack_from("<I", b, 20)
         if digest(b[:20]) != csum:
-            raise ValueError("snapshot metadata checksum mismatch")
+            raise ChecksumMismatch("snapshot metadata checksum mismatch",
+                                   path=path, component="snapshot.meta",
+                                   check="meta-checksum")
         return cls(seq, clseq)
 
 
@@ -99,8 +104,8 @@ def list_snapshots(root) -> list[SnapshotMetadata]:
     out = []
     for p in sorted(d.glob("meta-*.db"), key=lambda p: int(p.stem.split("-")[1])):
         try:
-            out.append(SnapshotMetadata.from_bytes(p.read_bytes()))
-        except ValueError:
+            out.append(SnapshotMetadata.from_bytes(p.read_bytes(), path=p))
+        except ValueError:  # CorruptionError — cleanup reaps it
             continue
     return out
 
@@ -118,14 +123,41 @@ def remove_snapshot(root, seq: int) -> None:
 
 def prune_snapshots(root, keep: int = 1) -> int:
     """Remove all but the newest `keep` complete snapshots plus any
-    uncommitted snapshot directories (crash leftovers).  Returns count
-    removed (reference cleanup.go snapshot/metadata cleanup)."""
-    snaps = list_snapshots(root)
+    uncommitted snapshot directories (crash leftovers) and any snapshot
+    whose metadata file is CORRUPT — ``latest_snapshot`` skips those,
+    so without this sweep the meta file (and its data dir) would leak
+    on disk forever.  Returns count removed (reference cleanup.go
+    snapshot/metadata cleanup)."""
     removed = 0
+    d = snapshots_root(root)
+    if d.exists():
+        for p in d.glob("meta-*.db"):
+            seq_s = p.stem.split("-")[1]
+            try:
+                raw = p.read_bytes()
+            except OSError:
+                # Unreadable ≠ corrupt: a transient EIO/race here must
+                # NOT delete a snapshot whose read would succeed next
+                # pass (its covering commitlogs may already be gone).
+                continue
+            try:
+                SnapshotMetadata.from_bytes(raw, path=p)
+            except ValueError as e:  # CorruptionError: verifiably rotten
+                if seq_s.isdigit():
+                    # Quarantine, don't destroy: the meta is rotten but
+                    # the data filesets may be fully intact — at rf=1
+                    # they can be the only copy of what the snapshot
+                    # covered (the WAL it superseded is already reaped).
+                    from m3_tpu.persist.quarantine import quarantine_snapshot
+
+                    quarantine_snapshot(root, int(seq_s), e)
+                else:
+                    p.unlink(missing_ok=True)
+                removed += 1
+    snaps = list_snapshots(root)
     for m in snaps[:-keep] if keep else snaps:
         remove_snapshot(root, m.seq)
         removed += 1
-    d = snapshots_root(root)
     if d.exists():
         live = {m.seq for m in list_snapshots(root)}
         for p in d.iterdir():

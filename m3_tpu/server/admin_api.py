@@ -12,6 +12,10 @@ over the KV control plane.  Routes:
     POST              /api/v1/services/m3db/placement          (add instance)
     GET/POST          /api/v1/topic
     GET/PUT           /api/v1/runtime                          (options)
+    POST              /api/v1/database/scrub                   (on-demand
+                      corruption sweep + peer repair; body optionally
+                      {"budget": N volumes (0 = whole disk, the default),
+                       "repair": bool})
 """
 
 from __future__ import annotations
@@ -52,13 +56,14 @@ def _parse_dur_nanos(s) -> int:
 
 
 class AdminContext:
-    def __init__(self, kv: KVStore, db=None, aggregator=None):
+    def __init__(self, kv: KVStore, db=None, aggregator=None, scrubber=None):
         self.kv = kv
         self.namespaces = NamespaceRegistry(kv)
         self.placements = PlacementService(kv)
         self.topics = TopicService(kv)
         self.runtime = RuntimeOptionsManager(kv)
         self.aggregator = aggregator
+        self.scrubber = scrubber
         if db is not None:
             self.namespaces.attach(db)
 
@@ -214,6 +219,19 @@ class _AdminHandler(BaseHTTPRequestHandler):
                     "namespace": dataclasses.asdict(meta),
                     "placement": placement_out,
                 })
+            if path == "/api/v1/database/scrub":
+                # On-demand integrity sweep (reference ops run
+                # verify_data_files out-of-band; here the scrubber is
+                # in-process so the sweep also quarantines and repairs
+                # from peers).  Default budget 0 = the whole disk.
+                if self.ctx.scrubber is None:
+                    return self._json(
+                        404, {"error": "no scrubber in this process"})
+                stats = self.ctx.scrubber.run_once(
+                    budget=int(body.get("budget", 0)),
+                    repair=bool(body.get("repair", True)),
+                )
+                return self._json(200, {"scrub": stats})
             if path == "/api/v1/topic":
                 t = Topic(
                     body["name"], body.get("num_shards", 64),

@@ -34,6 +34,7 @@ class Assembly:
     kv: object | None = None
     rpc_server: object | None = None
     peer_handles: list = dataclasses.field(default_factory=list)
+    scrubber: object | None = None
 
     @property
     def port(self) -> int | None:
@@ -177,12 +178,27 @@ def run_node(source, start_mediator: bool | None = None,
                 db, host=cfg.db.rpc_listen_host, port=cfg.db.rpc_listen_port
             )
 
+        # Corruption scrubber: always constructed (the admin endpoint
+        # scrubs on demand); attached to the mediator loop only when a
+        # per-tick budget is configured.  Peers double as the repair
+        # source — a quarantined (shard, block) hole heals from a
+        # replica on the next sweep.
+        from m3_tpu.storage.scrub import Scrubber
+
+        asm.scrubber = Scrubber(
+            db, peers=asm.peer_handles,
+            budget_volumes=cfg.mediator.scrub_volumes, instrument=scope,
+        )
+
         if cfg.mediator.enabled if start_mediator is None else start_mediator:
             asm.mediator = Mediator(
                 db,
                 tick_interval_s=parse_duration(cfg.mediator.tick_interval) / 1e9,
                 snapshot_every=cfg.mediator.snapshot_every,
                 cleanup_every=cfg.mediator.cleanup_every,
+                scrubber=(asm.scrubber
+                          if cfg.mediator.scrub_volumes > 0 else None),
+                scrub_every=cfg.mediator.scrub_every,
                 instrument=scope,
             )
             asm.mediator.open()
@@ -247,7 +263,7 @@ def run_node(source, start_mediator: bool | None = None,
                 asm.kv = RemoteKVStore((h, int(p)))
             else:
                 asm.kv = KVStore(cfg.db.root)  # file-backed control plane
-            admin_ctx = AdminContext(asm.kv, db)
+            admin_ctx = AdminContext(asm.kv, db, scrubber=asm.scrubber)
             # live-tune query limits + cache budget through runtime
             # options (runtime_options_manager.go's role)
             def _limit_applier(lim):

@@ -74,7 +74,7 @@ class _Handler(BaseHTTPRequestHandler):
         q = parse_qs(u.query)
         try:
             if u.path == "/health":
-                return self._json(200, {"ok": True})
+                return self._health()
             if u.path == "/metrics":
                 return self._metrics()
             if u.path == "/debug/traces":
@@ -121,6 +121,30 @@ class _Handler(BaseHTTPRequestHandler):
             return self._error(400, str(e))
 
     # -- handlers ----------------------------------------------------------
+
+    def _health(self):
+        """Liveness plus the corruption-quarantine inventory: a node
+        serving around quarantined volumes is healthy (that is the
+        design) but an operator must be able to SEE the holes without
+        shelling into the data dir."""
+        out = {"ok": True}
+        try:
+            inv = self.ctx.db.quarantine_inventory()
+        except Exception:  # noqa: BLE001 — health must never 500
+            inv = None
+        if inv:
+            out["quarantine"] = {
+                "entries": len(inv),
+                # brief per-entry detail; the full reason files live in
+                # <root>/quarantine/
+                "items": [
+                    {k: e.get(k) for k in ("label", "namespace", "shard",
+                                           "block_start", "volume", "check",
+                                           "error_type")}
+                    for e in inv[:50]
+                ],
+            }
+        return self._json(200, out)
 
     def _debug_dump(self, q):
         """One-stop debug zip: thread stacks, a short CPU profile, a

@@ -44,6 +44,7 @@ def main(argv=None) -> int:
         "port": asm.port,
         "carbon_port": asm.carbon_port,
         "rpc_port": asm.rpc_port,
+        "admin_port": asm.admin_port,
         "root": cfg.db.root,
     }
     status_path = Path(cfg.db.root) / "node.json"

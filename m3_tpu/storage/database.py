@@ -41,15 +41,20 @@ from m3_tpu.persist.commitlog import (
     CommitLogEntry, CommitLogWriter, commitlog_seq, list_commitlogs,
     read_commitlog,
 )
+from m3_tpu.persist.corruption import CorruptionError
 from m3_tpu.persist.fs import (
     DataFileSetReader, DataFileSetWriter, list_fileset_volumes, list_filesets,
     remove_fileset,
 )
+from m3_tpu.persist import quarantine as quar
 from m3_tpu.persist import snapshot as snap
+from m3_tpu.instrument import logger
 from m3_tpu.instrument.tracing import Tracepoint
 from m3_tpu.storage.limits import NO_LIMITS, NewSeriesLimiter, QueryLimits
 from m3_tpu.storage.buffer import ShardBuffer, dedupe_last_write_wins
 from m3_tpu.storage.series_merge import merge_point_sources
+
+_LOG = logger("storage.database")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -105,12 +110,15 @@ def shard_for_id(sid: bytes, num_shards: int) -> int:
 
 class Shard:
     def __init__(self, namespace: str, shard_id: int, opts: NamespaceOptions, root: str,
-                 block_cache=None, new_series_limiter=None):
+                 block_cache=None, new_series_limiter=None, corruption_cb=None):
         self.namespace = namespace
         self.shard_id = shard_id
         self.opts = opts
         self.root = root
         self.block_cache = block_cache
+        # Called (namespace, shard, block_start, volume, err) after a
+        # corrupt volume is quarantined — the Database's counter/log hook.
+        self._corruption_cb = corruption_cb
         self.slots = SlotAllocator(opts.slot_capacity,
                                    limiter=new_series_limiter)
         self.new_series_rejected = 0
@@ -210,17 +218,28 @@ class Shard:
             slots, ts, vals = self.buffer.drain_cold(block_start)
             if len(slots) == 0:
                 continue
-            merged: Dict[bytes, Dict[int, float]] = {}
             vol = -1
             for bs, v in list_filesets(self.root, self.namespace, self.shard_id):
                 if bs == block_start:
                     vol = v
-            if vol >= 0:
+
+            # Merge from the highest INTACT volume (corrupt ones are
+            # quarantined and the next-lower tried); the rewrite still
+            # lands at max_vol+1 so volume numbering stays monotonic
+            # across a quarantine.
+            def _decode_volume(merge_vol):
                 r = DataFileSetReader(
-                    self.root, self.namespace, self.shard_id, block_start, vol
+                    self.root, self.namespace, self.shard_id,
+                    block_start, merge_vol
                 )
-                for sid, seg in r.read_all():
-                    merged[sid] = {d.timestamp: d.value for d in decode_series(seg)}
+                return {
+                    sid: {d.timestamp: d.value for d in decode_series(seg)}
+                    for sid, seg in r.read_all()
+                }
+
+            merged: Dict[bytes, Dict[int, float]] = (
+                self._fold_intact_volumes(block_start, _decode_volume) or {}
+            )
             for slot, t, v in zip(slots, ts, vals):
                 sid = self.slots.id_of(int(slot))
                 if sid is None:
@@ -267,6 +286,93 @@ class Shard:
             written += len(series)
         return written
 
+    # -- corruption handling ----------------------------------------------
+
+    def quarantine_volume(self, block_start: int, volume: int, err) -> None:
+        """Pull one corrupt fileset volume out of the live tree
+        (persist/quarantine), drop its cached readers/blocks, and — when
+        no intact volume remains for the block — un-mark it flushed so
+        buffers/replay may serve it again (the corrupt volume is now
+        *missing*, not half-readable)."""
+        qdir = quar.quarantine_fileset(self.root, self.namespace,
+                                       self.shard_id, block_start, volume, err)
+        if self.block_cache is not None:
+            self.block_cache.invalidate_block(
+                self.namespace, self.shard_id, block_start
+            )
+        if not any(bs == block_start for bs, _ in list_filesets(
+                self.root, self.namespace, self.shard_id)):
+            self.flushed_blocks.discard(block_start)
+        _LOG.warning(
+            "quarantined corrupt fileset ns=%s shard=%d block=%d vol=%d: %s",
+            self.namespace, self.shard_id, block_start, volume, err,
+        )
+        if self._corruption_cb is not None:
+            self._corruption_cb(self.namespace, self.shard_id, block_start,
+                                volume, err, quarantined=qdir is not None)
+
+    def _fold_intact_volumes(self, block_start: int, consume):
+        """Apply ``consume(volume)`` to the block's volumes, highest
+        first, returning the first result that reads clean.  A corrupt
+        volume is quarantined and the next-lower one tried; a missing
+        one (raced cleanup/quarantine) is skipped.  ``consume`` must
+        build any partial state fresh per call — a mid-read
+        CorruptionError discards that attempt wholesale.  This is the
+        ONE place the quarantine-and-fall-back contract lives (read
+        path, cold-flush merge, and WAL-replay dedupe all fold through
+        it)."""
+        vols = sorted(
+            (v for bs, v in list_fileset_volumes(
+                self.root, self.namespace, self.shard_id)
+             if bs == block_start),
+            reverse=True,
+        )
+        for vol in vols:
+            try:
+                return consume(vol)
+            except FileNotFoundError:
+                continue
+            except CorruptionError as e:
+                self.quarantine_volume(block_start, vol, e)
+                continue
+        return None
+
+    def _read_fileset_series(self, block_start: int, sid: bytes,
+                             volume: int | None = None):
+        """Points for ``sid`` from the highest INTACT volume of a block,
+        or None.  A corrupt volume is quarantined and the next-lower
+        volume tried — corruption degrades this one source (buffers and
+        replicas still answer), it never fails the read (the reference's
+        checksum-verify-and-skip read path, persist/fs/read.go +
+        repair.go's expected-corruption contract).
+
+        ``volume`` is the caller's already-known latest volume: the hot
+        path reads it directly (no extra directory glob); only a
+        corrupt/vanished volume falls back to enumerating what remains
+        on disk."""
+        def consume(vol):
+            if self.block_cache is not None:
+                return self.block_cache.read_series(
+                    self.root, self.namespace, self.shard_id,
+                    block_start, vol, sid,
+                )
+            r = DataFileSetReader(
+                self.root, self.namespace, self.shard_id, block_start, vol
+            )
+            seg = r.read(sid)
+            return ([(d.timestamp, d.value) for d in decode_series(seg)]
+                    if seg else None)
+
+        if volume is not None:
+            try:
+                return consume(volume)
+            except FileNotFoundError:
+                pass
+            except CorruptionError as e:
+                self.quarantine_volume(block_start, volume, e)
+            # quarantined/vanished: whatever remains on disk, if anything
+        return self._fold_intact_volumes(block_start, consume)
+
     # -- read path ---------------------------------------------------------
 
     def read_sources(
@@ -285,25 +391,9 @@ class Shard:
         sources: list[list[tuple[int, float]]] = []
         for bs in range(lo, end_nanos + bsz, bsz):
             if bs in filesets:
-                try:
-                    if self.block_cache is not None:
-                        pts = self.block_cache.read_series(
-                            self.root, self.namespace, self.shard_id, bs,
-                            filesets[bs], sid,
-                        )
-                    else:
-                        r = DataFileSetReader(
-                            self.root, self.namespace, self.shard_id, bs, filesets[bs]
-                        )
-                        seg = r.read(sid)
-                        pts = (
-                            [(d.timestamp, d.value) for d in decode_series(seg)]
-                            if seg else None
-                        )
-                    if pts:
-                        sources.append(pts)
-                except FileNotFoundError:
-                    pass
+                pts = self._read_fileset_series(bs, sid, volume=filesets[bs])
+                if pts:
+                    sources.append(pts)
             if slot is not None and bs in self.buffer.open_blocks:
                 ts, vals = self.buffer.read_window(bs, slot)
                 sources.append(list(zip(ts.tolist(), vals.tolist())))
@@ -327,13 +417,15 @@ class Shard:
 
 class Namespace:
     def __init__(self, name: str, opts: NamespaceOptions, root: str,
-                 block_cache=None, new_series_limiter=None):
+                 block_cache=None, new_series_limiter=None,
+                 corruption_cb=None):
         self.name = name
         self.opts = opts
         self.root = root
         self.shards = [
             Shard(name, i, opts, root, block_cache,
-                  new_series_limiter=new_series_limiter)
+                  new_series_limiter=new_series_limiter,
+                  corruption_cb=corruption_cb)
             for i in range(opts.num_shards)
         ]
         self.index = NamespaceIndex(opts.block_size_nanos, root, name)
@@ -449,11 +541,40 @@ class Database:
             self.namespaces[name] = Namespace(
                 name, nopts, self.opts.root, self.block_cache,
                 new_series_limiter=self.new_series_limiter,
+                corruption_cb=self._note_corruption,
             )
         self.commitlog = (
             CommitLogWriter(self.opts.root) if self.opts.commitlog_enabled else None
         )
         self.bootstrapped = False
+
+    def _note_corruption(self, namespace: str, shard: int, block_start: int,
+                         volume: int, err, quarantined: bool = True) -> None:
+        """Counter hook every shard's quarantine path reports through —
+        the ``corruption_*`` series on a node's /metrics.  ``detected``
+        counts every corruption event; ``quarantined`` only those where
+        files were actually moved (a volume whose files vanished before
+        the move detects without quarantining)."""
+        if self._scope is not None:
+            self._scope.counter("corruption_detected").inc()
+            if quarantined:
+                self._scope.counter("corruption_quarantined").inc()
+
+    def quarantine_inventory(self) -> list:
+        """Reason dicts of everything under <root>/quarantine/ (served
+        in /health detail)."""
+        return quar.list_quarantined(self.opts.root)
+
+    def quarantine_fileset_volume(self, namespace: str, shard: int,
+                                  block_start: int, volume: int,
+                                  err=None) -> None:
+        """Engine-locked quarantine of one fileset volume (the
+        scrubber's entry point — flushed-block bookkeeping must not
+        race ingest/tick)."""
+        with self._mu:
+            self.namespaces[namespace].shards[shard].quarantine_volume(
+                block_start, volume, err
+            )
 
     def ensure_namespace(self, name: str,
                          opts: NamespaceOptions | None = None) -> Namespace:
@@ -467,6 +588,7 @@ class Database:
                     name, opts or NamespaceOptions(), self.opts.root,
                     self.block_cache,
                     new_series_limiter=self.new_series_limiter,
+                    corruption_cb=self._note_corruption,
                 )
             return ns
 
@@ -673,6 +795,35 @@ class Database:
                         stats["filesets"] += 1
                         if bs <= cutoff:
                             shard.flushed_blocks.discard(bs)
+        # Quarantine entries age out WITH their data's retention: once
+        # the block is out of retention everywhere, the evidence (and
+        # the scrubber's repair worklist entry) has nothing left to
+        # heal toward — without this the inventory and /health payload
+        # grow forever.
+        import shutil as _shutil
+
+        max_keep = max(
+            (ns.opts.retention_nanos + ns.opts.block_size_nanos
+             for ns in self.namespaces.values()),
+            default=48 * 3600 * 10**9,
+        )
+        for entry in quar.list_quarantined(self.opts.root):
+            ns = self.namespaces.get(entry.get("namespace"))
+            bs = entry.get("block_start")
+            if ns is not None and isinstance(bs, int):
+                expired = (bs <= now_nanos - ns.opts.retention_nanos
+                           - ns.opts.block_size_nanos)
+            else:
+                # No retention anchor (quarantined snapshots, dropped
+                # namespaces, unreadable reasons): age out on the
+                # wall-clock quarantine time against the longest
+                # retention any namespace keeps.
+                qa = entry.get("quarantined_at")
+                expired = (isinstance(qa, (int, float))
+                           and qa * 1e9 <= now_nanos - max_keep)
+            if expired:
+                _shutil.rmtree(entry["dir"], ignore_errors=True)
+                stats["quarantine_reaped"] = stats.get("quarantine_reaped", 0) + 1
         stats["snapshots"] = snap.prune_snapshots(self.opts.root, keep=1)
         latest = snap.latest_snapshot(self.opts.root)
         if latest is not None:
@@ -715,16 +866,23 @@ class Database:
                 continue
             key = (name, shard_id, bs)
             if key not in flushed_pts:
-                per_sid: dict = {}
-                for fbs, vol in list_filesets(self.opts.root, ns.name, shard_id):
-                    if fbs != bs:
-                        continue
-                    r = DataFileSetReader(self.opts.root, ns.name, shard_id, bs, vol)
-                    for fsid, seg in r.read_all():
-                        per_sid[fsid] = {
-                            d.timestamp for d in decode_series(seg)
-                        }
-                flushed_pts[key] = per_sid
+                # Decode the highest INTACT volume; a corrupt one is
+                # quarantined and a lower volume tried.  When nothing
+                # intact remains the dedupe set is empty, so every WAL
+                # entry for the block is KEPT and re-buffered — replay
+                # re-covers exactly the data the corrupt fileset lost.
+                def _decode_timestamps(vol, _bs=bs, _shard=shard_id):
+                    r = DataFileSetReader(
+                        self.opts.root, ns.name, _shard, _bs, vol
+                    )
+                    return {
+                        fsid: {d.timestamp for d in decode_series(seg)}
+                        for fsid, seg in r.read_all()
+                    }
+
+                flushed_pts[key] = (
+                    sh._fold_intact_volumes(bs, _decode_timestamps) or {}
+                )
             if int(ts[i]) in flushed_pts[key].get(sid, ()):
                 keep[i] = False
         if not keep.any():
@@ -772,13 +930,34 @@ class Database:
                 for shard in ns.shards:
                     entries: list[CommitLogEntry] = []
                     for bs, vol in list_filesets(snap_root, name, shard.shard_id):
-                        r = DataFileSetReader(snap_root, name, shard.shard_id, bs, vol)
-                        for sid, seg in r.read_all():
-                            entries.extend(
-                                CommitLogEntry(sid, d.timestamp, d.value,
-                                               namespace=name.encode())
-                                for d in decode_series(seg)
+                        try:
+                            r = DataFileSetReader(
+                                snap_root, name, shard.shard_id, bs, vol
                             )
+                            for sid, seg in r.read_all():
+                                entries.extend(
+                                    CommitLogEntry(sid, d.timestamp, d.value,
+                                                   namespace=name.encode())
+                                    for d in decode_series(seg)
+                                )
+                        except CorruptionError as e:
+                            # A rotted snapshot fileset must not abort
+                            # node start: quarantine it (under the DB
+                            # root) and keep whatever decoded cleanly —
+                            # replicas/repair re-converge the remainder.
+                            qdir = quar.quarantine_fileset(
+                                snap_root, name, shard.shard_id, bs, vol, e,
+                                qroot=self.opts.root,
+                                label=f"snapshot-{latest.seq}",
+                            )
+                            _LOG.warning(
+                                "quarantined corrupt snapshot fileset "
+                                "seq=%d ns=%s shard=%d block=%d vol=%d: %s",
+                                latest.seq, name, shard.shard_id, bs, vol, e,
+                            )
+                            self._note_corruption(
+                                name, shard.shard_id, bs, vol, e,
+                                quarantined=qdir is not None)
                     if entries:
                         restored += self._replay_entries(name, entries, flushed_pts)
         replayed = 0

@@ -40,6 +40,8 @@ class Mediator:
         tick_interval_s: float = 10.0,
         snapshot_every: int = 6,
         cleanup_every: int = 6,
+        scrubber=None,
+        scrub_every: int = 1,
         instrument=None,
     ):
         self.db = db
@@ -47,6 +49,11 @@ class Mediator:
         self.tick_interval_s = tick_interval_s
         self.snapshot_every = max(1, snapshot_every)
         self.cleanup_every = max(1, cleanup_every)
+        # Optional storage.scrub.Scrubber: the corruption sweep rides
+        # the same maintenance loop as flush/snapshot/cleanup, budgeted
+        # per pass so it never monopolizes a tick.
+        self.scrubber = scrubber
+        self.scrub_every = max(1, scrub_every)
         self._ticks = 0
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -72,6 +79,12 @@ class Mediator:
                 stats["snapshot"] = self.db.snapshot()
             if self._ticks % self.cleanup_every == 0:
                 stats["cleanup"] = self.db.cleanup(now)
+            if (self.scrubber is not None
+                    and self._ticks % self.scrub_every == 0):
+                # Non-blocking: an admin-triggered whole-disk scrub in
+                # flight must not stall flush/snapshot/cleanup — the
+                # tick just skips its scrub stage and retries next pass.
+                stats["scrub"] = self.scrubber.run_once(wait=False)
             if self._scope is not None:
                 self._scope.counter("ticks").inc()
                 for ns_stats in stats["tick"].values():

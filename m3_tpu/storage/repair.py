@@ -29,16 +29,19 @@ from __future__ import annotations
 from typing import Dict, List
 
 from m3_tpu.encoding.m3tsz import decode_series, encode_series
+from m3_tpu.persist.corruption import CorruptionError
 from m3_tpu.persist.digest import digest as checksum
 from m3_tpu.server.rpc import RemoteError
 
-# A replica is skipped/demoted on transport failure (ConnectionError)
-# AND on application-level failure it reports (RemoteError: RPC_ERR
-# frames, e.g. a segment checksum ValueError while reading a corrupt
-# block) — one bad replica must never abort the anti-entropy sweep,
-# matching the reference's per-host fetch failure handling
-# (src/dbnode/storage/repair.go:115-246).
-_REPLICA_FAILURE = (ConnectionError, RemoteError)
+# A replica is skipped/demoted on transport failure (ConnectionError),
+# on application-level failure it reports (RemoteError: RPC_ERR frames
+# — a remote replica's CorruptionError arrives as one of these), AND on
+# a LOCAL handle's typed CorruptionError (a corrupt block under this
+# very process) — one bad replica must never abort the anti-entropy
+# sweep, matching the reference's per-host fetch failure handling
+# (src/dbnode/storage/repair.go:115-246).  The scrubber quarantines the
+# local corruption separately; repair's job is only to keep sweeping.
+_REPLICA_FAILURE = (ConnectionError, RemoteError, CorruptionError)
 
 
 class RepairReport(dict):

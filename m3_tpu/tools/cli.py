@@ -3,7 +3,8 @@
 Equivalents of the reference's `src/cmd/tools/*`: `read_data_files`
 (dump series from a fileset), `read_index_files` (dump index segment
 terms), `read_commitlog` (dump WAL entries), `verify_data_files`
-(checksum-verify every fileset), `clone_fileset`, and
+(checksum-verify every fileset), `scrub` (verify AND quarantine corrupt
+volumes under <root>/quarantine/), `clone_fileset`, and
 `query_index_segments` (run a term query against sealed segments).
 One binary, subcommand per tool, JSON-lines output for scripting.
 
@@ -22,7 +23,7 @@ import numpy as np
 from m3_tpu.encoding.m3tsz import decode_series
 from m3_tpu.persist.commitlog import list_commitlogs, read_commitlog
 from m3_tpu.persist.fs import (
-    DataFileSetReader, DataFileSetWriter, list_fileset_volumes, list_filesets,
+    DataFileSetReader, DataFileSetWriter, list_filesets,
 )
 
 
@@ -100,21 +101,16 @@ def read_commitlog_cmd(args) -> int:
 
 def verify_data_files(args) -> int:
     """Checksum-verify every fileset; exit 1 on any corruption
-    (cmd/tools/verify_data_files).  The reader validates checkpoint →
-    digest → per-file adler32 → per-segment checksums."""
+    (cmd/tools/verify_data_files).  Report-only view over the scrub
+    sweep (checkpoint → digest → per-file adler32 → per-segment
+    checksums); `scrub` is the same walk plus quarantine."""
+    from m3_tpu.storage.scrub import scrub_root
+
     bad = 0
-    for ns in _namespaces(args.root):
-        for shard in _shards(args.root, ns):
-            for bs, vol in list_fileset_volumes(args.root, ns, shard):
-                try:
-                    r = DataFileSetReader(args.root, ns, shard, bs, vol)
-                    n = sum(1 for _ in r.read_all())
-                    _out({"namespace": ns, "shard": shard, "block_start": bs,
-                          "volume": vol, "ok": True, "series": n})
-                except (ValueError, FileNotFoundError, EOFError) as e:
-                    bad += 1
-                    _out({"namespace": ns, "shard": shard, "block_start": bs,
-                          "volume": vol, "ok": False, "error": str(e)})
+    for rec in scrub_root(args.root, quarantine=False):
+        if not rec["ok"]:
+            bad += 1
+        _out(rec)
     return 1 if bad else 0
 
 
@@ -146,6 +142,30 @@ def query_index_segments(args) -> int:
         _out({"id": d.id.decode(errors="replace"),
               "tags": {k.decode(): v.decode() for k, v in d.tags().items()}})
     return 0
+
+
+def scrub(args) -> int:
+    """Offline corruption sweep of a data root: verify every
+    checkpointed fileset volume (checkpoint → digests → per-segment
+    checksums) and quarantine what fails under <root>/quarantine/ with
+    a reason file (report-only with --no-quarantine).  Exit 1 when any
+    corruption was found — the cron/CI shape of the reference's
+    verify_data_files tool, plus the quarantine step."""
+    from m3_tpu.persist.quarantine import list_quarantined
+    from m3_tpu.storage.scrub import scrub_root
+
+    results = scrub_root(args.root, quarantine=not args.no_quarantine)
+    bad = 0
+    for rec in results:
+        if not rec["ok"]:
+            bad += 1
+        if not rec["ok"] or args.verbose:
+            _out(rec)
+    if args.inventory:
+        for entry in list_quarantined(args.root):
+            _out(entry)
+    _out({"checked": len(results), "corrupt": bad})
+    return 1 if bad else 0
 
 
 def lint(args) -> int:
@@ -236,6 +256,18 @@ def main(argv=None) -> int:
     qi.add_argument("--block-size", type=int, dest="block_size",
                     default=2 * 3600 * 10**9)
     qi.set_defaults(fn=query_index_segments)
+
+    sc = sub.add_parser(
+        "scrub", help="verify + quarantine corrupt filesets in a data root")
+    sc.add_argument("root")
+    sc.add_argument("--no-quarantine", action="store_true",
+                    dest="no_quarantine",
+                    help="report corruption without moving anything")
+    sc.add_argument("--verbose", action="store_true",
+                    help="emit one line per clean volume too")
+    sc.add_argument("--inventory", action="store_true",
+                    help="also dump the quarantine inventory")
+    sc.set_defaults(fn=scrub)
 
     li = sub.add_parser(
         "lint", help="codebase-aware static analysis, baseline-gated")

@@ -1,6 +1,6 @@
 """m3lint: codebase-aware static analysis for the m3-tpu tree.
 
-Five rule families, each encoding a contract this repo already pays
+Seven rule families, each encoding a contract this repo already pays
 for at runtime (race tier, fault tier, bit-exactness goldens) as a
 static gate:
 
@@ -16,6 +16,9 @@ static gate:
   outside a faultpoint-wrapped helper (PR 1's invariant).
 * ``resource-hygiene`` — sockets/files opened with no owner on the
   error path.
+* ``corruption-typed`` — digest/checksum/magic verify sites under
+  ``m3_tpu/persist/`` raising bare ``ValueError`` instead of the typed
+  ``CorruptionError`` hierarchy (the quarantine/repair contract).
 
 Run: ``python -m m3_tpu.tools.cli lint`` (gates against
 ``m3_tpu/tools/lint_baseline.json``; see TESTING.md "Static analysis &

@@ -41,6 +41,7 @@ RULES = (
     "wire-exhaustive",
     "fault-coverage",
     "resource-hygiene",
+    "corruption-typed",
 )
 
 _SUPPRESS_RE = re.compile(r"#\s*m3lint:\s*disable=([\w,-]+)")
@@ -86,6 +87,9 @@ class Context:
                              "m3_tpu/server/ingest_tcp.py",
                              "m3_tpu/cluster/kv_remote.py",
                              "m3_tpu/query/remote.py")
+    # files whose digest/checksum/magic verify sites must raise the
+    # typed CorruptionError hierarchy, never a bare ValueError
+    persist_prefixes: tuple = ("m3_tpu/persist/",)
 
     def is_wire_module(self, path: str) -> bool:
         return (path in self.wire_files
@@ -93,6 +97,9 @@ class Context:
 
     def wants_dtype(self, path: str) -> bool:
         return any(path.startswith(p) for p in self.dtype_prefixes)
+
+    def is_persist_module(self, path: str) -> bool:
+        return any(path.startswith(p) for p in self.persist_prefixes)
 
 
 @dataclass
@@ -144,7 +151,9 @@ def apply_suppressions(unit: FileUnit, findings: Iterable[Finding]) -> List[Find
 
 
 def default_rules() -> List[Rule]:
-    from m3_tpu.x.lint import faultcov, locks, purity, resources, wirecheck
+    from m3_tpu.x.lint import (
+        corruption, faultcov, locks, purity, resources, wirecheck,
+    )
 
     return [
         locks.check,
@@ -153,6 +162,7 @@ def default_rules() -> List[Rule]:
         wirecheck.check,
         faultcov.check,
         resources.check,
+        corruption.check,
     ]
 
 

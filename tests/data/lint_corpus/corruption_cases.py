@@ -1,0 +1,47 @@
+"""corruption-typed seeds: bare ValueError at integrity verify sites
+(flagged), typed CorruptionError raises and plain argument validation
+(clean counterparts).  Line numbers are asserted exactly by
+tests/test_lint.py."""
+import struct
+import zlib
+
+INFO_MAGIC = b"M3TI"
+
+
+def digest(data):
+    return zlib.adler32(data) & 0xFFFFFFFF
+
+
+def parse_header_bad(b):
+    if b[:4] != INFO_MAGIC:                       # magic compare in the test
+        raise ValueError("bad header")            # line 17: VIOLATION
+    return struct.unpack_from("<I", b, 4)
+
+
+def verify_segment_bad(data, want):
+    if digest(data) != want:                      # digest() call in the test
+        raise ValueError("segment broken")        # line 23: VIOLATION
+
+
+def verify_message_bad(data, want):
+    if want != compute(data):
+        raise ValueError("payload checksum mismatch")   # line 28: VIOLATION
+
+
+class CorruptionError(ValueError):
+    pass
+
+
+def compute(data):
+    return len(data)
+
+
+def verify_segment_clean(data, want):
+    if digest(data) != want:
+        raise CorruptionError("segment checksum mismatch")  # typed: clean
+
+
+def validate_clean(n):
+    if n < 0:
+        raise ValueError("n must be >= 0")        # argument check: clean
+    return n

@@ -57,10 +57,14 @@ def _free_ports(n: int) -> list:
     return ports
 
 
-def _cluster_nodes(tmp_path, n=3):
+def _cluster_nodes(tmp_path, n=3, admin=False):
     """n node processes wired as an RF=n replica set: each serves the
-    socket RPC and peers-bootstraps from the others on startup."""
+    socket RPC and peers-bootstraps from the others on startup.
+    ``admin=True`` also opens each node's admin API on an ephemeral
+    port (published as ``admin_port`` in node.json)."""
     ports = _free_ports(n)
+    coord = ("{listen_port: 0, admin_listen_port: 0}" if admin
+             else "{listen_port: 0}")
     nodes = []
     for k in range(n):
         root = tmp_path / f"n{k}" / "data"
@@ -75,7 +79,7 @@ db:
   bootstrap_peers: true
   namespaces:
     default: {{num_shards: 2}}
-coordinator: {{listen_port: 0}}
+coordinator: {coord}
 mediator: {{enabled: false}}
 """)
         root.mkdir(parents=True, exist_ok=True)
@@ -331,6 +335,147 @@ class TestFaultedQuorumScenario:
             assert rep.converged, rep
         finally:
             fault.disarm()
+            for r in remotes.values():
+                r.close()
+            for nd in nodes:
+                nd.kill()
+
+
+@pytest.mark.slow
+class TestCorruptionQuarantineRepairScenario:
+    """dtest scenario for the corruption-resilience subsystem: one
+    replica's flushed fileset is byte-flipped on disk (its WAL is also
+    wiped, so only peers can heal it).  The node must bootstrap
+    cleanly, cluster queries must stay correct throughout the
+    degradation, the corrupt volume must land in quarantine/ with a
+    reason file, and an admin-triggered scrub must restore
+    bit-identical M3TSZ block bytes from the intact replicas
+    (sha256-compared)."""
+
+    def test_byte_flip_bootstrap_quarantine_peer_repair(self, tmp_path):
+        import hashlib
+        import shutil
+        import urllib.request
+
+        from m3_tpu.client.session import ConsistencyLevel, ReplicatedSession
+        from m3_tpu.cluster.placement import Instance, initial_placement
+        from m3_tpu.server.rpc import RemoteDatabase
+        from m3_tpu.storage.database import shard_for_id
+
+        nodes, ports = _cluster_nodes(tmp_path, n=3, admin=True)
+        remotes = {}
+        try:
+            for nd in nodes:
+                nd.start()
+            remotes = {
+                f"i{k}": RemoteDatabase(("127.0.0.1", ports[k]))
+                for k in range(3)
+            }
+            placement = initial_placement(
+                [Instance(f"i{k}") for k in range(3)], num_shards=2, rf=3
+            )
+            session = ReplicatedSession(
+                placement, dict(remotes),
+                write_level=ConsistencyLevel.ALL,
+                read_level=ConsistencyLevel.MAJORITY,
+            )
+            ids = [b"cq-%d" % i for i in range(8)]
+            ts = {sid: [T0 + (i + 1) * SEC for i in range(4)]
+                  for sid in ids}
+            for i in range(4):
+                t = np.full(len(ids), T0 + (i + 1) * SEC, np.int64)
+                session.write_batch("default", ids, t,
+                                    np.arange(len(ids), dtype=np.float64) + i,
+                                    now_nanos=T0 + (i + 1) * SEC)
+            for k in range(3):
+                remotes[f"i{k}"].tick(T0 + 2 * BLOCK)  # flush filesets
+
+            # Pick a flushed data file on n2 and byte-flip it; wipe
+            # n2's WAL so local replay CANNOT heal — only peers can.
+            n2root = tmp_path / "n2" / "data"
+            victims = sorted(
+                p for p in n2root.glob("data/default/*/fileset-*-data.db")
+                if p.stat().st_size > 0
+            )
+            assert victims, "no flushed data files on n2"
+            victim = victims[0]
+            shard = int(victim.parent.name)
+            block_start = int(victim.stem.split("-")[1])
+            want_sha = hashlib.sha256(
+                (tmp_path / "n0" / "data" / victim.relative_to(n2root)
+                 ).read_bytes()).hexdigest()
+            assert hashlib.sha256(
+                victim.read_bytes()).hexdigest() == want_sha  # replicas equal
+
+            nodes[2].kill()
+            shutil.rmtree(n2root / "commitlogs", ignore_errors=True)
+            raw = bytearray(victim.read_bytes())
+            raw[len(raw) // 2] ^= 0xFF
+            victim.write_bytes(bytes(raw))
+
+            # (1) clean bootstrap despite the rotten volume on disk
+            nodes[2].start()
+
+            # (2) cluster queries stay correct during degradation: the
+            # corrupt replica degrades per-source (quarantining as it
+            # goes), the healthy replicas fill the union.
+            for i, sid in enumerate(ids):
+                pts = session.fetch("default", sid, T0, T0 + BLOCK)
+                assert pts == [(t, float(i) + k)
+                               for k, t in enumerate(ts[sid])], (sid, pts)
+
+            # A direct read on the degraded node triggered quarantine
+            # for the corrupt (shard, block); make sure we exercised it.
+            sid_hit = next(s for s in ids if shard_for_id(s, 2) == shard)
+            remotes["i2"].read("default", sid_hit, T0, T0 + BLOCK)
+
+            # (3) the volume is in quarantine/ with a reason file
+            reasons = list((n2root / "quarantine").rglob("reason.json"))
+            assert reasons, "quarantine tree not populated"
+            reason = json.loads(reasons[0].read_text())
+            assert reason["namespace"] == "default"
+            assert reason["shard"] == shard
+            assert reason["block_start"] == block_start
+            assert reason["check"] == "digest:data"
+            assert (reasons[0].parent
+                    / f"fileset-{block_start}-0-data.db").exists()
+
+            # /health on the degraded node reports the inventory
+            status = json.loads((n2root / "node.json").read_text())
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{status['port']}/health",
+                    timeout=10) as r:
+                health = json.load(r)
+            assert health["ok"] and health["quarantine"]["entries"] >= 1
+
+            # (4) admin-triggered scrub sweep: peer-assisted repair
+            # restores the block bit-identically from the replicas.
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{status['admin_port']}"
+                "/api/v1/database/scrub",
+                data=b"{}", headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=60) as r:
+                out = json.load(r)
+            assert out["scrub"]["repaired"] >= 1, out
+            assert hashlib.sha256(
+                victim.read_bytes()).hexdigest() == want_sha
+
+            # the healed node answers alone now
+            for i, sid in enumerate(ids):
+                pts = remotes["i2"].read("default", sid, T0, T0 + BLOCK)
+                assert pts == [(t, float(i) + k)
+                               for k, t in enumerate(ts[sid])], (sid, pts)
+
+            # scrub counters are visible on the node's /metrics
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{status['port']}/metrics",
+                    timeout=10) as r:
+                metrics = r.read().decode()
+            assert "m3tpu_scrub_volumes_checked" in metrics
+            assert "m3tpu_scrub_repairs_completed" in metrics
+            assert "m3tpu_db_corruption_quarantined" in metrics
+        finally:
             for r in remotes.values():
                 r.close()
             for nd in nodes:

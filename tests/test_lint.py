@@ -27,7 +27,7 @@ CORPUS = Path(__file__).resolve().parent / "data" / "lint_corpus"
 # permissive scope: every rule applies to the corpus wherever it lives
 PERMISSIVE = Context(dtype_prefixes=("",), wire_prefixes=("",),
                      wire_files=(), fault_helper_files=(),
-                     constant_files=())
+                     constant_files=(), persist_prefixes=("",))
 
 EXPECTED = {
     ("lock_cases.py", "lock-discipline", 22),
@@ -46,6 +46,9 @@ EXPECTED = {
     ("resource_cases.py", "resource-hygiene", 7),
     ("resource_cases.py", "resource-hygiene", 13),
     ("resource_cases.py", "resource-hygiene", 34),
+    ("corruption_cases.py", "corruption-typed", 17),
+    ("corruption_cases.py", "corruption-typed", 23),
+    ("corruption_cases.py", "corruption-typed", 28),
 }
 
 
@@ -73,7 +76,7 @@ class TestCorpus:
             by_rule.setdefault(f.rule, []).append(f)
         for rule in ("lock-discipline", "jit-purity", "explicit-dtype",
                      "wire-exhaustive", "fault-coverage",
-                     "resource-hygiene"):
+                     "resource-hygiene", "corruption-typed"):
             assert len(by_rule.get(rule, [])) >= 2, rule
 
 
