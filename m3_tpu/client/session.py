@@ -26,6 +26,7 @@ import numpy as np
 
 from m3_tpu.cluster.placement import Placement, ShardState
 from m3_tpu.core.hash import shard_for
+from m3_tpu.storage.database import ShardNotOwnedError
 from m3_tpu.storage.series_merge import merge_point_sources
 from m3_tpu.x.retry import Retrier, RetryOptions
 
@@ -99,7 +100,11 @@ class ReplicatedSession:
         self.topology_version = 0
         self._closed = False
         self._retired: List[object] = []
-        self._kv = self._kv_key = self._on_change = None
+        self._kv = self._kv_key = self._on_change = self._resolve = None
+        # Per-replica ShardNotOwnedError responses observed (stale
+        # placement at one end of the conversation): routing misses,
+        # never data errors.  Observable for tests/metrics.
+        self.routing_misses = 0
         # Serializes topology swaps against close(): without it a
         # placement update racing close() could leak fresh handles or
         # close ones just installed as live.
@@ -149,7 +154,7 @@ class ReplicatedSession:
         sess = cls(p, cls._build_conns(p, resolve, {}),
                    write_level, read_level)
         sess.topology_version = vv.version
-        sess._kv, sess._kv_key = kv, key
+        sess._kv, sess._kv_key, sess._resolve = kv, key, resolve
 
         def on_change(v) -> None:
             if sess._closed or v.version <= sess.topology_version:
@@ -186,6 +191,8 @@ class ReplicatedSession:
         with self._swap_mu:
             if self._closed:  # raced close(): don't resurrect handles
                 return
+            if version <= self.topology_version:
+                return  # stale apply (watch and re-fan refresh race)
             old_p, old_conns = self._topo
             conns = self._build_conns(p, resolve, old_conns)
             self._topo = (p, conns)  # atomic swap
@@ -238,7 +245,7 @@ class ReplicatedSession:
 
     # ---- write path (session.go:1213 Write → fan-out + accumulate) ----
 
-    def _fan_out(
+    def _fan_out_once(
         self,
         op: str,
         shard: int,
@@ -257,6 +264,17 @@ class ReplicatedSession:
                 continue
             try:
                 results.append(self.retrier.run(lambda: fn(conn)))
+            except ShardNotOwnedError as e:
+                # Routing miss, not a data error: OUR placement said
+                # this replica owns the shard, THEIRS says otherwise —
+                # somebody is stale.  Counted distinctly so the caller
+                # (_fan_out) knows a topology refresh may still satisfy
+                # the consistency level (reference session retries on
+                # errTryAgain-shaped host errors after a topology
+                # update, client/session.go).
+                with self._swap_mu:  # concurrent fan-outs share the counter
+                    self.routing_misses += 1
+                errors.append(f"{iid}: routing miss ({e})")
             except Exception as e:  # per-replica failure, keep fanning
                 errors.append(f"{iid}: {e}")
         if len(results) < need and level.strict:
@@ -264,6 +282,40 @@ class ReplicatedSession:
         if not results and not level.strict:
             raise ConsistencyError(op, 0, 1, errors)
         return results
+
+    def _fan_out(
+        self,
+        op: str,
+        shard: int,
+        level: ConsistencyLevel,
+        fn: Callable[[object], object],
+        for_read: bool = False,
+    ) -> List[object]:
+        """One fan-out attempt; on a strict consistency failure where
+        the placement moved underneath us (a mark_available cutover
+        racing this very call), refresh the topology ONCE from KV and
+        re-fan before surfacing the error — a write racing a topology
+        change succeeds without the caller retrying (the reference
+        session's topology-watch + queued-op retry, session.go:527)."""
+        version_before = self.topology_version
+        try:
+            return self._fan_out_once(op, shard, level, fn, for_read)
+        except ConsistencyError:
+            if self._kv is None or self._closed:
+                raise
+            try:
+                vv = self._kv.get(self._kv_key)
+            except Exception:  # noqa: BLE001 — a KV hiccup must surface
+                vv = None      # the original consistency failure, not mask it
+            if vv is None or vv.version <= version_before:
+                raise  # nothing newer to route by
+            if vv.version > self.topology_version:
+                # The watch hasn't delivered it yet: apply directly
+                # (idempotent with the watch — _apply_placement drops
+                # stale versions).
+                self._apply_placement(Placement.from_json(vv.data),
+                                      self._resolve, vv.version)
+            return self._fan_out_once(op, shard, level, fn, for_read)
 
     def write_batch(
         self,

@@ -38,6 +38,11 @@ class Instance:
     weight: int = 1
     shards: dict = field(default_factory=dict)  # shard id -> ShardAssignment
     shard_set_id: int = 0  # mirrored placements: same set id => same shards
+    # Data-plane RPC address ("host:port") other nodes dial to stream
+    # this instance's blocks (the reference placement instance's
+    # endpoint field); empty when unknown (in-process tests resolve by
+    # id instead).
+    endpoint: str = ""
 
     def owned(self) -> list[int]:
         return sorted(self.shards)
@@ -89,6 +94,7 @@ class Placement:
                     "isolation_group": inst.isolation_group,
                     "weight": inst.weight,
                     "shard_set_id": inst.shard_set_id,
+                    "endpoint": inst.endpoint,
                     "shards": {
                         str(s): [a.state.value, a.source_id]
                         for s, a in inst.shards.items()
@@ -109,9 +115,21 @@ class Placement:
             }
             insts[iid] = Instance(iid, idata["isolation_group"],
                                   idata["weight"], shards,
-                                  idata.get("shard_set_id", 0))
+                                  idata.get("shard_set_id", 0),
+                                  idata.get("endpoint", ""))
         return cls(insts, d["num_shards"], d["replica_factor"], d["version"],
                    d.get("is_mirrored", False))
+
+
+def _copy_instances(p: Placement) -> dict:
+    """Deep-enough copy for the staged mutation algorithms: fresh
+    Instance objects with fresh shard dicts, every identity field
+    (isolation group, weight, shard set, endpoint) preserved."""
+    return {
+        iid: Instance(i.id, i.isolation_group, i.weight, dict(i.shards),
+                      i.shard_set_id, i.endpoint)
+        for iid, i in p.instances.items()
+    }
 
 
 def _least_loaded(instances: list[Instance], shard: int,
@@ -134,7 +152,8 @@ def initial_placement(instances: list[Instance], num_shards: int,
                       rf: int) -> Placement:
     """reference algo/sharded.go InitialPlacement: spread each shard's RF
     replicas across isolation groups onto the least-loaded instances."""
-    insts = {i.id: Instance(i.id, i.isolation_group, i.weight, {}) for i in instances}
+    insts = {i.id: Instance(i.id, i.isolation_group, i.weight, {},
+                            i.shard_set_id, i.endpoint) for i in instances}
     for s in range(num_shards):
         groups: set[str] = set()
         for _ in range(rf):
@@ -150,11 +169,9 @@ def add_instance(p: Placement, new: Instance) -> Placement:
     """reference algo/sharded.go AddInstance: steal shards from the most
     loaded instances; stolen shards go Initializing on the new instance
     with the donor as source (donor keeps serving until cutover)."""
-    insts = {iid: Instance(i.id, i.isolation_group, i.weight, dict(i.shards),
-                           i.shard_set_id)
-             for iid, i in p.instances.items()}
+    insts = _copy_instances(p)
     newcomer = Instance(new.id, new.isolation_group, new.weight, {},
-                        new.shard_set_id)
+                        new.shard_set_id, new.endpoint)
     insts[new.id] = newcomer
     target = p.num_shards * p.replica_factor // len(insts)
     while len(newcomer.shards) < target:
@@ -170,15 +187,14 @@ def add_instance(p: Placement, new: Instance) -> Placement:
         s = movable[0]
         donor.shards[s] = ShardAssignment(s, ShardState.LEAVING)
         newcomer.shards[s] = ShardAssignment(s, ShardState.INITIALIZING, donor.id)
-    return Placement(insts, p.num_shards, p.replica_factor, p.version + 1)
+    return Placement(insts, p.num_shards, p.replica_factor, p.version + 1,
+                     p.is_mirrored)
 
 
 def remove_instance(p: Placement, instance_id: str) -> Placement:
     """reference algo/sharded.go RemoveInstance: the leaver's shards go
     Initializing on the least-loaded survivors."""
-    insts = {iid: Instance(i.id, i.isolation_group, i.weight, dict(i.shards),
-                           i.shard_set_id)
-             for iid, i in p.instances.items()}
+    insts = _copy_instances(p)
     leaver = insts[instance_id]
     for s in list(leaver.shards):
         a = leaver.shards[s]
@@ -189,32 +205,30 @@ def remove_instance(p: Placement, instance_id: str) -> Placement:
             [i for i in insts.values() if i.id != instance_id], s, groups
         )
         dest.shards[s] = ShardAssignment(s, ShardState.INITIALIZING, instance_id)
-    return Placement(insts, p.num_shards, p.replica_factor, p.version + 1)
+    return Placement(insts, p.num_shards, p.replica_factor, p.version + 1,
+                     p.is_mirrored)
 
 
 def replace_instance(p: Placement, leaving_id: str, new: Instance) -> Placement:
     """reference algo/sharded.go ReplaceInstances: the replacement takes
     exactly the leaver's shards."""
-    insts = {iid: Instance(i.id, i.isolation_group, i.weight, dict(i.shards),
-                           i.shard_set_id)
-             for iid, i in p.instances.items()}
+    insts = _copy_instances(p)
     leaver = insts[leaving_id]
     newcomer = Instance(new.id, new.isolation_group, new.weight, {},
-                        new.shard_set_id)
+                        new.shard_set_id, new.endpoint)
     insts[new.id] = newcomer
     for s, a in list(leaver.shards.items()):
         leaver.shards[s] = ShardAssignment(s, ShardState.LEAVING)
         newcomer.shards[s] = ShardAssignment(s, ShardState.INITIALIZING, leaving_id)
-    return Placement(insts, p.num_shards, p.replica_factor, p.version + 1)
+    return Placement(insts, p.num_shards, p.replica_factor, p.version + 1,
+                     p.is_mirrored)
 
 
 def mark_available(p: Placement, instance_id: str, shard: int) -> Placement:
     """Cutover: Initializing→Available on the target, and the matching
     Leaving shard disappears from its source (reference
     MarkShardsAvailable)."""
-    insts = {iid: Instance(i.id, i.isolation_group, i.weight, dict(i.shards),
-                           i.shard_set_id)
-             for iid, i in p.instances.items()}
+    insts = _copy_instances(p)
     inst = insts[instance_id]
     a = inst.shards.get(shard)
     if a is None or a.state != ShardState.INITIALIZING:
@@ -224,12 +238,45 @@ def mark_available(p: Placement, instance_id: str, shard: int) -> Placement:
         src = insts[a.source_id]
         if shard in src.shards and src.shards[shard].state == ShardState.LEAVING:
             del src.shards[shard]
-    return Placement(insts, p.num_shards, p.replica_factor, p.version + 1)
+    return Placement(insts, p.num_shards, p.replica_factor, p.version + 1,
+                     p.is_mirrored)
+
+
+def forget_instance(p: Placement, instance_id: str) -> Placement:
+    """Drop an instance's entry outright (no staged handoff) — the
+    operator's final delete of a drained/dead instance whose shards are
+    all gone (or a dead leaver whose shards already re-initialized
+    elsewhere via remove_instance).  Refuses while the instance still
+    carries non-Leaving shards: those owners must be moved first."""
+    inst = p.instances.get(instance_id)
+    if inst is None:
+        raise KeyError(f"no instance {instance_id} in placement")
+    live = [s for s, a in inst.shards.items()
+            if a.state != ShardState.LEAVING]
+    if live:
+        raise ValueError(
+            f"instance {instance_id} still owns shards {sorted(live)}; "
+            "remove_instance/replace_instance first"
+        )
+    insts = _copy_instances(p)
+    del insts[instance_id]
+    return Placement(insts, p.num_shards, p.replica_factor, p.version + 1,
+                     p.is_mirrored)
 
 
 class PlacementService:
     """Versioned placement storage over KV (reference
-    placement/service + placement/storage)."""
+    placement/service + placement/storage).
+
+    Every mutation of the placement key MUST go through this class (the
+    m3lint ``placement-cas`` rule gates it): ``update()`` is the
+    get→mutate→CAS loop with bounded retry on version conflicts, so two
+    concurrent admin mutations (or an admin mutation racing a node's
+    ``mark_available`` cutover) serialize instead of one 500ing."""
+
+    #: bounded CAS retries: placement churn is operator-paced, so a
+    #: handful of re-reads always wins unless something is spinning.
+    CAS_ATTEMPTS = 5
 
     def __init__(self, kv: KVStore, key: str = "placement"):
         self.kv = kv
@@ -242,3 +289,24 @@ class PlacementService:
     def set(self, p: Placement) -> None:
         cur = self.kv.get(self.key)
         self.kv.check_and_set(self.key, cur.version if cur else 0, p.to_json())
+
+    def update(self, mutate, max_attempts: int | None = None) -> Placement:
+        """Apply ``mutate(placement | None) -> Placement`` atomically:
+        re-read + re-mutate + CAS, retrying (bounded) when another
+        writer moved the version between our get and our set.  Only the
+        CAS conflict retries — errors raised by ``mutate`` itself
+        (validation, unknown instance...) surface immediately."""
+        attempts = self.CAS_ATTEMPTS if max_attempts is None else max_attempts
+        last: Exception | None = None
+        for _ in range(max(1, attempts)):
+            cur = self.kv.get(self.key)
+            p2 = mutate(Placement.from_json(cur.data) if cur else None)
+            try:
+                self.kv.check_and_set(
+                    self.key, cur.version if cur else 0, p2.to_json())
+                return p2
+            except ValueError as e:
+                if "version conflict" not in str(e):
+                    raise
+                last = e  # another writer won: re-read and re-apply
+        raise last

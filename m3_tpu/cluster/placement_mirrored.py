@@ -81,7 +81,8 @@ def mirrored_initial_placement(instances: list[Instance], num_shards: int,
     carry it), balanced by group load (algo/mirrored.go InitialPlacement
     via the grouped sharded algorithm)."""
     groups = _groups([
-        Instance(i.id, i.isolation_group, i.weight, {}, i.shard_set_id)
+        Instance(i.id, i.isolation_group, i.weight, {}, i.shard_set_id,
+                 i.endpoint)
         for i in instances
     ])
     if not groups:
@@ -104,7 +105,7 @@ def mirrored_initial_placement(instances: list[Instance], num_shards: int,
 def _copy(p: Placement) -> dict[str, Instance]:
     return {
         iid: Instance(i.id, i.isolation_group, i.weight, dict(i.shards),
-                      i.shard_set_id)
+                      i.shard_set_id, i.endpoint)
         for iid, i in p.instances.items()
     }
 
@@ -125,7 +126,7 @@ def mirrored_add_group(p: Placement, new_members: list[Instance]) -> Placement:
     if ssid in {i.shard_set_id for i in insts.values()}:
         raise ValueError(f"shard set {ssid} already present")
     newcomers = [
-        Instance(i.id, i.isolation_group, i.weight, {}, ssid)
+        Instance(i.id, i.isolation_group, i.weight, {}, ssid, i.endpoint)
         for i in sorted(new_members, key=lambda i: i.id)
     ]
     for m in newcomers:
@@ -190,7 +191,8 @@ def mirrored_replace_instance(p: Placement, leaving_id: str,
     ssid = leaver.shard_set_id
     peers = [i for i in insts.values()
              if i.shard_set_id == ssid and i.id != leaving_id]
-    newcomer = Instance(new.id, new.isolation_group, new.weight, {}, ssid)
+    newcomer = Instance(new.id, new.isolation_group, new.weight, {}, ssid,
+                        new.endpoint)
     insts[new.id] = newcomer
     for s, a in list(leaver.shards.items()):
         leaver.shards[s] = ShardAssignment(s, ShardState.LEAVING)

@@ -127,6 +127,13 @@ class DBConfig:
     # file-backed inside this node (single-node deployments).  The
     # reference's etcd endpoint role (client/etcd/client.go).
     kv_endpoint: Optional[str] = None
+    # This node's identity in the cluster placement (the reference's
+    # hostID, config.go HostID resolvers).  With an instance_id set the
+    # node watches the placement key in KV and serves ONLY its assigned
+    # shards — streaming INITIALIZING ones from their donor, cutting
+    # them AVAILABLE, and dropping handed-off ones (see
+    # storage/migration.py).  None keeps the own-every-shard behavior.
+    instance_id: Optional[str] = None
 
     def validate(self, errs: list) -> None:
         if not self.namespaces:
@@ -165,6 +172,14 @@ class MediatorConfig:
     # be a permanent background read load competing with query I/O.
     scrub_every: int = 6
     scrub_volumes: int = 4
+    # Shard-migration cadence: every migrate_every-th tick streams up
+    # to migrate_blocks missing fileset blocks into INITIALIZING shards
+    # (0 = unbudgeted) and advances LEAVING-drop grace countdowns; a
+    # dropped shard's data is deleted migrate_grace_ticks migration
+    # passes after its cutover is observed.
+    migrate_every: int = 1
+    migrate_blocks: int = 4
+    migrate_grace_ticks: int = 2
 
     def validate(self, errs: list) -> None:
         try:
@@ -175,6 +190,12 @@ class MediatorConfig:
             errs.append("mediator.scrub_every: must be >= 1")
         if self.scrub_volumes < 0:
             errs.append("mediator.scrub_volumes: must be >= 0")
+        if self.migrate_every < 1:
+            errs.append("mediator.migrate_every: must be >= 1")
+        if self.migrate_blocks < 0:
+            errs.append("mediator.migrate_blocks: must be >= 0")
+        if self.migrate_grace_ticks < 0:
+            errs.append("mediator.migrate_grace_ticks: must be >= 0")
 
 
 @dataclasses.dataclass

@@ -25,6 +25,7 @@ import numpy as np
 from m3_tpu.index.search import (
     All, Conjunction, FieldExists, Negation, Regexp, Term,
 )
+from m3_tpu.storage.database import ShardNotOwnedError
 
 NAN = float("nan")
 
@@ -296,7 +297,10 @@ class GraphiteStorage:
             p = document_to_path(d)
             if p is None:
                 continue
-            pts = self.db.read(self.namespace, d.id, start, end)
+            try:
+                pts = self.db.read(self.namespace, d.id, start, end)
+            except ShardNotOwnedError:
+                continue  # unowned shard: replicas answer it
             vals = np.full(T, NAN)
             for t, v in pts:  # last point per bucket wins (consolidation)
                 b = (t - start) // step

@@ -15,7 +15,7 @@ from m3_tpu.index.search import (
 )
 from m3_tpu.query.block import RawBlock, SeriesMeta
 from m3_tpu.query.promql import LabelMatcher
-from m3_tpu.storage.database import Database
+from m3_tpu.storage.database import Database, ShardNotOwnedError
 
 
 def matchers_to_query(name: bytes | None,
@@ -57,7 +57,16 @@ class DatabaseStorage:
         pts = []
         metas = []
         for d in docs:
-            pts.append(self.db.read(self.namespace, d.id, start_nanos, end_nanos))
+            try:
+                pts.append(
+                    self.db.read(self.namespace, d.id, start_nanos, end_nanos))
+            except ShardNotOwnedError:
+                # "Reads answer only owned shards": the index still
+                # knows series whose shard the placement moved away —
+                # a local query answers from what this node owns, and
+                # the cluster-level union comes from the session's
+                # replica fan-out, not from this handle.
+                continue
             metas.append(SeriesMeta(tuple(sorted(d.tags().items()))))
         return RawBlock.from_lists(pts, metas)
 

@@ -10,12 +10,30 @@ over the KV control plane.  Routes:
     GET/DELETE        /api/v1/services/m3db/placement
     POST              /api/v1/services/m3db/placement/init
     POST              /api/v1/services/m3db/placement          (add instance)
+    POST              /api/v1/services/m3db/placement/replace  (body
+                      {"leaving_id": ..., "instance": {...}}: the
+                      newcomer takes the leaver's shards INITIALIZING,
+                      streaming from it — the rolling node-replace verb)
+    DELETE            /api/v1/services/m3db/placement/<instance_id>
+                      (staged remove_instance while the instance still
+                      owns shards; outright forget once it is drained —
+                      also the dead-leaver cleanup)
+    POST              /api/v1/topology/migrate                 (run one
+                      shard-migration pass in-process now, instead of
+                      waiting for the mediator tick)
+    GET               /api/v1/topology/status                  (the same
+                      migration-progress document /health embeds)
     GET/POST          /api/v1/topic
     GET/PUT           /api/v1/runtime                          (options)
     POST              /api/v1/database/scrub                   (on-demand
                       corruption sweep + peer repair; body optionally
                       {"budget": N volumes (0 = whole disk, the default),
                        "repair": bool})
+
+Every placement mutation goes through ``PlacementService.update`` — a
+get→mutate→CAS loop with bounded retry on version conflict, so two
+concurrent admin calls (or an admin call racing a node's cutover CAS)
+both land instead of one 500ing.
 """
 
 from __future__ import annotations
@@ -28,7 +46,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from m3_tpu.cluster.kv import KVStore
 from m3_tpu.cluster.namespace_registry import NamespaceMeta, NamespaceRegistry
 from m3_tpu.cluster.placement import (
-    Instance, PlacementService, add_instance, initial_placement,
+    Instance, PlacementService, add_instance, forget_instance,
+    initial_placement, remove_instance, replace_instance,
 )
 from m3_tpu.core.runtime_options import RuntimeOptionsManager
 from m3_tpu.msg.bus import ConsumerService, ConsumptionType, Topic, TopicService
@@ -56,7 +75,8 @@ def _parse_dur_nanos(s) -> int:
 
 
 class AdminContext:
-    def __init__(self, kv: KVStore, db=None, aggregator=None, scrubber=None):
+    def __init__(self, kv: KVStore, db=None, aggregator=None, scrubber=None,
+                 migrator=None):
         self.kv = kv
         self.namespaces = NamespaceRegistry(kv)
         self.placements = PlacementService(kv)
@@ -64,8 +84,16 @@ class AdminContext:
         self.runtime = RuntimeOptionsManager(kv)
         self.aggregator = aggregator
         self.scrubber = scrubber
+        self.migrator = migrator  # storage.migration.ShardMigrator | None
         if db is not None:
             self.namespaces.attach(db)
+
+
+def _parse_instance(body: dict) -> Instance:
+    return Instance(body["id"], body.get("isolation_group", ""),
+                    body.get("weight", 1),
+                    shard_set_id=body.get("shard_set_id", 0),
+                    endpoint=body.get("endpoint", ""))
 
 
 class _AdminHandler(BaseHTTPRequestHandler):
@@ -112,6 +140,12 @@ class _AdminHandler(BaseHTTPRequestHandler):
                 return self._json(200, json.loads(t.to_json()))
             if path == "/api/v1/runtime":
                 return self._json(200, self.ctx.runtime.snapshot())
+            if path == "/api/v1/topology/status":
+                if self.ctx.migrator is None:
+                    return self._json(
+                        404, {"error": "no shard migrator in this process "
+                              "(db.instance_id not configured)"})
+                return self._json(200, {"topology": self.ctx.migrator.status()})
             if path == "/api/v1/aggregator/status":
                 # Engine operational counters incl. forwarded-tail
                 # conflicts (the reference aggregator httpd's /status
@@ -135,63 +169,92 @@ class _AdminHandler(BaseHTTPRequestHandler):
                 self.ctx.namespaces.add(meta)
                 return self._json(200, dataclasses.asdict(meta))
             if path == "/api/v1/services/m3db/placement/init":
-                instances = [
-                    Instance(i["id"], i.get("isolation_group", ""),
-                             i.get("weight", 1),
-                             shard_set_id=i.get("shard_set_id", 0))
-                    for i in body["instances"]
-                ]
-                if body.get("mirrored", False):
-                    # Aggregator-style HA placement (algo/mirrored.go):
-                    # shard sets of RF instances sharing identical shards.
-                    from m3_tpu.cluster.placement_mirrored import (
-                        mirrored_initial_placement,
+                instances = [_parse_instance(i) for i in body["instances"]]
+
+                def init_mutate(cur):
+                    if cur is not None:
+                        raise ValueError(
+                            "placement already exists; DELETE it first")
+                    if body.get("mirrored", False):
+                        # Aggregator-style HA placement (algo/mirrored.go):
+                        # shard sets of RF instances sharing identical
+                        # shards.
+                        from m3_tpu.cluster.placement_mirrored import (
+                            mirrored_initial_placement,
+                        )
+
+                        return mirrored_initial_placement(
+                            instances, body.get("num_shards", 64),
+                            body.get("rf", 3),
+                        )
+                    return initial_placement(
+                        instances, body.get("num_shards", 64),
+                        body.get("rf", 3),
                     )
 
-                    p = mirrored_initial_placement(
-                        instances, body.get("num_shards", 64),
-                        body.get("rf", 3),
-                    )
-                else:
-                    p = initial_placement(
-                        instances, body.get("num_shards", 64),
-                        body.get("rf", 3),
-                    )
-                self.ctx.placements.set(p)
+                p = self.ctx.placements.update(init_mutate)
                 return self._json(200, json.loads(p.to_json()))
             if path == "/api/v1/services/m3db/placement":
-                p = self.ctx.placements.get()
-                if p is None:
+                if self.ctx.placements.get() is None:
+                    # 404, not 400: the resource is missing (run init),
+                    # the request body may be perfectly fine
                     return self._json(404, {"error": "no placement; init first"})
-                if p.is_mirrored:
-                    # Mirrored placements grow by whole shard sets of RF
-                    # instances (algo/mirrored.go AddInstances); a solo
-                    # add would break the mirror invariant.
-                    insts = body.get("instances")
-                    if not insts:
-                        return self._json(400, {
-                            "error": "mirrored placement: POST "
-                            "{'instances': [RF members sharing a new "
-                            "shard_set_id]}"})
-                    from m3_tpu.cluster.placement_mirrored import (
-                        mirrored_add_group,
-                    )
 
-                    group = [
-                        Instance(i["id"], i.get("isolation_group", ""),
-                                 i.get("weight", 1),
-                                 shard_set_id=i["shard_set_id"])
-                        for i in insts
-                    ]
-                    p2 = mirrored_add_group(p, group)
-                else:
-                    inst = Instance(body["id"],
-                                    body.get("isolation_group", ""),
-                                    body.get("weight", 1),
-                                    shard_set_id=body.get("shard_set_id", 0))
-                    p2 = add_instance(p, inst)
-                self.ctx.placements.set(p2)
+                def add_mutate(p):
+                    if p is None:
+                        raise KeyError("no placement; init first")
+                    if p.is_mirrored:
+                        # Mirrored placements grow by whole shard sets
+                        # of RF instances (algo/mirrored.go
+                        # AddInstances); a solo add would break the
+                        # mirror invariant.
+                        insts = body.get("instances")
+                        if not insts:
+                            raise ValueError(
+                                "mirrored placement: POST {'instances': "
+                                "[RF members sharing a new shard_set_id]}")
+                        from m3_tpu.cluster.placement_mirrored import (
+                            mirrored_add_group,
+                        )
+
+                        group = [_parse_instance(dict(i, shard_set_id=i[
+                            "shard_set_id"])) for i in insts]
+                        return mirrored_add_group(p, group)
+                    return add_instance(p, _parse_instance(body))
+
+                p2 = self.ctx.placements.update(add_mutate)
                 return self._json(200, json.loads(p2.to_json()))
+            if path == "/api/v1/services/m3db/placement/replace":
+                # Rolling node replace (algo ReplaceInstances): the
+                # newcomer takes exactly the leaver's shards
+                # INITIALIZING with a streaming source; node-side
+                # migrators do the rest.  Mirrored placements use the
+                # mirror-preserving variant (the newcomer streams from
+                # the SURVIVING mirror, algo/mirrored.go).
+                if self.ctx.placements.get() is None:
+                    return self._json(404, {"error": "no placement; init first"})
+                new = _parse_instance(body["instance"])
+                leaving = body["leaving_id"]
+
+                def replace_mutate(p):
+                    if p is None:
+                        raise KeyError("no placement; init first")
+                    if p.is_mirrored:
+                        from m3_tpu.cluster.placement_mirrored import (
+                            mirrored_replace_instance,
+                        )
+
+                        return mirrored_replace_instance(p, leaving, new)
+                    return replace_instance(p, leaving, new)
+
+                p2 = self.ctx.placements.update(replace_mutate)
+                return self._json(200, json.loads(p2.to_json()))
+            if path == "/api/v1/topology/migrate":
+                if self.ctx.migrator is None:
+                    return self._json(
+                        404, {"error": "no shard migrator in this process "
+                              "(db.instance_id not configured)"})
+                return self._json(200, {"migrate": self.ctx.migrator.tick()})
             if path == "/api/v1/database/create":
                 # One-call bring-up (reference handler/database/create.go):
                 # namespace with a retention-recommended block size, plus a
@@ -211,9 +274,15 @@ class _AdminHandler(BaseHTTPRequestHandler):
                 if (body.get("type", "local") == "local"
                         and self.ctx.placements.get() is None):
                     host = body.get("hostID", "m3db_local")
-                    p = initial_placement(
-                        [Instance(host)], num_shards=meta.num_shards, rf=1)
-                    self.ctx.placements.set(p)
+
+                    def local_mutate(cur):
+                        if cur is not None:
+                            return cur  # raced another create: keep it
+                        return initial_placement(
+                            [Instance(host)], num_shards=meta.num_shards,
+                            rf=1)
+
+                    p = self.ctx.placements.update(local_mutate)
                     placement_out = json.loads(p.to_json())
                 return self._json(200, {
                     "namespace": dataclasses.asdict(meta),
@@ -281,7 +350,39 @@ class _AdminHandler(BaseHTTPRequestHandler):
             if path == "/api/v1/services/m3db/placement":
                 self.ctx.kv.delete(self.ctx.placements.key)
                 return self._json(200, {"deleted": "placement"})
+            if path.startswith("/api/v1/services/m3db/placement/"):
+                # Instance removal: staged (remove_instance — shards go
+                # INITIALIZING on survivors, streaming from the leaver)
+                # while the instance still owns live shards; outright
+                # forget once it is drained/empty — which also covers a
+                # dead leaver whose shards were already re-homed.
+                iid = path.rsplit("/", 1)[1]
+
+                def rm_mutate(p):
+                    if p is None:
+                        raise KeyError("no placement")
+                    if iid not in p.instances:
+                        raise KeyError(f"no instance {iid}")
+                    try:
+                        # drained/dead-leaver entry: drop it outright
+                        # (forget_instance owns the live-shard guard)
+                        return forget_instance(p, iid)
+                    except ValueError:
+                        if p.is_mirrored:
+                            # removing one loaded member would break the
+                            # shard-set mirror invariant; the mirror
+                            # verbs operate on whole groups
+                            raise ValueError(
+                                "mirrored placement: replace the member "
+                                "(POST .../placement/replace) or remove "
+                                "its whole shard set")
+                        return remove_instance(p, iid)
+
+                p2 = self.ctx.placements.update(rm_mutate)
+                return self._json(200, json.loads(p2.to_json()))
             return self._json(404, {"error": f"unknown path {path}"})
+        except KeyError as e:
+            return self._json(404, {"error": str(e)})
         except Exception as e:  # noqa: BLE001
             return self._json(400, {"error": str(e)})
 

@@ -35,6 +35,8 @@ class Assembly:
     rpc_server: object | None = None
     peer_handles: list = dataclasses.field(default_factory=list)
     scrubber: object | None = None
+    topology: object | None = None   # cluster.topology.TopologyWatcher
+    migrator: object | None = None   # storage.migration.ShardMigrator
 
     @property
     def port(self) -> int | None:
@@ -69,11 +71,58 @@ class Assembly:
             self.http_server.server_close()
         if self.mediator is not None:
             self.mediator.close()
+        if self.migrator is not None:
+            self.migrator.close()
+        if self.topology is not None:
+            self.topology.close()
         # the KV client closes only after every server that used it is
         # down — a racing admin request must not reconnect a closed store
         if self.kv is not None and hasattr(self.kv, "close"):
             self.kv.close()
         self.db.close()
+
+    def drain(self, handoff_timeout_s: float = 60.0) -> None:
+        """True SIGTERM drain (the reference dbnode's graceful shutdown
+        discipline): stop taking ingest → persist what we hold → wait
+        for any LEAVING shards to cut over to their new owners → tear
+        down.  The RPC listener stays up until the very end so peers
+        can stream this node's blocks throughout the handoff window.
+
+        Idempotent-ish with close(): the servers stopped here are
+        nulled so close() skips them."""
+        import time as _time
+
+        from m3_tpu.instrument import logger as _logger
+
+        log = _logger("server.assembly")
+        for attr in ("carbon_server", "http_server"):
+            srv = getattr(self, attr)
+            if srv is not None:
+                srv.shutdown()
+                srv.server_close()
+                setattr(self, attr, None)
+        if self.mediator is not None:
+            self.mediator.close()
+            self.mediator = None
+        # Persist everything persistable: seal+flush whatever left the
+        # warm window, snapshot the still-open buffers, rotate the WAL
+        # — a restart replays cleanly AND peers can stream every
+        # flushed block.  (The active warm block cannot become a
+        # fileset early; replicas + the snapshot cover it.)
+        try:
+            now = _time.time_ns()
+            self.db.tick(now)
+            self.db.snapshot()
+        except Exception:  # noqa: BLE001 — drain must reach close()
+            log.exception("drain: final flush/snapshot failed")
+        if self.migrator is not None:
+            if not self.migrator.wait_handed_off(handoff_timeout_s):
+                log.warning(
+                    "drain: handoff incomplete after %.0fs "
+                    "(LEAVING shards remain; replicas will repair)",
+                    handoff_timeout_s,
+                )
+        self.close()
 
 
 def namespace_options(ns_cfg) -> NamespaceOptions:
@@ -151,13 +200,51 @@ def run_node(source, start_mediator: bool | None = None,
     # mediator thread or bound HTTP socket.
     asm = Assembly(cfg, registry, db, None, None, None, tracer)
     try:
+        # Control plane FIRST: the topology watcher must install this
+        # node's shard ownership before bootstrap so WAL replay and the
+        # peers pass are placement-scoped from the very first byte.
+        need_kv = (
+            cfg.db.kv_endpoint is not None
+            or cfg.db.instance_id is not None
+            or (cfg.coordinator is not None
+                and cfg.coordinator.admin_listen_port is not None)
+        )
+        if need_kv:
+            if cfg.db.kv_endpoint:
+                # shared external control plane (etcd role) — survives
+                # this node and is visible to every replica
+                from m3_tpu.cluster.kv_remote import RemoteKVStore
+
+                h, _, p = cfg.db.kv_endpoint.rpartition(":")
+                asm.kv = RemoteKVStore((h, int(p)))
+            else:
+                from m3_tpu.cluster.kv import KVStore
+
+                asm.kv = KVStore(cfg.db.root)  # file-backed control plane
+        if cfg.db.instance_id is not None and asm.kv is not None:
+            from m3_tpu.cluster.placement import PlacementService
+            from m3_tpu.cluster.topology import TopologyWatcher
+            from m3_tpu.storage.migration import ShardMigrator
+
+            asm.topology = TopologyWatcher(asm.kv, cfg.db.instance_id)
+            asm.migrator = ShardMigrator(
+                db, asm.topology, PlacementService(asm.kv),
+                stream_blocks_per_tick=cfg.mediator.migrate_blocks,
+                grace_ticks=cfg.mediator.migrate_grace_ticks,
+                instrument=scope,
+            )
+
         db.bootstrap()
 
         # Wire peers bootstrap: after local fs+commitlog recovery, pull
         # any (shard, block) filesets a replica peer has that this node
         # lacks, over the socket RPC (the bootstrap chain's final
         # `peers` stage — bootstrapper/peers/source.go).  Unreachable
-        # peers are skipped; repair converges them later.
+        # peers are skipped; repair converges them later.  With a
+        # topology watcher installed the pass is scoped to
+        # placement-owned shards (peers_bootstrap reads the ownership
+        # the watcher installed) — a restarting node pulls its shards,
+        # never every peer's full dataset.
         if cfg.db.peers:
             from m3_tpu.server.rpc import RemoteDatabase
 
@@ -199,6 +286,8 @@ def run_node(source, start_mediator: bool | None = None,
                 scrubber=(asm.scrubber
                           if cfg.mediator.scrub_volumes > 0 else None),
                 scrub_every=cfg.mediator.scrub_every,
+                migrator=asm.migrator,
+                migrate_every=cfg.mediator.migrate_every,
                 instrument=scope,
             )
             asm.mediator.open()
@@ -214,6 +303,7 @@ def run_node(source, start_mediator: bool | None = None,
             ctx = ApiContext(
                 db, namespace=cfg.coordinator.namespace, registry=registry,
                 downsampler=downsampler, tracer=tracer,
+                migrator=asm.migrator,
             )
             asm.http_server = serve_background(
                 ctx, cfg.coordinator.listen_host, cfg.coordinator.listen_port
@@ -240,7 +330,18 @@ def run_node(source, start_mediator: bool | None = None,
                         return
                     docs = [docs[i] for i in idx]
                     ts, vals = ts[idx], vals[idx]
-                db.write_tagged_batch(ns_name, docs, ts, vals)
+                from m3_tpu.storage.database import ShardNotOwnedError
+
+                try:
+                    db.write_tagged_batch(ns_name, docs, ts, vals)
+                except ShardNotOwnedError:
+                    # Placement-scoped node fed carbon traffic for
+                    # shards it does not own: carbon has no ack channel
+                    # to push back on, and the connection thread must
+                    # survive (mixed batches partial-accept inside
+                    # write_batch; only an ALL-unowned flush lands
+                    # here).  Counted via db's shard_not_owned.
+                    pass
 
             asm.carbon_server = serve_carbon_background(
                 carbon_sink,
@@ -249,21 +350,13 @@ def run_node(source, start_mediator: bool | None = None,
             )
         if (serve_http and cfg.coordinator is not None
                 and cfg.coordinator.admin_listen_port is not None):
-            from m3_tpu.cluster.kv import KVStore
             from m3_tpu.server.admin_api import (
                 AdminContext, serve_admin_background,
             )
 
-            if cfg.db.kv_endpoint:
-                # shared external control plane (etcd role) — survives
-                # this node and is visible to every replica
-                from m3_tpu.cluster.kv_remote import RemoteKVStore
-
-                h, _, p = cfg.db.kv_endpoint.rpartition(":")
-                asm.kv = RemoteKVStore((h, int(p)))
-            else:
-                asm.kv = KVStore(cfg.db.root)  # file-backed control plane
-            admin_ctx = AdminContext(asm.kv, db, scrubber=asm.scrubber)
+            # asm.kv was built up front (the topology watcher shares it)
+            admin_ctx = AdminContext(asm.kv, db, scrubber=asm.scrubber,
+                                     migrator=asm.migrator)
             # live-tune query limits + cache budget through runtime
             # options (runtime_options_manager.go's role)
             def _limit_applier(lim):

@@ -23,7 +23,7 @@ from m3_tpu.index.doc import Document
 from m3_tpu.index.search import All, FieldExists, Term
 from m3_tpu.query.engine import Engine
 from m3_tpu.query.storage_adapter import DatabaseStorage
-from m3_tpu.storage.database import Database
+from m3_tpu.storage.database import Database, ShardNotOwnedError
 from m3_tpu.storage.limits import QueryLimitExceeded
 
 _DUR_RE = re.compile(r"^(\d+(?:\.\d+)?)([smhdwy]|ms)$")
@@ -144,6 +144,15 @@ class _Handler(BaseHTTPRequestHandler):
                     for e in inv[:50]
                 ],
             }
+        # Topology/migration visibility: which shards this node serves
+        # per the watched placement, per-shard streaming progress of
+        # INITIALIZING ones, and pending grace-period drops — the
+        # operator's window into a rolling node add/replace/remove.
+        if self.ctx.migrator is not None:
+            try:
+                out["topology"] = self.ctx.migrator.status()
+            except Exception:  # noqa: BLE001 — health must never 500
+                pass
         return self._json(200, out)
 
     def _debug_dump(self, q):
@@ -241,7 +250,7 @@ class _Handler(BaseHTTPRequestHandler):
                 docs, np.asarray(ts, np.int64), np.asarray(vals)
             )
         idx = np.nonzero(keep)[0]
-        rejected = 0
+        rejected = not_owned = 0
         if len(idx):
             res = ctx.db.write_tagged_batch(
                 ctx.namespace,
@@ -250,7 +259,11 @@ class _Handler(BaseHTTPRequestHandler):
                 np.asarray(vals)[idx],
             )
             rejected = getattr(res, "rejected", 0)
-        return int(len(idx)) - rejected, rejected
+            # samples whose shard this node does not own (placement-
+            # scoped node fed directly): dropped, not written — the
+            # correct ingest path for a scoped cluster is the session
+            not_owned = getattr(res, "not_owned", 0)
+        return int(len(idx)) - rejected - not_owned, rejected
 
     def _prom_remote_write(self):
         """Prometheus remote write: snappy+protobuf WriteRequest
@@ -313,8 +326,11 @@ class _Handler(BaseHTTPRequestHandler):
                                     q.start_nanos, end)
             series_out = []
             for d in sorted(docs, key=lambda d: d.id):
-                pts = ctx.db.read(ctx.namespace, d.id,
-                                  q.start_nanos, end)
+                try:
+                    pts = ctx.db.read(ctx.namespace, d.id,
+                                      q.start_nanos, end)
+                except ShardNotOwnedError:
+                    continue  # unowned shard: replicas answer it
                 series_out.append(PromTimeSeries(d.tags(), list(pts)))
             results.append(series_out)
         body = build_read_response(results)
@@ -437,12 +453,14 @@ def _fmt(v: float) -> str:
 
 class ApiContext:
     def __init__(self, db: Database, namespace: str = "default",
-                 downsampler=None, registry=None, tracer=None):
+                 downsampler=None, registry=None, tracer=None,
+                 migrator=None):
         self.db = db
         self.namespace = namespace
         self.downsampler = downsampler
         self.registry = registry
         self.tracer = tracer
+        self.migrator = migrator  # storage.migration.ShardMigrator | None
         self.engine = Engine(DatabaseStorage(db, namespace), tracer=tracer)
         from m3_tpu.query.graphite import GraphiteEngine, GraphiteStorage
 

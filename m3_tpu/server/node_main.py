@@ -59,8 +59,16 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, _term)
     signal.signal(signal.SIGINT, _term)
     stop.wait()
-    log.info("node shutting down")
-    asm.close()
+    # SIGTERM is a true drain, not a fast exit: stop the ingest front
+    # doors, flush/snapshot everything persistable, wait (bounded) for
+    # any LEAVING shards to cut over to their new owners, then close —
+    # the RPC listener serves peer streams until the very end.  The
+    # M3_DRAIN_TIMEOUT_S env knob bounds the handoff wait (dtest
+    # harnesses shrink it; operators may extend it for big handoffs).
+    log.info("node draining")
+    asm.drain(handoff_timeout_s=float(
+        os.environ.get("M3_DRAIN_TIMEOUT_S", "60")))
+    log.info("node shut down")
     status_path.unlink(missing_ok=True)
     return 0
 

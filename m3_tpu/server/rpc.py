@@ -32,6 +32,7 @@ recursive binary form (`_enc_query`).
 
 from __future__ import annotations
 
+import re
 import socket
 import socketserver
 import struct
@@ -61,6 +62,28 @@ class RemoteError(RuntimeError):
     repair catch it per replica and demote the handle instead of
     aborting (reference: per-host fetch failures in
     src/dbnode/storage/repair.go:115-246 fail only that host)."""
+
+
+# ShardNotOwnedError crosses the wire TYPED (not as a generic
+# RemoteError): the session must tell "your placement is stale, refresh
+# and re-route" apart from "the data operation failed".  The server side
+# encodes it like any error (type name prefix); the client re-raises the
+# real class, parsing namespace/shard back out of the stable message.
+_SHARD_NOT_OWNED_RE = re.compile(
+    r"shard (\d+) not owned by this node \(namespace '([^']*)'\)"
+)
+
+
+def _decode_remote_error(msg: str):
+    """RPC_ERR payload → the exception to raise client-side."""
+    if msg.startswith("ShardNotOwnedError:"):
+        from m3_tpu.storage.database import ShardNotOwnedError
+
+        m = _SHARD_NOT_OWNED_RE.search(msg)
+        if m:
+            return ShardNotOwnedError(m.group(2), int(m.group(1)))
+        return ShardNotOwnedError(None, None)
+    return RemoteError(msg)
 
 # methods
 M_WRITE_BATCH = 1
@@ -393,7 +416,7 @@ class RemoteDatabase:
                 raise ConnectionError(f"rpc {self.address}: connection closed")
         ftype, payload = frame
         if ftype == RPC_ERR:
-            raise RemoteError(payload.decode(errors="replace"))
+            raise _decode_remote_error(payload.decode(errors="replace"))
         if ftype != RPC_OK:
             # _drop mutates the connection — retake the lock (the frame
             # was already read; another caller may be mid-_call).

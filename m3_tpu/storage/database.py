@@ -81,6 +81,23 @@ class DatabaseOptions:
     write_new_series_limit_per_sec: float = 0.0
 
 
+class ShardNotOwnedError(RuntimeError):
+    """A write or read addressed a shard this node does not own under
+    the current placement (reference dbnode's per-shard state check in
+    `storage/shard.go` — writes to a shard the topology moved away are
+    errors, not silent drops).  Wire-mapped by server/rpc.py so a
+    remote caller gets the SAME typed error; the replicated session
+    counts it as a per-replica routing miss (stale placement) and
+    refreshes its topology, never as a data error."""
+
+    def __init__(self, namespace: str | None, shard: int | None):
+        super().__init__(
+            f"shard {shard} not owned by this node (namespace {namespace!r})"
+        )
+        self.namespace = namespace
+        self.shard = shard
+
+
 class WriteResult(int):
     """Cold-write count (plain int for back-compat) carrying the typed
     ingest-rejection info: ``rejected`` = samples dropped because their
@@ -90,10 +107,16 @@ class WriteResult(int):
 
     rejected: int
     accepted = None
+    # Samples dropped because their shard is not owned under the
+    # current placement (mixed direct-ingest batches only — an
+    # ALL-unowned batch raises ShardNotOwnedError instead, which is
+    # what the per-shard session fan-out sees).
+    not_owned: int
 
-    def __new__(cls, ncold: int, rejected: int = 0):
+    def __new__(cls, ncold: int, rejected: int = 0, not_owned: int = 0):
         obj = super().__new__(cls, ncold)
         obj.rejected = rejected
+        obj.not_owned = not_owned
         return obj
 
 
@@ -428,7 +451,17 @@ class Namespace:
                   corruption_cb=corruption_cb)
             for i in range(opts.num_shards)
         ]
+        # Placement-driven ownership: None = own every shard (the
+        # single-node / no-placement default, bit-compatible with the
+        # pre-topology behavior); a set restricts writes AND reads to
+        # exactly those shards — everything else raises the typed
+        # ShardNotOwnedError (reference dbnode shard state gating).
+        self.owned: frozenset | None = None
         self.index = NamespaceIndex(opts.block_size_nanos, root, name)
+
+    def check_owned(self, shard: int) -> None:
+        if self.owned is not None and shard not in self.owned:
+            raise ShardNotOwnedError(self.name, shard)
 
     def write_tagged_batch(self, docs: Sequence[Document], ts: np.ndarray,
                            vals: np.ndarray, now_nanos: int) -> int:
@@ -458,10 +491,28 @@ class Namespace:
         by_shard: Dict[int, List[int]] = {}
         for i, sid in enumerate(ids):
             by_shard.setdefault(shard_for_id(sid, self.opts.num_shards), []).append(i)
-        ncold = nrej = 0
+        # Ownership gate BEFORE any shard buffers a sample.  An
+        # ALL-unowned batch rejects atomically with the typed error —
+        # the session fans single-shard sub-batches, so that maps to
+        # one routing miss.  A MIXED direct-ingest batch (carbon/HTTP
+        # front doors hash one flush across many shards) must NOT lose
+        # its owned samples to one stray id: owned shards land, the
+        # unowned remainder is dropped into the accepted mask like a
+        # limiter rejection (counted as ``not_owned``; never
+        # WAL-logged, never indexed).
+        owned_set = self.owned
+        unowned = ([] if owned_set is None
+                   else sorted(sh for sh in by_shard if sh not in owned_set))
+        if unowned and len(unowned) == len(by_shard):
+            raise ShardNotOwnedError(self.name, unowned[0])
+        ncold = nrej = ndropped = 0
         full = np.ones(len(ids), bool)
         for sh, idxs in by_shard.items():
             sel = np.asarray(idxs)
+            if owned_set is not None and sh not in owned_set:
+                full[sel] = False
+                ndropped += len(idxs)
+                continue
             res = self.shards[sh].write_batch(
                 [ids[i] for i in idxs], ts[sel], vals[sel], now_nanos
             )
@@ -469,8 +520,8 @@ class Namespace:
             nrej += res.rejected
             if res.accepted is not None:
                 full[sel] = res.accepted
-        out = WriteResult(ncold, nrej)
-        if nrej:
+        out = WriteResult(ncold, nrej, ndropped)
+        if nrej or ndropped:
             out.accepted = full
         return out
 
@@ -479,7 +530,9 @@ class Namespace:
         return sum(sh.new_series_rejected for sh in self.shards)
 
     def read(self, sid: bytes, start: int, end: int) -> list[tuple[int, float]]:
-        return self.shards[shard_for_id(sid, self.opts.num_shards)].read(sid, start, end)
+        shard = shard_for_id(sid, self.opts.num_shards)
+        self.check_owned(shard)
+        return self.shards[shard].read(sid, start, end)
 
     def tick(self, now_nanos: int) -> dict:
         """Seal + warm-flush every open block that has left the warm
@@ -546,6 +599,9 @@ class Database:
         self.commitlog = (
             CommitLogWriter(self.opts.root) if self.opts.commitlog_enabled else None
         )
+        # (num_shards, owned) the topology watcher last installed:
+        # inherited by namespaces created later (see ensure_namespace).
+        self._ownership_template: tuple | None = None
         self.bootstrapped = False
 
     def _note_corruption(self, namespace: str, shard: int, block_start: int,
@@ -576,6 +632,70 @@ class Database:
                 block_start, volume, err
             )
 
+    # ---- placement-driven shard ownership -------------------------------
+
+    def set_ownership_template(self, num_shards: int,
+                               owned: Iterable[int] | None) -> None:
+        """Ownership applied to namespaces created AFTER the placement
+        was observed (dynamic namespace add, downsampler
+        ensure_namespace): a new namespace sharing the placement's
+        shard space must start placement-scoped, not own-all — without
+        this it would silently bypass the ownership invariant until the
+        next placement version bump."""
+        with self._mu:
+            self._ownership_template = (
+                int(num_shards), None if owned is None else frozenset(owned))
+
+    def set_shard_ownership(self, namespace: str | None,
+                            owned: Iterable[int] | None) -> None:
+        """Install the placement-derived shard set this node serves
+        (None = own everything, the no-placement default).  Applies to
+        one namespace, or to every namespace when ``namespace`` is None
+        (the topology watcher's shape: one placement governs the node).
+        Takes effect atomically under the engine lock — a mid-batch
+        ingest either wholly precedes or wholly follows the swap."""
+        with self._mu:
+            targets = (self.namespaces.values() if namespace is None
+                       else [self.namespaces[namespace]])
+            for ns in targets:
+                ns.owned = None if owned is None else frozenset(owned)
+
+    def owned_shards(self, namespace: str) -> frozenset | None:
+        ns = self.namespaces[namespace]
+        return ns.owned
+
+    def drop_shard(self, namespace: str, shard_id: int) -> int:
+        """Discard one shard's local state: every fileset volume on
+        disk, the in-memory buffers/slots, and cached blocks — the
+        post-cutover cleanup of a LEAVING shard (reference dbnode
+        closes and deletes shards the topology moved away).  Returns
+        the number of fileset volumes removed.  The caller (migrator)
+        is responsible for grace: by the time this runs, ownership has
+        already been revoked and clients re-routed."""
+        with self._mu:
+            ns = self.namespaces[namespace]
+            sh = ns.shards[shard_id]
+            removed = 0
+            for bs, vol in list_fileset_volumes(self.opts.root, namespace,
+                                                shard_id):
+                remove_fileset(self.opts.root, namespace, shard_id, bs, vol)
+                self.block_cache.invalidate_block(namespace, shard_id, bs)
+                removed += 1
+            # A fresh Shard starts empty (the fileset scan above left
+            # nothing) — buffers, slots and flushed-block bookkeeping
+            # all reset in one swap.
+            ns.shards[shard_id] = Shard(
+                namespace, shard_id, ns.opts, self.opts.root,
+                self.block_cache,
+                new_series_limiter=self.new_series_limiter,
+                corruption_cb=self._note_corruption,
+            )
+            _LOG.info("dropped shard ns=%s shard=%d (%d fileset volumes)",
+                      namespace, shard_id, removed)
+            if self._scope is not None:
+                self._scope.counter("shards_dropped").inc()
+            return removed
+
     def ensure_namespace(self, name: str,
                          opts: NamespaceOptions | None = None) -> Namespace:
         """Create-if-missing (the reference adds namespaces dynamically
@@ -590,6 +710,9 @@ class Database:
                     new_series_limiter=self.new_series_limiter,
                     corruption_cb=self._note_corruption,
                 )
+                tpl = self._ownership_template
+                if tpl is not None and tpl[0] == ns.opts.num_shards:
+                    ns.owned = tpl[1]  # placement-scoped from birth
             return ns
 
     def write_batch(self, namespace: str, ids: Sequence[bytes], ts, vals,
@@ -604,7 +727,14 @@ class Database:
         ):
             if self._scope is not None:
                 self._scope.counter("writes").inc(len(ids))
-            res = ns.write_batch(ids, ts, vals, now_nanos)
+            try:
+                res = ns.write_batch(ids, ts, vals, now_nanos)
+            except ShardNotOwnedError:
+                if self._scope is not None:
+                    self._scope.counter("shard_not_owned").inc()
+                raise
+            if self._scope is not None and getattr(res, "not_owned", 0):
+                self._scope.counter("shard_not_owned").inc(res.not_owned)
             # Log AFTER acceptance so the WAL never contains
             # rate-limit-rejected samples (the reference writes the
             # commitlog after the in-memory write succeeds, as an async
@@ -634,7 +764,14 @@ class Database:
         ):
             if self._scope is not None:
                 self._scope.counter("writes_tagged").inc(len(docs))
-            res = ns.write_tagged_batch(docs, ts, vals, now_nanos)
+            try:
+                res = ns.write_tagged_batch(docs, ts, vals, now_nanos)
+            except ShardNotOwnedError:
+                if self._scope is not None:
+                    self._scope.counter("shard_not_owned").inc()
+                raise
+            if self._scope is not None and getattr(res, "not_owned", 0):
+                self._scope.counter("shard_not_owned").inc(res.not_owned)
             if self.commitlog is not None:
                 # Tags ride the annotation field so WAL replay can rebuild
                 # index documents (the reference's commitlog entries carry
@@ -737,6 +874,11 @@ class Database:
 
         with self._mu:
             ns = self.namespaces[namespace]
+            # A non-owner must not accept streamed blocks: repair
+            # writing a merged block at a decommissioned replica would
+            # resurrect data the topology moved away (callers treat
+            # this like any per-replica failure and skip the replica).
+            ns.check_owned(shard)
             filesets = dict(list_filesets(self.opts.root, namespace, shard))
             vol = filesets.get(block_start, -1) + 1
             DataFileSetWriter(
@@ -845,6 +987,17 @@ class Database:
         ns = self.namespaces.get(name)
         if ns is None:
             return 0
+        if ns.owned is not None:
+            # Placement-scoped recovery: WAL/snapshot entries for shards
+            # this node no longer owns are NOT re-buffered (a restarting
+            # ex-donor must not resurrect handed-off shards; the new
+            # owner already streamed or re-ingested them).
+            entries = [
+                e for e in entries
+                if shard_for_id(e.series_id, ns.opts.num_shards) in ns.owned
+            ]
+            if not entries:
+                return 0
         ts = np.asarray([e.timestamp for e in entries], np.int64)
         vals = np.asarray([e.value for e in entries], np.float64)
         ids = [e.series_id for e in entries]

@@ -42,6 +42,8 @@ class Mediator:
         cleanup_every: int = 6,
         scrubber=None,
         scrub_every: int = 1,
+        migrator=None,
+        migrate_every: int = 1,
         instrument=None,
     ):
         self.db = db
@@ -54,6 +56,11 @@ class Mediator:
         # per pass so it never monopolizes a tick.
         self.scrubber = scrubber
         self.scrub_every = max(1, scrub_every)
+        # Optional storage.migration.ShardMigrator: the shard lifecycle
+        # (stream INITIALIZING, cut over, grace-drop LEAVING leftovers)
+        # runs off this same thread, budgeted per tick like the scrub.
+        self.migrator = migrator
+        self.migrate_every = max(1, migrate_every)
         self._ticks = 0
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -79,6 +86,13 @@ class Mediator:
                 stats["snapshot"] = self.db.snapshot()
             if self._ticks % self.cleanup_every == 0:
                 stats["cleanup"] = self.db.cleanup(now)
+            if (self.migrator is not None
+                    and self._ticks % self.migrate_every == 0):
+                # Shard lifecycle before the scrub stage: a freshly
+                # streamed block is immediately eligible for verify,
+                # and a due drop frees its volumes before the sweep
+                # re-lists them.
+                stats["topology"] = self.migrator.tick()
             if (self.scrubber is not None
                     and self._ticks % self.scrub_every == 0):
                 # Non-blocking: an admin-triggered whole-disk scrub in

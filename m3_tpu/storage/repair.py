@@ -26,22 +26,27 @@ as a ppermute collective) lives in ``m3_tpu/parallel/replication.py``.
 
 from __future__ import annotations
 
-from typing import Dict, List
+from typing import Dict, Iterable, List
 
 from m3_tpu.encoding.m3tsz import decode_series, encode_series
 from m3_tpu.persist.corruption import CorruptionError
 from m3_tpu.persist.digest import digest as checksum
 from m3_tpu.server.rpc import RemoteError
+from m3_tpu.storage.database import ShardNotOwnedError
 
 # A replica is skipped/demoted on transport failure (ConnectionError),
 # on application-level failure it reports (RemoteError: RPC_ERR frames
-# — a remote replica's CorruptionError arrives as one of these), AND on
-# a LOCAL handle's typed CorruptionError (a corrupt block under this
-# very process) — one bad replica must never abort the anti-entropy
-# sweep, matching the reference's per-host fetch failure handling
-# (src/dbnode/storage/repair.go:115-246).  The scrubber quarantines the
-# local corruption separately; repair's job is only to keep sweeping.
-_REPLICA_FAILURE = (ConnectionError, RemoteError, CorruptionError)
+# — a remote replica's CorruptionError arrives as one of these), on a
+# LOCAL handle's typed CorruptionError (a corrupt block under this very
+# process), AND on the typed ShardNotOwnedError (the placement moved
+# the shard off that replica — writing the merged block there would
+# resurrect decommissioned data) — one bad replica must never abort the
+# anti-entropy sweep, matching the reference's per-host fetch failure
+# handling (src/dbnode/storage/repair.go:115-246).  The scrubber
+# quarantines the local corruption separately; repair's job is only to
+# keep sweeping.
+_REPLICA_FAILURE = (ConnectionError, RemoteError, CorruptionError,
+                    ShardNotOwnedError)
 
 
 class RepairReport(dict):
@@ -181,6 +186,7 @@ def repair_namespace(dbs: List[object], namespace: str,
 
 def peers_bootstrap(
     db, peers: List[object], namespace: str, num_shards: int | None = None,
+    shards: "Iterable[int] | None" = None,
 ) -> Dict[str, int]:
     """Fill every (shard, block) fileset missing locally from a replica
     peer (bootstrapper/peers/source.go: stream blocks from peers and
@@ -190,11 +196,21 @@ def peers_bootstrap(
     (local or ``RemoteDatabase``).  Streams the peer's encoded segments
     verbatim — bit-identical blocks, so a follow-up repair pass reports
     convergence immediately.  Unreachable peers are skipped.
+
+    Scope: only PLACEMENT-OWNED shards are copied.  ``shards`` names
+    them explicitly; when None, the namespace's installed ownership
+    (``Namespace.owned``) applies — a restarting node pulls exactly its
+    shards, never every peer's full dataset (the reference's peers
+    bootstrapper walks the topology's shard set for this node, not the
+    shard space).  A namespace with no ownership installed (single-node
+    / no placement) keeps the copy-everything behavior.
     """
     ns = db.namespaces[namespace]
-    shards = num_shards if num_shards is not None else ns.opts.num_shards
+    total = num_shards if num_shards is not None else ns.opts.num_shards
+    if shards is None:
+        shards = range(total) if ns.owned is None else sorted(ns.owned)
     copied_blocks = copied_series = 0
-    for shard in range(shards):
+    for shard in sorted(shards):
         local = dict(db.list_block_filesets(namespace, shard))
         for peer in peers:
             if peer is None or peer is db:

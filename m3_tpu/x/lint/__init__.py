@@ -1,6 +1,6 @@
 """m3lint: codebase-aware static analysis for the m3-tpu tree.
 
-Seven rule families, each encoding a contract this repo already pays
+Eight rule families, each encoding a contract this repo already pays
 for at runtime (race tier, fault tier, bit-exactness goldens) as a
 static gate:
 
@@ -19,6 +19,10 @@ static gate:
 * ``corruption-typed`` — digest/checksum/magic verify sites under
   ``m3_tpu/persist/`` raising bare ``ValueError`` instead of the typed
   ``CorruptionError`` hierarchy (the quarantine/repair contract).
+* ``placement-cas``    — raw ``kv.set``/``check_and_set`` of the
+  placement key outside ``cluster/placement.py`` (mutations must go
+  through ``PlacementService`` so concurrent admin edits and node
+  cutovers CAS-serialize).
 
 Run: ``python -m m3_tpu.tools.cli lint`` (gates against
 ``m3_tpu/tools/lint_baseline.json``; see TESTING.md "Static analysis &

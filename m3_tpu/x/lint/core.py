@@ -42,6 +42,7 @@ RULES = (
     "fault-coverage",
     "resource-hygiene",
     "corruption-typed",
+    "placement-cas",
 )
 
 _SUPPRESS_RE = re.compile(r"#\s*m3lint:\s*disable=([\w,-]+)")
@@ -90,6 +91,9 @@ class Context:
     # files whose digest/checksum/magic verify sites must raise the
     # typed CorruptionError hierarchy, never a bare ValueError
     persist_prefixes: tuple = ("m3_tpu/persist/",)
+    # the blessed home of raw placement-key KV mutations; everywhere
+    # else must go through PlacementService (placement-cas rule)
+    placement_files: tuple = ("m3_tpu/cluster/placement.py",)
 
     def is_wire_module(self, path: str) -> bool:
         return (path in self.wire_files
@@ -152,7 +156,7 @@ def apply_suppressions(unit: FileUnit, findings: Iterable[Finding]) -> List[Find
 
 def default_rules() -> List[Rule]:
     from m3_tpu.x.lint import (
-        corruption, faultcov, locks, purity, resources, wirecheck,
+        corruption, faultcov, locks, placement, purity, resources, wirecheck,
     )
 
     return [
@@ -163,6 +167,7 @@ def default_rules() -> List[Rule]:
         faultcov.check,
         resources.check,
         corruption.check,
+        placement.check,
     ]
 
 

@@ -1,0 +1,39 @@
+"""placement-cas seeds: raw KV mutations of the placement key
+(flagged) and the legal PlacementService / other-key / delete
+counterparts (clean).  Line numbers are asserted exactly by
+tests/test_lint.py."""
+
+
+def overwrite_bad(kv, data):
+    kv.set("placement", data)                     # line 8: VIOLATION
+
+
+def cas_bad(kv, version, data):
+    kv.check_and_set("placement", version, data)  # line 12: VIOLATION
+
+
+def init_bad(kv, data):
+    return kv.set_if_not_exists(
+        f"placement/{1}", data)                   # line 17: VIOLATION
+
+
+class PlacementService:
+    def __init__(self, kv):
+        self.kv = kv
+        self.key = "placement"
+
+    def set_clean(self, p):
+        # attribute key, not the literal: the blessed service path
+        self.kv.check_and_set(self.key, 1, p)
+
+
+def other_key_clean(kv, data):
+    kv.set("namespaces", data)                    # different key: clean
+
+
+def delete_clean(kv):
+    kv.delete("placement")                        # operator reset: clean
+
+
+def service_clean(placements, p):
+    placements.set(p)                             # first arg not the key

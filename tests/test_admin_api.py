@@ -137,6 +137,117 @@ class TestAdminAPI:
         assert code == 200
         assert "n3" in out["instances"]
 
+    def test_placement_replace_and_instance_delete(self, server):
+        base, _db = server
+        code, _ = _req(base, "POST", "/api/v1/services/m3db/placement/init", {
+            "instances": [
+                {"id": "n1", "endpoint": "127.0.0.1:9001"},
+                {"id": "n2", "endpoint": "127.0.0.1:9002"},
+            ],
+            "num_shards": 4, "rf": 2,
+        })
+        assert code == 200
+        # rolling replace: n3 takes n2's shards INITIALIZING from it
+        code, out = _req(base, "POST",
+                         "/api/v1/services/m3db/placement/replace",
+                         {"leaving_id": "n2",
+                          "instance": {"id": "n3",
+                                       "endpoint": "127.0.0.1:9003"}})
+        assert code == 200
+        n3 = out["instances"]["n3"]
+        assert n3["endpoint"] == "127.0.0.1:9003"
+        assert all(st == "I" and src == "n2"
+                   for st, src in n3["shards"].values())
+        assert all(st == "L"
+                   for st, _ in out["instances"]["n2"]["shards"].values())
+        # fresh placement for the staged instance delete (a remove needs
+        # survivors with free capacity for the leaver's shards)
+        code, _ = _req(base, "DELETE", "/api/v1/services/m3db/placement")
+        assert code == 200
+        code, _ = _req(base, "POST", "/api/v1/services/m3db/placement/init", {
+            "instances": [{"id": "m1"}, {"id": "m2"}, {"id": "m3"}],
+            "num_shards": 6, "rf": 1,
+        })
+        assert code == 200
+        # deleting the still-loaded m1 stages a remove (shards go
+        # INITIALIZING on survivors, streaming from the leaver)
+        code, out = _req(base, "DELETE",
+                         "/api/v1/services/m3db/placement/m1")
+        assert code == 200
+        assert all(st == "L"
+                   for st, _ in out["instances"]["m1"]["shards"].values())
+        takers = [
+            (iid, s) for iid, inst in out["instances"].items()
+            for s, (st, src) in inst["shards"].items()
+            if st == "I"
+        ]
+        assert takers and all(
+            out["instances"][iid]["shards"][s][1] == "m1"
+            for iid, s in takers)
+        # unknown instance -> 404, not 500
+        code, out = _req(base, "DELETE",
+                         "/api/v1/services/m3db/placement/ghost")
+        assert code == 404
+
+    def test_concurrent_add_instance_both_land(self, tmp_path):
+        """Satellite: two racing add-instance calls read the same base
+        placement version; the CAS loser must retry and land (both 200,
+        both instances present) instead of one 500ing.  The race is
+        made deterministic by holding the first two CAS attempts at a
+        barrier so both handler threads mutate the same version."""
+        import threading
+
+        kv = KVStore()
+        real_cas = kv.check_and_set
+        barrier = threading.Barrier(2, timeout=10)
+        state = {"n": 0}
+        lock = threading.Lock()
+
+        def synced_cas(key, expect, data):
+            with lock:
+                state["n"] += 1
+                n = state["n"]
+            if 2 <= n <= 3:  # the two racing add-instance CAS attempts
+                try:
+                    barrier.wait()
+                except threading.BrokenBarrierError:
+                    pass
+            return real_cas(key, expect, data)
+
+        kv.check_and_set = synced_cas
+        ctx = AdminContext(kv)
+        srv = serve_admin_background(ctx)
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        try:
+            code, _ = _req(base, "POST",
+                           "/api/v1/services/m3db/placement/init", {
+                               "instances": [{"id": "n1"}],
+                               "num_shards": 4, "rf": 1,
+                           })
+            assert code == 200
+            results = []
+
+            def post(iid):
+                results.append(
+                    (iid,) + _req(base, "POST",
+                                  "/api/v1/services/m3db/placement",
+                                  {"id": iid}))
+
+            threads = [threading.Thread(target=post, args=(iid,))
+                       for iid in ("ra", "rb")]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(20)
+            assert len(results) == 2
+            assert all(code == 200 for _, code, _ in results), results
+            code, out = _req(base, "GET", "/api/v1/services/m3db/placement")
+            assert {"ra", "rb"} <= set(out["instances"])
+            assert state["n"] >= 4  # init + both CAS + the loser's retry
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
     def test_topic_crud(self, server):
         base, _db = server
         code, out = _req(base, "POST", "/api/v1/topic", {

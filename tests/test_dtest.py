@@ -8,6 +8,7 @@ compile in a fresh process) — kept to the two essential scenarios.
 
 import json
 import socket
+import threading
 import urllib.request
 from pathlib import Path
 
@@ -480,6 +481,260 @@ class TestCorruptionQuarantineRepairScenario:
                 r.close()
             for nd in nodes:
                 nd.kill()
+
+
+@pytest.mark.slow
+class TestRollingReplaceScenario:
+    """The headline topology dtest: a 3-node RF=3 cluster under
+    sustained ingest gets a rolling node REPLACE — i3 joins, streams
+    i2's shards from the donor over the RPC surface, CAS-flips them
+    AVAILABLE, the donor grace-drops its data and SIGTERM-drains, and
+    the operator deletes the empty entry — with ZERO acked-sample loss
+    and correct Majority reads at every phase, the whole migration
+    visible in /metrics (topology_*) and /health."""
+
+    def _configs(self, tmp_path, kv_port, rpc_ports, n=4):
+        nodes = []
+        for k in range(n):
+            root = tmp_path / f"n{k}" / "data"
+            cfg = tmp_path / f"n{k}" / "node.yaml"
+            peers = [f"127.0.0.1:{p}" for i, p in enumerate(rpc_ports)
+                     if i != k]
+            cfg.parent.mkdir(parents=True, exist_ok=True)
+            cfg.write_text(f"""
+db:
+  root: {root}
+  instance_id: i{k}
+  kv_endpoint: 127.0.0.1:{kv_port}
+  rpc_listen_port: {rpc_ports[k]}
+  peers: [{", ".join(repr(p) for p in peers)}]
+  bootstrap_peers: true
+  namespaces:
+    default: {{num_shards: 2}}
+coordinator: {{listen_port: 0, admin_listen_port: 0}}
+mediator:
+  enabled: true
+  tick_interval: 300ms
+  snapshot_every: 10
+  cleanup_every: 10
+  scrub_volumes: 0
+  migrate_blocks: 2
+  migrate_grace_ticks: 1
+""")
+            root.mkdir(parents=True, exist_ok=True)
+            nodes.append(NodeProcess(str(cfg), str(root),
+                                     env={"M3_DRAIN_TIMEOUT_S": "20"}))
+        return nodes
+
+    def test_rolling_node_replace_zero_acked_loss(self, tmp_path):
+        import time as _time
+
+        from m3_tpu.client.session import (
+            ConsistencyError, ConsistencyLevel, ReplicatedSession,
+        )
+        from m3_tpu.cluster.kv_remote import (
+            RemoteKVStore, serve_kv_background,
+        )
+        from m3_tpu.cluster.placement import PlacementService
+        from m3_tpu.server.rpc import RemoteDatabase
+
+        (tmp_path / "kv").mkdir(exist_ok=True)
+        kv_srv = serve_kv_background(root=str(tmp_path / "kv"))
+        rpc_ports = _free_ports(4)
+        nodes = self._configs(tmp_path, kv_srv.port, rpc_ports)
+        endpoints = {f"i{k}": f"127.0.0.1:{rpc_ports[k]}" for k in range(4)}
+        kv = RemoteKVStore(("127.0.0.1", kv_srv.port), watch_poll_s=0.2)
+        sess = None
+        ingest_stop = threading.Event()
+        acked = {}          # sid -> {ts: value}, only session-acked writes
+        acked_lock = threading.Lock()
+        # wall-clock-anchored history, two blocks back: inside
+        # retention, outside the warm window -> the mediator flushes it
+        T_HIST = (_time.time_ns() // BLOCK - 2) * BLOCK
+
+        def resolve(inst):
+            h, _, p = inst.endpoint.rpartition(":")
+            return RemoteDatabase((h, int(p)))
+
+        def admin(k, method, path, body=None):
+            status = json.loads(
+                (tmp_path / f"n{k}" / "data" / "node.json").read_text())
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{status['admin_port']}{path}",
+                method=method,
+                data=json.dumps(body).encode() if body is not None else None,
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return json.load(r)
+
+        def node_http(k, path):
+            status = json.loads(
+                (tmp_path / f"n{k}" / "data" / "node.json").read_text())
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{status['port']}{path}",
+                    timeout=10) as r:
+                body = r.read()
+            try:
+                return json.loads(body)
+            except ValueError:
+                return body.decode()
+
+        def verify_acked(phase):
+            with acked_lock:
+                want = {sid: dict(pts) for sid, pts in acked.items()}
+            for sid, pts in want.items():
+                got = dict(sess.fetch("default", sid, T_HIST,
+                                      _time.time_ns() + BLOCK))
+                for t, v in pts.items():
+                    assert got.get(t) == v, (phase, sid, t, v)
+
+        try:
+            for nd in nodes[:3]:
+                nd.start()
+
+            # placement init THROUGH the admin API, endpoints included
+            # (the migrators dial donors by placement endpoint).
+            out = admin(0, "POST", "/api/v1/services/m3db/placement/init", {
+                "instances": [
+                    {"id": f"i{k}", "isolation_group": f"g{k}",
+                     "endpoint": endpoints[f"i{k}"]}
+                    for k in range(3)
+                ],
+                "num_shards": 2, "rf": 3,
+            })
+            assert set(out["instances"]) == {"i0", "i1", "i2"}
+
+            sess = ReplicatedSession.dynamic(
+                kv, resolve,
+                write_level=ConsistencyLevel.MAJORITY,
+                read_level=ConsistencyLevel.MAJORITY,
+            )
+
+            # ---- phase 1: historical corpus that FLUSHES to filesets
+            ids = [b"roll-%d" % i for i in range(6)]
+            for r in range(4):
+                t = np.full(len(ids), T_HIST + (r + 1) * 10**9, np.int64)
+                v = np.arange(len(ids), dtype=np.float64) + 10 * r
+                sess.write_batch("default", ids, t, v, now_nanos=int(t[0]))
+                with acked_lock:
+                    for i, sid in enumerate(ids):
+                        acked.setdefault(sid, {})[int(t[i])] = float(v[i])
+
+            def hist_flushed(k):
+                return sorted((tmp_path / f"n{k}" / "data").glob(
+                    "data/default/*/fileset-*-data.db"))
+
+            deadline = _time.monotonic() + 180
+            while _time.monotonic() < deadline:
+                if all(hist_flushed(k) for k in range(3)):
+                    break
+                _time.sleep(0.5)
+            assert all(hist_flushed(k) for k in range(3)), \
+                "historical block did not flush on every node"
+            verify_acked("after-flush")
+
+            # ---- phase 2: sustained ingest at wall-clock timestamps
+            def ingest():
+                r = 0
+                while not ingest_stop.is_set():
+                    t_ns = _time.time_ns()
+                    t = np.full(len(ids), t_ns, np.int64)
+                    v = np.arange(len(ids), dtype=np.float64) + 1000 * r
+                    try:
+                        sess.write_batch("default", ids, t, v,
+                                         now_nanos=t_ns)
+                    except (ConsistencyError, ConnectionError):
+                        r += 1
+                        continue  # unacknowledged: no durability claim
+                    with acked_lock:
+                        for i, sid in enumerate(ids):
+                            acked.setdefault(sid, {})[int(t[i])] = float(v[i])
+                    r += 1
+                    _time.sleep(0.15)
+
+            ingest_t = threading.Thread(target=ingest, daemon=True)
+            ingest_t.start()
+
+            # ---- phase 3: the replacement node joins (not in the
+            # placement yet -> placement-scoped bootstrap copies NOTHING)
+            nodes[3].start()
+            assert not list((tmp_path / "n3" / "data").glob(
+                "data/default/*/fileset-*")), \
+                "out-of-placement node must not peer-copy any shard"
+
+            out = admin(0, "POST",
+                        "/api/v1/services/m3db/placement/replace", {
+                            "leaving_id": "i2",
+                            "instance": {"id": "i3", "isolation_group": "g3",
+                                         "endpoint": endpoints["i3"]},
+                        })
+            assert all(st == "I" and src == "i2"
+                       for st, src in out["instances"]["i3"]["shards"].values())
+
+            # ---- phase 4: donor shards stream + CAS-flip AVAILABLE
+            ps = PlacementService(kv)
+            deadline = _time.monotonic() + 180
+            while _time.monotonic() < deadline:
+                p = ps.get()
+                i3 = p.instances.get("i3")
+                done = (i3 is not None and i3.shards
+                        and all(a.state.value == "A"
+                                for a in i3.shards.values())
+                        and not p.instances["i2"].shards)
+                if done:
+                    break
+                _time.sleep(0.5)
+            else:
+                pytest.fail(f"migration did not complete: {ps.get().to_json()}")
+            verify_acked("after-cutover")
+
+            # the newcomer really streamed the flushed history
+            assert hist_flushed(3), "streamed filesets missing on i3"
+            metrics = node_http(3, "/metrics")
+            assert "m3tpu_topology_blocks_streamed" in metrics
+            assert "m3tpu_topology_placement_version" in metrics
+            health = node_http(3, "/health")
+            assert health["topology"]["in_placement"]
+            assert set(map(int, health["topology"]["shards"]["available"])) \
+                == {0, 1}
+
+            # ---- phase 5: the donor grace-drops its shard data
+            deadline = _time.monotonic() + 60
+            while _time.monotonic() < deadline:
+                if not hist_flushed(2):
+                    break
+                _time.sleep(0.5)
+            assert not hist_flushed(2), "donor kept its dropped filesets"
+            health2 = node_http(2, "/health")
+            assert health2["topology"]["shards"]["available"] == []
+
+            # ---- phase 6: donor drains (SIGTERM is a true drain) and
+            # the operator removes the empty entry
+            rc = nodes[2].stop(timeout_s=90)
+            assert rc == 0
+            out = admin(0, "DELETE", "/api/v1/services/m3db/placement/i2")
+            assert "i2" not in out["instances"]
+
+            ingest_stop.set()
+            ingest_t.join(20)
+
+            # ---- final: ZERO acked-sample loss at Majority over the
+            # surviving topology {i0, i1, i3}
+            with acked_lock:
+                n_acked = sum(len(p) for p in acked.values())
+            # 24 historical points plus at least a couple of live
+            # rounds: Majority ingest must have kept acking throughout
+            assert n_acked >= 4 * len(ids) + 2 * len(ids), n_acked
+            verify_acked("final")
+        finally:
+            ingest_stop.set()
+            if sess is not None:
+                sess.close()
+            kv.close()
+            for nd in nodes:
+                nd.kill()
+            kv_srv.shutdown()
+            kv_srv.server_close()
 
 
 @pytest.mark.slow

@@ -49,6 +49,9 @@ EXPECTED = {
     ("corruption_cases.py", "corruption-typed", 17),
     ("corruption_cases.py", "corruption-typed", 23),
     ("corruption_cases.py", "corruption-typed", 28),
+    ("placement_cases.py", "placement-cas", 8),
+    ("placement_cases.py", "placement-cas", 12),
+    ("placement_cases.py", "placement-cas", 16),
 }
 
 
@@ -76,7 +79,8 @@ class TestCorpus:
             by_rule.setdefault(f.rule, []).append(f)
         for rule in ("lock-discipline", "jit-purity", "explicit-dtype",
                      "wire-exhaustive", "fault-coverage",
-                     "resource-hygiene", "corruption-typed"):
+                     "resource-hygiene", "corruption-typed",
+                     "placement-cas"):
             assert len(by_rule.get(rule, [])) >= 2, rule
 
 

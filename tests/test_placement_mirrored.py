@@ -138,6 +138,22 @@ class TestMirroredReplace:
         }
         validate_mirrored(p2)
 
+    def test_mutations_preserve_the_mirrored_flag(self):
+        """A node-side mark_available cutover (shared by both flavors)
+        must not silently demote a mirrored placement — the admin add
+        path branches on is_mirrored, so losing the flag would route
+        the NEXT mutation through the wrong algorithm."""
+        from m3_tpu.cluster.placement import mark_available
+
+        p = mirrored_initial_placement(
+            _insts({1: ["a1", "a2"], 2: ["b1", "b2"]}), num_shards=4, rf=2
+        )
+        p2 = mirrored_replace_instance(p, "a2", Instance("a3", "g1"))
+        assert p2.is_mirrored
+        s0 = next(iter(p2.instances["a3"].shards))
+        p3 = mark_available(p2, "a3", s0)
+        assert p3.is_mirrored
+
 
 class TestMirroredRoundtripAndClient:
     def test_json_roundtrip_preserves_shard_sets(self):

@@ -1,0 +1,779 @@
+"""Topology-change resilience: the node-side shard lifecycle.
+
+Reference models: `dbnode/topology/dynamic.go` (placement watch →
+topology maps), `storage/bootstrap/bootstrapper/peers` (INITIALIZING
+shards stream from the donor), the coordinator's MarkShardsAvailable
+cutover, and the session's errTryAgain-style re-route on topology
+moves.  Covers:
+
+* ``TopologyWatcher`` — version-filtered placement views per instance.
+* ``Database`` shard ownership — typed ``ShardNotOwnedError`` on
+  writes/reads/streamed blocks outside the owned set; placement-scoped
+  WAL replay; ``drop_shard``.
+* the wire mapping — a remote replica's rejection arrives as the SAME
+  typed error, which the session counts as a routing miss.
+* the session's one-shot topology refresh: a write racing a
+  ``mark_available`` cutover succeeds without caller retry.
+* ``ShardMigrator`` — stream → digest-verify → CAS cutover → grace
+  drop; dead-donor fallback to an AVAILABLE replica; dead-leaver
+  removal; the ``topology.stream`` faultpoint.
+* placement-scoped ``peers_bootstrap`` (non-owned shards stay empty).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from m3_tpu.client.session import (
+    ConsistencyError, ConsistencyLevel, ReplicatedSession,
+)
+from m3_tpu.cluster.kv import KVStore
+from m3_tpu.cluster.placement import (
+    Instance, Placement, PlacementService, ShardAssignment, ShardState,
+    add_instance, forget_instance, initial_placement, mark_available,
+    remove_instance, replace_instance,
+)
+from m3_tpu.cluster.topology import TopologyWatcher
+from m3_tpu.storage.database import (
+    Database, DatabaseOptions, NamespaceOptions, ShardNotOwnedError,
+    shard_for_id,
+)
+from m3_tpu.storage.migration import ShardMigrator
+from m3_tpu.storage.repair import peers_bootstrap
+
+SEC = 10**9
+HOUR = 3600 * SEC
+BLOCK = 2 * HOUR
+T0 = (1_600_000_000 * SEC) // BLOCK * BLOCK
+NSHARDS = 4
+
+
+def _mk_db(tmp_path, name, commitlog=False):
+    return Database(
+        DatabaseOptions(root=str(tmp_path / name),
+                        commitlog_enabled=commitlog),
+        namespaces={
+            "default": NamespaceOptions(
+                num_shards=NSHARDS, slot_capacity=256, sample_capacity=2048
+            )
+        },
+    )
+
+
+def _ids_for_shard(shard, n=3, tag=b"tp"):
+    """n series ids that hash onto ``shard``."""
+    out = []
+    i = 0
+    while len(out) < n:
+        sid = b"%s-%d" % (tag, i)
+        if shard_for_id(sid, NSHARDS) == shard:
+            out.append(sid)
+        i += 1
+    return out
+
+
+def _write_all_shards(db, rounds=4):
+    ids = [sid for s in range(NSHARDS) for sid in _ids_for_shard(s)]
+    for k in range(rounds):
+        t = np.full(len(ids), T0 + (k + 1) * 10 * SEC, np.int64)
+        v = np.arange(len(ids), dtype=np.float64) + k
+        db.write_batch("default", ids, t, v, now_nanos=int(t[0]))
+    return ids
+
+
+# ---------------------------------------------------------------------------
+# TopologyWatcher
+# ---------------------------------------------------------------------------
+
+
+class TestTopologyWatcher:
+    def test_no_placement_means_own_everything(self):
+        kv = KVStore()
+        w = TopologyWatcher(kv, "i0")
+        v = w.view()
+        assert v.placement is None and v.version == 0
+        assert v.owned_shards() is None  # own-all default
+        assert not v.in_placement
+        w.close()
+
+    def test_view_tracks_versions_and_my_shards(self):
+        kv = KVStore()
+        ps = PlacementService(kv)
+        w = TopologyWatcher(kv, "i1")
+        seen = []
+        w.on_change(lambda view: seen.append(view.version))
+        ps.set(initial_placement([Instance("i0"), Instance("i1")],
+                                 num_shards=NSHARDS, rf=2))
+        v = w.view()
+        assert v.in_placement
+        assert v.owned_shards() == frozenset(range(NSHARDS))  # rf=2/2 insts
+        assert seen == [1]
+        # a second version delivers exactly once, monotonically
+        ps.update(lambda p: add_instance(p, Instance("i2")))
+        assert w.view().version == 2
+        assert seen == [1, 2]
+        w.close()
+
+    def test_not_in_placement_owns_nothing(self):
+        kv = KVStore()
+        PlacementService(kv).set(
+            initial_placement([Instance("i0")], num_shards=NSHARDS, rf=1))
+        w = TopologyWatcher(kv, "ghost")
+        v = w.view()
+        assert v.placement is not None and not v.in_placement
+        assert v.owned_shards() == frozenset()
+        w.close()
+
+    def test_malformed_placement_keeps_last_good_view(self):
+        kv = KVStore()
+        ps = PlacementService(kv)
+        ps.set(initial_placement([Instance("i0")], num_shards=NSHARDS, rf=1))
+        w = TopologyWatcher(kv, "i0")
+        assert w.view().version == 1
+        kv.set("placement", b"{not json")  # corrupted control plane write
+        assert w.view().version == 1       # previous good view survives
+        w.close()
+
+    def test_listener_replay_on_register(self):
+        kv = KVStore()
+        PlacementService(kv).set(
+            initial_placement([Instance("i0")], num_shards=NSHARDS, rf=1))
+        w = TopologyWatcher(kv, "i0")
+        seen = []
+        w.on_change(lambda view: seen.append(view.version))
+        assert seen == [1]  # current state replayed to the late listener
+        w.close()
+
+
+# ---------------------------------------------------------------------------
+# Database ownership
+# ---------------------------------------------------------------------------
+
+
+class TestDatabaseOwnership:
+    def test_write_to_unowned_shard_raises_typed(self, tmp_path):
+        db = _mk_db(tmp_path, "own")
+        db.set_shard_ownership("default", {0, 1})
+        good = _ids_for_shard(0, 1)
+        bad = _ids_for_shard(2, 1)
+        db.write_batch("default", good, np.array([T0 + SEC]),
+                       np.array([1.0]), now_nanos=T0 + SEC)
+        with pytest.raises(ShardNotOwnedError) as ei:
+            db.write_batch("default", bad, np.array([T0 + SEC]),
+                           np.array([1.0]), now_nanos=T0 + SEC)
+        assert ei.value.shard == 2 and ei.value.namespace == "default"
+        db.close()
+
+    def test_mixed_batch_partial_accepts_owned_shards(self, tmp_path):
+        """A direct-ingest batch hashing across owned AND unowned
+        shards must not lose the owned samples to one stray id: owned
+        shards land, the rest is dropped into the accepted mask
+        (``not_owned``) — only an ALL-unowned batch raises the typed
+        error (the single-shard session sub-batch shape)."""
+        db = _mk_db(tmp_path, "mix")
+        db.set_shard_ownership("default", {0})
+        ids = _ids_for_shard(0, 1) + _ids_for_shard(3, 1)
+        res = db.write_batch("default", ids,
+                             np.full(2, T0 + SEC, np.int64),
+                             np.array([1.0, 2.0]), now_nanos=T0 + SEC)
+        assert res.not_owned == 1
+        assert list(res.accepted) == [True, False]
+        assert db.read("default", ids[0], T0, T0 + BLOCK) == [(T0 + SEC, 1.0)]
+        with pytest.raises(ShardNotOwnedError):
+            db.read("default", ids[1], T0, T0 + BLOCK)
+        db.close()
+
+    def test_new_namespace_inherits_ownership_template(self, tmp_path):
+        """A namespace created AFTER the placement was observed
+        (dynamic add / downsampler) must start placement-scoped, not
+        own-all."""
+        db = _mk_db(tmp_path, "tpl")
+        db.set_shard_ownership("default", {0, 1})
+        db.set_ownership_template(NSHARDS, {0, 1})
+        ns = db.ensure_namespace("agg_5m", NamespaceOptions(
+            num_shards=NSHARDS, slot_capacity=256, sample_capacity=2048))
+        assert ns.owned == frozenset({0, 1})
+        with pytest.raises(ShardNotOwnedError):
+            db.write_batch("agg_5m", _ids_for_shard(2, 1),
+                           np.array([T0 + SEC]), np.array([1.0]),
+                           now_nanos=T0 + SEC)
+        # a differently-sharded namespace is outside the placement's
+        # shard space: stays own-all
+        ns2 = db.ensure_namespace("other", NamespaceOptions(
+            num_shards=8, slot_capacity=256, sample_capacity=2048))
+        assert ns2.owned is None
+        db.close()
+
+    def test_read_answers_only_owned_shards(self, tmp_path):
+        db = _mk_db(tmp_path, "rd")
+        ids = _write_all_shards(db)
+        db.set_shard_ownership("default", {0})
+        assert db.read("default", _ids_for_shard(0, 1)[0], T0, T0 + BLOCK)
+        with pytest.raises(ShardNotOwnedError):
+            db.read("default", _ids_for_shard(1, 1)[0], T0, T0 + BLOCK)
+        # None restores the own-everything default
+        db.set_shard_ownership("default", None)
+        assert db.read("default", _ids_for_shard(1, 1)[0], T0, T0 + BLOCK)
+        assert ids
+        db.close()
+
+    def test_write_block_rejected_on_unowned_shard(self, tmp_path):
+        db = _mk_db(tmp_path, "wb")
+        db.set_shard_ownership("default", {0})
+        with pytest.raises(ShardNotOwnedError):
+            db.write_block("default", 1, T0, [(b"x", b"seg")])
+        db.close()
+
+    def test_tagged_write_unowned_shard_skips_index_too(self, tmp_path):
+        from m3_tpu.index.doc import Document
+        from m3_tpu.index.search import All
+
+        db = _mk_db(tmp_path, "tag")
+        db.set_shard_ownership("default", {0})
+        sid = _ids_for_shard(1, 1)[0]
+        doc = Document.from_tags(sid, {b"__name__": b"m"})
+        with pytest.raises(ShardNotOwnedError):
+            db.write_tagged_batch("default", [doc], np.array([T0 + SEC]),
+                                  np.array([1.0]), now_nanos=T0 + SEC)
+        assert db.query_ids("default", All(), T0, T0 + BLOCK) == []
+        db.close()
+
+    def test_wal_replay_scoped_to_owned_shards(self, tmp_path):
+        db = _mk_db(tmp_path, "wal", commitlog=True)
+        _write_all_shards(db)
+        db.close()
+        # restart as an ex-donor that now owns only shards {0, 1}
+        db2 = _mk_db(tmp_path, "wal", commitlog=True)
+        db2.set_shard_ownership("default", {0, 1})
+        db2.bootstrap()
+        assert db2.read("default", _ids_for_shard(0, 1)[0], T0, T0 + BLOCK)
+        # the unowned shard was NOT re-buffered (and reads reject)
+        with pytest.raises(ShardNotOwnedError):
+            db2.read("default", _ids_for_shard(2, 1)[0], T0, T0 + BLOCK)
+        sh = db2.namespaces["default"].shards[2]
+        assert not sh.buffer.open_blocks and not sh.buffer.cold
+        db2.close()
+
+    def test_drop_shard_deletes_filesets_and_buffers(self, tmp_path):
+        db = _mk_db(tmp_path, "drop")
+        _write_all_shards(db)
+        db.tick(T0 + 2 * BLOCK)  # flush filesets
+        assert db.list_block_filesets("default", 1)
+        removed = db.drop_shard("default", 1)
+        assert removed >= 1
+        assert db.list_block_filesets("default", 1) == []
+        sh = db.namespaces["default"].shards[1]
+        assert not sh.buffer.open_blocks and not sh.flushed_blocks
+        db.close()
+
+
+# ---------------------------------------------------------------------------
+# shard-state routing matrix (session side)
+# ---------------------------------------------------------------------------
+
+
+def _matrix_placement(shard=0):
+    """One shard in all three states: AVAILABLE on ia, LEAVING on il,
+    INITIALIZING on ii (streaming from il)."""
+    insts = {
+        "ia": Instance("ia", shards={
+            shard: ShardAssignment(shard, ShardState.AVAILABLE)}),
+        "il": Instance("il", shards={
+            shard: ShardAssignment(shard, ShardState.LEAVING)}),
+        "ii": Instance("ii", shards={
+            shard: ShardAssignment(shard, ShardState.INITIALIZING, "il")}),
+    }
+    return Placement(insts, num_shards=1, replica_factor=2, version=3)
+
+
+class TestShardStateMatrix:
+    def test_writes_fan_to_I_A_L_reads_to_A_L_only(self):
+        p = _matrix_placement()
+        sess = ReplicatedSession(p, {"ia": None, "il": None, "ii": None})
+        assert set(sess._replicas_for_shard(0, for_read=False)) == {
+            "ia", "ii", "il"}
+        assert set(sess._replicas_for_shard(0, for_read=True)) == {
+            "ia", "il"}
+
+    def test_mark_available_clears_the_donors_leaving_entry(self):
+        p = _matrix_placement()
+        p2 = mark_available(p, "ii", 0)
+        assert p2.instances["ii"].shards[0].state == ShardState.AVAILABLE
+        assert 0 not in p2.instances["il"].shards  # donor entry gone
+        # routing follows: reads now hit the newcomer, not the leaver
+        sess = ReplicatedSession(p2, {"ia": None, "il": None, "ii": None})
+        assert sess._replicas_for_shard(0, for_read=True) == ["ia", "ii"]
+
+    def test_remove_instance_when_leaver_is_already_dead(self):
+        """remove_instance is a pure placement edit — it must stage the
+        same INITIALIZING/LEAVING handoff whether or not the leaver
+        still answers (the dead donor is the MIGRATION's problem, which
+        falls back to an AVAILABLE replica — covered below)."""
+        p = initial_placement(
+            [Instance(f"i{k}") for k in range(3)], num_shards=NSHARDS, rf=2)
+        p2 = remove_instance(p, "i0")
+        for s, a in p2.instances["i0"].shards.items():
+            assert a.state == ShardState.LEAVING
+        takers = [
+            (iid, s) for iid, inst in p2.instances.items()
+            for s, a in inst.shards.items()
+            if a.state == ShardState.INITIALIZING
+        ]
+        assert takers and all(
+            p2.instances[iid].shards[s].source_id == "i0"
+            for iid, s in takers
+        )
+        # once every shard cuts over, the dead leaver's entry is
+        # forgettable outright
+        for iid, s in takers:
+            p2 = mark_available(p2, iid, s)
+        p3 = forget_instance(p2, "i0")
+        assert "i0" not in p3.instances
+
+    def test_forget_refuses_while_instance_owns_live_shards(self):
+        p = initial_placement([Instance("i0"), Instance("i1")],
+                              num_shards=2, rf=2)
+        with pytest.raises(ValueError):
+            forget_instance(p, "i0")
+
+
+# ---------------------------------------------------------------------------
+# the typed error over the wire + routing-miss accounting
+# ---------------------------------------------------------------------------
+
+
+class TestWireShardNotOwned:
+    def test_remote_rejection_arrives_typed(self, tmp_path):
+        from m3_tpu.server.rpc import RemoteDatabase, serve_rpc_background
+
+        db = _mk_db(tmp_path, "wire")
+        db.set_shard_ownership("default", {0})
+        srv = serve_rpc_background(db)
+        remote = RemoteDatabase(("127.0.0.1", srv.port))
+        sid = _ids_for_shard(3, 1)[0]
+        try:
+            with pytest.raises(ShardNotOwnedError) as ei:
+                remote.write_batch("default", [sid],
+                                   np.array([T0 + SEC]), np.array([1.0]),
+                                   now_nanos=T0 + SEC)
+            assert ei.value.shard == 3
+            assert ei.value.namespace == "default"
+            with pytest.raises(ShardNotOwnedError):
+                remote.read("default", sid, T0, T0 + BLOCK)
+        finally:
+            remote.close()
+            srv.shutdown()
+            srv.server_close()
+            db.close()
+
+    def test_session_counts_stale_placement_as_routing_miss(self, tmp_path):
+        """A stale-placement client fanning at a node that no longer
+        owns the shard: the failure is a routing miss (visible as such
+        in the ConsistencyError detail and the counter), not a data
+        error."""
+        db = _mk_db(tmp_path, "stale")
+        db.set_shard_ownership("default", set())  # owns nothing anymore
+        p = initial_placement([Instance("i0")], num_shards=NSHARDS, rf=1)
+        sess = ReplicatedSession(p, {"i0": db})  # stale: still routes to i0
+        sid = _ids_for_shard(0, 1)[0]
+        with pytest.raises(ConsistencyError) as ei:
+            sess.write_batch("default", [sid], np.array([T0 + SEC]),
+                             np.array([1.0]), now_nanos=T0 + SEC)
+        assert sess.routing_misses == 1
+        assert "routing miss" in str(ei.value)
+        db.close()
+
+
+class TestSessionRefanOnCutover:
+    def test_write_racing_cutover_succeeds_without_caller_retry(
+            self, tmp_path):
+        """Satellite: the watch-race.  The placement moves (cutover) but
+        the session's watch has not delivered yet; its fan-out hits the
+        ex-owner, takes routing misses, refreshes the topology ONCE
+        from KV and re-fans — the caller's write_batch returns
+        normally."""
+        kv = KVStore()
+        ps = PlacementService(kv)
+        db_old = _mk_db(tmp_path, "old")
+        db_new = _mk_db(tmp_path, "new")
+        dbs = {"i0": db_old, "i1": db_new}
+        p1 = initial_placement([Instance("i0")], num_shards=NSHARDS, rf=1)
+        ps.set(p1)
+        sess = ReplicatedSession.dynamic(
+            kv, lambda inst: dbs[inst.id],
+            write_level=ConsistencyLevel.MAJORITY)
+        # Simulate the undelivered watch: detach it, then cut the whole
+        # topology over to i1 (placement v2 + node-side ownership).
+        kv.unwatch("placement", sess._on_change)
+        p2 = replace_instance(p1, "i0", Instance("i1"))
+        for s in range(NSHARDS):
+            p2 = mark_available(p2, "i1", s)
+        ps.set(p2)
+        db_old.set_shard_ownership("default", set())
+        db_new.set_shard_ownership("default", set(range(NSHARDS)))
+        assert sess.placement.instances.keys() == {"i0"}  # genuinely stale
+
+        sid = _ids_for_shard(0, 1)[0]
+        sess.write_batch("default", [sid], np.array([T0 + SEC]),
+                         np.array([2.5]), now_nanos=T0 + SEC)  # no raise
+        assert sess.routing_misses >= 1
+        # refreshed: routes by v2 now (only i1 carries shards)
+        assert sess.topology_version == kv.get("placement").version
+        assert set(sess.connections) == {"i1"}
+        assert db_new.read("default", sid, T0, T0 + BLOCK) == [
+            (T0 + SEC, 2.5)]
+        sess.close()
+        db_old.close()
+        db_new.close()
+
+
+# ---------------------------------------------------------------------------
+# the migrator lifecycle
+# ---------------------------------------------------------------------------
+
+
+def _drive_until(migrators, ps, pred, max_ticks=12):
+    """Tick every node's migrator round-robin until ``pred(placement)``
+    or the budget runs out; returns the final placement."""
+    for _ in range(max_ticks):
+        for m in migrators:
+            m.tick()
+        p = ps.get()
+        if pred(p):
+            return p
+    return ps.get()
+
+
+def _no_initializing(p):
+    return not any(
+        a.state == ShardState.INITIALIZING
+        for inst in p.instances.values() for a in inst.shards.values()
+    )
+
+
+class TestMigrationLifecycle:
+    def _bootstrap_pair(self, tmp_path, kv):
+        """Two nodes owning everything (rf=2), flushed corpus, watchers
+        + migrators wired over LOCAL handles."""
+        ps = PlacementService(kv)
+        dbs = {"i0": _mk_db(tmp_path, "i0"), "i1": _mk_db(tmp_path, "i1")}
+        ps.set(initial_placement(
+            [Instance(iid) for iid in dbs], num_shards=NSHARDS, rf=2))
+
+        def resolve(inst):
+            db = dbs.get(inst.id)
+            if db is None:
+                raise ConnectionError(f"{inst.id} is dead")
+            return db
+
+        rig = {}
+        for iid, db in dbs.items():
+            w = TopologyWatcher(kv, iid)
+            rig[iid] = ShardMigrator(db, w, PlacementService(kv),
+                                     resolve=resolve, grace_ticks=1)
+        ids = _write_all_shards(dbs["i0"])
+        for sid in ids:  # mirror onto the replica
+            pts = dbs["i0"].read("default", sid, T0, T0 + BLOCK)
+            t = np.array([p[0] for p in pts], np.int64)
+            v = np.array([p[1] for p in pts], np.float64)
+            dbs["i1"].write_batch("default", [sid] * len(pts), t, v,
+                                  now_nanos=int(t.max()))
+        for db in dbs.values():
+            db.tick(T0 + 2 * BLOCK)  # flush filesets everywhere
+        return ps, dbs, rig, resolve, ids
+
+    def test_add_instance_streams_cuts_over_and_donors_drop(self, tmp_path):
+        kv = KVStore()
+        ps, dbs, rig, resolve, ids = self._bootstrap_pair(tmp_path, kv)
+        dbs["i2"] = _mk_db(tmp_path, "i2")
+        w2 = TopologyWatcher(kv, "i2")
+        rig["i2"] = ShardMigrator(dbs["i2"], w2, PlacementService(kv),
+                                  resolve=resolve, grace_ticks=1)
+        ps.update(lambda p: add_instance(p, Instance("i2")))
+        moved = [s for s, a in ps.get().instances["i2"].shards.items()
+                 if a.state == ShardState.INITIALIZING]
+        donors = {s: ps.get().instances["i2"].shards[s].source_id
+                  for s in moved}
+        assert moved
+
+        p = _drive_until(list(rig.values()), ps, _no_initializing)
+        # cutover landed: newcomer AVAILABLE, donor entries cleared
+        for s in moved:
+            assert p.instances["i2"].shards[s].state == ShardState.AVAILABLE
+            assert s not in p.instances[donors[s]].shards
+        # the newcomer's filesets are digest-identical to the donor's
+        # (compared BEFORE the donor's grace drop deletes its copy)
+        for s in moved:
+            got = dbs["i2"].block_metadata("default", s, T0)
+            assert got and got == dbs[donors[s]].block_metadata(
+                "default", s, T0)
+        # let the donors' grace countdowns (1 tick) expire
+        for _ in range(4):
+            for m in rig.values():
+                m.tick()
+        # donors dropped the handed-off shards after grace (ownership
+        # revoked AND data gone)
+        for s in moved:
+            donor_db = dbs[donors[s]]
+            assert donor_db.list_block_filesets("default", s) == []
+            with pytest.raises(ShardNotOwnedError):
+                donor_db.read("default", _ids_for_shard(s, 1)[0],
+                              T0, T0 + BLOCK)
+        # data stayed fully readable on the new owner
+        for s in moved:
+            for sid in _ids_for_shard(s):
+                assert dbs["i2"].read("default", sid, T0, T0 + BLOCK)
+        for m in rig.values():
+            m.close()
+
+    def test_replace_with_unreachable_donor_falls_back(self, tmp_path):
+        """Replace of a DEAD node: the newcomer's named donor never
+        answers, so streaming falls back to any AVAILABLE replica of
+        the shard (rf=2 guarantees one) and cutover still lands."""
+        kv = KVStore()
+        ps, dbs, rig, resolve, ids = self._bootstrap_pair(tmp_path, kv)
+        rig["i0"].close()
+        dead = dbs.pop("i0")   # resolve("i0") now raises ConnectionError
+        del rig["i0"]
+        dead.close()
+        dbs["i9"] = _mk_db(tmp_path, "i9")
+        w9 = TopologyWatcher(kv, "i9")
+        rig["i9"] = ShardMigrator(dbs["i9"], w9, PlacementService(kv),
+                                  resolve=resolve, grace_ticks=1)
+        ps.update(lambda p: replace_instance(p, "i0", Instance("i9")))
+
+        p = _drive_until(list(rig.values()), ps, _no_initializing)
+        assert _no_initializing(p)
+        for s, a in p.instances["i9"].shards.items():
+            assert a.state == ShardState.AVAILABLE
+        # blocks really streamed (from i1, the surviving replica)
+        for s in range(NSHARDS):
+            got = dbs["i9"].block_metadata("default", s, T0)
+            assert got and got == dbs["i1"].block_metadata("default", s, T0)
+        for m in rig.values():
+            m.close()
+
+    def test_stream_faultpoint_corruption_is_verify_rejected(self, tmp_path):
+        """topology.stream armed in corrupt mode: the streamed segment
+        fails digest verification against the donor's block metadata —
+        the block is refused (no partial/poisoned cutover), and heals
+        on the next clean tick."""
+        from m3_tpu.x import fault
+
+        kv = KVStore()
+        ps, dbs, rig, resolve, ids = self._bootstrap_pair(tmp_path, kv)
+        dbs["i2"] = _mk_db(tmp_path, "i2")
+        w2 = TopologyWatcher(kv, "i2")
+        m2 = ShardMigrator(dbs["i2"], w2, PlacementService(kv),
+                           resolve=resolve, grace_ticks=1)
+        rig["i2"] = m2
+        ps.update(lambda p: add_instance(p, Instance("i2")))
+        moved = [s for s, a in ps.get().instances["i2"].shards.items()
+                 if a.state == ShardState.INITIALIZING]
+        try:
+            with fault.armed("topology.stream", "corrupt", p=1.0, seed=5):
+                stats = m2.tick()
+            assert stats["verify_failures"] >= 1
+            assert stats["blocks_streamed"] == 0
+            # nothing poisoned landed, nothing cut over
+            for s in moved:
+                assert dbs["i2"].list_block_filesets("default", s) == []
+            assert not _no_initializing(ps.get())
+        finally:
+            fault.disarm()
+        p = _drive_until(list(rig.values()), ps, _no_initializing)
+        assert _no_initializing(p)
+        for s in moved:
+            got = dbs["i2"].block_metadata("default", s, T0)
+            assert got and got == dbs["i0"].block_metadata("default", s, T0)
+        for m in rig.values():
+            m.close()
+
+    def test_remove_dead_leaver_rehomes_shards_to_survivors(self, tmp_path):
+        """remove_instance of a dead node: survivors stream the
+        INITIALIZING shards from each other (fallback — the named
+        source is the dead leaver), cut over, and the drained entry is
+        forgettable."""
+        kv = KVStore()
+        ps, dbs, rig, resolve, ids = self._bootstrap_pair(tmp_path, kv)
+        dbs["i2"] = _mk_db(tmp_path, "i2")
+        w2 = TopologyWatcher(kv, "i2")
+        rig["i2"] = ShardMigrator(dbs["i2"], w2, PlacementService(kv),
+                                  resolve=resolve, grace_ticks=1)
+        ps.update(lambda p: add_instance(p, Instance("i2")))
+        _drive_until(list(rig.values()), ps, _no_initializing)
+
+        # i0 dies; remove it — its shards re-home to the survivors
+        rig["i0"].close()
+        dead = dbs.pop("i0")
+        del rig["i0"]
+        dead.close()
+        ps.update(lambda p: remove_instance(p, "i0"))
+
+        p = _drive_until(list(rig.values()), ps, _no_initializing)
+        assert _no_initializing(p)
+        leaver = p.instances.get("i0")
+        assert leaver is None or not leaver.shards or all(
+            a.state == ShardState.LEAVING for a in leaver.shards.values())
+        # every shard still has rf AVAILABLE owners among survivors
+        for s in range(NSHARDS):
+            owners = [i.id for i in p.instances_for_shard(s)
+                      if i.shards[s].state == ShardState.AVAILABLE]
+            assert len(owners) == 2 and "i0" not in owners
+        # the drained leaver is deletable outright
+        if "i0" in p.instances:
+            p2 = ps.update(lambda pp: forget_instance(pp, "i0"))
+            assert "i0" not in p2.instances
+        for m in rig.values():
+            m.close()
+
+    def test_reacquired_shard_cancels_pending_drop(self, tmp_path):
+        """Operator reverts a move mid-grace: the shard re-enters the
+        node's entry before the countdown expires — its data must NOT
+        be deleted."""
+        kv = KVStore()
+        ps = PlacementService(kv)
+        db = _mk_db(tmp_path, "i0")
+        ps.set(initial_placement([Instance("i0")], num_shards=NSHARDS, rf=1))
+        w = TopologyWatcher(kv, "i0")
+        m = ShardMigrator(db, w, PlacementService(kv),
+                          resolve=lambda inst: db, grace_ticks=3)
+        _write_all_shards(db)
+        db.tick(T0 + 2 * BLOCK)
+        # hand shard 0's ownership away by hand-editing the placement
+        def take_away(p):
+            insts = {iid: Instance(i.id, i.isolation_group, i.weight,
+                                   dict(i.shards), i.shard_set_id, i.endpoint)
+                     for iid, i in p.instances.items()}
+            del insts[
+                "i0"].shards[0]
+            return Placement(insts, p.num_shards, p.replica_factor,
+                             p.version + 1)
+        def give_back(p):
+            insts = {iid: Instance(i.id, i.isolation_group, i.weight,
+                                   dict(i.shards), i.shard_set_id, i.endpoint)
+                     for iid, i in p.instances.items()}
+            insts["i0"].shards[0] = ShardAssignment(0, ShardState.AVAILABLE)
+            return Placement(insts, p.num_shards, p.replica_factor,
+                             p.version + 1)
+        ps.update(take_away)
+        m.tick()  # grace countdown starts (3 ticks)
+        ps.update(give_back)
+        for _ in range(5):
+            m.tick()
+        assert db.list_block_filesets("default", 0)  # data survived
+        assert db.read("default", _ids_for_shard(0, 1)[0], T0, T0 + BLOCK)
+        m.close()
+        w.close()
+
+
+# ---------------------------------------------------------------------------
+# placement-scoped peers bootstrap (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestScopedPeersBootstrap:
+    def test_non_owned_shards_stay_empty_on_disk(self, tmp_path):
+        src = _mk_db(tmp_path, "src")
+        _write_all_shards(src)
+        src.tick(T0 + 2 * BLOCK)  # flush all shards
+
+        dst = _mk_db(tmp_path, "dst")
+        dst.set_shard_ownership("default", {0, 1})
+        out = peers_bootstrap(dst, [src], "default")
+        assert out["blocks"] >= 2
+        for s in (0, 1):
+            assert dst.list_block_filesets("default", s)
+        for s in (2, 3):
+            # not copied — and nothing on disk either
+            assert dst.list_block_filesets("default", s) == []
+            shard_dir = (tmp_path / "dst" / "data" / "default" / str(s))
+            assert not shard_dir.exists() or not any(shard_dir.iterdir())
+        # explicit shard scoping wins over installed ownership
+        dst2 = _mk_db(tmp_path, "dst2")
+        out2 = peers_bootstrap(dst2, [src], "default", shards={3})
+        assert out2["blocks"] >= 1
+        assert dst2.list_block_filesets("default", 3)
+        assert dst2.list_block_filesets("default", 0) == []
+        src.close()
+        dst.close()
+        dst2.close()
+
+
+# ---------------------------------------------------------------------------
+# PlacementService.update CAS retry
+# ---------------------------------------------------------------------------
+
+
+class TestPlacementServiceUpdate:
+    def test_retries_version_conflict_once_then_lands(self):
+        kv = KVStore()
+        ps = PlacementService(kv)
+        ps.set(initial_placement([Instance("i0")], num_shards=2, rf=1))
+        real_cas = kv.check_and_set
+
+        def flaky_cas(key, expect, data):
+            # a competing writer slips in between update()'s get and its
+            # CAS exactly once; our CAS then conflicts and must retry
+            kv.check_and_set = real_cas
+            real_cas(key, expect, ps.get().to_json())
+            return real_cas(key, expect, data)  # raises version conflict
+
+        kv.check_and_set = flaky_cas
+        p2 = ps.update(lambda p: add_instance(p, Instance("i1")))
+        assert "i1" in p2.instances
+        # v1 initial set, v2 the competing writer, v3 the retried CAS
+        assert kv.get("placement").version == 3
+
+    def test_mutate_errors_do_not_retry(self):
+        kv = KVStore()
+        ps = PlacementService(kv)
+        ps.set(initial_placement([Instance("i0")], num_shards=2, rf=1))
+        calls = {"n": 0}
+
+        def bad_mutate(p):
+            calls["n"] += 1
+            raise ValueError("no such instance")
+
+        with pytest.raises(ValueError, match="no such instance"):
+            ps.update(bad_mutate)
+        assert calls["n"] == 1
+
+    def test_concurrent_updates_both_land(self):
+        """Two threads race get→mutate→CAS on the same base version; the
+        loser's conflict retries and both instances land."""
+        kv = KVStore()
+        ps = PlacementService(kv)
+        ps.set(initial_placement([Instance("i0")], num_shards=2, rf=1))
+        barrier = threading.Barrier(2, timeout=10)
+        real_cas = kv.check_and_set
+        first_two = {"n": 0}
+        lock = threading.Lock()
+
+        def synced_cas(key, expect, data):
+            with lock:
+                first_two["n"] += 1
+                n = first_two["n"]
+            if n <= 2:
+                barrier.wait()  # both threads read the SAME base version
+            return real_cas(key, expect, data)
+
+        kv.check_and_set = synced_cas
+        errs = []
+
+        def add(iid):
+            try:
+                ps.update(lambda p: add_instance(p, Instance(iid)))
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=add, args=(iid,))
+                   for iid in ("ia", "ib")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(20)
+        assert not errs
+        final = ps.get()
+        assert {"ia", "ib"} <= set(final.instances)
