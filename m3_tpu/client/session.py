@@ -28,6 +28,8 @@ from m3_tpu.cluster.placement import Placement, ShardState
 from m3_tpu.core.hash import shard_for
 from m3_tpu.storage.database import ShardNotOwnedError
 from m3_tpu.storage.series_merge import merge_point_sources
+from m3_tpu.x import deadline as xdeadline
+from m3_tpu.x.breaker import BreakerOpenError, CircuitBreaker
 from m3_tpu.x.retry import Retrier, RetryOptions
 
 
@@ -100,6 +102,18 @@ class ReplicatedSession:
         self.topology_version = 0
         self._closed = False
         self._retired: List[object] = []
+        # Per-replica circuit breakers for the READ fan-out: a dead or
+        # deadline-blowing replica fails fast (counted as that
+        # replica's error toward the consistency level) instead of
+        # eating the full deadline on every fetch.  Session-local
+        # instances — replicas come and go with the placement, and a
+        # session's read health must not leak across sessions/tests.
+        # Writes keep the plain retry contract: shedding a write
+        # replica would trade durability for latency.
+        self._breakers: Dict[str, object] = {}
+        self._breaker_mu = threading.Lock()
+        self.breaker_failures = 5
+        self.breaker_reset_s = 10.0
         self._kv = self._kv_key = self._on_change = self._resolve = None
         # Per-replica ShardNotOwnedError responses observed (stale
         # placement at one end of the conversation): routing misses,
@@ -243,6 +257,22 @@ class ReplicatedSession:
     def _shard(self, sid: bytes) -> int:
         return shard_for(sid, self.placement.num_shards)
 
+    def _breaker(self, iid: str) -> CircuitBreaker:
+        with self._breaker_mu:
+            br = self._breakers.get(iid)
+            if br is None:
+                br = CircuitBreaker(
+                    f"session:{iid}",
+                    failure_threshold=self.breaker_failures,
+                    reset_timeout_s=self.breaker_reset_s)
+                self._breakers[iid] = br
+            return br
+
+    def breaker_states(self) -> Dict[str, str]:
+        """Per-replica read-breaker states (observability/tests)."""
+        with self._breaker_mu:
+            return {iid: br.state for iid, br in self._breakers.items()}
+
     # ---- write path (session.go:1213 Write → fan-out + accumulate) ----
 
     def _fan_out_once(
@@ -257,13 +287,38 @@ class ReplicatedSession:
         replicas = self._replicas_for_shard(shard, for_read, placement)
         need = level.required(len(replicas))
         results, errors = [], []
+        # An expired query deadline aborts the retry schedule instead of
+        # sleeping out backoff the caller will never see.
+        dl = xdeadline.current()
+        abort = (lambda: dl.expired) if dl is not None else None
         for iid in replicas:
             conn = connections.get(iid)
             if conn is None:
                 errors.append(f"{iid}: down")
                 continue
+            br = self._breaker(iid) if for_read else None
             try:
-                results.append(self.retrier.run(lambda: fn(conn)))
+                if br is not None:
+                    # budget already spent: the query's failure, raised
+                    # OUTSIDE the breaker — overload must not open a
+                    # healthy replica's breaker
+                    if dl is not None:
+                        dl.check(f"fetch {iid}")
+                    results.append(br.call(
+                        lambda: self.retrier.run(lambda: fn(conn),
+                                                 abort=abort)))
+                else:
+                    results.append(self.retrier.run(lambda: fn(conn),
+                                                    abort=abort))
+            except xdeadline.DeadlineExceeded:
+                # The SHARED query budget is spent (or the query was
+                # cancelled): not this replica's failure — surface
+                # typed so the API maps 504, never a 400
+                # ConsistencyError.
+                raise
+            except BreakerOpenError as e:
+                # fail-fast replica: counted as its failure, no dial paid
+                errors.append(f"{iid}: {e}")
             except ShardNotOwnedError as e:
                 # Routing miss, not a data error: OUR placement said
                 # this replica owns the shard, THEIRS says otherwise —
@@ -393,9 +448,15 @@ class ReplicatedSession:
                 errors.append(f"{iid}: down")
                 continue
             try:
-                for d in conn.query_ids(namespace, query, start, end):
+                # pre-spent budget raises OUTSIDE the replica's breaker
+                # (the query's failure, not the peer's)
+                xdeadline.check_current(f"query_ids {iid}")
+                for d in self._breaker(iid).call(
+                        lambda: conn.query_ids(namespace, query, start, end)):
                     docs.setdefault(d.id, d)
                 ok += 1
+            except xdeadline.DeadlineExceeded:
+                raise  # shared budget spent: the query's 504, not a replica error
             except Exception as e:
                 errors.append(f"{iid}: {e}")
         need = self.read_level.required(placement.replica_factor)

@@ -199,6 +199,56 @@ class MediatorConfig:
 
 
 @dataclasses.dataclass
+class QueryConfig:
+    """Read-path overload controls: the query-side mirror of the ingest
+    load-shed contract.  Every query carries an end-to-end deadline
+    (``timeout=`` param, defaulting to ``default_timeout``); admission
+    control bounds concurrent queries (``max_concurrent`` slots, a
+    ``max_queue``-deep wait queue shedding 503 after
+    ``queue_timeout``); per-peer circuit breakers trip after
+    ``breaker_failures`` consecutive transport/deadline failures and
+    probe again after ``breaker_reset``.  ``listen_port`` serves this
+    node's storage to peer coordinators over the QUERY_FETCH protocol;
+    ``remotes`` federates their stores into this node's engine
+    (best-effort unless ``remotes_required``)."""
+
+    default_timeout: str = "30s"
+    max_concurrent: int = 0          # 0 disables admission gating
+    max_queue: int = 0
+    queue_timeout: str = "1s"
+    # log queries that spend more than this fraction of their deadline
+    # (0 disables the slow-query log)
+    slow_query_fraction: float = 0.75
+    listen_port: Optional[int] = None  # None = no federation server
+    remotes: list = dataclasses.field(default_factory=list)
+    remotes_required: bool = False
+    breaker_failures: int = 5
+    breaker_reset: str = "10s"
+
+    def validate(self, errs: list) -> None:
+        for f in ("default_timeout", "queue_timeout", "breaker_reset"):
+            try:
+                parse_duration(getattr(self, f))
+            except ConfigError as e:
+                errs.append(f"query.{f}: {e}")
+        for f in ("max_concurrent", "max_queue"):
+            if getattr(self, f) < 0:
+                errs.append(f"query.{f}: must be >= 0")
+        if not (0.0 <= self.slow_query_fraction <= 1.0):
+            errs.append("query.slow_query_fraction: must be in [0, 1]")
+        if self.breaker_failures < 1:
+            errs.append("query.breaker_failures: must be >= 1")
+        if self.listen_port is not None and not (
+                0 <= self.listen_port < 65536):
+            errs.append("query.listen_port: out of range")
+        for p in self.remotes:
+            host, _, port = (p.rpartition(":") if isinstance(p, str)
+                             else ("", "", ""))
+            if not host or not port.isdigit() or not (0 < int(port) < 65536):
+                errs.append(f"query.remotes: expected 'host:port', got {p!r}")
+
+
+@dataclasses.dataclass
 class CoordinatorConfig:
     listen_host: str = "127.0.0.1"
     listen_port: int = 0  # 0 = ephemeral
@@ -239,6 +289,7 @@ class NodeConfig:
         default_factory=CoordinatorConfig
     )
     mediator: MediatorConfig = dataclasses.field(default_factory=MediatorConfig)
+    query: QueryConfig = dataclasses.field(default_factory=QueryConfig)
     metrics_prefix: str = "m3tpu"
 
     def validate(self) -> None:
@@ -247,6 +298,7 @@ class NodeConfig:
         if self.coordinator is not None:
             self.coordinator.validate(errs)
         self.mediator.validate(errs)
+        self.query.validate(errs)
         if errs:
             raise ConfigError("; ".join(errs))
 
@@ -256,6 +308,7 @@ _NESTED = {
     "db": DBConfig,
     "coordinator": CoordinatorConfig,
     "mediator": MediatorConfig,
+    "query": QueryConfig,
 }
 # Optional nested sections: an explicit `field: null` disables the
 # subsystem (yields None) instead of instantiating defaults.

@@ -20,6 +20,7 @@ import numpy as np
 
 from m3_tpu.query import functions as fn
 from m3_tpu.query import temporal as tp
+from m3_tpu.x import deadline as xdeadline
 from m3_tpu.query.block import Block, RawBlock, SeriesMeta
 from m3_tpu.query.promql import (
     Subquery,
@@ -73,15 +74,20 @@ class Engine:
     # -- public API --------------------------------------------------------
 
     def execute_range(self, query: str, start_nanos: int, end_nanos: int,
-                      step_nanos: int) -> Block:
+                      step_nanos: int, deadline=None) -> Block:
         """PromQL range query (reference api/v1 native read →
-        ExecuteExpr)."""
+        ExecuteExpr).  ``deadline`` (an ``x/deadline.Deadline``) bounds
+        the whole evaluation: checked between eval nodes and inside
+        per-step loops, threaded to storage fetches through the context
+        binding (callers that already bound one can omit it)."""
         from m3_tpu.instrument.tracing import Tracepoint
 
         with self.tracer.start_span(Tracepoint.ENGINE_EXECUTE,
                                     {"query": query}):
-            return self._execute_range(query, start_nanos, end_nanos,
-                                       step_nanos)
+            with xdeadline.bind(deadline if deadline is not None
+                                else xdeadline.current()):
+                return self._execute_range(query, start_nanos, end_nanos,
+                                           step_nanos)
 
     def _execute_range(self, query: str, start_nanos: int, end_nanos: int,
                        step_nanos: int) -> Block:
@@ -102,12 +108,18 @@ class Engine:
         # host float64.
         return out.materialized()
 
-    def execute_instant(self, query: str, time_nanos: int) -> Block:
-        return self.execute_range(query, time_nanos, time_nanos, 10**9)
+    def execute_instant(self, query: str, time_nanos: int,
+                        deadline=None) -> Block:
+        return self.execute_range(query, time_nanos, time_nanos, 10**9,
+                                  deadline=deadline)
 
     # -- evaluation --------------------------------------------------------
 
     def _eval(self, e: Expr, steps: np.ndarray):
+        # Cooperative cancellation point between eval nodes: a deep AST
+        # over a spent budget stops HERE, not after the next expensive
+        # kernel (the per-step loops below check too).
+        xdeadline.check_current("query eval")
         if isinstance(e, NumberLiteral):
             return _Scalar(e.value)
         if isinstance(e, StringLiteral):
@@ -193,11 +205,12 @@ class Engine:
                 np.asarray(b.value, np.float64), (len(inner),))
             b = Block(inner, vals[None, :].copy(), [SeriesMeta(())])
         bvals = np.asarray(b.values)  # one sync, not one per row
-        pts = [
-            [(int(t), float(v)) for t, v in zip(inner, row)
-             if not math.isnan(v)]
-            for row in bvals
-        ]
+        pts = []
+        for i, row in enumerate(bvals):
+            if i % 256 == 0:  # per-row loop over the inner grid
+                xdeadline.check_current("subquery rows")
+            pts.append([(int(t), float(v)) for t, v in zip(inner, row)
+                        if not math.isnan(v)])
         raw = RawBlock.from_lists(pts, b.series)
         return raw, steps - sub.offset_nanos
 
@@ -486,6 +499,8 @@ class Engine:
             return Block(lhs.step_times, vals, metas)
         out = np.full_like(lvals, np.nan)
         for i, m in enumerate(lhs.series):
+            if i % 256 == 0:  # per-series host loop: cancellable
+                xdeadline.check_current("set-op rows")
             j = rkeys.get(fn._match_key(m, on, ig))
             if b.op == "and":
                 if j is not None:

@@ -26,6 +26,7 @@ from m3_tpu.index.search import (
     All, Conjunction, FieldExists, Negation, Regexp, Term,
 )
 from m3_tpu.storage.database import ShardNotOwnedError
+from m3_tpu.x import deadline as xdeadline
 
 NAN = float("nan")
 
@@ -293,7 +294,9 @@ class GraphiteStorage:
         docs = self.db.query_ids(self.namespace, path_to_index_query(path),
                                  start, end)
         out = []
-        for d in sorted(docs, key=lambda d: d.id):
+        for i, d in enumerate(sorted(docs, key=lambda d: d.id)):
+            if i % 64 == 0:  # per-series read loop: cancellable
+                xdeadline.check_current("render fetch")
             p = document_to_path(d)
             if p is None:
                 continue
@@ -1578,6 +1581,9 @@ class GraphiteEngine:
         return out
 
     def _eval(self, node, ctx: _Ctx):
+        # cancellation point between render-pipeline nodes (the
+        # graphite entry rides the same deadline as PromQL queries)
+        xdeadline.check_current("render eval")
         if isinstance(node, PathExpr):
             return ctx.storage.fetch(node.path, ctx.start, ctx.end, ctx.step)
         if isinstance(node, Call):

@@ -4,11 +4,33 @@ Equivalent of the reference's gRPC query federation (`src/query/remote`
 — rpcpb client/server letting one coordinator query another region's
 storage, plugged into fanout as a remote store).  gRPC collapses to the
 framework's framed TCP protocol (msg/protocol.py): a QUERY_FETCH frame
-carries (name, matchers, start, end); the QUERY_RESULT frame carries
-the matched series (tags + raw points).  `RemoteStorage` implements the
-same `fetch_raw` seam as DatabaseStorage, so it drops straight into
-`FanoutSource` — cross-region federation is just another fanout source
-with a coarser typical resolution.
+carries (name, matchers, start, end, remaining-deadline-ms); the
+QUERY_RESULT frame carries the matched series (tags + raw points).
+`RemoteStorage` implements the same `fetch_raw` seam as
+DatabaseStorage, so it drops straight into `FanoutSource` —
+cross-region federation is just another fanout source with a coarser
+typical resolution.
+
+Overload contract (the read-path mirror of PR 1's wire retries):
+
+* the query's **deadline** rides the frame as a relative ms budget, so
+  the server stops work for a client that already gave up, and every
+  per-call socket timeout derives from ``remaining()`` instead of a
+  fixed constant;
+* server-side errors cross the wire **typed** (`TypeName: message`,
+  like the rpc layer) — a remote ``QueryLimitExceeded`` surfaces as a
+  client-side ``QueryLimitExceeded`` (HTTP 429), a remote deadline trip
+  as ``DeadlineExceeded`` (504), never a generic ``RuntimeError`` 500;
+* a small **per-peer connection pool** replaces the old single
+  socket + lock, so concurrent fanout fetches never serialize behind —
+  or wedge on — one slow peer's round-trip;
+* every fetch flows through the peer's shared **circuit breaker**
+  (x/breaker): a dead region fails fast instead of eating the whole
+  deadline on every query.
+
+The ``query.fetch`` faultpoint fires server-side in the storage adapter
+(`query/storage_adapter.py`) so delay/error injection covers local and
+federated reads through one point.
 """
 
 from __future__ import annotations
@@ -22,6 +44,9 @@ import numpy as np
 
 from m3_tpu.msg import protocol as wire
 from m3_tpu.query.block import RawBlock, SeriesMeta
+from m3_tpu.x import deadline as xdeadline
+from m3_tpu.x.breaker import CircuitBreaker
+from m3_tpu.x.deadline import Deadline, DeadlineExceeded
 
 QUERY_FETCH = 8
 QUERY_RESULT = 9
@@ -30,7 +55,8 @@ QUERY_RESULT = 9
 # -- payload codecs ---------------------------------------------------------
 
 
-def encode_fetch(name: bytes | None, matchers, start: int, end: int) -> bytes:
+def encode_fetch(name: bytes | None, matchers, start: int, end: int,
+                 deadline_ms: int = -1) -> bytes:
     parts = [struct.pack("<qq", start, end)]
     parts.append(struct.pack("<H", len(name) if name is not None else 0xFFFF))
     if name is not None:
@@ -42,6 +68,9 @@ def encode_fetch(name: bytes | None, matchers, start: int, end: int) -> bytes:
         parts.append(op)
         parts.append(m.name)
         parts.append(m.value)
+    # trailer: the query's REMAINING budget (relative ms; -1 = none) so
+    # the server stops work once the client's deadline is spent
+    parts.append(struct.pack("<q", deadline_ms))
     return b"".join(parts)
 
 
@@ -69,7 +98,10 @@ def decode_fetch(raw: bytes):
         value = raw[pos : pos + vl]
         pos += vl
         matchers.append(LabelMatcher(mname, op, value))
-    return name, tuple(matchers), start, end
+    deadline_ms = -1
+    if pos + 8 <= len(raw):  # pre-deadline encoders have no trailer
+        (deadline_ms,) = struct.unpack_from("<q", raw, pos)
+    return name, tuple(matchers), start, end, deadline_ms
 
 
 def encode_result(block: RawBlock) -> bytes:
@@ -115,6 +147,22 @@ def decode_result(raw: bytes) -> RawBlock:
     return RawBlock.from_lists(pts, metas)
 
 
+# -- typed error mapping ----------------------------------------------------
+
+
+def _decode_query_error(msg: str) -> Exception:
+    """wire.ERROR payload (``TypeName: message``) → the exception to
+    re-raise client-side.  Overload errors map through the shared
+    ``x/deadline.decode_wire_error`` (one mapping for both wire
+    protocols): a remote limit trip stays a ``QueryLimitExceeded``
+    (HTTP 429) and a remote deadline trip a ``DeadlineExceeded``
+    (504) — not a generic 500."""
+    typed = xdeadline.decode_wire_error(msg)
+    if typed is not None:
+        return typed
+    return RuntimeError(f"remote query failed: {msg}")
+
+
 # -- server -----------------------------------------------------------------
 
 
@@ -131,12 +179,20 @@ class _QueryHandler(socketserver.BaseRequestHandler):
             if frame is None or frame[0] != QUERY_FETCH:
                 return
             try:
-                name, matchers, start, end = decode_fetch(frame[1])
-                block = srv.storage.fetch_raw(name, matchers, start, end)
+                name, matchers, start, end, dl_ms = decode_fetch(frame[1])
+                # The client's remaining budget becomes THIS side's
+                # deadline: storage stops work (typed) once the caller
+                # has given up, instead of computing an answer nobody
+                # will read.
+                dl = Deadline(dl_ms / 1000.0) if dl_ms >= 0 else None
+                with xdeadline.bind(dl):
+                    xdeadline.check_current("remote fetch")
+                    block = srv.storage.fetch_raw(name, matchers, start, end)
                 wire.send_frame(sock, QUERY_RESULT, encode_result(block))
             except Exception as e:  # noqa: BLE001 — report, don't die
                 try:
-                    wire.send_frame(sock, wire.ERROR, str(e).encode())
+                    wire.send_frame(sock, wire.ERROR,
+                                    f"{type(e).__name__}: {e}".encode()[:4096])
                 except OSError:
                     return
 
@@ -167,46 +223,142 @@ def serve_query_background(storage, host: str = "127.0.0.1",
 # -- client -----------------------------------------------------------------
 
 
+class _ConnPool:
+    """Small per-peer socket pool: concurrent queries each check out
+    their own connection instead of serializing behind one shared
+    socket (the old single-socket + lock shape let one slow peer wedge
+    EVERY concurrent fanout fetch).  ``max_idle`` bounds what a burst
+    leaves warm; checkouts beyond it dial fresh and close on return."""
+
+    def __init__(self, address, max_idle: int = 4):
+        self.address = address
+        self.max_idle = int(max_idle)
+        self._mu = threading.Lock()
+        self._idle: list[socket.socket] = []
+        self._closed = False
+
+    def get(self, cap_s: float, fresh: bool = False) -> socket.socket:
+        # per-checkout timeout from the bound deadline's remaining
+        # budget (capped): a pooled socket must never outlive its query.
+        # ``fresh`` skips the idle list and dials — retry-after-failure
+        # must not pop ANOTHER socket staled by the same peer restart.
+        timeout_s = xdeadline.socket_timeout(cap_s)
+        if not fresh:
+            with self._mu:
+                if self._idle:
+                    sock = self._idle.pop()
+                    sock.settimeout(timeout_s)
+                    return sock
+        return wire.connect(self.address, timeout=timeout_s)
+
+    def put(self, sock: socket.socket) -> None:
+        with self._mu:
+            if not self._closed and len(self._idle) < self.max_idle:
+                self._idle.append(sock)
+                return
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        with self._mu:
+            self._closed = True
+            idle, self._idle = self._idle, []
+        for sock in idle:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
 class RemoteStorage:
     """fetch_raw over the wire: a drop-in fanout source
-    (reference query/remote/client.go wrapped as a remote store)."""
+    (reference query/remote/client.go wrapped as a remote store).
 
-    def __init__(self, address, timeout_s: float = 30.0):
-        self.address = address
+    Deadline-aware: per-call socket timeouts derive from the bound
+    deadline's ``remaining()`` (capped by ``timeout_s``), the remaining
+    budget rides the QUERY_FETCH frame, and a transport timeout with
+    the budget spent surfaces as typed ``DeadlineExceeded``.  All calls
+    flow through ``breaker`` (one per peer) so a dead region fails fast
+    for every sharer at once."""
+
+    def __init__(self, address, timeout_s: float = 30.0, pool_size: int = 4,
+                 breaker: CircuitBreaker | None = None):
+        self.address = tuple(address)
         self.timeout_s = timeout_s
-        self._sock: socket.socket | None = None
-        self._lock = threading.Lock()
+        self.breaker = breaker
+        self._pool = _ConnPool(self.address, max_idle=pool_size)
 
-    def _connect(self) -> socket.socket:
-        if self._sock is None:
-            self._sock = wire.connect(self.address, timeout=self.timeout_s)
-        return self._sock
+    @property
+    def peer(self) -> str:
+        return f"{self.address[0]}:{self.address[1]}"
+
+    def _round_trip(self, payload: bytes, fresh: bool = False):
+        """One send/recv on a pooled connection; the connection returns
+        to the pool only after a clean exchange.  EOF mid-exchange (the
+        peer restarted; send into the half-closed socket still
+        "succeeds") raises ``ConnectionError`` — an ``OSError``, so the
+        caller's one-reconnect retry fires — and the dead socket is
+        closed, never re-pooled."""
+        xdeadline.check_current("remote fetch")
+        sock = self._pool.get(self.timeout_s, fresh=fresh)
+        try:
+            wire.send_frame(sock, QUERY_FETCH, payload)
+            frame = wire.recv_frame(sock)
+            if frame is None:
+                raise ConnectionError("remote query peer closed connection")
+        except BaseException:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
+        self._pool.put(sock)
+        return frame
 
     def fetch_raw(self, name, matchers, start_nanos, end_nanos) -> RawBlock:
-        payload = encode_fetch(name, matchers, start_nanos, end_nanos)
-        with self._lock:
+        # A budget already spent UPSTREAM (engine eval, another fanout
+        # source) raises here, before the breaker: it is the query's
+        # failure, not this peer's — a burst of slow queries must not
+        # trip a healthy peer's breaker open.
+        xdeadline.check_current("remote fetch")
+        if self.breaker is not None:
+            return self.breaker.call(
+                lambda: self._fetch_raw(name, matchers, start_nanos,
+                                        end_nanos))
+        return self._fetch_raw(name, matchers, start_nanos, end_nanos)
+
+    def _fetch_raw(self, name, matchers, start_nanos, end_nanos) -> RawBlock:
+        dl = xdeadline.current()
+
+        def payload() -> bytes:
+            # encoded per attempt: the trailer must carry the budget
+            # REMAINING at send time, not at first-attempt time
+            return encode_fetch(name, matchers, start_nanos, end_nanos,
+                                deadline_ms=xdeadline.remaining_ms())
+
+        try:
             try:
-                sock = self._connect()
-                wire.send_frame(sock, QUERY_FETCH, payload)
-                frame = wire.recv_frame(sock)
+                frame = self._round_trip(payload())
             except (OSError, wire.ProtocolError):
-                # one reconnect attempt (server restarts are routine)
-                self.close()
-                sock = self._connect()
-                wire.send_frame(sock, QUERY_FETCH, payload)
-                frame = wire.recv_frame(sock)
-        if frame is None:
-            raise ConnectionError("remote query peer closed connection")
+                # one reconnect attempt (server restarts are routine);
+                # ``fresh`` dials a new socket — the restart that staled
+                # this one staled every idle pooled socket too
+                if dl is not None:
+                    dl.check("remote fetch retry")
+                frame = self._round_trip(payload(), fresh=True)
+        except (socket.timeout, TimeoutError) as e:
+            if dl is not None and dl.expired:
+                raise dl.exceeded(
+                    f"remote fetch {self.peer}: deadline exceeded") from e
+            raise
         ftype, body = frame
         if ftype == wire.ERROR:
-            raise RuntimeError(f"remote query failed: {body.decode()}")
+            raise _decode_query_error(body.decode())
         if ftype != QUERY_RESULT:
             raise wire.ProtocolError(f"unexpected frame type {ftype}")
         return decode_result(body)
 
     def close(self) -> None:
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            finally:
-                self._sock = None
+        self._pool.close()

@@ -8,6 +8,8 @@ process, as in the reference's embedded coordinator mode.
 
 from __future__ import annotations
 
+import contextlib
+
 import numpy as np
 
 from m3_tpu.index.search import (
@@ -16,6 +18,11 @@ from m3_tpu.index.search import (
 from m3_tpu.query.block import RawBlock, SeriesMeta
 from m3_tpu.query.promql import LabelMatcher
 from m3_tpu.storage.database import Database, ShardNotOwnedError
+from m3_tpu.x import deadline as xdeadline
+from m3_tpu.x import fault
+
+# reusable no-op scope for the unbound-deadline fast path
+_NULL_PHASE = contextlib.nullcontext()
 
 
 def matchers_to_query(name: bytes | None,
@@ -51,12 +58,25 @@ class DatabaseStorage:
         self.namespace = namespace
 
     def fetch_raw(self, name, matchers, start_nanos, end_nanos) -> RawBlock:
+        # The read path's deterministic injection point: delay = slow
+        # storage/peer (the overload dtest arms this on one replica),
+        # error = failed fetch.  Fired here so BOTH local engine reads
+        # and federation-served remote fetches cross one boundary.
+        fault.fire("query.fetch")
+        dl = xdeadline.current()
+        with (dl.phase("fetch") if dl is not None
+              else _NULL_PHASE):
+            return self._fetch_raw(name, matchers, start_nanos, end_nanos)
+
+    def _fetch_raw(self, name, matchers, start_nanos, end_nanos) -> RawBlock:
         q = matchers_to_query(name, matchers)
         docs = self.db.query_ids(self.namespace, q, start_nanos, end_nanos)
         docs.sort(key=lambda d: d.id)
         pts = []
         metas = []
-        for d in docs:
+        for i, d in enumerate(docs):
+            if i % 64 == 0:  # per-series read loop: cancellable
+                xdeadline.check_current("fetch series")
             try:
                 pts.append(
                     self.db.read(self.namespace, d.id, start_nanos, end_nanos))
@@ -82,11 +102,15 @@ class SessionStorage:
         self.namespace = namespace
 
     def fetch_raw(self, name, matchers, start_nanos, end_nanos) -> RawBlock:
+        fault.fire("query.fetch")
         q = matchers_to_query(name, matchers)
         docs = self.session.query_ids(self.namespace, q, start_nanos, end_nanos)
-        pts = [
-            self.session.fetch(self.namespace, d.id, start_nanos, end_nanos)
-            for d in docs
-        ]
+        pts = []
+        for i, d in enumerate(docs):
+            if i % 64 == 0:  # per-series replica fan-out: cancellable
+                xdeadline.check_current("fetch series")
+            pts.append(
+                self.session.fetch(self.namespace, d.id, start_nanos,
+                                   end_nanos))
         metas = [SeriesMeta(tuple(sorted(d.tags().items()))) for d in docs]
         return RawBlock.from_lists(pts, metas)

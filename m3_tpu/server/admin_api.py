@@ -34,6 +34,15 @@ Every placement mutation goes through ``PlacementService.update`` — a
 get→mutate→CAS loop with bounded retry on version conflict, so two
 concurrent admin calls (or an admin call racing a node's cutover CAS)
 both land instead of one 500ing.
+
+Query-path overload controls live on the MAIN HTTP API
+(server/http_api.py), not here: the read endpoints accept a
+``timeout=`` param (end-to-end deadline, default
+``query.default_timeout``) and map the typed overload errors to
+**429** (resource limit), **503 + Retry-After** (admission shed) and
+**504** (deadline exceeded); admission/breaker/slow-query state is
+observable on every node's ``/health`` (``query`` section) and
+``/metrics`` — see TESTING.md "Query deadlines, admission & breakers".
 """
 
 from __future__ import annotations

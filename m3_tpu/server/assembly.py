@@ -37,6 +37,8 @@ class Assembly:
     scrubber: object | None = None
     topology: object | None = None   # cluster.topology.TopologyWatcher
     migrator: object | None = None   # storage.migration.ShardMigrator
+    query_server: object | None = None  # query.remote.QueryServer
+    remote_stores: list = dataclasses.field(default_factory=list)
 
     @property
     def port(self) -> int | None:
@@ -45,6 +47,10 @@ class Assembly:
     @property
     def rpc_port(self) -> int | None:
         return self.rpc_server.port if self.rpc_server else None
+
+    @property
+    def query_port(self) -> int | None:
+        return self.query_server.port if self.query_server else None
 
     @property
     def carbon_port(self) -> int | None:
@@ -57,6 +63,11 @@ class Assembly:
     def close(self) -> None:
         for h in self.peer_handles:
             h.close()
+        for r in self.remote_stores:
+            r.close()
+        if self.query_server is not None:
+            self.query_server.shutdown()
+            self.query_server.server_close()
         if self.rpc_server is not None:
             self.rpc_server.shutdown()
             self.rpc_server.server_close()
@@ -265,6 +276,40 @@ def run_node(source, start_mediator: bool | None = None,
                 db, host=cfg.db.rpc_listen_host, port=cfg.db.rpc_listen_port
             )
 
+        # Query federation (query/remote): serve THIS node's storage to
+        # peer coordinators over QUERY_FETCH, and/or federate peer
+        # coordinators' stores into this node's engine.  Each remote
+        # gets the process-shared per-peer circuit breaker so a dead
+        # region fails fast for every query at once.
+        ns0 = (cfg.coordinator.namespace if cfg.coordinator is not None
+               else "default")
+        if cfg.query.listen_port is not None:
+            from m3_tpu.query.remote import serve_query_background
+            from m3_tpu.query.storage_adapter import DatabaseStorage
+
+            asm.query_server = serve_query_background(
+                DatabaseStorage(db, ns0),
+                host=(cfg.coordinator.listen_host
+                      if cfg.coordinator is not None else "127.0.0.1"),
+                port=cfg.query.listen_port,
+            )
+        if cfg.query.remotes:
+            from m3_tpu.query.remote import RemoteStorage
+            from m3_tpu.x.breaker import breaker_for
+
+            breaker_reset_s = parse_duration(cfg.query.breaker_reset) / 1e9
+            asm.remote_stores = [
+                RemoteStorage(
+                    (h, int(p)),
+                    timeout_s=parse_duration(cfg.query.default_timeout) / 1e9,
+                    breaker=breaker_for(
+                        f"query:{h}:{p}",
+                        failure_threshold=cfg.query.breaker_failures,
+                        reset_timeout_s=breaker_reset_s),
+                )
+                for h, _, p in (a.rpartition(":") for a in cfg.query.remotes)
+            ]
+
         # Corruption scrubber: always constructed (the admin endpoint
         # scrubs on demand); attached to the mediator loop only when a
         # per-tick budget is configured.  Peers double as the repair
@@ -300,11 +345,36 @@ def run_node(source, start_mediator: bool | None = None,
                 downsampler = Downsampler(
                     db, ruleset, namespace=cfg.coordinator.namespace
                 )
+            from m3_tpu.x.admission import AdmissionController
+
+            admission = AdmissionController(
+                max_concurrent=cfg.query.max_concurrent,
+                max_queue=cfg.query.max_queue,
+                queue_timeout_s=parse_duration(cfg.query.queue_timeout) / 1e9,
+            )
             ctx = ApiContext(
                 db, namespace=cfg.coordinator.namespace, registry=registry,
                 downsampler=downsampler, tracer=tracer,
                 migrator=asm.migrator,
+                admission=admission,
+                query_timeout_s=parse_duration(cfg.query.default_timeout) / 1e9,
+                slow_query_fraction=cfg.query.slow_query_fraction,
+                remotes=asm.remote_stores,
+                remotes_required=cfg.query.remotes_required,
             )
+
+            # Admission/slow-query observability: query_active,
+            # query_shed_total etc. ride the same scrape-time collector
+            # pattern as the fault/retry/breaker mirrors.
+            def collect_query(_ctx=ctx) -> None:
+                m = _ctx.admission.metrics()
+                scope.gauge("query_active").update(m["active"])
+                scope.gauge("query_queued").update(m["waiting"])
+                scope.gauge("query_shed_total").update(m["shed_total"])
+                scope.gauge("query_admitted_total").update(m["admitted_total"])
+                scope.gauge("slow_query_total").update(_ctx.slow_query_total)
+
+            registry.register_collector(collect_query)
             asm.http_server = serve_background(
                 ctx, cfg.coordinator.listen_host, cfg.coordinator.listen_port
             )

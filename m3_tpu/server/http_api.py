@@ -6,14 +6,39 @@ write endpoint (`api/v1/json/write`), and label/series metadata
 endpoints.  Response shapes follow the Prometheus HTTP API so Grafana
 pointed at `/api/v1/query_range` works unchanged — the same
 compatibility target the reference serves.
+
+Read-path overload contract (`/api/v1/query`, `/api/v1/query_range`,
+`/render`, `/api/v1/prom/remote/read`):
+
+* ``timeout=`` query param (seconds or a duration like ``30s``/``2m``)
+  sets the query's END-TO-END deadline, defaulting to the
+  ``query.default_timeout`` config; the deadline is threaded through
+  the engine, fanout and every wire hop (x/deadline), and partial
+  results from non-required fanout sources surface in the Prometheus
+  ``warnings`` response field.
+* Status mapping: **429** a per-query resource limit tripped
+  (``QueryLimitExceeded``, local or remote) — client should back off;
+  **503 + Retry-After** admission control shed the query
+  (``QueryShedError``: concurrency slots and wait queue full) — retry
+  after the hinted delay; **504** the deadline was exceeded
+  (``DeadlineExceeded``, including cooperative cancellation) — retry
+  with a longer ``timeout=`` or narrower query.  Multiple REQUIRED
+  fanout sources failing together (``PartialResultError``) map by the
+  dominant cause: 504 if any missed the deadline, 429 if any tripped a
+  limit, else **502**.
+* Queries spending more than ``query.slow_query_fraction`` of their
+  deadline land in the slow-query log (`/health` ``query.slow`` +
+  ``slow_query_total`` on /metrics) with per-phase timings.
 """
 
 from __future__ import annotations
 
+import collections
 import json
 import math
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
@@ -22,9 +47,13 @@ import numpy as np
 from m3_tpu.index.doc import Document
 from m3_tpu.index.search import All, FieldExists, Term
 from m3_tpu.query.engine import Engine
+from m3_tpu.query.fanout import FederatedStorage, PartialResultError
 from m3_tpu.query.storage_adapter import DatabaseStorage
 from m3_tpu.storage.database import Database, ShardNotOwnedError
 from m3_tpu.storage.limits import QueryLimitExceeded
+from m3_tpu.x import deadline as xdeadline
+from m3_tpu.x.admission import AdmissionController, QueryShedError
+from m3_tpu.x.deadline import Deadline, DeadlineExceeded
 
 _DUR_RE = re.compile(r"^(\d+(?:\.\d+)?)([smhdwy]|ms)$")
 
@@ -52,16 +81,51 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- helpers -----------------------------------------------------------
 
-    def _json(self, code: int, obj) -> None:
+    def _json(self, code: int, obj, headers: dict | None = None) -> None:
         data = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
 
-    def _error(self, code: int, msg: str) -> None:
-        self._json(code, {"status": "error", "error": msg})
+    def _error(self, code: int, msg: str,
+               headers: dict | None = None) -> None:
+        self._json(code, {"status": "error", "error": msg}, headers)
+
+    def _overload_status(self, e: Exception) -> None:
+        """The typed read-path overload errors → HTTP status (see
+        module docstring: 429 limit / 503 shed / 504 deadline).  A
+        multi-source ``PartialResultError`` maps by its dominant cause
+        — these are server-side failures, never a 400."""
+        if isinstance(e, PartialResultError):
+            causes = e.failures.values()
+            if any(isinstance(c, DeadlineExceeded) for c in causes):
+                return self._error(504, str(e))
+            if any(isinstance(c, QueryLimitExceeded) for c in causes):
+                return self._error(429, str(e))
+            return self._error(502, str(e))
+        if isinstance(e, QueryLimitExceeded):
+            return self._error(429, str(e))
+        if isinstance(e, QueryShedError):
+            return self._error(
+                503, str(e),
+                headers={"Retry-After":
+                         str(max(1, math.ceil(e.retry_after_s)))})
+        if isinstance(e, DeadlineExceeded):
+            return self._error(504, str(e))
+        raise e
+
+    def _deadline(self, q) -> Deadline:
+        """Every read request gets an end-to-end deadline: the
+        ``timeout=`` param (seconds or ``30s``-style duration), default
+        from config (``query.default_timeout``)."""
+        v = q.get("timeout", [None])[0]
+        timeout_s = (self.ctx.query_timeout_s if v is None
+                     else _parse_step(v) / 1e9)
+        return Deadline(timeout_s)
 
     def _body(self):
         n = int(self.headers.get("Content-Length", 0))
@@ -95,8 +159,9 @@ class _Handler(BaseHTTPRequestHandler):
             if u.path == "/metrics/find":
                 return self._find(q)
             return self._error(404, f"unknown path {u.path}")
-        except QueryLimitExceeded as e:
-            return self._error(429, str(e))
+        except (QueryLimitExceeded, QueryShedError, DeadlineExceeded,
+                PartialResultError) as e:
+            return self._overload_status(e)
         except Exception as e:  # noqa: BLE001 — API boundary
             return self._error(400, str(e))
 
@@ -110,13 +175,14 @@ class _Handler(BaseHTTPRequestHandler):
             if u.path == "/api/v1/prom/remote/write":
                 return self._prom_remote_write()
             if u.path == "/api/v1/prom/remote/read":
-                return self._prom_remote_read()
+                return self._prom_remote_read(parse_qs(u.query))
             if u.path in ("/api/v1/query_range", "/api/v1/query"):
                 q = parse_qs(self._body().decode())
                 return self._query(u.path.endswith("query_range"), q)
             return self._error(404, f"unknown path {u.path}")
-        except QueryLimitExceeded as e:
-            return self._error(429, str(e))
+        except (QueryLimitExceeded, QueryShedError, DeadlineExceeded,
+                PartialResultError) as e:
+            return self._overload_status(e)
         except Exception as e:  # noqa: BLE001
             return self._error(400, str(e))
 
@@ -153,6 +219,23 @@ class _Handler(BaseHTTPRequestHandler):
                 out["topology"] = self.ctx.migrator.status()
             except Exception:  # noqa: BLE001 — health must never 500
                 pass
+        # Read-path overload visibility: admission gauges, the slow-
+        # query log tail, and per-peer breaker states — the operator's
+        # window into WHY queries are shedding/504ing.  Omitted while
+        # there is nothing to see (no gating configured, no slow
+        # queries, no peers): a clean node's health stays noise-free.
+        try:
+            q = self.ctx.query_status()
+            from m3_tpu.x.breaker import all_breakers
+
+            breakers = {name: br.state for name, br in all_breakers().items()}
+            if breakers:
+                q["breakers"] = breakers
+            if (breakers or q["max_concurrent"] > 0
+                    or q["slow_query_total"] or q["shed_total"]):
+                out["query"] = q
+        except Exception:  # noqa: BLE001 — health must never 500
+            pass
         return self._json(200, out)
 
     def _debug_dump(self, q):
@@ -195,18 +278,27 @@ class _Handler(BaseHTTPRequestHandler):
         start = parse_graphite_time(q.get("from", ["-1h"])[0], now)
         end = parse_graphite_time(q.get("until", ["now"])[0], now)
         step = _parse_step(q.get("step", ["10s"])[0])
+        dl = self._deadline(q)
         out = []
-        for target in q.get("target", []):
-            for s in self.ctx.graphite.render(target, start, end, step):
-                step_s = s.step_nanos / 1e9
-                out.append({
-                    "target": s.name,
-                    "datapoints": [
-                        [None if _math.isnan(v) else v,
-                         int(s.start_nanos / 1e9 + i * step_s)]
-                        for i, v in enumerate(s.values.tolist())
-                    ],
-                })
+        targets = q.get("target", [])
+        try:
+            with self.ctx.admission.admit(deadline=dl), xdeadline.bind(dl):
+                for target in targets:
+                    for s in self.ctx.graphite.render(target, start, end,
+                                                      step):
+                        step_s = s.step_nanos / 1e9
+                        out.append({
+                            "target": s.name,
+                            "datapoints": [
+                                [None if _math.isnan(v) else v,
+                                 int(s.start_nanos / 1e9 + i * step_s)]
+                                for i, v in enumerate(s.values.tolist())
+                            ],
+                        })
+        except Exception as e:  # noqa: BLE001 — observed, then re-raised
+            self.ctx.observe_query("graphite", ";".join(targets), dl, error=e)
+            raise
+        self.ctx.observe_query("graphite", ";".join(targets), dl)
         return self._json(200, out)
 
     def _find(self, q):
@@ -302,9 +394,11 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         return None
 
-    def _prom_remote_read(self):
+    def _prom_remote_read(self, uq):
         """Prometheus remote read: snappy+protobuf ReadRequest →
-        ReadResponse (reference handler/prometheus/remote/read.go)."""
+        ReadResponse (reference handler/prometheus/remote/read.go).
+        ``timeout=`` rides the URL query string (the body is
+        protobuf)."""
         from m3_tpu.query.promql import LabelMatcher
         from m3_tpu.query.storage_adapter import matchers_to_query
         from m3_tpu.server.prom_remote import (
@@ -314,25 +408,30 @@ class _Handler(BaseHTTPRequestHandler):
         ctx = self.ctx
         _OPS = {0: "=", 1: "!=", 2: "=~", 3: "!~"}
         results = []
-        for q in parse_read_request(self._body()):
-            matchers = tuple(
-                LabelMatcher(m.name, _OPS[m.type], m.value) for m in q.matchers
-            )
-            idx_q = matchers_to_query(None, matchers)
-            # prompb end timestamps are INCLUSIVE; db reads are
-            # end-exclusive (same boundary rule as Engine._fetch)
-            end = q.end_nanos + 1
-            docs = ctx.db.query_ids(ctx.namespace, idx_q,
-                                    q.start_nanos, end)
-            series_out = []
-            for d in sorted(docs, key=lambda d: d.id):
-                try:
-                    pts = ctx.db.read(ctx.namespace, d.id,
-                                      q.start_nanos, end)
-                except ShardNotOwnedError:
-                    continue  # unowned shard: replicas answer it
-                series_out.append(PromTimeSeries(d.tags(), list(pts)))
-            results.append(series_out)
+        dl = self._deadline(uq)
+        with ctx.admission.admit(deadline=dl), xdeadline.bind(dl):
+            for q in parse_read_request(self._body()):
+                matchers = tuple(
+                    LabelMatcher(m.name, _OPS[m.type], m.value)
+                    for m in q.matchers
+                )
+                idx_q = matchers_to_query(None, matchers)
+                # prompb end timestamps are INCLUSIVE; db reads are
+                # end-exclusive (same boundary rule as Engine._fetch)
+                end = q.end_nanos + 1
+                docs = ctx.db.query_ids(ctx.namespace, idx_q,
+                                        q.start_nanos, end)
+                series_out = []
+                for i, d in enumerate(sorted(docs, key=lambda d: d.id)):
+                    if i % 64 == 0:  # per-series read loop: cancellable
+                        dl.check("remote read")
+                    try:
+                        pts = ctx.db.read(ctx.namespace, d.id,
+                                          q.start_nanos, end)
+                    except ShardNotOwnedError:
+                        continue  # unowned shard: replicas answer it
+                    series_out.append(PromTimeSeries(d.tags(), list(pts)))
+                results.append(series_out)
         body = build_read_response(results)
         self.send_response(200)
         self.send_header("Content-Type", "application/x-protobuf")
@@ -394,7 +493,17 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             start = end = _parse_time(q["time"][0])
             step = 10**9
-        block = self.ctx.engine.execute_range(query, start, end, step)
+        dl = self._deadline(q)
+        ctx = self.ctx
+        try:
+            # admission first (a shed query must not bind engine
+            # resources), then the deadline rides the context into the
+            # engine → fanout → wire
+            with ctx.admission.admit(deadline=dl), xdeadline.bind(dl):
+                block = ctx.engine.execute_range(query, start, end, step)
+        except Exception as e:  # noqa: BLE001 — observed, then re-raised
+            ctx.observe_query("promql", query, dl, error=e)
+            raise
         result = []
         for i, meta in enumerate(block.series):
             values = [
@@ -409,13 +518,19 @@ class _Handler(BaseHTTPRequestHandler):
                 result.append({"metric": metric, "values": values})
             else:
                 result.append({"metric": metric, "value": values[-1]})
-        return self._json(200, {
+        ctx.observe_query("promql", query, dl)
+        payload = {
             "status": "success",
             "data": {
                 "resultType": "matrix" if is_range else "vector",
                 "result": result,
             },
-        })
+        }
+        if dl.warnings:
+            # partial-result policy: non-required fanout sources that
+            # failed/missed the deadline (Prometheus warnings field)
+            payload["warnings"] = list(dl.warnings)
+        return self._json(200, payload)
 
     def _fetch_docs(self, q):
         ctx = self.ctx
@@ -454,17 +569,78 @@ def _fmt(v: float) -> str:
 class ApiContext:
     def __init__(self, db: Database, namespace: str = "default",
                  downsampler=None, registry=None, tracer=None,
-                 migrator=None):
+                 migrator=None, admission: AdmissionController | None = None,
+                 query_timeout_s: float = 30.0,
+                 slow_query_fraction: float = 0.75,
+                 remotes=None, remotes_required: bool = False):
         self.db = db
         self.namespace = namespace
         self.downsampler = downsampler
         self.registry = registry
         self.tracer = tracer
         self.migrator = migrator  # storage.migration.ShardMigrator | None
-        self.engine = Engine(DatabaseStorage(db, namespace), tracer=tracer)
+        # read-path overload controls (see module docstring); the
+        # default AdmissionController(0) gates nothing
+        self.admission = admission or AdmissionController()
+        self.query_timeout_s = float(query_timeout_s)
+        self.slow_query_fraction = float(slow_query_fraction)
+        self.slow_query_total = 0
+        self._slow_mu = threading.Lock()
+        self.slow_queries = collections.deque(maxlen=32)
+        # cross-coordinator federation: remote stores (query/remote
+        # RemoteStorage) merged best-effort with the local database
+        # unless remotes_required
+        self.remotes = list(remotes or [])
+        local = DatabaseStorage(db, namespace)
+        if self.remotes:
+            stores = [local] + self.remotes
+            required = [0] + (list(range(1, len(stores)))
+                              if remotes_required else [])
+            storage = FederatedStorage(stores, required=required)
+        else:
+            storage = local
+        self.engine = Engine(storage, tracer=tracer)
         from m3_tpu.query.graphite import GraphiteEngine, GraphiteStorage
 
         self.graphite = GraphiteEngine(GraphiteStorage(db, namespace))
+
+    def observe_query(self, kind: str, query: str, dl: Deadline,
+                      error: Exception | None = None) -> None:
+        """Slow-query log: a query that spent more than
+        ``slow_query_fraction`` of its deadline (or died trying) is
+        recorded with matchers and per-phase timings — the operator's
+        view of WHAT is eating the budget (`/health` ``query.slow``)."""
+        if self.slow_query_fraction <= 0 or dl.timeout_s <= 0:
+            return
+        frac = dl.elapsed() / dl.timeout_s
+        if frac < self.slow_query_fraction:
+            return  # fast queries — including fast failures — skip the log
+        entry = {
+            "kind": kind,
+            "query": query,
+            "timeout_s": round(dl.timeout_s, 3),
+            "elapsed_s": round(dl.elapsed(), 3),
+            "deadline_fraction": round(frac, 3),
+            "phases": {k: round(v, 3) for k, v in dl.phases.items()},
+            "time_unix": time.time(),
+        }
+        if dl.warnings:
+            entry["warnings"] = list(dl.warnings)
+        if error is not None:
+            entry["error"] = f"{type(error).__name__}: {error}"
+        with self._slow_mu:
+            self.slow_query_total += 1
+            self.slow_queries.append(entry)
+
+    def query_status(self) -> dict:
+        """The /health ``query`` document: admission gauges + the slow
+        log tail."""
+        out = self.admission.metrics()
+        out["default_timeout_s"] = self.query_timeout_s
+        with self._slow_mu:
+            out["slow_query_total"] = self.slow_query_total
+            out["slow"] = list(self.slow_queries)[-10:]
+        return out
 
 
 def make_server(ctx: ApiContext, host: str = "127.0.0.1", port: int = 0):

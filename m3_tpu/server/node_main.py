@@ -45,6 +45,7 @@ def main(argv=None) -> int:
         "carbon_port": asm.carbon_port,
         "rpc_port": asm.rpc_port,
         "admin_port": asm.admin_port,
+        "query_port": asm.query_port,
         "root": cfg.db.root,
     }
     status_path = Path(cfg.db.root) / "node.json"

@@ -46,12 +46,16 @@ from m3_tpu.index.doc import Document, Field
 from m3_tpu.msg.protocol import (
     ProtocolError, connect as wire_connect, recv_frame, send_frame,
 )
+from m3_tpu.x import deadline as xdeadline
 from m3_tpu.x import fault
+from m3_tpu.x.breaker import CircuitBreaker
+from m3_tpu.x.deadline import Deadline, DeadlineExceeded
 
 # frame types (disjoint from the bus's so a misdirected client fails fast)
-RPC_REQ = 16
+RPC_REQ = 16     # legacy request: [method u8][body]
 RPC_OK = 17
 RPC_ERR = 18
+RPC_REQ_DL = 19  # deadline-carrying request: [method u8][budget ms i64][body]
 
 
 class RemoteError(RuntimeError):
@@ -75,7 +79,12 @@ _SHARD_NOT_OWNED_RE = re.compile(
 
 
 def _decode_remote_error(msg: str):
-    """RPC_ERR payload → the exception to raise client-side."""
+    """RPC_ERR payload → the exception to raise client-side.  Besides
+    routing misses, the overload family crosses typed too (via the
+    shared ``x/deadline.decode_wire_error`` mapping): a remote
+    ``QueryLimitExceeded`` must surface as 429 and a remote deadline
+    trip as 504 at the API boundary, never a generic ``RemoteError``
+    500."""
     if msg.startswith("ShardNotOwnedError:"):
         from m3_tpu.storage.database import ShardNotOwnedError
 
@@ -83,6 +92,9 @@ def _decode_remote_error(msg: str):
         if m:
             return ShardNotOwnedError(m.group(2), int(m.group(1)))
         return ShardNotOwnedError(None, None)
+    typed = xdeadline.decode_wire_error(msg)
+    if typed is not None:
+        return typed
     return RemoteError(msg)
 
 # methods
@@ -242,7 +254,7 @@ class _RpcHandler(socketserver.BaseRequestHandler):
                 frame = recv_frame(sock)
             except (ProtocolError, OSError):
                 return
-            if frame is None or frame[0] != RPC_REQ:
+            if frame is None or frame[0] not in (RPC_REQ, RPC_REQ_DL):
                 return
             payload = frame[1]
             try:
@@ -252,9 +264,26 @@ class _RpcHandler(socketserver.BaseRequestHandler):
                 act, payload = fault.mangle("rpc.server", payload)
                 if act == "drop":
                     return
-                if not payload:
-                    raise ProtocolError("empty rpc request")
-                resp = self._dispatch(srv.db, payload[0], payload[1:])
+                if frame[0] == RPC_REQ_DL:
+                    # [method u8][remaining-deadline ms i64][body]: bind
+                    # the client's surviving budget so the server stops
+                    # work (typed DeadlineExceeded → RPC_ERR) once the
+                    # caller has given up; -1 = no deadline.
+                    if len(payload) < 9:
+                        raise ProtocolError("short rpc request")
+                    (dl_ms,) = struct.unpack_from("<q", payload, 1)
+                    dl = Deadline(dl_ms / 1000.0) if dl_ms >= 0 else None
+                    body = payload[9:]
+                else:
+                    # legacy [method u8][body] frame from a pre-deadline
+                    # client (rolling upgrade): no budget, full service
+                    if not payload:
+                        raise ProtocolError("empty rpc request")
+                    dl = None
+                    body = payload[1:]
+                with xdeadline.bind(dl):
+                    xdeadline.check_current("rpc dispatch")
+                    resp = self._dispatch(srv.db, payload[0], body)
                 send_frame(sock, RPC_OK, resp)
             except Exception as e:  # application error -> typed error frame
                 try:
@@ -379,24 +408,48 @@ class RemoteDatabase:
 
     Lazily (re)connects per call; any transport failure closes the
     socket and raises ConnectionError so quorum layers can count the
-    replica as failed and the next call can retry a bounced node."""
+    replica as failed and the next call can retry a bounced node.
 
-    def __init__(self, address: Tuple[str, int], timeout_s: float = 180.0):
+    Deadline-aware: with a query deadline bound (x/deadline), per-call
+    socket timeouts derive from ``remaining()`` (capped at
+    ``timeout_s``), the surviving budget rides the RPC_REQ_DL frame so the
+    server stops work too, and a transport timeout with the budget
+    spent surfaces typed as ``DeadlineExceeded``.  An optional shared
+    ``breaker`` (x/breaker, one per peer) makes calls to a dead node
+    fail fast for every holder at once."""
+
+    def __init__(self, address: Tuple[str, int], timeout_s: float = 180.0,
+                 breaker: CircuitBreaker | None = None):
         # The generous default absorbs one-time jit compiles behind
         # flush/tick paths on a freshly started node (CPU backend pays
         # tens of seconds for the encoder scan); connect failures to a
         # dead node still surface immediately (ECONNREFUSED).
         self.address = tuple(address)
         self.timeout_s = timeout_s
+        self.breaker = breaker
         self._sock: socket.socket | None = None
         self._mu = threading.Lock()
 
     # -- transport --
 
     def _connect(self) -> socket.socket:
-        return wire_connect(self.address, timeout=self.timeout_s)
+        # dial timeout from the bound deadline's remaining budget
+        # (capped by the legacy constant, never extended past it)
+        return wire_connect(self.address,
+                            timeout=xdeadline.socket_timeout(self.timeout_s))
 
     def _call(self, method: int, body: bytes) -> bytes:
+        # A budget spent before this call is the QUERY's failure, not
+        # this peer's: raise outside the breaker so overload upstream
+        # cannot trip a healthy node's breaker open.
+        xdeadline.check_current("rpc call")
+        if self.breaker is not None:
+            return self.breaker.call(lambda: self._call_inner(method, body))
+        return self._call_inner(method, body)
+
+    def _call_inner(self, method: int, body: bytes) -> bytes:
+        dl = xdeadline.current()
+        header = bytes([method]) + struct.pack("<q", xdeadline.remaining_ms())
         with self._mu:
             try:
                 # Socket-boundary faultpoint: drop/error surface as the
@@ -406,10 +459,20 @@ class RemoteDatabase:
                     raise fault.FaultInjected("rpc.call: request dropped")
                 if self._sock is None:
                     self._sock = self._connect()
-                send_frame(self._sock, RPC_REQ, bytes([method]) + body)
+                # per-call timeout from the remaining budget: a wire
+                # hop must never outlive its query (raises typed when
+                # the budget is already spent)
+                self._sock.settimeout(
+                    xdeadline.socket_timeout(self.timeout_s))
+                send_frame(self._sock, RPC_REQ_DL, header + body)
                 frame = recv_frame(self._sock)
+            except DeadlineExceeded:
+                raise  # budget spent BEFORE I/O: the socket is intact
             except (OSError, ProtocolError) as e:
                 self._drop()
+                if dl is not None and dl.expired:
+                    raise dl.exceeded(
+                        f"rpc {self.address}: deadline exceeded") from e
                 raise ConnectionError(f"rpc {self.address}: {e}") from e
             if frame is None:
                 self._drop()

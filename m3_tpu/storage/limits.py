@@ -10,9 +10,14 @@ than letting one heavy query exhaust the node.
 from __future__ import annotations
 
 import contextlib
+import re
 import threading
 import time
 from dataclasses import dataclass
+
+_LIMIT_MSG_RE = re.compile(
+    r"query limit exceeded: ([\w-]+) \((\d+) > (\d+) within window\)"
+)
 
 
 class QueryLimitExceeded(RuntimeError):
@@ -21,6 +26,17 @@ class QueryLimitExceeded(RuntimeError):
             f"query limit exceeded: {name} ({value} > {limit} within window)"
         )
         self.name = name
+
+    @classmethod
+    def from_message(cls, msg: str) -> "QueryLimitExceeded":
+        """Rebuild from the stable message form — the wire layers
+        (query/remote, server/rpc) ship errors as ``TypeName: message``
+        strings and must re-raise the REAL class client-side so a
+        remote limit trip still maps to HTTP 429, not 500."""
+        m = _LIMIT_MSG_RE.search(msg)
+        if m:
+            return cls(m.group(1), int(m.group(2)), int(m.group(3)))
+        return cls("remote", 0, 0)
 
 
 class _WindowedLimit:

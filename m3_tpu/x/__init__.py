@@ -7,6 +7,17 @@
 * ``m3_tpu.x.retry`` — the reference ``src/x/retry`` equivalent:
   exponential backoff + jitter + attempt caps + a shared retry budget,
   adopted by every wire client in the tree.
+* ``m3_tpu.x.deadline`` — end-to-end query deadlines + cooperative
+  cancellation: one absolute expiry threaded HTTP → engine → fanout →
+  wire (context-bound, serialized into the query/rpc frames), raising
+  typed ``DeadlineExceeded`` the API maps to 504.
+* ``m3_tpu.x.admission`` — bounded concurrent-query slots + wait queue
+  with queue timeout; saturation sheds typed ``QueryShedError``
+  (HTTP 503 + Retry-After) instead of queueing unboundedly.
+* ``m3_tpu.x.breaker`` — per-peer circuit breakers
+  (closed/open/half-open on consecutive transport failures or deadline
+  blowouts) shared by the remote-query client, the session read
+  fan-out, and the rpc client through one process registry.
 * ``m3_tpu.x.lockcheck`` — runtime lock-order sanitizer: wraps
   ``threading.Lock``/``RLock`` behind an env-armed seam
   (``M3_LOCKCHECK``, like ``M3_FAULTPOINTS``) and fails fast on
@@ -27,14 +38,14 @@ from __future__ import annotations
 # a node subprocess wraps its locks before fault/retry (or anything
 # else) constructs one.
 from m3_tpu.x import lockcheck  # noqa: F401  (env-armed seam)
-from m3_tpu.x import fault, retry
+from m3_tpu.x import breaker, deadline, fault, retry
 
 
 def register_metrics(registry, prefix: str = "") -> object:
-    """Register a scrape-time collector mirroring the fault and retry
-    counters into ``registry`` gauges (tagged by point/retrier name).
-    Returns the collector so callers with a shutdown path can
-    ``registry.unregister_collector`` it."""
+    """Register a scrape-time collector mirroring the fault, retry,
+    deadline and breaker counters into ``registry`` gauges (tagged by
+    point/retrier/peer name).  Returns the collector so callers with a
+    shutdown path can ``registry.unregister_collector`` it."""
     scope = registry.scope(prefix)
 
     def collect() -> None:
@@ -44,6 +55,17 @@ def register_metrics(registry, prefix: str = "") -> object:
         for name, value in retry.counters().items():
             rname, _, key = name.rpartition(".")
             scope.tagged({"retrier": rname}).gauge(f"retry.{key}").update(value)
+        dl = deadline.counters()
+        scope.gauge("query_deadline_exceeded_total").update(
+            dl.get("deadline.exceeded", 0))
+        scope.gauge("query_cancelled_total").update(
+            dl.get("deadline.cancelled", 0))
+        for peer, br in breaker.all_breakers().items():
+            scope.tagged({"peer": peer}).gauge("breaker_state").update(
+                br.state_code)
+        for name, value in breaker.counters().items():
+            peer, _, key = name.rpartition(".")
+            scope.tagged({"peer": peer}).gauge(f"breaker.{key}").update(value)
 
     registry.register_collector(collect)
     return collect

@@ -1,6 +1,6 @@
 """m3lint: codebase-aware static analysis for the m3-tpu tree.
 
-Eight rule families, each encoding a contract this repo already pays
+Nine rule families, each encoding a contract this repo already pays
 for at runtime (race tier, fault tier, bit-exactness goldens) as a
 static gate:
 
@@ -23,6 +23,11 @@ static gate:
   placement key outside ``cluster/placement.py`` (mutations must go
   through ``PlacementService`` so concurrent admin edits and node
   cutovers CAS-serialize).
+* ``deadline-aware``   — blocking ``send_frame``/``recv_frame``/
+  ``connect`` calls in query-path modules (``query/remote.py``, the
+  ``server/rpc.py`` client classes, ``client/session.py``) outside a
+  deadline-accepting helper (the read-path overload contract: wire
+  hops derive their timeouts from ``x.deadline``).
 
 Run: ``python -m m3_tpu.tools.cli lint`` (gates against
 ``m3_tpu/tools/lint_baseline.json``; see TESTING.md "Static analysis &

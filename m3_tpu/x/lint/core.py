@@ -43,6 +43,7 @@ RULES = (
     "resource-hygiene",
     "corruption-typed",
     "placement-cas",
+    "deadline-aware",
 )
 
 _SUPPRESS_RE = re.compile(r"#\s*m3lint:\s*disable=([\w,-]+)")
@@ -94,6 +95,13 @@ class Context:
     # the blessed home of raw placement-key KV mutations; everywhere
     # else must go through PlacementService (placement-cas rule)
     placement_files: tuple = ("m3_tpu/cluster/placement.py",)
+    # query-path modules whose blocking wire calls must flow through a
+    # deadline-accepting helper (deadline-aware rule); prefixes let the
+    # seeded corpus opt in wholesale
+    deadline_files: tuple = ("m3_tpu/query/remote.py",
+                             "m3_tpu/server/rpc.py",
+                             "m3_tpu/client/session.py")
+    deadline_prefixes: tuple = ()
 
     def is_wire_module(self, path: str) -> bool:
         return (path in self.wire_files
@@ -156,7 +164,8 @@ def apply_suppressions(unit: FileUnit, findings: Iterable[Finding]) -> List[Find
 
 def default_rules() -> List[Rule]:
     from m3_tpu.x.lint import (
-        corruption, faultcov, locks, placement, purity, resources, wirecheck,
+        corruption, deadline_aware, faultcov, locks, placement, purity,
+        resources, wirecheck,
     )
 
     return [
@@ -168,6 +177,7 @@ def default_rules() -> List[Rule]:
         resources.check,
         corruption.check,
         placement.check,
+        deadline_aware.check,
     ]
 
 

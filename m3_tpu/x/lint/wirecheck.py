@@ -37,7 +37,7 @@ FAMILIES = {
     # frame families owned by other wire modules (server/rpc.py,
     # cluster/kv_remote.py, query/remote.py) — their dispatchers get the
     # same exhaustiveness treatment as protocol.py's
-    "rpc": frozenset({"RPC_REQ", "RPC_OK", "RPC_ERR"}),
+    "rpc": frozenset({"RPC_REQ", "RPC_REQ_DL", "RPC_OK", "RPC_ERR"}),
     "kv": frozenset({"KV_REQ", "KV_OK", "KV_ERR"}),
     "query": frozenset({"QUERY_FETCH", "QUERY_RESULT"}),
     "rpc-method": frozenset({"M_WRITE_BATCH", "M_WRITE_TAGGED", "M_READ",

@@ -1,0 +1,29 @@
+"""Seeded deadline-aware violations (never imported).  The corpus run
+passes a Context whose deadline prefixes match this directory."""
+
+wire = None  # placeholder; names resolve statically in the analyzer
+
+
+def bare_round_trip(sock, payload):
+    wire.send_frame(sock, 8, payload)     # VIOLATION: deadline-aware (L8)
+    return wire.recv_frame(sock)          # VIOLATION: deadline-aware (L9)
+
+
+def bare_dial(address):
+    return wire.connect(address, timeout=30.0)  # VIOLATION (L13)
+
+
+def aware_round_trip(sock, payload, deadline):  # ok: explicit deadline param
+    sock.settimeout(deadline.remaining())
+    wire.send_frame(sock, 8, payload)
+    return wire.recv_frame(sock)
+
+
+def aware_dial(address, xdeadline):       # ok: derives budget from the module
+    timeout = xdeadline.socket_timeout(30.0)
+    return wire.connect(address, timeout=timeout)
+
+
+def aware_budget_call(sock, payload, dl):  # ok: .remaining_ms() marks it
+    wire.send_frame(sock, 8, payload + dl.remaining_ms().to_bytes(8, "little"))
+    return wire.recv_frame(sock)

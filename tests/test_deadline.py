@@ -1,0 +1,966 @@
+"""Query-path overload resilience: deadlines, admission, breakers.
+
+The read-path mirror of tests/test_x_retry_fault.py — unit coverage for
+the x/deadline, x/admission and x/breaker substrate plus the
+integration seams: concurrent fanout under a shared deadline with the
+partial-result policy, the engine's cooperative cancellation points,
+the session read fan-out's per-replica breakers, the rpc client's
+deadline-derived socket timeouts, and the HTTP status mapping
+(429 limit / 503 shed + Retry-After / 504 deadline).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from m3_tpu.index.doc import Document
+from m3_tpu.query.block import RawBlock, SeriesMeta
+from m3_tpu.storage.database import Database, DatabaseOptions, NamespaceOptions
+from m3_tpu.x import deadline as xdeadline
+from m3_tpu.x import fault
+from m3_tpu.x.admission import AdmissionController, QueryShedError
+from m3_tpu.x.breaker import (
+    BreakerOpenError, CircuitBreaker, all_breakers, breaker_for,
+    reset_registry,
+)
+from m3_tpu.x.deadline import Deadline, DeadlineExceeded, QueryCancelled
+
+SEC = 10**9
+BLOCK = 2 * 3600 * SEC
+START = (1_700_000_000 * SEC) // BLOCK * BLOCK
+NS = NamespaceOptions(num_shards=2, slot_capacity=1 << 10,
+                      sample_capacity=1 << 12)
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# x/deadline
+# ---------------------------------------------------------------------------
+
+
+class TestDeadline:
+    def test_remaining_and_check(self):
+        clock = FakeClock()
+        dl = Deadline(5.0, clock=clock)
+        assert dl.remaining() == pytest.approx(5.0)
+        dl.check()  # inside budget: no raise
+        clock.t += 4.0
+        assert dl.remaining() == pytest.approx(1.0)
+        assert not dl.expired
+        clock.t += 1.5
+        assert dl.expired
+        with pytest.raises(DeadlineExceeded):
+            dl.check("unit")
+
+    def test_cancel_is_cooperative_and_typed(self):
+        dl = Deadline(60.0)
+        dl.check()
+        dl.cancel()
+        with pytest.raises(QueryCancelled):
+            dl.check()
+        assert dl.expired  # cancellation counts as spent budget
+
+    def test_socket_timeout_derives_from_remaining(self):
+        clock = FakeClock()
+        dl = Deadline(5.0, clock=clock)
+        assert dl.socket_timeout(cap=30.0) == pytest.approx(5.0)
+        assert dl.socket_timeout(cap=1.0) == pytest.approx(1.0)  # capped
+        clock.t += 5.1
+        with pytest.raises(DeadlineExceeded):
+            dl.socket_timeout(cap=30.0)
+
+    def test_bind_current_and_helpers(self):
+        assert xdeadline.current() is None
+        assert xdeadline.socket_timeout(7.0) == 7.0  # unbound: the cap
+        assert xdeadline.remaining_ms() == -1
+        clock = FakeClock()
+        dl = Deadline(2.0, clock=clock)
+        with xdeadline.bind(dl):
+            assert xdeadline.current() is dl
+            assert 0 < xdeadline.remaining_ms() <= 2000
+            assert xdeadline.socket_timeout(30.0) == pytest.approx(2.0)
+            xdeadline.check_current()
+        assert xdeadline.current() is None
+        xdeadline.check_current()  # unbound: no-op
+
+    def test_bind_does_not_leak_to_new_threads(self):
+        seen = []
+        with xdeadline.bind(Deadline(60.0)):
+            t = threading.Thread(target=lambda: seen.append(
+                xdeadline.current()))
+            t.start()
+            t.join()
+        assert seen == [None]
+
+    def test_warnings_and_phases(self):
+        clock = FakeClock()
+        dl = Deadline(10.0, clock=clock)
+        dl.add_warning("source x skipped")
+        with dl.phase("fetch"):
+            clock.t += 1.5
+        with dl.phase("fetch"):
+            clock.t += 0.5
+        assert dl.warnings == ["source x skipped"]
+        assert dl.phases["fetch"] == pytest.approx(2.0)
+
+    def test_exceeded_counter_advances_once_per_deadline(self):
+        """deadline.exceeded counts QUERIES, not exception objects: the
+        first local detection on a Deadline bumps it; further checks on
+        the same deadline (fanout stragglers, per-replica observers)
+        and bare constructions (wire-decoded remote trips) do not."""
+        before = xdeadline.counters().get("deadline.exceeded", 0)
+        DeadlineExceeded("bare")  # uncounted: no deadline detected it
+        assert xdeadline.counters().get(
+            "deadline.exceeded", 0) == before
+        clock = FakeClock()
+        dl = Deadline(1.0, clock=clock)
+        clock.t += 2.0
+        for _ in range(3):  # N observers, ONE blown deadline
+            with pytest.raises(DeadlineExceeded):
+                dl.check()
+        assert xdeadline.counters()["deadline.exceeded"] == before + 1
+
+    def test_cancelled_counts_once_not_as_exceeded(self):
+        """A cancellation bumps ONLY deadline.cancelled: dashboards
+        split real deadline trips from cancellations, so the subclass
+        must not also inflate the parent's counter."""
+        before = xdeadline.counters()
+        dl = Deadline(60.0, clock=FakeClock())
+        dl.cancel()
+        for _ in range(2):
+            with pytest.raises(QueryCancelled):
+                dl.check()
+        after = xdeadline.counters()
+        assert (after.get("deadline.cancelled", 0)
+                == before.get("deadline.cancelled", 0) + 1)
+        assert (after.get("deadline.exceeded", 0)
+                == before.get("deadline.exceeded", 0))
+
+
+# ---------------------------------------------------------------------------
+# x/admission
+# ---------------------------------------------------------------------------
+
+
+class TestAdmission:
+    def test_disabled_is_free(self):
+        adm = AdmissionController(max_concurrent=0)
+        with adm.admit():
+            with adm.admit():
+                pass  # never gates
+
+    def test_sheds_beyond_capacity_and_queue(self):
+        adm = AdmissionController(max_concurrent=1, max_queue=0,
+                                  queue_timeout_s=0.5)
+        with adm.admit():
+            with pytest.raises(QueryShedError) as ei:
+                with adm.admit():
+                    pass
+            assert ei.value.retry_after_s == pytest.approx(0.5)
+        assert adm.shed_total == 1
+        assert adm.admitted_total == 1
+        # slot released: admits again
+        with adm.admit():
+            pass
+        assert adm.active == 0
+
+    def test_queue_waits_for_slot(self):
+        adm = AdmissionController(max_concurrent=1, max_queue=2,
+                                  queue_timeout_s=5.0)
+        order = []
+        release = threading.Event()
+
+        def holder():
+            with adm.admit():
+                order.append("holder")
+                release.wait(5.0)
+
+        def waiter():
+            with adm.admit():
+                order.append("waiter")
+
+        t1 = threading.Thread(target=holder)
+        t1.start()
+        while adm.active != 1:
+            time.sleep(0.005)
+        t2 = threading.Thread(target=waiter)
+        t2.start()
+        while adm.waiting != 1:
+            time.sleep(0.005)
+        release.set()
+        t2.join(5.0)
+        t1.join(5.0)
+        assert order == ["holder", "waiter"]
+        assert adm.active == 0 and adm.waiting == 0
+        assert adm.shed_total == 0
+
+    def test_queue_timeout_sheds(self):
+        adm = AdmissionController(max_concurrent=1, max_queue=2,
+                                  queue_timeout_s=0.05)
+        release = threading.Event()
+
+        def holder():
+            with adm.admit():
+                release.wait(5.0)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        while adm.active != 1:
+            time.sleep(0.005)
+        t0 = time.monotonic()
+        with pytest.raises(QueryShedError):
+            with adm.admit():
+                pass
+        assert time.monotonic() - t0 < 1.0
+        assert adm.queue_timeout_total == 1
+        release.set()
+        t.join(5.0)
+        assert adm.waiting == 0  # the queue drained
+
+    def test_wait_bounded_by_deadline(self):
+        adm = AdmissionController(max_concurrent=1, max_queue=2,
+                                  queue_timeout_s=10.0)
+        release = threading.Event()
+
+        def holder():
+            with adm.admit():
+                release.wait(5.0)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        while adm.active != 1:
+            time.sleep(0.005)
+        t0 = time.monotonic()
+        with pytest.raises(QueryShedError):
+            with adm.admit(deadline=Deadline(0.05)):
+                pass
+        assert time.monotonic() - t0 < 1.0  # not the 10s queue timeout
+        release.set()
+        t.join(5.0)
+
+
+# ---------------------------------------------------------------------------
+# x/breaker
+# ---------------------------------------------------------------------------
+
+
+def _boom():
+    raise ConnectionError("peer down")
+
+
+class TestBreaker:
+    def test_trips_after_consecutive_failures(self):
+        clock = FakeClock()
+        br = CircuitBreaker("p1", failure_threshold=3, reset_timeout_s=10.0,
+                            clock=clock)
+        for _ in range(3):
+            with pytest.raises(ConnectionError):
+                br.call(_boom)
+        assert br.state == "open"
+        # open: fails fast without invoking fn
+        calls = []
+        with pytest.raises(BreakerOpenError):
+            br.call(lambda: calls.append(1))
+        assert not calls
+
+    def test_success_resets_the_streak(self):
+        br = CircuitBreaker("p2", failure_threshold=3)
+        for _ in range(2):
+            with pytest.raises(ConnectionError):
+                br.call(_boom)
+        br.call(lambda: "ok")
+        for _ in range(2):
+            with pytest.raises(ConnectionError):
+                br.call(_boom)
+        assert br.state == "closed"  # streak broken by the success
+
+    def test_half_open_probe_closes_or_reopens(self):
+        clock = FakeClock()
+        br = CircuitBreaker("p3", failure_threshold=1, reset_timeout_s=10.0,
+                            clock=clock)
+        with pytest.raises(ConnectionError):
+            br.call(_boom)
+        assert br.state == "open"
+        clock.t += 10.0
+        assert br.state == "half_open"
+        # probe fails -> re-open with a fresh cool-down
+        with pytest.raises(ConnectionError):
+            br.call(_boom)
+        assert br.state == "open"
+        clock.t += 10.0
+        # probe succeeds -> closed
+        assert br.call(lambda: 42) == 42
+        assert br.state == "closed"
+
+    def test_half_open_allows_one_probe(self):
+        clock = FakeClock()
+        br = CircuitBreaker("p4", failure_threshold=1, reset_timeout_s=1.0,
+                            clock=clock)
+        with pytest.raises(ConnectionError):
+            br.call(_boom)
+        clock.t += 1.0
+        br.allow()  # the probe slot
+        with pytest.raises(BreakerOpenError):
+            br.allow()  # second concurrent caller: refused
+
+    def test_application_errors_do_not_trip(self):
+        br = CircuitBreaker("p5", failure_threshold=2)
+
+        def app_fail():
+            raise RuntimeError("remote computed an error")
+
+        for _ in range(5):
+            with pytest.raises(RuntimeError):
+                br.call(app_fail)
+        assert br.state == "closed"
+
+    def test_deadline_blowouts_do_trip(self):
+        br = CircuitBreaker("p6", failure_threshold=2)
+        for _ in range(2):
+            with pytest.raises(DeadlineExceeded):
+                br.call(lambda: (_ for _ in ()).throw(
+                    DeadlineExceeded("slow peer")))
+        assert br.state == "open"
+
+    def test_registry_shares_one_breaker_per_peer(self):
+        reset_registry()
+        try:
+            a = breaker_for("peer:1", failure_threshold=1)
+            b = breaker_for("peer:1", failure_threshold=99)
+            assert a is b
+            assert "peer:1" in all_breakers()
+        finally:
+            reset_registry()
+
+
+# ---------------------------------------------------------------------------
+# fanout under deadline
+# ---------------------------------------------------------------------------
+
+
+def _block_for(tag: bytes, n=3):
+    pts = [[(START + k * SEC, float(k)) for k in range(n)]]
+    return RawBlock.from_lists(pts, [SeriesMeta(((b"region", tag),))])
+
+
+class _Store:
+    def __init__(self, tag, delay_s=0.0, error=None):
+        self.tag = tag
+        self.delay_s = delay_s
+        self.error = error
+
+    def fetch_raw(self, name, matchers, start, end):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        if self.error is not None:
+            raise self.error
+        return _block_for(self.tag)
+
+
+class TestFederatedUnderDeadline:
+    def test_stores_fetch_concurrently(self):
+        from m3_tpu.query.fanout import FederatedStorage
+
+        fed = FederatedStorage([_Store(b"a", 0.3), _Store(b"b", 0.3),
+                                _Store(b"c", 0.3)])
+        t0 = time.monotonic()
+        out = fed.fetch_raw(b"x", (), START, START + BLOCK)
+        wall = time.monotonic() - t0
+        assert len(out.series) == 3
+        assert wall < 0.75  # 3 × 0.3s sequential would be ≥ 0.9s
+
+    def test_non_required_slow_store_becomes_warning(self):
+        from m3_tpu.query.fanout import FederatedStorage
+
+        fed = FederatedStorage([_Store(b"a"), _Store(b"b", delay_s=2.0)])
+        dl = Deadline(0.4)
+        with xdeadline.bind(dl):
+            t0 = time.monotonic()
+            out = fed.fetch_raw(b"x", (), START, START + BLOCK)
+            wall = time.monotonic() - t0
+        assert {m.tags[0][1] for m in out.series} == {b"a"}
+        assert wall < 1.5  # did NOT wait out the slow store
+        assert any("skipped" in w for w in dl.warnings)
+
+    def test_required_store_failure_is_typed(self):
+        from m3_tpu.query.fanout import FederatedStorage, PartialResultError
+
+        fed = FederatedStorage(
+            [_Store(b"a"), _Store(b"b", error=ConnectionError("down"))],
+            required=[0, 1])
+        # a lone transport failure wraps typed (server-side 502, never
+        # a client-error mapping), carrying the underlying cause
+        with pytest.raises(PartialResultError) as one:
+            fed.fetch_raw(b"x", (), START, START + BLOCK)
+        assert "down" in str(one.value)
+        # ... but a lone OVERLOAD failure stays itself (504/429 mapping)
+        fed_dl = FederatedStorage(
+            [_Store(b"a"), _Store(b"b", error=DeadlineExceeded("spent"))],
+            required=[0, 1])
+        with pytest.raises(DeadlineExceeded):
+            fed_dl.fetch_raw(b"x", (), START, START + BLOCK)
+        # two required failures -> PartialResultError wrapping both
+        fed2 = FederatedStorage(
+            [_Store(b"a", error=ConnectionError("down a")),
+             _Store(b"b", error=ConnectionError("down b"))],
+            required=[0, 1])
+        with pytest.raises(PartialResultError) as ei:
+            fed2.fetch_raw(b"x", (), START, START + BLOCK)
+        assert len(ei.value.failures) == 2
+
+    def test_all_best_effort_failing_still_raises(self):
+        from m3_tpu.query.fanout import FederatedStorage, PartialResultError
+
+        fed = FederatedStorage([_Store(b"a", error=ConnectionError("x")),
+                                _Store(b"b", error=ConnectionError("y"))])
+        with pytest.raises(PartialResultError) as ei:
+            fed.fetch_raw(b"x", (), START, START + BLOCK)
+        assert len(ei.value.failures) == 2
+
+
+class TestFanoutBandsUnderDeadline:
+    def test_multi_band_sources_fetch_concurrently(self):
+        from m3_tpu.query.fanout import FanoutSource, FanoutStorage
+
+        now = START + 10 * BLOCK
+        fine = FanoutSource(_Store(b"fine", 0.3), SEC, 2 * BLOCK,
+                            name="fine")
+        coarse = FanoutSource(_Store(b"coarse", 0.3), 60 * SEC, 20 * BLOCK,
+                              name="coarse")
+        fo = FanoutStorage([fine, coarse], now_fn=lambda: now)
+        t0 = time.monotonic()
+        out = fo.fetch_raw(b"x", (), now - 5 * BLOCK, now)
+        wall = time.monotonic() - t0
+        assert len(out.series) == 2  # both bands answered
+        assert wall < 0.55  # concurrent, not 0.6s sequential
+
+    def test_non_required_band_misses_deadline_with_warning(self):
+        from m3_tpu.query.fanout import FanoutSource, FanoutStorage
+
+        now = START + 10 * BLOCK
+        fine = FanoutSource(_Store(b"fine"), SEC, 2 * BLOCK, name="fine")
+        coarse = FanoutSource(_Store(b"coarse", delay_s=2.0), 60 * SEC,
+                              20 * BLOCK, required=False, name="coarse")
+        fo = FanoutStorage([fine, coarse], now_fn=lambda: now)
+        dl = Deadline(0.4)
+        with xdeadline.bind(dl):
+            out = fo.fetch_raw(b"x", (), now - 5 * BLOCK, now)
+        assert {m.tags[0][1] for m in out.series} == {b"fine"}
+        assert any("coarse" in w for w in dl.warnings)
+
+    def test_required_band_missing_deadline_raises_typed(self):
+        from m3_tpu.query.fanout import FanoutSource, FanoutStorage
+
+        now = START + 10 * BLOCK
+        fine = FanoutSource(_Store(b"fine", delay_s=2.0), SEC, 2 * BLOCK,
+                            name="fine")
+        coarse = FanoutSource(_Store(b"coarse"), 60 * SEC, 20 * BLOCK,
+                              name="coarse")
+        fo = FanoutStorage([fine, coarse], now_fn=lambda: now)
+        with xdeadline.bind(Deadline(0.3)):
+            with pytest.raises(DeadlineExceeded):
+                fo.fetch_raw(b"x", (), now - 5 * BLOCK, now)
+
+    def test_single_source_fast_path_keeps_failure_policy(self):
+        """The one-chosen-source fast path honours the same contract as
+        the fanned path: a best-effort source degrades to warning +
+        empty result, a required one fails typed (never a raw transport
+        error the API would map as 400)."""
+        from m3_tpu.query.fanout import (
+            FanoutSource, FanoutStorage, PartialResultError,
+        )
+
+        now = START + 10 * BLOCK
+        # only source covering the window is best-effort and down
+        remote = FanoutSource(_Store(b"r", error=ConnectionError("down")),
+                              SEC, 20 * BLOCK, required=False, name="remote")
+        fo = FanoutStorage([remote], now_fn=lambda: now)
+        dl = Deadline(5.0)
+        with xdeadline.bind(dl):
+            out = fo.fetch_raw(b"x", (), now - 5 * BLOCK, now)
+        assert len(out.series) == 0
+        assert any("remote" in w and "down" in w for w in dl.warnings)
+        # same source marked required: typed, carrying the cause
+        req = FanoutSource(_Store(b"r", error=ConnectionError("down")),
+                           SEC, 20 * BLOCK, name="req")
+        fo2 = FanoutStorage([req], now_fn=lambda: now)
+        with pytest.raises(PartialResultError, match="down"):
+            fo2.fetch_raw(b"x", (), now - 5 * BLOCK, now)
+        # ... while a lone overload failure stays itself (504 mapping)
+        over = FanoutSource(_Store(b"r", error=DeadlineExceeded("spent")),
+                            SEC, 20 * BLOCK, name="over")
+        fo3 = FanoutStorage([over], now_fn=lambda: now)
+        with pytest.raises(DeadlineExceeded):
+            fo3.fetch_raw(b"x", (), now - 5 * BLOCK, now)
+
+    def test_straggler_cannot_overwrite_claimed_slot(self):
+        """Once the join times out and a slot is recorded as
+        DeadlineExceeded, the still-running worker must not overwrite
+        it afterwards — the caller is already classifying the results
+        (a late success would turn an already-counted 504 into a
+        nondeterministic 200/502)."""
+        from m3_tpu.query.fanout import _fetch_concurrent
+
+        jobs = [("fast", lambda: _block_for(b"a")),
+                ("slow", lambda: time.sleep(0.4) or _block_for(b"b"))]
+        with xdeadline.bind(Deadline(0.15)):
+            out = _fetch_concurrent(jobs)
+        assert isinstance(out[1], DeadlineExceeded)
+        time.sleep(0.5)  # let the straggler finish and try to write
+        assert isinstance(out[1], DeadlineExceeded)  # slot stays claimed
+
+
+# ---------------------------------------------------------------------------
+# engine + storage adapter cooperative cancellation
+# ---------------------------------------------------------------------------
+
+
+def _seed_db(tmp_path, n=10):
+    db = Database(DatabaseOptions(root=str(tmp_path)),
+                  namespaces={"default": NS})
+    docs = [Document.from_tags(
+        b"reqs{host=a}", {b"__name__": b"reqs", b"host": b"a"})] * n
+    ts = START + np.arange(n, dtype=np.int64) * SEC
+    db.write_tagged_batch("default", docs, ts, np.arange(float(n)))
+    return db
+
+
+class TestEngineDeadline:
+    def test_spent_budget_stops_evaluation(self, tmp_path):
+        from m3_tpu.query.engine import Engine
+        from m3_tpu.query.storage_adapter import DatabaseStorage
+
+        db = _seed_db(tmp_path)
+        eng = Engine(DatabaseStorage(db))
+        with pytest.raises(DeadlineExceeded):
+            eng.execute_range("sum(reqs)", START, START + 9 * SEC, SEC,
+                              deadline=Deadline(0.0))
+        db.close()
+
+    def test_cancel_mid_query_is_typed(self, tmp_path):
+        from m3_tpu.query.engine import Engine
+        from m3_tpu.query.storage_adapter import DatabaseStorage
+
+        db = _seed_db(tmp_path)
+        eng = Engine(DatabaseStorage(db))
+        dl = Deadline(60.0)
+        dl.cancel()
+        with pytest.raises(QueryCancelled):
+            eng.execute_range("sum(reqs)", START, START + 9 * SEC, SEC,
+                              deadline=dl)
+        db.close()
+
+    def test_fetch_phase_is_recorded(self, tmp_path):
+        from m3_tpu.query.engine import Engine
+        from m3_tpu.query.storage_adapter import DatabaseStorage
+
+        db = _seed_db(tmp_path)
+        eng = Engine(DatabaseStorage(db))
+        dl = Deadline(60.0)
+        out = eng.execute_range("reqs", START, START + 9 * SEC, SEC,
+                                deadline=dl)
+        assert out.values.shape[1] == 10
+        assert "fetch" in dl.phases
+        db.close()
+
+
+# ---------------------------------------------------------------------------
+# session read fan-out breakers
+# ---------------------------------------------------------------------------
+
+
+class TestSessionBreakers:
+    def _session(self, dead_iid="i1"):
+        from m3_tpu.client.session import ConsistencyLevel, ReplicatedSession
+        from m3_tpu.cluster.placement import Instance, initial_placement
+        from m3_tpu.x.retry import RetryOptions
+
+        class Healthy:
+            def read(self, ns, sid, start, end):
+                return [(START, 1.0)]
+
+            def query_ids(self, ns, q, start, end):
+                return []
+
+        class Dead:
+            def read(self, ns, sid, start, end):
+                raise ConnectionError("replica down")
+
+            def query_ids(self, ns, q, start, end):
+                raise ConnectionError("replica down")
+
+        conns = {"i0": Healthy(), "i1": Healthy(), "i2": Healthy()}
+        conns[dead_iid] = Dead()
+        p = initial_placement([Instance(i) for i in conns], num_shards=2,
+                              rf=3)
+        s = ReplicatedSession(
+            p, conns,
+            read_level=ConsistencyLevel.UNSTRICT_MAJORITY,
+            retry_options=RetryOptions(initial_backoff_s=0.001,
+                                       max_backoff_s=0.002, max_attempts=2))
+        s.breaker_failures = 2
+        return s
+
+    def test_dead_replica_breaker_opens_and_reads_keep_working(self):
+        s = self._session()
+        for _ in range(4):
+            pts = s.fetch("default", b"sid", START, START + SEC)
+            assert pts == [(START, 1.0)]
+        assert s.breaker_states().get("i1") == "open"
+
+    def test_open_breaker_fails_fast(self):
+        s = self._session()
+        for _ in range(3):
+            s.fetch("default", b"sid", START, START + SEC)
+        dead = s.connections["i1"]
+        calls = {"n": 0}
+        orig = dead.read
+
+        def counting_read(*a):
+            calls["n"] += 1
+            return orig(*a)
+
+        dead.read = counting_read
+        s.fetch("default", b"sid", START, START + SEC)
+        assert calls["n"] == 0  # breaker open: the dead replica not dialed
+
+    def test_spent_budget_does_not_trip_replica_breakers(self):
+        """A budget already spent upstream is the QUERY's failure: a
+        burst of over-budget reads must not open healthy replicas'
+        breakers (that would turn client overload into a false outage)
+        — and it surfaces TYPED (504 mapping), never degraded into a
+        per-replica error that a ConsistencyError would map as 400."""
+        s = self._session()
+        calls = {"n": 0}
+        healthy = s.connections["i0"]
+        orig = healthy.read
+
+        def counting_read(*a):
+            calls["n"] += 1
+            return orig(*a)
+
+        healthy.read = counting_read
+        with xdeadline.bind(Deadline(0.0)):
+            for _ in range(4):
+                with pytest.raises(DeadlineExceeded):
+                    s.fetch("default", b"sid", START, START + SEC)
+        assert calls["n"] == 0  # raised before any replica was dialed
+        assert all(st == "closed" for st in s.breaker_states().values())
+
+    def test_spent_budget_query_ids_surfaces_typed(self):
+        """Same contract on the index fan-out: query_ids with a spent
+        budget raises DeadlineExceeded, not ConsistencyError."""
+        s = self._session()
+        with xdeadline.bind(Deadline(0.0)):
+            with pytest.raises(DeadlineExceeded):
+                s.query_ids("default", object(), START, START + SEC)
+        assert all(st == "closed" for st in s.breaker_states().values())
+
+
+# ---------------------------------------------------------------------------
+# rpc client deadline
+# ---------------------------------------------------------------------------
+
+
+class TestRpcDeadline:
+    def test_spent_budget_raises_before_io(self, tmp_path):
+        from m3_tpu.server.rpc import RemoteDatabase
+
+        rd = RemoteDatabase(("127.0.0.1", 1))  # nothing listens; no dial
+        dl = Deadline(60.0)
+        dl.cancel()
+        with xdeadline.bind(dl):
+            with pytest.raises(DeadlineExceeded):
+                rd.health()
+
+    def test_slow_server_surfaces_typed_deadline(self, tmp_path):
+        from m3_tpu.server.rpc import RemoteDatabase, serve_rpc_background
+
+        db = _seed_db(tmp_path)
+        srv = serve_rpc_background(db)
+        rd = RemoteDatabase(("127.0.0.1", srv.port))
+        assert rd.health()  # warm connection, no deadline
+        with fault.armed("rpc.server", "delay", delay_ms=1500):
+            with xdeadline.bind(Deadline(0.3)):
+                t0 = time.monotonic()
+                with pytest.raises(DeadlineExceeded):
+                    rd.health()
+                assert time.monotonic() - t0 < 1.2  # not the 180s default
+        rd.close()
+        srv.shutdown()
+        db.close()
+
+    def test_rpc_client_shares_the_peer_breaker(self, tmp_path):
+        """A RemoteDatabase wired with a breaker fails fast once the
+        peer trips it — and every other holder of the same breaker sees
+        the open state at once."""
+        from m3_tpu.server.rpc import RemoteDatabase
+
+        br = CircuitBreaker("rpc:dead", failure_threshold=2,
+                            reset_timeout_s=30.0)
+        rd = RemoteDatabase(("127.0.0.1", 1), timeout_s=0.2, breaker=br)
+        for _ in range(2):
+            with pytest.raises(ConnectionError):
+                rd.health()  # nothing listens: ECONNREFUSED
+        assert br.state == "open"
+        t0 = time.monotonic()
+        with pytest.raises(BreakerOpenError):
+            rd.health()
+        assert time.monotonic() - t0 < 0.1  # no dial paid
+        rd.close()
+
+    def test_spent_budget_does_not_trip_rpc_breaker(self):
+        """Pre-spent budget raises OUTSIDE the breaker: slow queries
+        must not open a healthy node's breaker."""
+        from m3_tpu.server.rpc import RemoteDatabase
+
+        br = CircuitBreaker("rpc:healthy", failure_threshold=2,
+                            reset_timeout_s=30.0)
+        rd = RemoteDatabase(("127.0.0.1", 1), breaker=br)  # never dialed
+        with xdeadline.bind(Deadline(0.0)):
+            for _ in range(4):
+                with pytest.raises(DeadlineExceeded):
+                    rd.health()
+        assert br.state == "closed"
+
+    def test_legacy_rpc_req_frame_still_served(self, tmp_path):
+        """Rolling-upgrade compat: a pre-deadline client's RPC_REQ
+        frame ([method u8][body], no budget header) is served
+        unchanged — only RPC_REQ_DL carries the deadline header."""
+        from m3_tpu.msg.protocol import connect, recv_frame, send_frame
+        from m3_tpu.server.rpc import (
+            M_HEALTH, RPC_OK, RPC_REQ, serve_rpc_background,
+        )
+
+        db = _seed_db(tmp_path)
+        srv = serve_rpc_background(db)
+        sock = connect(("127.0.0.1", srv.port), timeout=5.0)
+        send_frame(sock, RPC_REQ, bytes([M_HEALTH]))
+        ftype, body = recv_frame(sock)
+        assert ftype == RPC_OK and body == b"ok"
+        sock.close()
+        srv.shutdown()
+        db.close()
+
+    def test_remote_deadline_trip_crosses_typed(self, tmp_path):
+        """Server-side DeadlineExceeded (budget spent in the frame) maps
+        back to the real class, not RemoteError."""
+        from m3_tpu.server.rpc import RemoteDatabase, serve_rpc_background
+
+        db = _seed_db(tmp_path)
+        srv = serve_rpc_background(db)
+        rd = RemoteDatabase(("127.0.0.1", srv.port))
+        assert rd.health()
+        # a real-but-tiny budget: the server sees ~0ms remaining and
+        # refuses in dispatch; the client socket stays healthy
+        with xdeadline.bind(Deadline(0.0005)):
+            with pytest.raises(DeadlineExceeded):
+                rd.health()
+        rd.close()
+        srv.shutdown()
+        db.close()
+
+
+# ---------------------------------------------------------------------------
+# HTTP status mapping + warnings + slow-query log
+# ---------------------------------------------------------------------------
+
+
+class TestHttpOverloadMapping:
+    def _serve(self, tmp_path, **ctx_kw):
+        from m3_tpu.server.http_api import ApiContext, serve_background
+
+        db = _seed_db(tmp_path)
+        ctx = ApiContext(db, **ctx_kw)
+        srv = serve_background(ctx)
+        return db, ctx, srv, srv.server_address[1]
+
+    @staticmethod
+    def _get(url):
+        return urllib.request.urlopen(url, timeout=30)
+
+    def _query_url(self, port, timeout=None):
+        t0 = START // SEC
+        u = (f"http://127.0.0.1:{port}/api/v1/query_range?"
+             f"query=sum(reqs)&start={t0}&end={t0 + 9}&step=1s")
+        if timeout is not None:
+            u += f"&timeout={timeout}"
+        return u
+
+    def test_timeout_param_maps_to_504(self, tmp_path):
+        db, ctx, srv, port = self._serve(tmp_path)
+        try:
+            assert json.load(self._get(self._query_url(port)))[
+                "status"] == "success"  # warm (jit compile outside fault)
+            with fault.armed("query.fetch", "delay", delay_ms=800):
+                t0 = time.monotonic()
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    self._get(self._query_url(port, timeout="0.2"))
+                wall = time.monotonic() - t0
+            assert ei.value.code == 504
+            assert wall < 5.0
+            body = json.load(ei.value)
+            assert "deadline" in body["error"].lower()
+        finally:
+            srv.shutdown()
+            db.close()
+
+    def test_shed_maps_to_503_with_retry_after(self, tmp_path):
+        db, ctx, srv, port = self._serve(
+            tmp_path,
+            admission=AdmissionController(max_concurrent=1, max_queue=0,
+                                          queue_timeout_s=2.0))
+        try:
+            assert json.load(self._get(self._query_url(port)))[
+                "status"] == "success"  # warm up compile first
+            results = {}
+
+            def slow():
+                with fault.armed("query.fetch", "delay", delay_ms=1200,
+                                 n=1):
+                    try:
+                        self._get(self._query_url(port, timeout="10"))
+                        results["slow"] = 200
+                    except urllib.error.HTTPError as e:
+                        results["slow"] = e.code
+
+            t = threading.Thread(target=slow)
+            t.start()
+            deadline = time.monotonic() + 5.0
+            while ctx.admission.active != 1:  # slow query holds the slot
+                assert time.monotonic() < deadline, "slow query never admitted"
+                time.sleep(0.01)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(self._query_url(port, timeout="10"))
+            assert ei.value.code == 503
+            assert int(ei.value.headers["Retry-After"]) >= 1
+            t.join(10.0)
+            assert results.get("slow") == 200  # the held query finished
+            # queue drained: a fresh query admits fine
+            assert json.load(self._get(self._query_url(port)))[
+                "status"] == "success"
+            assert ctx.admission.shed_total == 1
+            assert ctx.admission.active == 0
+        finally:
+            srv.shutdown()
+            db.close()
+
+    def test_limit_trip_still_maps_to_429(self, tmp_path):
+        from m3_tpu.storage.limits import LimitsOptions, QueryLimits
+
+        from m3_tpu.server.http_api import ApiContext, serve_background
+
+        db = Database(
+            DatabaseOptions(root=str(tmp_path)), namespaces={"default": NS},
+            limits=QueryLimits(LimitsOptions(max_docs_matched=1)))
+        docs = [Document.from_tags(b"reqs{host=%d}" % i,
+                                   {b"__name__": b"reqs",
+                                    b"host": b"%d" % i})
+                for i in range(4)]
+        ts = np.full(4, START, np.int64)
+        db.write_tagged_batch("default", docs, ts, np.arange(4.0))
+        srv = serve_background(ApiContext(db))
+        port = srv.server_address[1]
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(self._query_url(port))
+            assert ei.value.code == 429
+        finally:
+            srv.shutdown()
+            db.close()
+
+    def test_multi_required_failure_maps_by_cause(self, tmp_path):
+        """Two REQUIRED federation sources failing together raise
+        PartialResultError — a server-side condition that must map by
+        its dominant cause (504 if any missed the deadline, else 502),
+        never fall through to 400 Bad Request."""
+
+        class DeadRegion:
+            def fetch_raw(self, *a):
+                raise ConnectionError("region down")
+
+        class ExpiredRegion:
+            def fetch_raw(self, *a):
+                raise DeadlineExceeded("region timed out")
+
+        db, ctx, srv, port = self._serve(
+            tmp_path / "a", remotes=[DeadRegion(), DeadRegion()],
+            remotes_required=True)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(self._query_url(port))
+            assert ei.value.code == 502  # pure upstream failure
+        finally:
+            srv.shutdown()
+            db.close()
+
+        db, ctx, srv, port = self._serve(
+            tmp_path / "b", remotes=[DeadRegion(), ExpiredRegion()],
+            remotes_required=True)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(self._query_url(port))
+            assert ei.value.code == 504  # deadline is the dominant cause
+        finally:
+            srv.shutdown()
+            db.close()
+
+    def test_remote_read_honors_timeout_param(self, tmp_path):
+        """``timeout=`` rides the URL query string on the protobuf
+        POST: a zero budget 504s where the default would serve."""
+        from m3_tpu.server import snappy
+        from m3_tpu.server.prom_remote import (
+            _emit_field, _emit_len, _emit_varint,
+        )
+
+        db, ctx, srv, port = self._serve(tmp_path)
+        try:
+            m = _emit_len(3, _emit_field(1, 0, _emit_varint(0)) +
+                          _emit_len(2, b"__name__") + _emit_len(3, b"reqs"))
+            pb = (_emit_field(1, 0, _emit_varint(START // 10**6)) +
+                  _emit_field(2, 0, _emit_varint(
+                      (START + 9 * SEC) // 10**6)) + m)
+            body = snappy.compress(_emit_len(1, pb))
+            url = f"http://127.0.0.1:{port}/api/v1/prom/remote/read"
+            resp = urllib.request.urlopen(url, data=body, timeout=30)
+            assert resp.status == 200  # default budget serves fine
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(url + "?timeout=0", data=body,
+                                       timeout=30)
+            assert ei.value.code == 504
+        finally:
+            srv.shutdown()
+            db.close()
+
+    def test_slow_query_log_and_health(self, tmp_path):
+        db, ctx, srv, port = self._serve(tmp_path,
+                                         slow_query_fraction=0.1)
+        try:
+            assert json.load(self._get(self._query_url(port)))[
+                "status"] == "success"  # warm
+            with fault.armed("query.fetch", "delay", delay_ms=300):
+                assert json.load(self._get(
+                    self._query_url(port, timeout="2")))["status"] == "success"
+            health = json.load(self._get(
+                f"http://127.0.0.1:{port}/health"))
+            q = health["query"]
+            assert q["slow_query_total"] >= 1
+            entry = q["slow"][-1]
+            assert entry["query"] == "sum(reqs)"
+            assert entry["elapsed_s"] >= 0.3
+            assert "fetch" in entry["phases"]
+        finally:
+            srv.shutdown()
+            db.close()

@@ -1,0 +1,251 @@
+"""dtest scenarios: query-path overload resilience across real nodes.
+
+The read-path mirror of TestFaultedQuorumScenario: a 3-coordinator
+federation under sustained queries with one region's storage delayed
+past every deadline (the `query.fetch` faultpoint in delay mode), and a
+single node under an admission-control burst.  Asserted from OUTSIDE
+the processes via HTTP + /metrics:
+
+* queries keep succeeding from the healthy majority within their
+  deadline (partial results + warnings, never 500s);
+* the slow peer's circuit breaker opens (``breaker_state`` gauge);
+* shed/deadline counters advance;
+* no query exceeds ``timeout + epsilon`` wall-clock;
+* a burst beyond the configured concurrency sheds 503 + Retry-After and
+  the wait queue drains without leaking slots.
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from m3_tpu.dtest.harness import NodeProcess
+
+SEC = 10**9
+BLOCK = 2 * 3600 * SEC
+START_S = (1_700_000_000 * SEC) // BLOCK * BLOCK // 10**9
+
+
+def _free_ports(n: int) -> list:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _get(url, timeout=60):
+    return urllib.request.urlopen(url, timeout=timeout)
+
+
+def _get_json(url, timeout=60):
+    return json.load(_get(url, timeout))
+
+
+def _write_samples(port, region, n=20):
+    samples = [
+        {"tags": {"__name__": "ov", "region": region},
+         "timestamp": START_S + i * 10, "value": float(i)}
+        for i in range(n)
+    ]
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/api/v1/json/write",
+        data=json.dumps(samples).encode(),
+        headers={"Content-Type": "application/json"})
+    assert _get(req).status == 200
+
+
+def _query_url(port, timeout_param=None):
+    u = (f"http://127.0.0.1:{port}/api/v1/query_range?"
+         f"query=sum(ov)%20by%20(region)&start={START_S}"
+         f"&end={START_S + 190}&step=10s")
+    if timeout_param is not None:
+        u += f"&timeout={timeout_param}"
+    return u
+
+
+@pytest.mark.slow
+class TestOverloadResilienceScenario:
+    """3-node federation; one region's storage delayed past every
+    deadline."""
+
+    def test_slow_region_breaker_opens_queries_stay_in_budget(self, tmp_path):
+        qports = _free_ports(3)
+        nodes = []
+        for k in range(3):
+            root = tmp_path / f"n{k}" / "data"
+            cfg = tmp_path / f"n{k}" / "node.yaml"
+            cfg.parent.mkdir(parents=True, exist_ok=True)
+            if k == 0:
+                remotes = [f"127.0.0.1:{qports[1]}", f"127.0.0.1:{qports[2]}"]
+                query = (
+                    "query:\n"
+                    f"  listen_port: {qports[0]}\n"
+                    f"  remotes: [{', '.join(repr(r) for r in remotes)}]\n"
+                    "  default_timeout: '30s'\n"
+                    "  breaker_failures: 3\n"
+                    "  breaker_reset: '60s'\n"
+                    "  slow_query_fraction: 0.5\n"
+                )
+            else:
+                query = f"query: {{listen_port: {qports[k]}}}\n"
+            cfg.write_text(
+                "db:\n"
+                f"  root: {root}\n"
+                "  namespaces:\n"
+                "    default: {num_shards: 2}\n"
+                "coordinator: {listen_port: 0}\n"
+                "mediator: {enabled: false}\n"
+                + query
+            )
+            root.mkdir(parents=True, exist_ok=True)
+            env = None
+            if k == 1:
+                # region 1 is the drowning peer: every post-warmup fetch
+                # stalls far past any query deadline (after=2 lets the
+                # two warmup queries through clean)
+                env = {"M3_FAULTPOINTS": "query.fetch=delay:ms=30000:after=2"}
+            nodes.append(NodeProcess(str(cfg), str(root), env=env))
+        try:
+            for nd in nodes:
+                nd.start()
+            ports = [json.loads(Path(nd.root, "node.json").read_text())["port"]
+                     for nd in nodes]
+            for k in range(3):
+                _write_samples(ports[k], f"n{k}")
+
+            # -- warmup: jit compile on every node, clean federation ----
+            for _ in range(2):
+                out = _get_json(_query_url(ports[0], "120"), timeout=180)
+                assert out["status"] == "success"
+            regions = {r["metric"]["region"] for r in out["data"]["result"]}
+            assert regions == {"n0", "n1", "n2"}  # all three answered
+
+            # -- sustained queries against a 3s deadline ---------------
+            TIMEOUT_S, EPSILON_S = 3.0, 3.0
+            walls, all_regions, warn_counts = [], [], 0
+            for i in range(8):
+                t0 = time.monotonic()
+                out = _get_json(_query_url(ports[0], "3"), timeout=30)
+                walls.append(time.monotonic() - t0)
+                assert out["status"] == "success"
+                got = {r["metric"]["region"] for r in out["data"]["result"]}
+                all_regions.append(got)
+                # the healthy majority always answers
+                assert {"n0", "n2"} <= got, got
+                if out.get("warnings"):
+                    warn_counts += 1
+            # no query exceeded its deadline + epsilon
+            assert max(walls) < TIMEOUT_S + EPSILON_S, walls
+            # the slow region degraded to warnings (partial results)
+            assert warn_counts >= 3, warn_counts
+            assert any("n1" not in g for g in all_regions)
+            # once the breaker opened, queries stopped paying the full
+            # deadline: the tail of the run is fast
+            assert walls[-1] < 1.5, walls
+
+            # -- observability from outside the process ----------------
+            metrics = _get(f"http://127.0.0.1:{ports[0]}/metrics").read(
+            ).decode()
+            peer = f'query:127.0.0.1:{qports[1]}'
+            line = [ln for ln in metrics.splitlines()
+                    if ln.startswith("breaker_state")
+                    and peer in ln]
+            assert line, metrics[:2000]
+            assert line[0].rstrip().endswith(" 2.0") or \
+                line[0].rstrip().endswith(" 2"), line  # 2 = open
+            dlx = [ln for ln in metrics.splitlines()
+                   if ln.startswith("query_deadline_exceeded_total")]
+            assert dlx and float(dlx[0].split()[-1]) > 0, dlx
+            health = _get_json(f"http://127.0.0.1:{ports[0]}/health")
+            assert health["query"]["breakers"][peer] == "open"
+            assert health["query"]["slow_query_total"] >= 3
+            slow = health["query"]["slow"]
+            assert slow and slow[-1]["query"].startswith("sum(ov)")
+        finally:
+            for nd in nodes:
+                nd.kill()
+
+
+@pytest.mark.slow
+class TestAdmissionBurstScenario:
+    """Burst past the configured concurrency: typed 503 shed, queue
+    drains, no slot leaks."""
+
+    def test_burst_sheds_503_and_queue_drains(self, tmp_path):
+        root = tmp_path / "data"
+        cfg = tmp_path / "node.yaml"
+        cfg.write_text(
+            "db:\n"
+            f"  root: {root}\n"
+            "  namespaces:\n"
+            "    default: {num_shards: 2}\n"
+            "coordinator: {listen_port: 0}\n"
+            "mediator: {enabled: false}\n"
+            "query:\n"
+            "  max_concurrent: 2\n"
+            "  max_queue: 2\n"
+            "  queue_timeout: '10s'\n"
+            "  default_timeout: '60s'\n"
+        )
+        root.mkdir(parents=True, exist_ok=True)
+        # every post-warmup fetch takes ~1.2s: burst queries HOLD their
+        # admission slot long enough for the burst to pile up
+        node = NodeProcess(str(cfg), str(root),
+                           env={"M3_FAULTPOINTS":
+                                "query.fetch=delay:ms=1200:after=3"})
+        try:
+            node.start()
+            port = json.loads(Path(root, "node.json").read_text())["port"]
+            _write_samples(port, "n0")
+            for _ in range(3):  # warmup: compile, clean faultpoint passes
+                assert _get_json(_query_url(port))["status"] == "success"
+
+            results = []
+            lock = threading.Lock()
+
+            def one():
+                try:
+                    r = _get(_query_url(port), timeout=60)
+                    with lock:
+                        results.append((r.status, None))
+                except urllib.error.HTTPError as e:
+                    with lock:
+                        results.append((e.code, e.headers.get("Retry-After")))
+
+            threads = [threading.Thread(target=one) for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(60.0)
+            codes = sorted(c for c, _ in results)
+            # 2 slots + 2 queue = 4 eventually succeed; 4 shed typed
+            assert codes.count(200) == 4, results
+            assert codes.count(503) == 4, results
+            retry_after = [ra for c, ra in results if c == 503]
+            assert all(ra is not None and int(ra) >= 1 for ra in retry_after)
+
+            # queue drained, no leaked slots: fresh queries admit, the
+            # active gauge returns to zero
+            assert _get_json(_query_url(port))["status"] == "success"
+            metrics = _get(f"http://127.0.0.1:{port}/metrics").read().decode()
+            vals = {ln.split()[0]: float(ln.split()[-1])
+                    for ln in metrics.splitlines()
+                    if ln.startswith("m3tpu_query_")}
+            assert vals.get("m3tpu_query_active") == 0.0, vals
+            assert vals.get("m3tpu_query_queued") == 0.0, vals
+            assert vals.get("m3tpu_query_shed_total") == 4.0, vals
+            assert vals.get("m3tpu_query_admitted_total", 0) >= 8.0, vals
+        finally:
+            node.kill()

@@ -1,0 +1,134 @@
+"""Direct unit coverage for storage/limits.py `_WindowedLimit`.
+
+The windowed check-and-add gates EVERY query (docs matched, series and
+bytes read — reference `storage/limits/query_limits.go` lookbackLimit)
+but was only exercised indirectly through query-path tests before.
+Pinned here: the window-rollover boundary (the accumulator resets
+exactly at lookback), concurrent `inc` from many threads (no lost
+updates, the limit still trips), and the `limit <= 0` disabled path.
+"""
+
+import threading
+
+import pytest
+
+from m3_tpu.storage.limits import (
+    LimitsOptions, QueryLimitExceeded, QueryLimits, _WindowedLimit,
+)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class TestWindowRollover:
+    def test_resets_exactly_at_lookback(self):
+        clock = FakeClock()
+        lim = _WindowedLimit("docs", limit=10, lookback_s=5.0, now=clock)
+        lim.inc(8)
+        assert lim.current == 8
+        # just BEFORE the boundary: still the same window — trips
+        clock.t += 5.0 - 1e-6
+        with pytest.raises(QueryLimitExceeded):
+            lim.inc(3)
+        # the failed inc still counted into the window (check-and-add)
+        assert lim.current == 11
+        # exactly AT the boundary (>= lookback): fresh window
+        clock.t += 1e-6
+        lim.inc(3)
+        assert lim.current == 3
+
+    def test_value_accumulates_within_window(self):
+        clock = FakeClock()
+        lim = _WindowedLimit("series", limit=100, lookback_s=5.0, now=clock)
+        for _ in range(10):
+            lim.inc(5)
+            clock.t += 0.4  # 4s total: stays inside one window
+        assert lim.current == 50
+        clock.t += 1.1  # crosses 5s since window start
+        lim.inc(1)
+        assert lim.current == 1
+
+    def test_exceeding_message_is_stable(self):
+        """The wire layers parse this message back into the typed class
+        (QueryLimitExceeded.from_message) — format drift would turn
+        remote 429s into 500s."""
+        lim = _WindowedLimit("docs-matched", limit=2, lookback_s=5.0)
+        with pytest.raises(QueryLimitExceeded) as ei:
+            lim.inc(3)
+        rebuilt = QueryLimitExceeded.from_message(str(ei.value))
+        assert rebuilt.name == "docs-matched"
+        assert str(rebuilt) == str(ei.value)
+
+
+class TestConcurrentInc:
+    def test_no_lost_updates_and_limit_trips(self):
+        lim = _WindowedLimit("bytes", limit=100_000, lookback_s=60.0)
+        trips = []
+        n_threads, per_thread = 8, 500
+
+        def worker():
+            for _ in range(per_thread):
+                try:
+                    lim.inc(1)
+                except QueryLimitExceeded:
+                    trips.append(1)
+
+        threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # 4000 total incs, limit 100k: every inc lands, none trip
+        assert lim.current == n_threads * per_thread
+        assert not trips
+
+    def test_concurrent_trips_are_all_raised(self):
+        lim = _WindowedLimit("docs", limit=100, lookback_s=60.0)
+        results = []
+
+        def worker():
+            ok = trip = 0
+            for _ in range(100):
+                try:
+                    lim.inc(1)
+                    ok += 1
+                except QueryLimitExceeded:
+                    trip += 1
+            results.append((ok, trip))
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        ok_total = sum(r[0] for r in results)
+        trip_total = sum(r[1] for r in results)
+        assert ok_total + trip_total == 400
+        # check-and-add counts even tripping incs, so exactly the first
+        # `limit` incs succeed and every later one raises
+        assert ok_total == 100
+        assert trip_total == 300
+
+
+class TestDisabledPath:
+    def test_zero_limit_never_trips_or_accumulates(self):
+        lim = _WindowedLimit("docs", limit=0, lookback_s=5.0)
+        lim.inc(10**9)
+        lim.inc(10**9)
+        assert lim.current == 0  # disabled: inc is a no-op
+
+    def test_negative_limit_is_disabled_too(self):
+        lim = _WindowedLimit("docs", limit=-1, lookback_s=5.0)
+        lim.inc(10**9)
+        assert lim.current == 0
+
+    def test_query_limits_defaults_are_disabled(self):
+        ql = QueryLimits(LimitsOptions())
+        ql.inc_docs(10**9)
+        ql.inc_series(10**9)
+        ql.inc_bytes(10**9)  # no raise: 0 disables every limit

@@ -27,7 +27,8 @@ CORPUS = Path(__file__).resolve().parent / "data" / "lint_corpus"
 # permissive scope: every rule applies to the corpus wherever it lives
 PERMISSIVE = Context(dtype_prefixes=("",), wire_prefixes=("",),
                      wire_files=(), fault_helper_files=(),
-                     constant_files=(), persist_prefixes=("",))
+                     constant_files=(), persist_prefixes=("",),
+                     deadline_files=(), deadline_prefixes=("",))
 
 EXPECTED = {
     ("lock_cases.py", "lock-discipline", 22),
@@ -52,6 +53,9 @@ EXPECTED = {
     ("placement_cases.py", "placement-cas", 8),
     ("placement_cases.py", "placement-cas", 12),
     ("placement_cases.py", "placement-cas", 16),
+    ("deadline_cases.py", "deadline-aware", 8),
+    ("deadline_cases.py", "deadline-aware", 9),
+    ("deadline_cases.py", "deadline-aware", 13),
 }
 
 
@@ -80,7 +84,7 @@ class TestCorpus:
         for rule in ("lock-discipline", "jit-purity", "explicit-dtype",
                      "wire-exhaustive", "fault-coverage",
                      "resource-hygiene", "corruption-typed",
-                     "placement-cas"):
+                     "placement-cas", "deadline-aware"):
             assert len(by_rule.get(rule, [])) >= 2, rule
 
 

@@ -10,7 +10,9 @@ import pytest
 from m3_tpu.index.doc import Document
 from m3_tpu.query.block import RawBlock, SeriesMeta
 from m3_tpu.query.engine import Engine
-from m3_tpu.query.fanout import FanoutSource, FanoutStorage, FederatedStorage
+from m3_tpu.query.fanout import (
+    FanoutSource, FanoutStorage, FederatedStorage, PartialResultError,
+)
 from m3_tpu.query.promql import LabelMatcher
 from m3_tpu.query.remote import (
     RemoteStorage, decode_fetch, decode_result, encode_fetch, encode_result,
@@ -41,12 +43,15 @@ class TestCodecs:
         matchers = (LabelMatcher(b"region", "=", b"us"),
                     LabelMatcher(b"host", "=~", b"h.*"))
         raw = encode_fetch(b"reqs", matchers, START, START + 100)
-        name, m2, s, e = decode_fetch(raw)
+        name, m2, s, e, dl_ms = decode_fetch(raw)
         assert name == b"reqs" and (s, e) == (START, START + 100)
         assert m2 == matchers
-        # nameless fetch
-        name, m2, _s, _e = decode_fetch(encode_fetch(None, (), 0, 1))
+        assert dl_ms == -1  # no deadline attached
+        # nameless fetch, with a deadline budget riding the trailer
+        name, m2, _s, _e, dl_ms = decode_fetch(
+            encode_fetch(None, (), 0, 1, deadline_ms=1500))
         assert name is None and m2 == ()
+        assert dl_ms == 1500
 
     def test_result_roundtrip(self):
         block = RawBlock.from_lists(
@@ -109,7 +114,7 @@ class TestFederation:
         out = fed.fetch_raw(b"reqs", m, START, START + BLOCK)
         assert len(out.series) == 1
         all_dead = FederatedStorage([Dead(), Dead()])
-        with pytest.raises(ConnectionError):
+        with pytest.raises(PartialResultError):
             all_dead.fetch_raw(b"reqs", m, START, START + BLOCK)
         db.close()
 
@@ -124,6 +129,113 @@ class TestFederation:
             remote.fetch_raw(b"x", (), START, START + 1)
         srv.shutdown()
         remote.close()
+
+    def test_concurrent_fetches_do_not_serialize(self, tmp_path):
+        """Satellite regression: the old single-socket client held one
+        lock across the whole request round-trip, so a slow peer
+        serialized (and could wedge) EVERY concurrent fanout fetch.
+        With the per-peer pool, a fast fetch completes while a slow one
+        is still in flight."""
+        import threading
+        import time as _time
+
+        from m3_tpu.query.block import RawBlock
+
+        slow_started = threading.Event()
+
+        class SlowFirst:
+            def __init__(self):
+                self.calls = 0
+                self._mu = threading.Lock()
+
+            def fetch_raw(self, name, matchers, start, end):
+                with self._mu:
+                    self.calls += 1
+                    first = self.calls == 1
+                if first:
+                    slow_started.set()
+                    _time.sleep(1.0)
+                return RawBlock.from_lists([], [])
+
+        srv = serve_query_background(SlowFirst())
+        remote = RemoteStorage(("127.0.0.1", srv.port))
+        done: dict = {}
+
+        def fetch(tag):
+            t0 = _time.monotonic()
+            remote.fetch_raw(b"x", (), START, START + 1)
+            done[tag] = _time.monotonic() - t0
+
+        t_slow = threading.Thread(target=fetch, args=("slow",))
+        t_slow.start()
+        assert slow_started.wait(5.0)
+        t_fast = threading.Thread(target=fetch, args=("fast",))
+        t_fast.start()
+        t_fast.join(5.0)
+        # the fast fetch must NOT have waited out the slow round-trip
+        assert done.get("fast") is not None and done["fast"] < 0.8, done
+        t_slow.join(5.0)
+        assert done.get("slow") is not None  # both completed
+        srv.shutdown()
+        remote.close()
+
+    def test_remote_limit_and_deadline_cross_typed(self, tmp_path):
+        """Satellite: server-side QueryLimitExceeded / DeadlineExceeded
+        must re-raise as the REAL classes client-side (429/504 at the
+        API), not flatten to RuntimeError (500)."""
+        from m3_tpu.storage.limits import QueryLimitExceeded
+        from m3_tpu.x.deadline import DeadlineExceeded
+
+        class Limited:
+            def fetch_raw(self, *a):
+                raise QueryLimitExceeded("docs-matched", 1000, 100)
+
+        srv = serve_query_background(Limited())
+        remote = RemoteStorage(("127.0.0.1", srv.port))
+        with pytest.raises(QueryLimitExceeded) as ei:
+            remote.fetch_raw(b"x", (), START, START + 1)
+        assert ei.value.name == "docs-matched"
+        srv.shutdown()
+        remote.close()
+
+        class Expired:
+            def fetch_raw(self, *a):
+                raise DeadlineExceeded("server side budget spent")
+
+        srv2 = serve_query_background(Expired())
+        remote2 = RemoteStorage(("127.0.0.1", srv2.port))
+        with pytest.raises(DeadlineExceeded):
+            remote2.fetch_raw(b"x", (), START, START + 1)
+        srv2.shutdown()
+        remote2.close()
+
+    def test_deadline_rides_the_frame_and_server_stops_work(self, tmp_path):
+        """A spent client budget reaches the server in the frame
+        trailer; the server answers typed DeadlineExceeded WITHOUT
+        touching storage (stop work server-side)."""
+        from m3_tpu.msg import protocol as wire
+        from m3_tpu.query.remote import QUERY_FETCH
+
+        class MustNotRun:
+            def __init__(self):
+                self.calls = 0
+
+            def fetch_raw(self, *a):
+                self.calls += 1
+                return RawBlock.from_lists([], [])
+
+        storage = MustNotRun()
+        srv = serve_query_background(storage)
+        sock = wire.connect(("127.0.0.1", srv.port), timeout=5.0)
+        wire.send_frame(sock, QUERY_FETCH,
+                        encode_fetch(b"x", (), START, START + 1,
+                                     deadline_ms=0))
+        ftype, body = wire.recv_frame(sock)
+        assert ftype == wire.ERROR
+        assert body.startswith(b"DeadlineExceeded")
+        assert storage.calls == 0  # server refused before storage
+        sock.close()
+        srv.shutdown()
 
     def test_reconnect_after_server_restart(self, tmp_path):
         db = _seed(tmp_path, b"eu")
@@ -141,3 +253,48 @@ class TestFederation:
         srv2.shutdown()
         remote.close()
         db.close()
+
+    def test_retry_dials_fresh_not_another_stale_pooled_socket(self, tmp_path):
+        """A peer restart stales EVERY idle pooled socket at once: the
+        one-reconnect retry must dial fresh, not pop the next stale
+        socket (which would fail the fetch against a healthy server)."""
+
+        class _DeadSock:
+            def settimeout(self, t):
+                pass
+
+            def sendall(self, b):
+                raise OSError("connection reset by stale peer")
+
+            def close(self):
+                pass
+
+        db = _seed(tmp_path, b"eu")
+        srv = serve_query_background(DatabaseStorage(db))
+        remote = RemoteStorage(("127.0.0.1", srv.port))
+        # a warm pool left behind by a burst, then the peer restarted
+        remote._pool._idle = [_DeadSock(), _DeadSock()]
+        m = (LabelMatcher(b"region", "=", b"eu"),)
+        out = remote.fetch_raw(b"reqs", m, START, START + BLOCK)
+        assert out.series
+        srv.shutdown()
+        remote.close()
+        db.close()
+
+    def test_spent_budget_does_not_trip_peer_breaker(self):
+        """A budget eaten upstream (engine eval, another source) raises
+        BEFORE the breaker: overload must not open a healthy peer's
+        breaker and fake a regional outage."""
+        from m3_tpu.x import deadline as xdeadline
+        from m3_tpu.x.breaker import CircuitBreaker
+        from m3_tpu.x.deadline import Deadline, DeadlineExceeded
+
+        br = CircuitBreaker("query:healthy", failure_threshold=2,
+                            reset_timeout_s=30.0)
+        remote = RemoteStorage(("127.0.0.1", 1), breaker=br)  # never dialed
+        with xdeadline.bind(Deadline(0.0)):
+            for _ in range(4):
+                with pytest.raises(DeadlineExceeded):
+                    remote.fetch_raw(b"x", (), START, START + 1)
+        assert br.state == "closed"
+        remote.close()
