@@ -243,30 +243,39 @@ def _emit(kind: str, payload: dict) -> None:
     print("RESULT " + json.dumps({kind: payload}), flush=True)
 
 
+# The pre-rewrite single-scan decoder's round-5 numbers — deleted in
+# round 6 (the two-phase rewrite replaced it wholesale), so the bench's
+# old-vs-new head-to-head reports against these RECORDED baselines.
+# Sources: PROFILE_decode_r05.json "full" (CPU, S=10K x 720) and
+# TPU_RESULTS_r05.json run2 (TPU v5e, S=2K x 720).
+OLD_R05_DECODE_DPS = {"cpu": 2_182_331, "tpu": 11_842_443}
+
+
 def _run_decode_stage(S: int, T: int, platform: str) -> dict:
     """Device decode: packed streams -> (ts, float64 value BITS); returns
-    stage dict with dps + bit-exactness verdict."""
+    stage dict with dps + bit-exactness verdict, timing BOTH phase-2
+    chains tails (fused / gather — encoding/m3tsz_jax.py) head-to-head
+    plus the old single-scan decoder's recorded r05 number."""
     import jax
     import jax.numpy as jnp
 
-    from m3_tpu.encoding import f64_emul as fe
     from m3_tpu.encoding.m3tsz_jax import (
-        decode_batch_device, encode_batch, pack_streams)
+        encode_batch, pack_streams, resolved_chains)
+    from m3_tpu.parallel.sharded_decode import decode_batch_device_sharded
 
-    @functools.partial(jax.jit, static_argnames=("max_points",))
-    def _decode_to_values(words, nbits, max_points: int):
-        # The result stays uint64 on device: the TPU backend emulates
-        # f64 as an f32 pair (double-double), so materializing a float64
-        # output loses the low mantissa bits (~1 ulp) — the BENCH_r02
-        # validation failure.  All codec math is integer (f64_emul); the
-        # host reinterprets the returned bits as float64 losslessly.
-        ts, payload, meta, err, prec, _ann = decode_batch_device(
-            words, nbits, max_points)
-        isf = (meta & 8) != 0
-        mult = (meta & 7).astype(jnp.int64)
-        ibits = fe.int_div_pow10(payload.astype(jnp.int64), mult)
-        vbits = jnp.where(isf, payload, ibits)
-        return ts, vbits, meta, err | prec
+    def _decode_to_values(words, nbits, max_points: int, chains: str):
+        # Scan-major + series-sharded across every local device (one
+        # scan per core — the native yardstick threads across cores
+        # too; single-device when only one exists, e.g. the TPU v5e
+        # child).  The timed run is the DECODE alone: the old device-
+        # side value epilogue was bench-validation plumbing, and as a
+        # separate single-device jit it forced the sharded outputs to
+        # reassemble on one device, eating the sharding win; the
+        # value-bits reconstruction now happens on the host, untimed
+        # (integer payloads + numpy's IEEE f64 division — the same
+        # lossless-bits contract as before).
+        return decode_batch_device_sharded(
+            words, nbits, max_points, chains=chains, scan_major=True)
 
     streams, ts, vals = _encode_corpus(S, T)
     if streams is None:
@@ -285,16 +294,24 @@ def _run_decode_stage(S: int, T: int, platform: str) -> dict:
     words = jnp.asarray(words_np)
     nbits = jnp.asarray(nbits_np)
 
-    run = lambda: jax.block_until_ready(
-        _decode_to_values(words, nbits, max_points=T + 1))
+    primary = resolved_chains()  # the backend's auto pick
+    other = "gather" if primary == "fused" else "fused"
+
+    run = lambda ch=primary: jax.block_until_ready(
+        _decode_to_values(words, nbits, max_points=T + 1, chains=ch))
     out = run()  # compile
-    _log(f"stage S={S}: compiled+ran, {_left():.0f}s left")
+    _log(f"stage S={S}: compiled+ran ({primary}), {_left():.0f}s left")
 
     # Bit-exactness: decoded timestamps and value BIT PATTERNS must match
     # the corpus exactly (immune to any host<->device f64 conversion).
-    dec_ts = np.asarray(out[0][:, :T])
-    dec_bits = np.asarray(out[1][:, :T])
-    errs = np.asarray(out[3])
+    # Value bits from the raw payloads on the host, untimed — the
+    # codec's own payload_value_bits (the one home of the meta layout).
+    from m3_tpu.encoding.m3tsz_jax import payload_value_bits
+
+    dec_ts = np.asarray(out[0]).T[:, :T]
+    dec_bits = payload_value_bits(np.asarray(out[1]),
+                                  np.asarray(out[2])).T[:, :T]
+    errs = np.asarray(out[3]) | np.asarray(out[4])
     if errs.any():
         verdict = f"decode-error on {int(errs.sum())}/{S} series"
     elif not np.array_equal(dec_ts, ts):
@@ -312,8 +329,38 @@ def _run_decode_stage(S: int, T: int, platform: str) -> dict:
         t0 = time.perf_counter()
         run()
         best = min(best, time.perf_counter() - t0)
-    return {"dps": round(S * T / best), "S": S, "T": T,
-            "platform": platform, "validation": verdict}
+    res = {"dps": round(S * T / best), "S": S, "T": T,
+           "platform": platform, "validation": verdict,
+           "chains": primary, "layout": "scan_major",
+           "devices": jax.device_count()}
+    # Old-vs-new: the recorded r05 single-scan number for this backend,
+    # plus the non-default chains tail so the seam's flip decision stays
+    # re-measurable every round (both tails are parity-pinned by
+    # tests/test_decode_fuzz.py — only speed can differ).
+    old = OLD_R05_DECODE_DPS.get(platform)
+    if old:
+        res["old_r05_single_scan_dps"] = old
+        res["vs_old_r05"] = round(res["dps"] / old, 2)
+    if _left() > 45:
+        try:
+            out2 = run(other)  # compile
+            bits_match = (
+                np.array_equal(np.asarray(out2[0]), np.asarray(out[0]))
+                and np.array_equal(np.asarray(out2[1]), np.asarray(out[1])))
+            best2 = float("inf")
+            for _ in range(3):
+                if _left() < 20 and best2 < float("inf"):
+                    break
+                t0 = time.perf_counter()
+                run(other)
+                best2 = min(best2, time.perf_counter() - t0)
+            res[f"dps_{other}"] = round(S * T / best2)
+            res[f"{other}_vs_{primary}"] = round(best / best2, 3)
+            if not bits_match:
+                res["validation"] = f"chains tails disagree ({primary} vs {other})"
+        except Exception as e:  # record, keep the primary result
+            res[f"dps_{other}"] = f"{type(e).__name__}: {e}"[:120]
+    return res
 
 
 def _run_device_encode_stage(S: int, T: int, platform: str) -> dict:
@@ -442,19 +489,21 @@ def _run_agg_bench(kind: str, C: int, N: int, NT: int, platform: str) -> dict:
                    "platform": platform,
                    "validation": "ok" if count_ok else
                    f"ingest count mismatch: {total_counts}"}
-            # The sorted (sort/scan/gather) impl exists because TPU
-            # scatter measured ~1us/element (window #3); record both
-            # so the flip decision is always re-measurable.
-            if _left() > 120:
+            # The pallas kernel exists because TPU scatter measured
+            # ~1us/element (window #3); record both on TPU so the flip
+            # decision is always re-measurable.  (The sorted impl this
+            # stage used to time was deleted in round 6: 0.45-0.50x of
+            # scatter on CPU, never validated faster on TPU.)
+            if _left() > 120 and platform == "tpu":
                 try:
-                    srate, sok, scnt = time_impl("sorted", 60)
+                    prate, pok, pcnt = time_impl("pallas", 60)
                     out.update(
-                        samples_per_sec_sorted=round(srate),
-                        sorted_validation="ok" if sok else
-                        f"ingest count mismatch: {scnt}",
-                        sorted_vs_scatter=round(srate / dev_rate, 3))
+                        samples_per_sec_pallas=round(prate),
+                        pallas_validation="ok" if pok else
+                        f"ingest count mismatch: {pcnt}",
+                        pallas_vs_scatter=round(prate / dev_rate, 3))
                 except Exception as e:  # record, keep the scatter result
-                    out["sorted_validation"] = \
+                    out["pallas_validation"] = \
                         f"{type(e).__name__}: {e}"[:200]
         finally:
             arena.set_ingest_impl(prior_impl)
@@ -552,32 +601,10 @@ def _run_agg_bench(kind: str, C: int, N: int, NT: int, platform: str) -> dict:
                            atol=1e-9):
             out["validation"] = "quantile mismatch vs host proxy"
 
-    # Sorted-impl ingest comparison (same drain; sample buffers are
-    # bit-identical across impls, so only the ingest is re-timed).
-    if _left() > 90 + NT // 200_000:
-        prior_impl = arena.ingest_impl()
-        try:
-            arena.set_ingest_impl("sorted")
-            tstep.clear_cache()
-            ts2 = tstep(arena.timer_init(1, C, NTpad), *batches[0], jt)
-            jax.block_until_ready(ts2.sum)  # compile+warm, then discard
-            ts2 = arena.timer_init(1, C, NTpad)
-            t0 = time.perf_counter()
-            for win, slots, values in batches:
-                ts2 = tstep(ts2, win, slots, values, jt)
-            jax.block_until_ready(ts2.sum)
-            s_ingest = time.perf_counter() - t0
-            sok = int(jnp.sum(tdrain(ts2)[1])) == NT
-            out.update(
-                ingest_s_sorted=round(s_ingest, 3),
-                samples_per_sec_sorted=round(NT / (s_ingest + drain_s)),
-                sorted_validation="ok" if sok else "count mismatch",
-                sorted_vs_scatter_ingest=round(ingest_s / s_ingest, 3))
-        except Exception as e:  # record, keep the scatter result
-            out["sorted_validation"] = f"{type(e).__name__}: {e}"[:200]
-        finally:
-            arena.set_ingest_impl(prior_impl)
-            tstep.clear_cache()
+    # (The sorted-impl ingest comparison that used to follow was
+    # deleted with the impl in round 6 — BENCH_r05 measured it at
+    # 0.063-0.102x of scatter end-to-end here, a regression the bench
+    # kept reporting as a feature.)
     return out
 
 
@@ -849,6 +876,15 @@ def child_main(platform: str) -> None:
             jax.config.update("jax_platforms", "cpu")
         except Exception:
             pass
+        # One virtual device per core (BEFORE any backend touch): the
+        # decode stage shards the series axis across them
+        # (parallel/sharded_decode.py) — the native yardstick threads
+        # across cores, so the JAX number must be allowed to as well
+        # (XLA-CPU won't intra-op-parallelize the scan's small per-op
+        # arrays).
+        from m3_tpu.parallel.mesh import enable_cpu_core_devices
+
+        enable_cpu_core_devices()
 
     import m3_tpu  # noqa: F401  (x64 config)
 
@@ -1043,6 +1079,17 @@ def main() -> None:
         if pallas_block:
             result["pallas_ingest"] = pallas_block
         result["probe_timeline"] = PROBE_TIMELINE
+        # Structured probe outcome (round-6 satellite): a dead relay
+        # used to be one clause in the free-text `note`, which is how
+        # three rounds of flat TPU trajectories went undiagnosed.  The
+        # machine-readable field makes "no TPU evidence this round"
+        # grep-able in the artifact.
+        if PROBE_TIMELINE:
+            opened = any(p["result"] == "open" for p in PROBE_TIMELINE)
+            probe: dict = {"ok": opened, "probes": len(PROBE_TIMELINE)}
+            if not opened:
+                probe["error"] = PROBE_TIMELINE[-1]["result"]
+            result["tpu_probe"] = probe
         if errors:
             result["note"] = "; ".join(errors)[-600:]
         _log(f"partial-result [{tag}]", json.dumps(result))
@@ -1117,6 +1164,10 @@ def main() -> None:
             compose_and_log("tpu-1")
     else:
         errors.append("tpu relay probe: connection refused at t=0")
+        _log("WARNING: TPU relay probe FAILED at t=0 — no TPU numbers "
+             "will be recorded unless a re-probe succeeds; this round's "
+             "TPU trajectory will be flat for ENVIRONMENTAL reasons "
+             "(see tpu_probe / probe_timeline in the artifact)")
         _log("relay down at t=0; running CPU stages first, will re-probe")
 
     # ---- stage 3: CPU-JAX stages (decode + full-size & smoke aggs +

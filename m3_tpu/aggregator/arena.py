@@ -62,22 +62,25 @@ def raw(jitted):
 
 
 # ---------------------------------------------------------------------------
-# Ingest implementation selection, M3_ARENA_INGEST=scatter|pallas|sorted
+# Ingest implementation selection, M3_ARENA_INGEST=scatter|pallas
 # or set_ingest_impl():
 #   scatter — XLA scatter ops (default; fastest on XLA-CPU).
 #   pallas  — binned segment reduction kernel (parallel/pallas_ingest.py):
-#             wins when slot collisions serialize the scatter AND the
-#             flat arena (W*C) is moderate.
-#   sorted  — sort/scan/gather with NO scatters (parallel/
-#             sorted_ingest.py): built for TPU, where scatter measured
-#             ~1us/element at C=1M (TPU_RESULTS_r05.json window #3).
+#             built for TPU, where scatter measured ~1us/element at C=1M
+#             (TPU_RESULTS_r05.json window #3); also wins on CPU when
+#             slot collisions serialize the scatter AND the flat arena
+#             (W*C) is moderate.
+# (A third sort/scan/gather impl — parallel/sorted_ingest.py — was
+# deleted in round 6: BENCH_r05 measured it at 0.45-0.50x of scatter on
+# CPU and it was never validated faster on real TPU hardware.  Its
+# generic segmented-scan helpers live on in parallel/segmented.py.)
 # The bench's rollup/timer stages time the candidates side by side.
 # The choice binds at TRACE time, so set_ingest_impl clears the arena
 # jit caches — jits composed elsewhere via raw() keep whatever impl
 # they traced with.
 # ---------------------------------------------------------------------------
 
-INGEST_IMPLS = ("scatter", "pallas", "sorted", "auto")
+INGEST_IMPLS = ("scatter", "pallas", "auto")
 _INGEST_IMPLS = INGEST_IMPLS  # back-compat alias
 _INGEST_IMPL = (os.environ.get("M3_ARENA_INGEST", "").strip().lower()
                 or "scatter")
@@ -95,14 +98,14 @@ def ingest_impl() -> str:
 
 def resolved_ingest_impl() -> str:
     """'auto' resolves per backend: scatter where XLA's scatter is fast
-    (CPU), sorted where scatter measured ~1us/element (TPU —
+    (CPU), the Pallas kernel where scatter measured ~1us/element (TPU —
     TPU_RESULTS_r05.json window #3).  Resolution happens at trace
     time, so a backend can't change under an already-compiled arena."""
     if _INGEST_IMPL != "auto":
         return _INGEST_IMPL
     import jax
 
-    return "sorted" if jax.default_backend() == "tpu" else "scatter"
+    return "pallas" if jax.default_backend() == "tpu" else "scatter"
 
 
 # Jitted programs that COMPOSE raw(ingest) ops and must be re-traced
@@ -126,175 +129,6 @@ def set_ingest_impl(impl: str) -> None:
             f.clear_cache()
         except AttributeError:  # raw function or older jax
             pass
-
-
-def _sorted_prep(state_cols_n: int, cap: int, idx, slots):
-    """Shared head of the sorted impl: ring geometry + composite key.
-    Contract (same as the scatter path's implicit one): for valid idx,
-    ``slots == idx % capacity`` — flat_window_index builds idx from
-    these very slots."""
-    from m3_tpu.parallel import sorted_ingest as so
-
-    W = state_cols_n // cap
-    k = so.composite_key(idx, slots, W, cap)
-    return so, W, k
-
-
-def _counter_ingest_sorted(state: "CounterState", idx, slots, values,
-                           times) -> "CounterState":
-    """Sort/scan/gather form of Counter.Update — no scatters (see
-    parallel/sorted_ingest.py for the measured rationale)."""
-    if values.shape[0] == 0:
-        return state
-    cap = state.last_at.shape[0]
-    so, W, k = _sorted_prep(state.sum.shape[0], cap, idx, slots)
-    s_k, s_val, s_tim = jax.lax.sort((k, values, times), num_keys=1)
-    is_start = jnp.concatenate(
-        [jnp.ones(1, bool), s_k[1:] != s_k[:-1]])
-    ones = jnp.ones_like(s_val)
-    (ssum, ssq, scnt), (smn,), (smx,) = so.head_flag_scan(
-        is_start, adds=(s_val, s_val * s_val, ones),
-        mins=(s_val,), maxs=(s_val,))
-    pos, found = so.last_occurrence(s_k, so.arena_queries(W, cap))
-    zero = jnp.zeros((), jnp.int64)
-    return CounterState(
-        sum=state.sum + jnp.where(found, ssum[pos], zero),
-        sum_sq=state.sum_sq + jnp.where(found, ssq[pos], zero),
-        count=state.count + jnp.where(found, scnt[pos], zero),
-        max=jnp.maximum(state.max, jnp.where(found, smx[pos], I64_MIN)),
-        min=jnp.minimum(state.min, jnp.where(found, smn[pos], I64_MAX)),
-        last_at=so.merged_slot_last_at(state.last_at, s_k, s_tim, W, cap),
-    )
-
-
-def _gauge_ingest_sorted(state: "GaugeState", idx, slots, values,
-                         times) -> "GaugeState":
-    """Sort/scan/gather form of Gauge.Update.  The one sort also serves
-    the last-value winner rule: within a (slot, window) segment the
-    order is (time asc, arrival desc), so the segment's final element
-    is (max time, first arrival) — gathered, not scattered."""
-    if values.shape[0] == 0:
-        return state
-    cap = state.last_at.shape[0]
-    so, W, k = _sorted_prep(state.sum.shape[0], cap, idx, slots)
-    n = values.shape[0]
-    arrival_desc = jnp.arange(n - 1, -1, -1, dtype=jnp.int32)
-    s_k, s_tim, _s_arr, s_val = jax.lax.sort(
-        (k, times, arrival_desc, values), num_keys=3)
-    s_nan = jnp.isnan(s_val)
-    s_safe = jnp.where(s_nan, 0.0, s_val)
-    is_start = jnp.concatenate(
-        [jnp.ones(1, bool), s_k[1:] != s_k[:-1]])
-    ones = jnp.ones(n, state.count.dtype)
-    (ssum, ssq, scnt), (smn,), (smx,) = so.head_flag_scan(
-        is_start, adds=(s_safe, s_safe * s_safe, ones),
-        mins=(jnp.where(s_nan, jnp.inf, s_val),),
-        maxs=(jnp.where(s_nan, -jnp.inf, s_val),))
-    pos, found = so.last_occurrence(s_k, so.arena_queries(W, cap))
-    wtime, wval = s_tim[pos], s_val[pos]
-    take = found & (wtime > state.last_time)
-    zero_f = jnp.zeros((), state.sum.dtype)
-    zero_i = jnp.zeros((), state.count.dtype)
-    return GaugeState(
-        last=jnp.where(take, wval, state.last),
-        last_time=jnp.where(take, wtime, state.last_time),
-        sum=state.sum + jnp.where(found, ssum[pos], zero_f),
-        sum_sq=state.sum_sq + jnp.where(found, ssq[pos], zero_f),
-        count=state.count + jnp.where(found, scnt[pos], zero_i),
-        max=jnp.maximum(state.max,
-                        jnp.where(found, smx[pos], -jnp.inf)),
-        min=jnp.minimum(state.min,
-                        jnp.where(found, smn[pos], jnp.inf)),
-        last_at=so.merged_slot_last_at(state.last_at, s_k, s_tim, W, cap),
-    )
-
-
-def _timer_ingest_sorted(state: "TimerState", windows, slots, values,
-                         times, capacity: int) -> "TimerState":
-    """Sort/scan/gather form of Timer.AddBatch: moments and per-slot
-    expiry ride the shared slot-major machinery; the sample append
-    keeps the scatter path's exact buffer layout (batch order at
-    ``sample_n[w] + rank``), with a contiguous dynamic_update_slice
-    fast path when a single-window batch has no drops and fits — the
-    common shape, and a memcpy instead of a ~1us/element scatter."""
-    if values.shape[0] == 0:
-        return state
-    num_w, scap = state.sample_slot.shape
-    n = values.shape[0]
-    idx = windows * capacity + slots
-    oob = (windows < 0) | (windows >= num_w)
-    # Same combined drop mask as the scatter path: out-of-range slots
-    # must neither alias window w+1's moment region nor consume sample
-    # buffer capacity/sample_n (the impls stay bit-identical).
-    drop = oob | (slots < 0) | (slots >= capacity)
-    idx = jnp.where(drop, num_w * capacity, idx)
-
-    so, W, k = _sorted_prep(state.sum.shape[0], capacity, idx, slots)
-    s_k, s_val, s_tim = jax.lax.sort((k, values, times), num_keys=1)
-    is_start = jnp.concatenate(
-        [jnp.ones(1, bool), s_k[1:] != s_k[:-1]])
-    ones = jnp.ones(n, state.count.dtype)
-    (ssum, ssq, scnt), _, _ = so.head_flag_scan(
-        is_start, adds=(s_val, s_val * s_val, ones))
-    pos, found = so.last_occurrence(s_k, so.arena_queries(W, capacity))
-    zero_f = jnp.zeros((), state.sum.dtype)
-    zero_i = jnp.zeros((), state.count.dtype)
-
-    # Append ranks: identical to the scatter path (batch order), so the
-    # buffers come out bit-identical under either impl.
-    order_key = jnp.where(drop, num_w, windows)
-    onehot = order_key[None, :] == jnp.arange(
-        num_w, dtype=order_key.dtype)[:, None]
-    ranks_all = jnp.cumsum(onehot.astype(jnp.int64), axis=1) - 1
-    w_clip = jnp.clip(order_key, 0, num_w - 1)
-    rank = jnp.take_along_axis(ranks_all, w_clip[None, :], axis=0)[0]
-    base = state.sample_n[w_clip]
-    dst = base + rank
-    flat = jnp.where(~drop & (dst < scap),
-                     w_clip.astype(jnp.int64) * scap + dst, num_w * scap)
-    per_w_counts = onehot.sum(axis=1, dtype=state.sample_n.dtype)
-
-    def _append_scatter(ops):
-        fslot, fval = ops
-        return (fslot.at[flat].set(slots, mode="drop"),
-                fval.at[flat].set(values, mode="drop"))
-
-    flat_slot = state.sample_slot.ravel()
-    flat_val = state.sample_val.ravel()
-    # The dus update operand must be no larger than one window's
-    # buffer, a TRACE-time constraint: a batch bigger than that can
-    # never fit anyway, so it is statically pinned to the scatter form.
-    # At runtime the gate is on the BATCH: all samples targeting ONE
-    # valid window (the common ingest shape on a multi-window ring).
-    if 0 < n <= scap:
-        row = jnp.clip(windows[0], 0, num_w - 1).astype(jnp.int64)
-        same = jnp.logical_not(drop.any()) & (windows == windows[0]).all()
-        fits = same & (state.sample_n[row] + n <= scap)
-
-        def _append_dus(ops):
-            fslot, fval = ops
-            start = row * scap + state.sample_n[row]
-            return (
-                jax.lax.dynamic_update_slice_in_dim(
-                    fslot, slots.astype(fslot.dtype), start, 0),
-                jax.lax.dynamic_update_slice_in_dim(fval, values, start, 0),
-            )
-
-        new_slot, new_val = jax.lax.cond(
-            fits, _append_dus, _append_scatter, (flat_slot, flat_val))
-    else:
-        new_slot, new_val = _append_scatter((flat_slot, flat_val))
-
-    return TimerState(
-        sum=state.sum + jnp.where(found, ssum[pos], zero_f),
-        sum_sq=state.sum_sq + jnp.where(found, ssq[pos], zero_f),
-        count=state.count + jnp.where(found, scnt[pos], zero_i),
-        sample_slot=new_slot.reshape(num_w, scap),
-        sample_val=new_val.reshape(num_w, scap),
-        sample_n=state.sample_n + per_w_counts,
-        last_at=so.merged_slot_last_at(state.last_at, s_k, s_tim, W,
-                                       capacity),
-    )
 
 
 def _seg3(sum_col, sq_col, cnt_col, idx, values):
@@ -332,10 +166,9 @@ def flat_window_index(windows, slots, num_windows: int, capacity: int):
     out-of-ring windows AND out-of-range slots map to the drop sentinel
     W*C.  Without the slot check, a valid window with slot >= C would
     compute w*C + slot inside window w+1's region — the exact aliasing
-    timer_ingest was fixed for; the sorted impl already drops such
-    samples via its composite-key sentinel, so sentineling here keeps
-    the two impls parity on ANY input (including pad_slots sentinels
-    and negative slots)."""
+    timer_ingest was fixed for; sentineling here keeps every ingest
+    impl parity on ANY input (including pad_slots sentinels and
+    negative slots)."""
     oob = ((windows < 0) | (windows >= num_windows)
            | (slots < 0) | (slots >= capacity))
     return jnp.where(
@@ -348,8 +181,8 @@ def _sanitize_slots(slots, capacity: int):
     under mode='drop' (a lowering artifact — it would bump slot C+s's
     expiry), so map it to the drop sentinel C; slots >= C already fall
     out of the (C,) column's range and drop.  Keeps the scatter paths
-    on the package-wide contract the sorted impl pins (invalid indices
-    DROP — sorted_ingest.composite_key)."""
+    on the package-wide contract (invalid indices DROP — also pinned
+    by xla_segment_ingest and the pallas kernel)."""
     return jnp.where(slots < 0, capacity, slots)
 
 
@@ -395,8 +228,6 @@ def counter_ingest(
     times: jnp.ndarray,  # i64 (N,)
 ) -> CounterState:
     """Counter.Update for a batch (reference counter.go:53-76)."""
-    if resolved_ingest_impl() == "sorted":
-        return _counter_ingest_sorted(state, idx, slots, values, times)
     s, sq, c = _seg3(state.sum, state.sum_sq, state.count, idx, values)
     slot_safe = _sanitize_slots(slots, state.last_at.shape[0])
     return CounterState(
@@ -525,8 +356,6 @@ def gauge_ingest(
     when strictly after); count includes NaN values but sum/min/max
     ignore them (gauge.go:57-63,95-103).
     """
-    if resolved_ingest_impl() == "sorted":
-        return _gauge_ingest_sorted(state, idx, slots, values, times)
     n = values.shape[0]
     nan = jnp.isnan(values)
     safe = jnp.where(nan, 0.0, values)
@@ -672,15 +501,11 @@ def timer_ingest(
     moment stats stay exact; quantiles degrade — counted by the caller
     via sample_n overflow).
     """
-    if resolved_ingest_impl() == "sorted":
-        return _timer_ingest_sorted(state, windows, slots, values, times,
-                                    capacity)
     num_w, scap = state.sample_slot.shape
     idx = windows * capacity + slots
     oob = (windows < 0) | (windows >= num_w)
     # Out-of-range SLOTS must drop too: w*C + slot with slot >= C would
-    # otherwise land in window w+1's region (fuzz-caught; the sorted
-    # impl already drops them via its composite-key sentinel).  The
+    # otherwise land in window w+1's region (fuzz-caught).  The
     # combined mask also gates the sample APPEND below — a dropped
     # sample must not consume quantile-buffer capacity or inflate
     # sample_n's overflow accounting.

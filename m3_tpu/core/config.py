@@ -259,8 +259,8 @@ class CoordinatorConfig:
     tracing: bool = False
     # Aggregation-arena ingest implementation for this process:
     # "" = leave the global default (M3_ARENA_INGEST env / scatter);
-    # scatter | pallas | sorted | auto select explicitly (auto resolves
-    # scatter on CPU, sorted on TPU — see aggregator/arena.py).
+    # scatter | pallas | auto select explicitly (auto resolves scatter
+    # on CPU, pallas on TPU — see aggregator/arena.py).
     arena_ingest: str = ""
 
     def validate(self, errs: list) -> None:

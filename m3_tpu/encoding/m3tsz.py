@@ -114,8 +114,12 @@ def convert_to_int_float(v: float, cur_max_mult: int) -> tuple[float, int, bool]
     Returns (value, multiplier, is_float).
     """
     if cur_max_mult == 0 and v < _MAX_INT:
-        # Quick check for vals that are already ints.
-        r = math.fmod(v, 1.0)
+        # Quick check for vals that are already ints.  Go's math.Mod
+        # yields NaN for ±Inf (and NaN) inputs, which fails the r == 0
+        # test and falls through to the float path; Python's math.fmod
+        # RAISES on an infinite numerator, so guard explicitly to keep
+        # the reference behavior (m3tsz.go:81-86).
+        r = math.fmod(v, 1.0) if math.isfinite(v) else math.nan
         if r == 0:
             return v - r, 0, False
 

@@ -69,6 +69,14 @@ try:
     _SCAN_UNROLL = max(1, int(os.environ.get("M3_SCAN_UNROLL", "1")))
 except ValueError:
     _SCAN_UNROLL = 1
+# The DECODE scan's unroll is tuned separately: its carry is a handful
+# of narrow (S,) lanes (no word window since the round-6 two-phase
+# split), so chaining two step bodies wins ~11% on XLA-CPU where the
+# encode scan's wide carry still spills.
+try:
+    _DECODE_UNROLL = max(1, int(os.environ.get("M3_DECODE_UNROLL", "2")))
+except ValueError:
+    _DECODE_UNROLL = 2
 
 # time-unit byte -> nanos (0 = invalid/None)
 _UNIT_NANOS = np.zeros(16, dtype=np.int64)
@@ -638,7 +646,8 @@ def pack_streams(streams: list[bytes], pad_words: int = 0):
     (S, pad_words) big-endian uint64 word arrays + per-stream bit lengths.
 
     ``pad_words`` of 0 sizes the array to the longest stream plus two
-    slack words (the decoder pads further to whole refill blocks).
+    slack words (the decoder pads further — ``_PAD_WORDS`` zero words —
+    so its register-file gathers and phase-2 funnels never read OOB).
     """
     S = len(streams)
     if pad_words == 0:
@@ -741,105 +750,240 @@ def _peek(words, cursor, n):
     return _shr(window, _c(64) - _c(n, I32).astype(U64))
 
 
-# -- Window-carry bit reader ------------------------------------------------
+# -- Register-file bit reader -----------------------------------------------
 #
-# Per-lane dynamic gathers from the (S, W) word array cost O(S*W) vector
-# work on TPU (the backend lowers them to masked reductions over the W
-# axis); the original decoder issued ~24 of them per scan step and was
-# gather-bound (round-2: 0.96M datapoints/s on a v5e).  The decoder now
-# carries a 32-word (2048-bit) window of each lane's stream in the scan
-# carry.  All field reads are register-level selects/shifts against a
-# 9-word buffer extracted from that window once per step; the only memory
-# access is a 16-word block refill, executed under a *scalar* `lax.cond`
-# only on steps where some lane's window runs low (~every 1024/avg-bits
-# steps on typical corpora).  Worst case (adversarial drift) is one
-# block gather per step -- still ~24x less gather work than before.
+# Phase 1 reads at most 229 bits per step — 64 (start) + 11+8+64
+# (marker + unit byte + full dod) + 16 (value control prefix) + 64
+# (payload peek) — and every read starts within 102 bits of the
+# post-start cursor ``c0``.  One 4-word gather at the word index below
+# c0 therefore covers the whole step: bits [b0, b0+256) with
+# c0 - b0 <= 63, so reads end at most at c0+166 <= b0+229 < b0+256.
+# Earlier rounds carried a 32-word window in the scan carry instead
+# (per-lane gathers lowered to O(S*W) masked reductions on TPU,
+# round-2), but with phase 2 owning ALL wide payload extraction the
+# per-step demand collapsed to these 4 words, and round-6 CPU profiling
+# showed the window machinery (16-word refills + 9-word select funnels)
+# costing ~5x the single tiny gather it avoided.  The padded stream
+# array keeps >= 4 zero words past the longest stream, so the gather
+# never clips in range.
 
-_WIN_WORDS = 32          # carried window: 2 blocks of 16 words (2048 bits)
-_BLK_WORDS = 16          # refill granularity (1024 bits)
-# Maximum bits one decode step can consume — the invariants in _buf9/_rd
-# and the refill depend on this bound staying <= 256: first step worst
-# case is 64 (start) + 11+8+64 (marker + unit byte + full dod) +
-# 1 (mode) + 1+1+6 (sig) + 1+3 (mult) + 1+64 (diff) = 225 bits;
-# steady-state steps top out lower (no 64-bit start).
+_PAD_WORDS = 16          # zero padding after the longest stream (words)
 
 
-def _buf9(window, rel):
-    """Extract 9 consecutive words from the 32-word window starting at the
-    4-word-aligned word index below bit offset ``rel`` (rel in [0, 1024)).
+def _regfile4(words, w0i):
+    """Gather the 4 consecutive u64 stream words starting at per-lane
+    word index ``w0i`` from the padded (S, W) array."""
+    idx = w0i[:, None] + jnp.arange(4, dtype=I32)[None, :]
+    R = jnp.take_along_axis(words, idx, axis=1, mode="promise_in_bounds")
+    return R[:, 0], R[:, 1], R[:, 2], R[:, 3]
 
-    Returns (B, base_bits) where B is a tuple of 9 (S,) words and
-    base_bits is the window bit offset of B[0].  All selects are
-    elementwise (no gathers): the aligned start has only 4 possibilities.
-    9 words cover the worst case: a step starts at buffer offset < 256
-    and consumes <= 225 bits, so reads end below 481 < 8*64, and the
-    funnel in ``_rd`` may touch one word past the last data word.
+
+# -- Value-control lookup table ---------------------------------------------
+#
+# The value section's control prefix — mode / update-opcode / sig / mult
+# / XOR-class flags — is a pure function of (first-value pending,
+# int-or-float mode, next 16 stream bits): every branch's control bits
+# fit inside a 16-bit window, and only the *payload* beyond it is wider.
+# Round-6 profiling: the original 13-read flag cascade was ~250 fused
+# element-ops per lane per scan step, while an XLA-CPU gather costs a
+# few ns per lane — so the whole cascade collapses into ONE gather into
+# this precomputed 2^18-entry table plus ~30 unpack ops.  Table rows are
+# u32-packed:
+#
+#   bits  0-4   ctrl: control bits consumed before the payload/diff
+#               field (the field itself starts at ``value_cursor+ctrl``)
+#   bits  5-11  sig7: new significand width 0..64, 127 = keep carried
+#   bits 12-14  mult3: new decimal multiplier (valid when bit 15 clear)
+#   bit   15    mult_keep: no multiplier field, keep carried
+#   bit   16    sign: the int-diff sign bit's value
+#   bit   17    got_float_full: 64-bit raw float payload follows
+#   bit   18    xor_nz: nonzero XOR (contained or uncontained)
+#   bit   19    contained: XOR payload width = 64 - pl - pt (carried)
+#   bit   20    uncont: explicit lead6/meaningful6 then payload
+#   bit   21    diff_active: signed int-diff payload of eff-sig bits
+#   bit   22    nfloat_set: mode becomes float after this point
+#   bit   23    nfloat_keep: mode unchanged (neither set nor clear)
+#   bit   24    mult_err: multiplier field decoded > max (stream error)
+#   bit   25    xor_zero: zero-XOR repeat (no payload)
+#
+# For the uncontained path the lead/meaningful fields also sit inside
+# the 16-bit window (bits 3..14) and are re-extracted with two shifts —
+# cheaper than widening the table rows to u64.
+
+_VC_KEEP_SIG = 127
+
+
+def _build_value_ctrl_table() -> np.ndarray:
+    """Precompute the (2^18,) u32 value-control table (numpy, import
+    time).  Index = first << 17 | is_float << 16 | next-16-bits
+    (MSB-first).  Mirrors the reference decoder's branch structure
+    (m3tsz.py readIntSigMult / XOR paths) exactly; the jit path's
+    correctness against the scalar decoder is pinned by the round-trip
+    and sha256 corpus tests."""
+    idx = np.arange(1 << 18, dtype=np.int64)
+    X = idx & 0xFFFF
+    isf = ((idx >> 16) & 1) == 1
+    first = ((idx >> 17) & 1) == 1
+
+    def bit(k):  # k-th stream bit of the window, 0 = first read
+        return (X >> (15 - k)) & 1
+
+    def bit_at(pos):  # data-dependent bit position (numpy array)
+        return (X >> (15 - pos)) & 1
+
+    def cascade(k0: int):
+        """The sig/mult update cascade starting at control offset k0:
+        sb1 [sb2 sig6] mb1 [mult3] sign."""
+        sb1 = bit(k0)
+        sb2 = bit(k0 + 1)
+        sig6 = np.zeros_like(X)
+        for j in range(6):
+            sig6 = (sig6 << 1) | bit(k0 + 2 + j)
+        sig = np.where(sb1 == 0, _VC_KEEP_SIG,
+                       np.where(sb2 == 0, 0, sig6 + 1))
+        k_m = np.where(sb1 == 0, k0 + 1,
+                       np.where(sb2 == 0, k0 + 2, k0 + 8))
+        mb1 = bit_at(k_m)
+        m3 = (bit_at(k_m + 1) << 2) | (bit_at(k_m + 2) << 1) | bit_at(k_m + 3)
+        mult = np.where(mb1 == 1, m3, 0)
+        mult_keep = mb1 == 0
+        mult_err = (mb1 == 1) & (m3 > 6)  # MAX_MULT (m3tsz.py)
+        k_s = k_m + np.where(mb1 == 1, 4, 1)
+        sign = bit_at(k_s)
+        ctrl = k_s + 1
+        return ctrl, sig, mult, mult_keep, mult_err, sign
+
+    c1 = cascade(1)   # first-value int: after the mode bit
+    c3 = cascade(3)   # next-value to-int-update: after nb1 nb2 nb3
+
+    p_a2 = first & (bit(0) == 1)                                # full float
+    p_a1 = first & (bit(0) == 0)                                # first int
+    nfirst = ~first
+    p_rep = nfirst & (bit(0) == 0) & (bit(1) == 1)              # repeat
+    p_tofl = nfirst & (bit(0) == 0) & (bit(1) == 0) & (bit(2) == 1)
+    p_toint = nfirst & (bit(0) == 0) & (bit(1) == 0) & (bit(2) == 0)
+    p_xz = nfirst & (bit(0) == 1) & isf & (bit(1) == 0)         # zero XOR
+    p_cont = nfirst & (bit(0) == 1) & isf & (bit(1) == 1) & (bit(2) == 0)
+    p_unc = nfirst & (bit(0) == 1) & isf & (bit(1) == 1) & (bit(2) == 1)
+    p_ino = nfirst & (bit(0) == 1) & ~isf                       # int no-upd
+
+    def sel(pairs, default):
+        out = np.full_like(X, default)
+        for mask, val in pairs:
+            out = np.where(mask, val, out)
+        return out
+
+    ctrl = sel([(p_a2, 1), (p_a1, c1[0]), (p_rep, 2), (p_tofl, 3),
+                (p_toint, c3[0]), (p_xz, 2), (p_cont, 3), (p_unc, 15),
+                (p_ino, 2)], 0)
+    sig7 = sel([(p_a1, c1[1]), (p_toint, c3[1])], _VC_KEEP_SIG)
+    mult3 = sel([(p_a1, c1[2]), (p_toint, c3[2])], 0)
+    mult_keep = ~((p_a1 & ~c1[3]) | (p_toint & ~c3[3]))
+    mult_err = (p_a1 & c1[4]) | (p_toint & c3[4])
+    sign = sel([(p_a1, c1[5]), (p_toint, c3[5]), (p_ino, bit(1))], 0)
+
+    flags = ((p_a2 | p_tofl).astype(np.int64) << 17
+             | (p_cont | p_unc).astype(np.int64) << 18
+             | p_cont.astype(np.int64) << 19
+             | p_unc.astype(np.int64) << 20
+             | (p_a1 | p_toint | p_ino).astype(np.int64) << 21
+             | (p_a2 | p_tofl).astype(np.int64) << 22
+             | (p_rep | p_xz | p_cont | p_unc | p_ino).astype(np.int64) << 23
+             | mult_err.astype(np.int64) << 24
+             | p_xz.astype(np.int64) << 25)
+    packed = (ctrl | (sig7 << 5) | (mult3 << 12)
+              | mult_keep.astype(np.int64) << 15 | (sign << 16) | flags)
+    return packed.astype(np.uint32)
+
+
+_VALUE_CTRL_TBL = _build_value_ctrl_table()
+
+
+def _decode_step(carry, _, words, nbits, unit0, emit_chains: bool = False):
+    """Phase 1 of the two-phase decode: ONE datapoint slot for every
+    series at once ((S,) array ops), resolving ONLY the data-dependent
+    minimum — control bits, field widths and the bit cursor — and
+    emitting a per-datapoint lane table for the parallel phase-2 field
+    gather (``_phase2``).  No timestamps, no value reconstruction, no
+    wide XOR/int state rides the scan: the carry is the cursor plus a
+    handful of narrow i32 lanes (sig width, time unit, and the previous
+    XOR's leading/trailing-zero counts, which decide the 'contained'
+    field width).
+
+    ``words`` is the padded (S, W) stream array (closure, not carry);
+    ``nbits`` the per-series stream bit lengths.  All bit reads come
+    from a 4-word register file gathered once per step (``_regfile4``).
+    The body is deliberately ONE branch-free straight line — no
+    ``lax.cond`` anywhere (round-6 profiling: every cond is a thunk
+    boundary on XLA-CPU, and the buffer round-trips at those boundaries
+    cost more than the work the cond skipped).
     """
-    wi0 = (rel >> _c(6, I32)) & ~_c(3, I32)      # 0, 4, 8, 12
-    b = wi0 >> _c(2, I32)                         # 0..3
-    cols = [window[:, j] for j in range(12 + 9)]
-    B = []
-    for j in range(9):
-        w = jnp.where(b == _c(0, I32), cols[j],
-            jnp.where(b == _c(1, I32), cols[4 + j],
-            jnp.where(b == _c(2, I32), cols[8 + j], cols[12 + j])))
-        B.append(w)
-    return tuple(B), wi0 * _c(64, I32)
-
-
-def _rd(B, o, n):
-    """Read ``n`` (0..64, possibly traced) bits at buffer-relative bit
-    offset ``o`` (0 <= o+n <= 512) from the 9-word buffer B.  Pure shifts
-    and selects; no memory access."""
-    wi = o >> _c(6, I32)                          # 0..7
-    r = (o & _c(63, I32)).astype(U64)
-    hi = B[0]
-    lo = B[1]
-    for j in range(1, 8):
-        sel = wi == _c(j, I32)
-        hi = jnp.where(sel, B[j], hi)
-        lo = jnp.where(sel, B[j + 1], lo)
-    chunk = _shl(hi, r) | jnp.where(r > _c(0), _shr(lo, _c(64) - r), _c(0))
-    return _shr(chunk, _c(64) - _c(n, I32).astype(U64))
-
-
-def _decode_step(carry, _, words3, nbits, default_unit: int):
-    """One datapoint slot for every series at once ((S,) array ops).
-
-    ``words3`` is the (S, NB+1, 16) blocked stream array (closure, not
-    carry); ``nbits`` the per-series stream bit lengths.  All bit reads
-    come from the carried window via ``_buf9``/``_rd``.
-    """
-    (cursor, done, err, prec, need_start, first_val, saw_ann, prev_time,
-     prev_delta, unit_idx, prev_fbits, prev_xor, int_val, sig, mult,
-     is_float, window, blk) = carry
+    (cursor, done, err, need_start, first_val, saw_ann, unit_idx,
+     sig, mult, is_float, pl, pt) = carry[:12]
+    chain_carry = carry[12:]
     active = (~done) & (~err)
 
-    unit_tbl = jnp.asarray(_UNIT_NANOS, I64)
-
-    base_abs = blk * _c(_BLK_WORDS * 64, I32)
-    B, base_bits = _buf9(window, cursor - base_abs)
-    base_abs = base_abs + base_bits
-
-    def _peek(_w, cur, n):  # same read interface as before, window-backed
-        return _rd(B, cur - base_abs, n)
-
-    words = None  # all reads go through the window
-
-    # ---- first: 64-bit start timestamp ----
+    # ---- first: 64-bit start timestamp (only its ALIGNMENT matters —
+    # it decides the initial time unit; phase 2 re-reads the value
+    # directly from word 0).  need_start implies cursor == 0 (the
+    # encoder splices annotation prefixes AFTER the start word and
+    # every other step consumes it).  ``unit0`` — the per-series
+    # initial unit derived from that alignment — is loop-invariant, so
+    # the caller computes it ONCE and closes over it (the i64 rem it
+    # needs is division, ~20x an add per lane; round-6 profiling caught
+    # it riding every step). ----
     rd_first = jnp.where(active & need_start, _c(64, I32), _c(0, I32))
-    nt = _sign_extend(_peek(words, cursor, rd_first), _c(64, I32))
     cur = cursor + rd_first
-    d_ns = jnp.asarray(int(Unit(default_unit).nanos()), I64)
-    aligned = (lax.rem(nt, d_ns)) == _c(0, I64)
-    unit0 = jnp.where(aligned, _c(default_unit, I32), _c(0, I32))
     unit_eff = jnp.where(need_start, unit0, unit_idx)
-    base_time = jnp.where(need_start, nt, prev_time)
     first = first_val  # value-mode branch key (first value still pending)
+
+    # ---- register file: ONE 4-word gather at the word index below
+    # `cur` covers every read this step makes (see _regfile4).  The
+    # 64-bit funnel W0 at `cur` serves the marker peek (11), the
+    # annotation varint bytes (<= 43 bits in), the time-unit byte
+    # (<= 19 + 8) and the dod opcode (<= 19 + 4) as in-register shifts
+    # — they all start within 64 bits of `cur` on whichever path a
+    # lane takes; the value-section reads (<= 102 bits in) use the
+    # full 3-word funnel ``rd3``. ----
+    c0 = cur
+    w0i = c0 >> _c(6, I32)
+    r0, r1, r2, r3 = _regfile4(words, w0i)
+    rf_base = w0i << _c(6, I32)
+
+    # All shifts below are UNGUARDED (no _shl/_shr >=64 clamps): every
+    # data-dependent amount is < 64 by construction, and the one
+    # 64-minus case (a funnel's low word at offset 0) masks the shift
+    # to (64-r)&63 and discards the r==0 lane with the select — its
+    # clamped value is never read, so the result stays deterministic.
+    def _funnel(hi, lo, r):
+        return (hi << r) | jnp.where(
+            r > _c(0), lo >> ((_c(64) - r) & _c(63)), _c(0))
+
+    off0 = (c0 - rf_base).astype(U64)
+    W0 = _funnel(r0, r1, off0)
+
+    def rd0(cur_abs, n: int):
+        # n is a STATIC width (1..64); cur_abs - c0 <= 43 < 64.
+        off = (cur_abs - c0).astype(U64)
+        chunk = W0 << off
+        return chunk >> _c(64 - n) if n < 64 else chunk
+
+    def rd3(cur_abs, n: int):
+        """Up to 64 STATIC-width bits anywhere in [c0, rf_base+192):
+        3-way funnel over the register file."""
+        o = cur_abs - rf_base
+        k = o >> _c(6, I32)                       # 0..2
+        r = (o & _c(63, I32)).astype(U64)
+        hi = jnp.where(k == _c(0, I32), r0,
+                       jnp.where(k == _c(1, I32), r1, r2))
+        lo = jnp.where(k == _c(0, I32), r1,
+                       jnp.where(k == _c(1, I32), r2, r3))
+        chunk = _funnel(hi, lo, r)
+        return chunk >> _c(64 - n) if n < 64 else chunk
 
     # ---- marker peek (11 bits) ----
     can_peek = (cur + _c(11, I32)) <= nbits
-    peek11 = jnp.where(active & can_peek, _peek(words, cur, _c(11, I32)), _c(0))
+    peek11 = jnp.where(active & can_peek, rd0(cur, 11), _c(0))
     is_marker = (peek11 >> _c(2)) == _c(0x100)
     mval = (peek11 & _c(3)).astype(I32)
     eos = active & is_marker & (mval == _c(0, I32))
@@ -850,45 +994,50 @@ def _decode_step(carry, _, words3, nbits, default_unit: int):
 
     # ---- annotation skip (timestamp_encoder.go:99-116) ----
     # marker + zigzag-LEB128 varint of (len-1) + len bytes.  The step
-    # consumes the marker and varint from the window (<= 43 bits) and
-    # jumps the cursor over the payload; the refill below reloads the
-    # window for any lane whose cursor left it.  The annotation slot
-    # emits no datapoint — callers size max_points accordingly.
+    # consumes the marker and varint from W0 (<= 43 bits) and jumps the
+    # cursor over the payload.  The annotation slot emits no datapoint
+    # — callers size max_points accordingly.  All four varint bytes sit
+    # at FIXED offsets inside W0, so they are four shifts plus a
+    # continuation-chain mask — no data-dependent read offsets.
     acur = cur + _c(11, I32)
-    ux = jnp.zeros_like(peek11)
-    more = ann
-    abits = jnp.zeros_like(cur)
-    for k in range(4):
-        rd = jnp.where(more, _c(8, I32), _c(0, I32))
-        byte = _peek(words, acur + abits, rd)
-        ux = ux | _shl(byte & _c(0x7F), _c(7 * k))
-        abits = abits + rd
-        more = more & ((byte & _c(0x80)) != _c(0))
-    err = err | more  # varint > 4 bytes: host path
-    ann_len = (ux >> _c(1)).astype(I32) + _c(1, I32)  # zigzag, stored len-1
+
+    vb = [rd0(acur + _c(8 * k, I32), 8) for k in range(4)]
+    t1 = (vb[0] & _c(0x80)) != _c(0)
+    t2 = t1 & ((vb[1] & _c(0x80)) != _c(0))
+    t3 = t2 & ((vb[2] & _c(0x80)) != _c(0))
+    ux = ((vb[0] & _c(0x7F))
+          | jnp.where(t1, _shl(vb[1] & _c(0x7F), _c(7)), _c(0))
+          | jnp.where(t2, _shl(vb[2] & _c(0x7F), _c(14)), _c(0))
+          | jnp.where(t3, _shl(vb[3] & _c(0x7F), _c(21)), _c(0)))
+    abits = (_c(8, I32)
+             + jnp.where(t1, _c(8, I32), _c(0, I32))
+             + jnp.where(t2, _c(8, I32), _c(0, I32))
+             + jnp.where(t3, _c(8, I32), _c(0, I32)))
+    ann_len = (ux >> _c(1)).astype(I32) + _c(1, I32)
+    err = err | (ann & t3 & ((vb[3] & _c(0x80)) != _c(0)))  # varint > 4B
     ann_end = acur + abits + ann_len * _c(8, I32)
     err = err | (ann & (ann_end > nbits))
     saw_ann = saw_ann | (ann & ~err)
 
     cur = cur + jnp.where(is_tu, _c(11, I32), _c(0, I32))
-    rd_tu = jnp.where(is_tu, _c(8, I32), _c(0, I32))
-    ub = _peek(words, cur, rd_tu).astype(I32)
-    cur = cur + rd_tu
+    ub = jnp.where(is_tu, rd0(cur, 8), _c(0)).astype(I32)
+    cur = cur + jnp.where(is_tu, _c(8, I32), _c(0, I32))
     ub_valid = (ub >= _c(1, I32)) & (ub <= _c(8, I32))
     tu_changed = is_tu & ub_valid & (ub != unit_eff)
     new_unit = jnp.where(is_tu, ub, unit_eff)
-    unit_nanos = unit_tbl[jnp.clip(new_unit, 0, 15)]
-    err = err | (proceed & (unit_nanos == _c(0, I64)) & ~tu_changed)
+    # _UNIT_NANOS is nonzero exactly on 1..8: a range check, not a gather
+    unit_ok = (new_unit >= _c(1, I32)) & (new_unit <= _c(8, I32))
+    err = err | (proceed & ~unit_ok & ~tu_changed)
 
-    # ---- delta of delta ----
+    # ---- delta of delta: widths only (payload bits are phase 2's) ----
     full64 = tu_changed
     rd_dod64 = jnp.where(proceed & full64, _c(64, I32), _c(0, I32))
-    dod_full = _sign_extend(_peek(words, cur, rd_dod64), _c(64, I32))
     cur = cur + rd_dod64
+    dod64_off = cur - rd_dod64
 
     # bucketed path: peek 4 opcode bits, classify
     bucket_active = proceed & ~full64
-    op4 = jnp.where(bucket_active, _peek(words, cur, _c(4, I32)), _c(0))
+    op4 = jnp.where(bucket_active, rd0(cur, 4), _c(0))
     b3 = (op4 >> _c(3)) & _c(1)
     b2 = (op4 >> _c(2)) & _c(1)
     b1 = (op4 >> _c(1)) & _c(1)
@@ -905,159 +1054,91 @@ def _decode_step(carry, _, words3, nbits, default_unit: int):
     nop = jnp.where(bucket_active, nop, _c(0, I32))
     nv = jnp.where(bucket_active, nv, _c(0, I32))
     cur = cur + nop
-    dod_bits = _peek(words, cur, nv)
+    ts_off = jnp.where(full64, dod64_off, cur)
+    ts_w = jnp.where(full64, _c(64, I32), nv)
     cur = cur + nv
-    dod_units = jnp.where(nv > _c(0, I32),
-                          _sign_extend(dod_bits, jnp.maximum(nv, _c(1, I32))),
-                          _c(0, I64))
-    dod_ns = jnp.where(full64, dod_full, dod_units * unit_nanos)
 
-    pd = prev_delta + jnp.where(proceed, dod_ns, _c(0, I64))
-    new_time = base_time + pd
-    pd = jnp.where(full64, _c(0, I64), pd)
-
-    # ---- value ----
-    # Small-field chunk: every flag/sig/mult/sign read in the value
-    # section starts within 16 bits of the section origin on whichever
-    # path a lane takes (64-bit payload reads only precede reads that
-    # are inactive on that lane), so ONE 64-bit window read serves all
-    # thirteen of them as in-register shifts instead of full buffer
-    # funnels.  Inactive lanes may compute off >= 64: the guarded
-    # shifts return 0, matching a zero-width _peek.
+    # ---- value section: ONE 16-bit funnel read + ONE table gather ----
+    # Every value path's control bits fit in the next 16 stream bits
+    # (see _build_value_ctrl_table): the 13-read flag cascade of the
+    # previous formulation collapses into a single precomputed-table
+    # gather plus unpack shifts.  Only the *payload* beyond the control
+    # prefix is wider, and the only payload LOOKED AT here is the
+    # full-float / contained-XOR word, whose bit pattern decides the
+    # next leading/trailing counts.
     v0 = cur
-    W = _peek(words, v0, _c(64, I32))
+    X = rd3(v0, 16).astype(I32)
+    tidx = (X | jnp.where(is_float, _c(1 << 16, I32), _c(0, I32))
+              | jnp.where(first, _c(1 << 17, I32), _c(0, I32)))
+    tv = jnp.asarray(_VALUE_CTRL_TBL, jnp.uint32)[tidx].astype(I32)
 
-    def rdw(cur_abs, n):
-        off = (cur_abs - v0).astype(U64)
-        return _shr(_shl(W, off), _c(64) - _c(n, I32).astype(U64))
+    ctrl = tv & _c(0x1F, I32)
+    sig7 = (tv >> _c(5, I32)) & _c(0x7F, I32)
+    mult3 = (tv >> _c(12, I32)) & _c(0x7, I32)
+    mult_keep = (tv & _c(1 << 15, I32)) != _c(0, I32)
+    sign_v = (tv & _c(1 << 16, I32)) != _c(0, I32)
+    got_float_full = proceed & ((tv & _c(1 << 17, I32)) != _c(0, I32))
+    xor_nz = proceed & ((tv & _c(1 << 18, I32)) != _c(0, I32))
+    contained = proceed & ((tv & _c(1 << 19, I32)) != _c(0, I32))
+    uncont = proceed & ((tv & _c(1 << 20, I32)) != _c(0, I32))
+    diff_active = proceed & ((tv & _c(1 << 21, I32)) != _c(0, I32))
+    nfloat_set = (tv & _c(1 << 22, I32)) != _c(0, I32)
+    nfloat_keep = (tv & _c(1 << 23, I32)) != _c(0, I32)
+    xor_zero = proceed & ((tv & _c(1 << 25, I32)) != _c(0, I32))
+    err = err | (proceed & ((tv & _c(1 << 24, I32)) != _c(0, I32)))
 
-    # first value
-    f_active = proceed & first
-    rd = jnp.where(f_active, _c(1, I32), _c(0, I32))
-    mode_bit = rdw(cur, rd)
-    cur = cur + rd
-    f_is_float = f_active & (mode_bit == _c(1))
-    rd = jnp.where(f_is_float, _c(64, I32), _c(0, I32))
-    f_fbits = _peek(words, cur, rd)
-    cur = cur + rd
-
-    # next-value branch bits
-    n_active = proceed & ~first
-    rd = jnp.where(n_active, _c(1, I32), _c(0, I32))
-    nb1 = rdw(cur, rd)
-    cur = cur + rd
-    upd = n_active & (nb1 == _c(0))  # opcodeUpdate = 0
-    rd = jnp.where(upd, _c(1, I32), _c(0, I32))
-    nb2 = rdw(cur, rd)
-    cur = cur + rd
-    repeat = upd & (nb2 == _c(1))
-    upd2 = upd & (nb2 == _c(0))
-    rd = jnp.where(upd2, _c(1, I32), _c(0, I32))
-    nb3 = rdw(cur, rd)
-    cur = cur + rd
-    to_float = upd2 & (nb3 == _c(1))
-    rd = jnp.where(to_float, _c(64, I32), _c(0, I32))
-    n_fbits = _peek(words, cur, rd)
-    cur = cur + rd
-    to_int_upd = upd2 & (nb3 == _c(0))
-
-    # readIntSigMult for first-int or next-int-update
-    sig_rd_active = (f_active & ~f_is_float) | to_int_upd
-    rd = jnp.where(sig_rd_active, _c(1, I32), _c(0, I32))
-    sb1 = rdw(cur, rd)
-    cur = cur + rd
-    sig_upd = sig_rd_active & (sb1 == _c(1))
-    rd = jnp.where(sig_upd, _c(1, I32), _c(0, I32))
-    sb2 = rdw(cur, rd)
-    cur = cur + rd
-    sig_nonzero = sig_upd & (sb2 == _c(1))
-    rd = jnp.where(sig_nonzero, _c(6, I32), _c(0, I32))
-    sigbits = rdw(cur, rd)
-    cur = cur + rd
-    new_sig = jnp.where(sig_upd & ~sig_nonzero, _c(0, I32),
-               jnp.where(sig_nonzero, sigbits.astype(I32) + _c(1, I32), sig))
-    rd = jnp.where(sig_rd_active, _c(1, I32), _c(0, I32))
-    mb1 = rdw(cur, rd)
-    cur = cur + rd
-    mult_upd = sig_rd_active & (mb1 == _c(1))
-    rd = jnp.where(mult_upd, _c(3, I32), _c(0, I32))
-    multbits = rdw(cur, rd)
-    cur = cur + rd
-    new_mult = jnp.where(mult_upd, multbits.astype(I32), mult)
-    err = err | (mult_upd & (new_mult > _c(6, I32)))
-
-    # int val diff read (first-int, next-int-update, next-int-noupdate)
-    int_noupd = n_active & (nb1 == _c(1)) & ~is_float
-    diff_active = sig_rd_active | int_noupd
-    eff_sig = jnp.where(int_noupd, sig, new_sig)
-    rd = jnp.where(diff_active, _c(1, I32), _c(0, I32))
-    sign_bit = rdw(cur, rd)
-    cur = cur + rd
-    rd = jnp.where(diff_active, eff_sig, _c(0, I32))
-    diff_bits = _peek(words, cur, rd)
-    cur = cur + rd
-    # sign convention: opcodeNegative(1) -> +, opcodePositive(0) -> -
-    signed_diff = jnp.where(sign_bit == _c(1), diff_bits.astype(I64),
-                            -(diff_bits.astype(I64)))
-    prec = prec | (diff_active & (diff_bits > _c(_PRECISION_LIMIT)))
-
-    # XOR float next (n_active & ~upd & is_float)
-    xor_active = n_active & (nb1 == _c(1)) & is_float
-    rd = jnp.where(xor_active, _c(1, I32), _c(0, I32))
-    xb1 = rdw(cur, rd)
-    cur = cur + rd
-    xor_zero = xor_active & (xb1 == _c(0))
-    xor_nz = xor_active & (xb1 == _c(1))
-    rd = jnp.where(xor_nz, _c(1, I32), _c(0, I32))
-    xb2 = rdw(cur, rd)
-    cur = cur + rd
-    contained = xor_nz & (xb2 == _c(0))
-    uncont = xor_nz & (xb2 == _c(1))
-    pl = jnp.where(prev_xor == _c(0), _c(64, I32),
-                   lax.clz(prev_xor.astype(I64)).astype(I32))
-    pt = jnp.where(prev_xor == _c(0), _c(0, I32),
-                   (_num_sig(prev_xor & (~prev_xor + _c(1))) - _c(1, I32)))
+    eff_sig = jnp.where(sig7 == _c(_VC_KEEP_SIG, I32), sig, sig7)
     meaningful_c = _c(64, I32) - pl - pt
-    rd = jnp.where(contained, meaningful_c, _c(0, I32))
-    cbits = _peek(words, cur, rd)
-    cur = cur + rd
-    rd = jnp.where(uncont, _c(12, I32), _c(0, I32))
-    packed = rdw(cur, rd)
-    cur = cur + rd
-    u_lead = ((packed >> _c(6)) & _c(0x3F)).astype(I32)
-    u_meaningful = (packed & _c(0x3F)).astype(I32) + _c(1, I32)
-    rd = jnp.where(uncont, u_meaningful, _c(0, I32))
-    ubits = _peek(words, cur, rd)
-    cur = cur + rd
+    u_lead = (X >> _c(7, I32)) & _c(0x3F, I32)
+    u_meaningful = ((X >> _c(1, I32)) & _c(0x3F, I32)) + _c(1, I32)
     u_trail = _c(64, I32) - u_lead - u_meaningful
-    new_xor = jnp.where(xor_zero, _c(0),
-              jnp.where(contained, _shl(cbits, pt.astype(U64)),
-              jnp.where(uncont, _shl(ubits, jnp.clip(u_trail, 0, 63).astype(U64)),
-                        prev_xor)))
+    # lead + meaningful > 64 never leaves a valid encoder; route such
+    # streams to the scalar path instead of desyncing pl/pt.
+    err = err | (uncont & (u_trail < _c(0, I32)))
 
-    # ---- state update ----
-    got_float_full = f_is_float | to_float
-    n_prev_fbits = jnp.where(got_float_full, jnp.where(f_is_float, f_fbits, n_fbits),
-                    jnp.where(xor_active, prev_fbits ^ new_xor, prev_fbits))
-    n_prev_xor = jnp.where(got_float_full, jnp.where(f_is_float, f_fbits, n_fbits),
-                  jnp.where(xor_active, new_xor, prev_xor))
-    n_int_val = jnp.where(diff_active, int_val + signed_diff, int_val)
-    prec = prec | (diff_active & (jnp.abs(n_int_val) > _c(_PRECISION_LIMIT, I64)))
-    n_is_float = jnp.where(got_float_full, _c(True, jnp.bool_),
-                  jnp.where(to_int_upd | (f_active & ~f_is_float),
-                            _c(False, jnp.bool_), is_float))
-    n_sig = jnp.where(sig_rd_active, new_sig, sig)
-    n_mult = jnp.where(sig_rd_active, new_mult, mult)
+    val_w = jnp.where(got_float_full, _c(64, I32),
+            jnp.where(contained, meaningful_c,
+            jnp.where(uncont, u_meaningful,
+            jnp.where(diff_active, eff_sig, _c(0, I32)))))
+    val_off = v0 + ctrl
+    cur = v0 + jnp.where(proceed, ctrl + val_w, _c(0, I32))
+
+    # ---- leading/trailing update for the next step ----
+    # Full-float and contained-XOR writes set the float-chain word to a
+    # value whose clz/ctz depend on PAYLOAD bits, so those two (and
+    # only those two) paths read it.  Uncontained writes are canonical
+    # (top and bottom meaningful bits set — phase 2 verifies), so their
+    # counts come straight from the explicit lead/meaningful fields.
+    # Exactly one payload can be live per lane and all of them start at
+    # ``val_off``, so ONE funnel read serves every path: the full-float
+    # word is the raw 64 bits, the contained window is its top
+    # ``meaningful_c`` bits.
+    need_payload = got_float_full | contained
+    c_w = jnp.where(contained, meaningful_c, _c(0, I32))
+    raw = rd3(val_off, 64)
+    cb = _shr(raw, _c(64) - jnp.clip(c_w, 0, 64).astype(U64))
+    nx = jnp.where(got_float_full, raw, _shl(cb, pt.astype(U64)))
+    nx_zero = nx == _c(0)
+    pl_c = jnp.where(nx_zero, _c(64, I32),
+                     lax.clz(nx.astype(I64)).astype(I32))
+    pt_c = jnp.where(nx_zero, _c(0, I32),
+                     _num_sig(nx & (~nx + _c(1))) - _c(1, I32))
+    n_pl = jnp.where(need_payload, pl_c,
+            jnp.where(uncont, u_lead,
+            jnp.where(xor_zero, _c(64, I32), pl)))
+    n_pt = jnp.where(need_payload, pt_c,
+            jnp.where(uncont, u_trail,
+            jnp.where(xor_zero, _c(0, I32), pt)))
+
+    # ---- narrow state update (self-gating: every update predicate is
+    # already ANDed with ``proceed``) ----
+    n_is_float = jnp.where(proceed,
+                           nfloat_set | (nfloat_keep & is_float), is_float)
+    n_sig = jnp.where(proceed & (sig7 != _c(_VC_KEEP_SIG, I32)), sig7, sig)
+    n_mult = jnp.where(proceed & ~mult_keep, mult3, mult)
 
     err = err | (proceed & (cur > nbits))
     emit = proceed & ~err
-
-    out_ts = jnp.where(emit, new_time, _c(0, I64))
-    out_isf = n_is_float
-    out_payload = jnp.where(out_isf, n_prev_fbits, n_int_val.astype(U64))
-    out_meta = (jnp.where(emit, _c(1, I32), _c(0, I32)) << 4 |
-                jnp.where(out_isf, _c(1, I32), _c(0, I32)) << 3 |
-                jnp.clip(n_mult, 0, 7)).astype(jnp.uint8)
 
     # ---- cursor update ----
     # Normal datapoint steps advance to `cur`; annotation steps jump the
@@ -1067,82 +1148,295 @@ def _decode_step(carry, _, words3, nbits, default_unit: int):
     new_cursor = jnp.where(ann_ok, ann_end,
                            jnp.where(proceed, cur, cursor))
 
-    # ---- window refill ----
-    # Lanes whose cursor crossed into the window's second 16-word block
-    # shift down and pull the next block; annotation jumps may leave the
-    # window entirely and reload both halves.  All gathers sit behind a
-    # scalar predicate: on typical corpora only ~1 step in 15-100 pays.
-    new_rel = new_cursor - blk * _c(_BLK_WORDS * 64, I32)
-    advanced = proceed | ann_ok
-    need_shift = advanced & (new_rel >= _c(_BLK_WORDS * 64, I32)) & (
-        new_rel < _c(2 * _BLK_WORDS * 64, I32))
-    need_jump = advanced & (new_rel >= _c(2 * _BLK_WORDS * 64, I32))
-
-    def _refill(ops):
-        win, bk = ops
-        NB = words3.shape[1] - 1
-        # Shift path: window [bk, bk+1] -> [bk+1, bk+2].
-        bnext = jnp.clip(bk + _c(2, I32), 0, NB)
-        nxt = jnp.take_along_axis(
-            words3, bnext[:, None, None].astype(jnp.int32), axis=1)[:, 0]
-        shifted = jnp.concatenate([win[:, _BLK_WORDS:], nxt], axis=1)
-        win = jnp.where(need_shift[:, None], shifted, win)
-        bk = jnp.where(need_shift, bk + _c(1, I32), bk)
-
-        # Jump path (annotation skip may leave the window entirely):
-        # reload [tb, tb+1] from scratch.  Split behind its OWN scalar
-        # cond: at large S the outer cond fires nearly every step
-        # (P[any lane shifts] -> 1), but jumps exist only on
-        # annotation-carrying streams — the common corpus should not
-        # pay the two reload gathers and extra (S, WIN) select per
-        # step (profiling round 5: the refill layer dominates the
-        # decode scan on XLA-CPU at S=10K).
-        def _jump(ops2):
-            w2, b2 = ops2
-            tb = new_cursor // _c(_BLK_WORDS * 64, I32)
-            lo = jnp.take_along_axis(
-                words3, jnp.clip(tb, 0, NB)[:, None, None].astype(jnp.int32),
-                axis=1)[:, 0]
-            hi = jnp.take_along_axis(
-                words3,
-                jnp.clip(tb + 1, 0, NB)[:, None, None].astype(jnp.int32),
-                axis=1)[:, 0]
-            reload = jnp.concatenate([lo, hi], axis=1)
-            w2 = jnp.where(need_jump[:, None], reload, w2)
-            b2 = jnp.where(need_jump, tb, b2)
-            return w2, b2
-
-        return lax.cond(jnp.any(need_jump), _jump, lambda o: o, (win, bk))
-
-    window, blk = lax.cond(jnp.any(need_shift | need_jump), _refill,
-                           lambda ops: ops, (window, blk))
-
     consumed = proceed | ann_ok
     new_carry = (
         new_cursor,
-        done, err, prec,
+        done, err,
         need_start & ~consumed,
         first_val & ~proceed,
         saw_ann,
-        jnp.where(proceed, new_time,
-                  jnp.where(ann_ok & need_start, nt, prev_time)),
-        jnp.where(proceed, pd, prev_delta),
         jnp.where(proceed, new_unit,
                   jnp.where(ann_ok & need_start, unit0, unit_idx)),
-        jnp.where(proceed, n_prev_fbits, prev_fbits),
-        jnp.where(proceed, n_prev_xor, prev_xor),
-        jnp.where(proceed, n_int_val, int_val),
-        jnp.where(proceed, n_sig, sig),
-        jnp.where(proceed, n_mult, mult),
-        jnp.where(proceed, n_is_float, is_float),
-        window, blk,
+        n_sig, n_mult, n_is_float, n_pl, n_pt,
     )
-    return new_carry, (out_ts, out_payload, out_meta)
+
+    if not emit_chains:
+        # ---- GATHER tail: lane-table emission (see _phase2) ----
+        shift = jnp.where(contained, pt,
+                jnp.where(uncont, jnp.clip(u_trail, 0, 63), _c(0, I32)))
+        U32c = lambda b, n: jnp.where(b, jnp.uint32(1 << n), jnp.uint32(0))
+        out_p1 = (jnp.where(emit, ts_w, _c(0, I32)).astype(jnp.uint32)
+                  | U32c(emit & full64, 7)
+                  | (jnp.clip(new_unit, 0, 15).astype(jnp.uint32)
+                     << jnp.uint32(8))
+                  | U32c(emit, 12))
+        out_p2 = (jnp.where(emit, val_w, _c(0, I32)).astype(jnp.uint32)
+                  | (jnp.clip(shift, 0, 63).astype(jnp.uint32)
+                     << jnp.uint32(7))
+                  | (jnp.clip(n_mult, 0, 7).astype(jnp.uint32)
+                     << jnp.uint32(13))
+                  | U32c(n_is_float, 16)
+                  | U32c(emit & xor_nz, 17)
+                  | U32c(emit & got_float_full, 18)
+                  | U32c(emit & diff_active, 19)
+                  | U32c(sign_v, 20)
+                  | U32c(emit & uncont, 21))
+        return new_carry, (ts_off, out_p1, val_off, out_p2)
+
+    # ---- FUSED tail: the three value chains ride THIS scan, consuming
+    # the payload words already in registers (``raw`` was read for the
+    # pl/pt update; the dod word is one more register-file funnel).
+    # Bit-identical to the gather tail by the parity tests; see
+    # decode_batch_device for when each tail is selected. ----
+    (time, csum, csum_rst, fb, iv, prec, err2) = chain_carry
+    unit_tbl = jnp.asarray(_UNIT_NANOS, I64)
+
+    # timestamp chain: running delta = csum - csum@(last unit reset)
+    draw = rd3(ts_off, 64)
+    dmag = _shr(draw, _c(64) - jnp.clip(ts_w, 0, 64).astype(U64))
+    dod = _sign_extend(dmag, ts_w)
+    un = unit_tbl[jnp.clip(new_unit, 0, 15)]
+    d_k = jnp.where(emit, jnp.where(full64, dod, dod * un), _c(0, I64))
+    csum2 = csum + d_k
+    time2 = time + jnp.where(emit, csum2 - csum_rst, _c(0, I64))
+    csum_rst2 = jnp.where(emit & full64, csum2, csum_rst)
+
+    # float-bits chain (running XOR with full-write resets); nx already
+    # equals the XOR word for the full-float and contained paths
+    pay_unc = raw >> (_c(64) - jnp.clip(u_meaningful, 1, 64).astype(U64))
+    xv_unc = pay_unc << jnp.clip(u_trail, 0, 63).astype(U64)
+    xv = jnp.where(xor_nz & emit,
+                   jnp.where(uncont, xv_unc, nx), _c(0))
+    fb2 = jnp.where(emit & got_float_full, raw, fb ^ xv)
+
+    # int chain; sign: opcodeNegative(1) -> +, opcodePositive(0) -> -
+    dv = _shr(raw, _c(64) - jnp.clip(eff_sig, 0, 64).astype(U64))
+    sd = jnp.where(emit & diff_active,
+                   jnp.where(sign_v, dv.astype(I64), -(dv.astype(I64))),
+                   _c(0, I64))
+    iv2 = iv + sd
+    prec2 = prec | (emit & diff_active
+                    & ((dv > _c(_PRECISION_LIMIT))
+                       | (jnp.abs(iv2) > _c(_PRECISION_LIMIT, I64))))
+
+    # Canonical-XOR guard (the gather tail's phase-2 epilogue check)
+    top_ok = (pay_unc >> jnp.clip(u_meaningful - _c(1, I32), 0, 63)
+              .astype(U64)) == _c(1)
+    bot_ok = (pay_unc & _c(1)) == _c(1)
+    err2_2 = err2 | (emit & uncont & ~(top_ok & bot_ok))
+
+    ts_o = jnp.where(emit, time2, _c(0, I64))
+    pay_o = jnp.where(n_is_float, fb2, iv2.astype(U64))
+    meta_o = (jnp.where(emit, _c(16, I32), _c(0, I32))
+              | jnp.where(n_is_float, _c(8, I32), _c(0, I32))
+              | jnp.clip(n_mult, 0, 7)).astype(jnp.uint8)
+    return (new_carry + (time2, csum2, csum_rst2, fb2, iv2, prec2, err2_2),
+            (ts_o, pay_o, meta_o))
 
 
-@functools.partial(jax.jit, static_argnames=("max_points", "default_unit"))
-def decode_batch_device(words, nbits, max_points: int, default_unit: int = 1):
-    """Decode (S, W+1) padded word arrays in parallel.
+def _decode_carry0(S: int, base_time=None):
+    """Phase-1 initial carry (shared with tools/decode_profile.py).
+    ``base_time`` (the start words as int64) arms the fused-chains tail:
+    when given, the seven chain lanes ride the carry too."""
+    base = (
+        jnp.zeros(S, I32), jnp.zeros(S, jnp.bool_), jnp.zeros(S, jnp.bool_),
+        jnp.ones(S, jnp.bool_), jnp.ones(S, jnp.bool_),
+        jnp.zeros(S, jnp.bool_), jnp.zeros(S, I32),
+        jnp.zeros(S, I32), jnp.zeros(S, I32), jnp.zeros(S, jnp.bool_),
+        jnp.full(S, 64, I32), jnp.zeros(S, I32),  # pl/pt of prev_xor == 0
+    )
+    if base_time is None:
+        return base
+    z64 = jnp.zeros(S, I64)
+    return base + (base_time.astype(I64), z64, z64, jnp.zeros(S, U64), z64,
+                   jnp.zeros(S, jnp.bool_), jnp.zeros(S, jnp.bool_))
+
+
+def _phase2(wpad, ts_off, p1, val_off, p2, extract_impl: str | None = None):
+    """Phase 2: fully parallel, branchless field extraction + chain
+    reconstruction over the phase-1 lane table.
+
+    All lane tables arrive SCAN-MAJOR — (P, S), straight off the
+    ``lax.scan`` stack with no transpose.  The sequential scan resolved
+    every bit boundary; everything left is data-parallel over (P, S):
+    gather the timestamp-DoD and value payloads out of the int32-packed
+    stream words (shift/mask funnels — a Pallas kernel on TPU,
+    ``take_along_axis`` elsewhere; see parallel/pallas_decode.py), then
+    rebuild the three value chains in ONE cheap ``lax.scan`` over the
+    point axis with (S,) lanes (~8 fused element-ops per step — round-6
+    profiling: the previous O(log P) associative-scan formulation paid
+    five full (S, P) array passes PER LEVEL on XLA-CPU and dominated
+    phase 2):
+
+      timestamps — running delta + running sum, the delta segmented at
+        time-unit changes (where the reference resets it);
+      float bits — running XOR, reset at full-float writes;
+      int values — running sum of the signed significand diffs.
+
+    Returns (ts, payload, meta, prec, err2) — outputs (S, P) — where
+    err2 flags streams whose uncontained XOR fields are non-canonical
+    (top/bottom meaningful bit clear — impossible from a valid encoder;
+    phase 1's width bookkeeping assumes canonical, so such streams must
+    take the scalar path instead of silently diverging from it).
+    """
+    from m3_tpu.parallel import pallas_decode
+
+    P, S = ts_off.shape
+    U32 = jnp.uint32
+    base_time = wpad[:, 0].astype(I64)
+
+    # ---- the gather: both fields of every datapoint in one call ----
+    # Scan-major throughout: the lane tables arrive (P, S) and the
+    # stream array is transposed ONCE so the gather and every later
+    # pass run in the (point, series) layout.  The Pallas path gathers
+    # from the int32-packed view (big-endian u32 halves of the u64
+    # stream words — u32 word k holds stream bits [32k, 32k+32)
+    # MSB-first, the fixed-lane layout Mosaic needs); the jnp path
+    # reads the u64 words directly (one fewer gather, no repack).
+    ts_w = (p1 & jnp.uint32(0x7F)).astype(I32)
+    val_w = (p2 & jnp.uint32(0x7F)).astype(I32)
+    offs = jnp.concatenate([ts_off, val_off], axis=0)
+    widths = jnp.concatenate([ts_w, val_w], axis=0)
+    impl = extract_impl or pallas_decode.resolved_impl()
+    wpad_t = wpad.T
+    if impl == "pallas":
+        w32_t = jnp.stack([(wpad_t >> _c(32)).astype(U32),
+                           (wpad_t & _c(0xFFFFFFFF)).astype(U32)],
+                          axis=1).reshape(-1, S)
+        fields = pallas_decode.extract_fields_t(w32_t, offs, widths,
+                                                impl=impl)
+    else:
+        fields = pallas_decode.extract_fields64_t(wpad_t, offs, widths)
+    dod_bits = fields[:P]
+    payload = fields[P:]
+
+    # ---- the chain scan: three running chains over the point axis
+    # with (S,) lanes, lane tables unpacked IN the step body (the
+    # tables are the scan's xs — unpacking inside costs a few u32 ops
+    # per step on data already in registers, while precomputing the
+    # unpacked lanes outside materializes three more (P, S) arrays of
+    # memory-bound traffic; round-6 measured both, as well as the
+    # O(log P) associative-scan formulation that paid five full-array
+    # passes per level).  Everything derivable from the chain OUTPUTS
+    # (emit/float masking, meta, the precision and canonical-XOR
+    # reductions) runs vectorized in the epilogue instead.  Time-unit
+    # changes reset the carried delta AFTER applying their full 64-bit
+    # dod: the running delta is csum - csum@(last reset strictly before
+    # this point), tracked incrementally. ----
+    unit_tbl = jnp.asarray(_UNIT_NANOS, I64)
+
+    def bit(p, n):
+        return (p & jnp.uint32(1 << n)) != jnp.uint32(0)
+
+    def _chain_step(carry, x):
+        time, csum, csum_rst, fb, iv = carry
+        p1_i, p2_i, dod_i, pay_i = x
+        tsw = (p1_i & jnp.uint32(0x7F)).astype(I32)
+        full_i = bit(p1_i, 7)
+        unit_i = ((p1_i >> jnp.uint32(8)) & jnp.uint32(0xF)).astype(I32)
+        emit_i = bit(p1_i, 12)
+        sh = ((p2_i >> jnp.uint32(7)) & jnp.uint32(0x3F)).astype(I32)
+        xnz_i = bit(p2_i, 17)
+        ff_i = bit(p2_i, 18)
+        diff_i = bit(p2_i, 19)
+        sign_i = bit(p2_i, 20)
+
+        dod = jnp.where(tsw > _c(0, I32),
+                        _sign_extend(dod_i, jnp.maximum(tsw, _c(1, I32))),
+                        _c(0, I64))
+        d_k = jnp.where(full_i, dod,
+                        dod * unit_tbl[jnp.clip(unit_i, 0, 15)])
+        csum2 = csum + d_k
+        time2 = time + jnp.where(emit_i, csum2 - csum_rst, _c(0, I64))
+        csum_rst2 = jnp.where(full_i, csum2, csum_rst)
+
+        xv_k = jnp.where(ff_i, pay_i,
+                         jnp.where(xnz_i, _shl(pay_i, sh.astype(U64)),
+                                   _c(0)))
+        fb2 = jnp.where(ff_i, xv_k, fb ^ xv_k)  # XOR chain, full resets
+
+        # int diff; sign: opcodeNegative(1) -> +, opcodePositive(0) -> -
+        sd_k = jnp.where(diff_i,
+                         jnp.where(sign_i, pay_i.astype(I64),
+                                   -(pay_i.astype(I64))), _c(0, I64))
+        iv2 = iv + sd_k
+        return (time2, csum2, csum_rst2, fb2, iv2), (time2, fb2, iv2)
+
+    z64 = jnp.zeros(S, I64)
+    _, (time_o, fb_o, iv_o) = lax.scan(
+        _chain_step, (base_time, z64, z64, jnp.zeros(S, U64), z64),
+        (p1, p2, dod_bits, payload))
+
+    # ---- vectorized epilogue over (P, S) ----
+    emit = bit(p1, 12)
+    isf = bit(p2, 16)
+    diff = bit(p2, 19)
+    unc = bit(p2, 21)
+    vw = (p2 & jnp.uint32(0x7F)).astype(I32)
+
+    # Canonical-XOR guard: a valid encoder always sets the top and
+    # bottom bits of an uncontained meaningful window (the explicit
+    # lead/trail fields ARE its clz/ctz); anything else desyncs the
+    # carried pl/pt, so route such streams to the scalar path.
+    top_ok = _shr(payload, jnp.maximum(vw - _c(1, I32), _c(0, I32))
+                  .astype(U64)) == _c(1)
+    bot_ok = (payload & _c(1)) == _c(1)
+    err2 = jnp.any(unc & ~(top_ok & bot_ok), axis=0)
+    prec = jnp.any(diff & ((payload > _c(_PRECISION_LIMIT))
+                           | (jnp.abs(iv_o) > _c(_PRECISION_LIMIT, I64))),
+                   axis=0)
+    ts = jnp.where(emit, time_o, _c(0, I64))
+    out_payload = jnp.where(isf, fb_o, iv_o.astype(U64))
+    meta = (jnp.where(emit, _c(16, I32), _c(0, I32))
+            | jnp.where(isf, _c(8, I32), _c(0, I32))
+            | ((p2 >> jnp.uint32(13)) & jnp.uint32(0x7)).astype(I32)
+            ).astype(jnp.uint8)
+
+    return ts, out_payload, meta, prec, err2  # scan-major (P, S)
+
+
+_CHAIN_IMPLS = ("fused", "gather")
+
+
+def resolved_chains() -> str:
+    """Which tail ``chains='auto'`` resolves to on this process'
+    backend.  ``M3_DECODE_CHAINS`` overrides (parity tests pin both)."""
+    impl = os.environ.get("M3_DECODE_CHAINS", "").strip()
+    if impl:
+        if impl not in _CHAIN_IMPLS:
+            raise ValueError(
+                f"M3_DECODE_CHAINS={impl!r}: expected one of {_CHAIN_IMPLS}")
+        return impl
+    return "gather" if jax.default_backend() == "tpu" else "fused"
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("max_points", "default_unit", "chains",
+                                    "scan_major"))
+def decode_batch_device(words, nbits, max_points: int, default_unit: int = 1,
+                        chains: str = "auto", scan_major: bool = False):
+    """Decode (S, W+1) padded word arrays in parallel, in two phases:
+    a sequential bit-boundary scan (``_decode_step``) that resolves
+    control bits into a per-datapoint lane table, then branchless field
+    extraction + chain reconstruction.  Where the second phase runs is
+    the ``chains`` seam (same shape as M3_ENCODE_PLACE / the arena's
+    ingest impls — one contract, backend-measured formulations,
+    parity-pinned):
+
+    ``gather``  phase 2 is a separate parallel pass (``_phase2``): lane
+                tables -> payload gather (Pallas kernel on TPU, see
+                parallel/pallas_decode.py) -> vectorized chain scan.
+                The TPU shape: the boundary scan stays minimal and the
+                heavy field traffic runs as wide fixed-lane gathers.
+    ``fused``   the three value chains ride the boundary scan itself
+                (``_decode_step(emit_chains=True)``), consuming payload
+                words already in the step's register file.  The XLA-CPU
+                shape: round-6 measured the separate chain scan paying
+                more in (P, S) lane-table materialization + scan
+                mechanics than the ~10 fused element-ops it saves.
+    ``auto``    (default) fused on CPU, gather on TPU; override with
+                M3_DECODE_CHAINS.  Both tails are bit-identical — pinned
+                by the corpus sha256 + fuzz parity tests.
 
     Returns (ts (S, max_points) int64, payload (S, max_points) uint64,
     meta (S, max_points) uint8, err (S,), prec (S,), ann (S,)).
@@ -1151,36 +1445,50 @@ def decode_batch_device(words, nbits, max_points: int, default_unit: int = 1):
     datapoints are decoded (each annotation consumes one scan slot) but
     the annotation bytes are skipped — callers needing them re-read via
     the scalar iterator.
+
+    ``scan_major=True`` returns ts/payload/meta as (max_points, S) —
+    the layout the scan produces — skipping the three (P, S)->(S, P)
+    transposes.  As the TERMINAL ops of this jit they materialize full
+    passes XLA cannot fuse into anything (round-6 CPU profiling: 30% of
+    total decode wall-time); host callers flip axes with free numpy
+    views instead, and in-jit callers compose the decode so XLA folds
+    the layout change into their own downstream ops.
     """
+    if chains == "auto":
+        chains = resolved_chains()
+    if chains not in _CHAIN_IMPLS:
+        raise ValueError(f"chains={chains!r}: expected one of "
+                         f"{_CHAIN_IMPLS + ('auto',)}")
     S, Wp = words.shape
-    # Pad the stream out to whole refill blocks plus one zero block so the
-    # window gather never reads out of bounds, and reshape for block pulls.
-    NB = -(-Wp // _BLK_WORDS)
-    wpad = jnp.pad(words, ((0, 0), (0, (NB + 1) * _BLK_WORDS - Wp)))
-    words3 = wpad.reshape(S, NB + 1, _BLK_WORDS)
+    # Pad the stream with zero words so the phase-1 register-file gather
+    # (4 words at the cursor) and phase 2's 3-word funnels never read
+    # out of bounds.
+    wpad = jnp.pad(words, ((0, 0), (0, _PAD_WORDS)))
     nbits32 = nbits.astype(I32)
 
-    carry0 = (
-        jnp.zeros(S, I32), jnp.zeros(S, jnp.bool_), jnp.zeros(S, jnp.bool_),
-        jnp.zeros(S, jnp.bool_), jnp.ones(S, jnp.bool_),
-        jnp.ones(S, jnp.bool_), jnp.zeros(S, jnp.bool_),
-        jnp.zeros(S, I64), jnp.zeros(S, I64), jnp.zeros(S, I32),
-        jnp.zeros(S, U64), jnp.zeros(S, U64), jnp.zeros(S, I64),
-        jnp.zeros(S, I32), jnp.zeros(S, I32), jnp.zeros(S, jnp.bool_),
-        wpad[:, :_WIN_WORDS], jnp.zeros(S, I32),
-    )
-    step = functools.partial(_decode_step, words3=words3, nbits=nbits32,
-                             default_unit=default_unit)
+    # The per-series initial time unit depends only on the start
+    # word's alignment — computed once here, not per scan step (i64
+    # rem is division).
+    d_ns = jnp.asarray(int(Unit(default_unit).nanos()), I64)
+    aligned = (lax.rem(wpad[:, 0].astype(I64), d_ns)) == _c(0, I64)
+    unit0 = jnp.where(aligned, _c(default_unit, I32), _c(0, I32))
 
-    # Decode k datapoints per loop iteration (VERDICT round-3 weak #2:
-    # the per-step formulation was flat with scale).  Unrolling chains k
-    # step bodies inside one iteration, so the carry — the (S, 32) word
-    # window plus ~17 per-lane scalars — stays in registers/fused
+    fused = chains == "fused"
+    base_time = wpad[:, 0].astype(I64)
+    carry0 = _decode_carry0(S, base_time if fused else None)
+    step = functools.partial(_decode_step, words=wpad, nbits=nbits32,
+                             unit0=unit0, emit_chains=fused)
+
+    # Decode k datapoints per loop iteration.  Unrolling chains k step
+    # bodies inside one iteration, so the narrow carry stays fused
     # between them instead of round-tripping memory every datapoint,
     # and the loop's fixed dispatch overhead is paid T/k times.
-    carry, (ts, payload, meta) = lax.scan(step, carry0, None,
-                                          length=max_points,
-                                          unroll=_SCAN_UNROLL)
+    # (Round-5's unroll=1 pin predates the two-phase split: with the
+    # 32-word window gone from the carry, unroll=2 measured ~11% faster
+    # on XLA-CPU, round 6.)
+    carry, lanes = lax.scan(step, carry0, None, length=max_points,
+                            unroll=_DECODE_UNROLL)
+
     # A stream whose EOS marker sits exactly after max_points datapoints never
     # sets done inside the scan; peek once more for it.
     cursor, done = carry[0], carry[1]
@@ -1189,14 +1497,41 @@ def decode_batch_device(words, nbits, max_points: int, default_unit: int = 1):
     eos_tail = can & ((peek11 >> _c(2)) == _c(0x100)) & ((peek11 & _c(3)) == _c(0))
     done = done | eos_tail
     err = carry[2] | (~done)  # not done after max_points -> error
-    prec = carry[3]
-    ann = carry[6]  # series whose stream carried annotation markers
-    return ts.T, payload.T, meta.T, err, prec, ann
+    ann = carry[5]  # series whose stream carried annotation markers
+
+    if fused:
+        ts, payload, meta = lanes  # scan-major (P, S)
+        prec, err2 = carry[17], carry[18]
+    else:
+        ts_off, p1, val_off, p2 = lanes  # scan-major (P, S) — no transpose
+        ts, payload, meta, prec, err2 = _phase2(wpad, ts_off, p1, val_off, p2)
+    if not scan_major:
+        ts, payload, meta = ts.T, payload.T, meta.T
+    return ts, payload, meta, err | err2, prec, ann
+
+
+def payload_value_bits(payload: np.ndarray, meta: np.ndarray) -> np.ndarray:
+    """Host-side float64 BIT reconstruction from raw decode outputs.
+
+    Float payloads (meta bit 3) ARE the bits; int payloads divide by
+    10^mult (meta bits 0-2) in numpy's IEEE f64 — bit-identical to the
+    reference's own f64 division, so the result upholds the codec's
+    lossless-bits contract.  Elementwise/layout-blind: works on (S, P)
+    or scan-major (P, S) arrays.  THE one home of the meta-layout
+    knowledge on the host side — decode_batch and bench validation both
+    call it.
+    """
+    isf = (meta & 8) != 0
+    mult = (meta & 7).astype(np.int64)
+    ivals = (payload.astype(np.int64).astype(np.float64)
+             / np.power(10.0, mult))
+    return np.where(isf, payload, ivals.view(np.uint64))
 
 
 def decode_batch(streams: list[bytes], max_points: int,
                  default_unit: Unit = Unit.SECOND,
-                 annotations_fallback: bool = True):
+                 annotations_fallback: bool = True,
+                 chains: str = "auto"):
     """Host-facing batched decode.
 
     Returns (timestamps (S, P) int64, values (S, P) float64,
@@ -1213,23 +1548,30 @@ def decode_batch(streams: list[bytes], max_points: int,
     words, nbits = pack_streams(streams)
     ts, payload, meta, err, prec, ann = decode_batch_device(
         jnp.asarray(words), jnp.asarray(nbits), max_points=max_points,
-        default_unit=int(default_unit))
-    ts = np.asarray(ts)
-    payload = np.asarray(payload)
-    meta = np.asarray(meta)
-    valid = (meta & 16) != 0
-    isf = (meta & 8) != 0
-    mult = (meta & 7).astype(np.int64)
-    fvals = payload.view(np.float64)
-    ivals = payload.astype(np.int64).astype(np.float64) / np.power(10.0, mult)
-    values = np.where(isf, fvals, ivals)
-    counts = valid.sum(axis=1)
+        default_unit=int(default_unit), chains=chains, scan_major=True)
+    # Scan-major on device (the terminal transposes were 30% of decode
+    # wall-time on CPU); the value reconstruction (payload_value_bits)
+    # is elementwise (layout-blind), so it runs on the contiguous
+    # (P, S) arrays and the (S, P) flip happens ONCE on the two
+    # results, where numpy's tiled copy is cheaper than three XLA
+    # passes.  .T.copy() (not ascontiguousarray) so the result is
+    # ALWAYS a writable host copy — for S == 1 the transposed view is
+    # already C-contiguous and ascontiguousarray would return the
+    # read-only device buffer itself, breaking the in-place compaction
+    # below.
+    payload_pm = np.asarray(payload)            # (P, S), contiguous
+    meta_pm = np.asarray(meta)
+    valid_pm = (meta_pm & 16) != 0
+    ts = np.asarray(ts).T.copy()
+    values = payload_value_bits(payload_pm, meta_pm).view(np.float64).T.copy()
+    valid = valid_pm.T
+    counts = valid_pm.sum(axis=0)
     ann_np = np.asarray(ann)
     if ann_np.any():
         # Annotation slots leave holes in annotated rows; compact each
-        # row's valid datapoints to a prefix (the contract counts rely on).
-        ts = ts.copy()
-        values = values.copy()
+        # row's valid datapoints to a prefix (the contract counts rely
+        # on).  ts/values are fresh writable host copies (the .T.copy()
+        # above), so in-place edits are safe.
         for i in np.nonzero(ann_np)[0]:
             m = valid[i]
             k = int(m.sum())

@@ -34,6 +34,33 @@ SHARD_AXIS = "shard"
 REPLICA_AXIS = "replica"
 
 
+def enable_cpu_core_devices(n: int | None = None) -> None:
+    """One virtual CPU device per core (default: os.cpu_count()), so
+    series-sharded programs (parallel/sharded_decode.py) can use every
+    core — XLA-CPU runs their small per-op arrays single-threaded, and
+    the bench's native C++ yardstick threads across cores.
+
+    Must run BEFORE the backend initializes (first jnp/jit/devices()
+    touch); afterwards both knobs are inert.  Sets BOTH: the XLA_FLAGS
+    env var is what jax 0.4.x honors (read at backend init), while
+    jax_num_cpu_devices covers newer builds that ignore the flag.  The
+    one caller that cannot use this helper is tests/conftest.py, which
+    must set the env before jax is imported at all (the axon
+    sitecustomize imports jax at interpreter startup).
+    """
+    import os
+
+    n = n or max(1, os.cpu_count() or 1)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:  # pre-jax_num_cpu_devices era
+        pass
+
+
 def shard_map_compat(f, mesh, in_specs, out_specs):
     """``jax.shard_map`` across jax versions, replica-check disabled.
 

@@ -1,0 +1,74 @@
+"""Generic segmented-reduction primitives over sorted batches.
+
+Sort + head-flag segmented ``associative_scan`` + ``searchsorted``
+gather is the scatter-free reduction idiom on accelerators: reduce
+within segments of an already-sorted batch in one pass, then gather
+each query key's segment total from the last occurrence of the key.
+query/functions.py builds its grouped PromQL aggregations on these.
+
+(The aggregation arenas used to carry a third ingest implementation on
+this idiom — parallel/sorted_ingest.py, built for TPU where scatter
+measured ~1us/element.  BENCH_r05 measured it at 0.45-0.50x of the
+scatter path on CPU and it was never validated faster on real TPU
+hardware, so round 6 deleted it; the TPU answer to slow scatters is
+the hand-scheduled Pallas kernel, parallel/pallas_ingest.py.  These
+two helpers are what survived: they are generic and still earn their
+keep under the query engine.)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def head_flag_scan(is_start, adds=(), mins=(), maxs=()):
+    """Inclusive segmented reduction via one associative scan.
+
+    ``is_start`` (N,) bool marks segment heads of the already-sorted
+    batch.  Each array in ``adds``/``mins``/``maxs`` — shape (N,) or
+    (N, ...) with any trailing lane dims — is reduced with +/min/max
+    within segments; position i of a result holds the reduction of its
+    segment's prefix up to i, so the LAST position of a segment holds
+    the full segment total.  Returns (adds, mins, maxs) tuples in the
+    caller's order.
+    """
+    n_adds, n_mins = len(adds), len(mins)
+
+    def comb(a, b):
+        fa, fb = a[0], b[0]
+        out = [fa | fb]
+        j = 1
+
+        def sel(flag, yes, no):
+            # broadcast the (k,) head flag across any trailing lane dims
+            return jnp.where(
+                flag.reshape(flag.shape + (1,) * (yes.ndim - 1)), yes, no)
+
+        for _ in range(n_adds):
+            out.append(sel(fb, b[j], a[j] + b[j]))
+            j += 1
+        for _ in range(n_mins):
+            out.append(sel(fb, b[j], jnp.minimum(a[j], b[j])))
+            j += 1
+        for _ in range(len(maxs)):
+            out.append(sel(fb, b[j], jnp.maximum(a[j], b[j])))
+            j += 1
+        return tuple(out)
+
+    res = jax.lax.associative_scan(
+        comb, (is_start,) + tuple(adds) + tuple(mins) + tuple(maxs))
+    return (res[1:1 + n_adds], res[1 + n_adds:1 + n_adds + n_mins],
+            res[1 + n_adds + n_mins:])
+
+
+def last_occurrence(sorted_keys, queries):
+    """(position, found) of the last occurrence of each query in
+    ``sorted_keys`` — the gather side of the merge.  Positions are
+    clamped valid so callers can gather unconditionally and mask with
+    ``found``."""
+    n = sorted_keys.shape[0]
+    pos = jnp.searchsorted(sorted_keys, queries, side="right") - 1
+    pos_c = jnp.clip(pos, 0, max(n - 1, 0))
+    found = (pos >= 0) & (sorted_keys[pos_c] == queries)
+    return pos_c, found

@@ -1,0 +1,84 @@
+"""Series-axis sharded M3TSZ decode: all local devices, one call.
+
+The two-phase decode (encoding/m3tsz_jax.py) is embarrassingly
+parallel across the series axis — the sequential scan is per-series —
+but XLA-CPU runs each (S,) element op single-threaded (the per-op
+arrays sit below its intra-op parallelization threshold), so a
+single-device decode uses ONE core no matter how many the host has.
+The native C++ yardstick bench.py compares against threads across
+cores; this helper makes the comparison fair by sharding the series
+axis over every local device with the repo's shard_map seam
+(parallel/mesh.py) — on a 2-core CPU host with 2 virtual devices it
+measured 1.74x (13.4M vs 7.7M dps, round 6), and on a TPU pod slice
+the same call spreads series across chips (ROADMAP item 3's decode
+axis).
+
+Bit-identity: each shard runs the IDENTICAL per-series program, so
+outputs equal the single-device decode exactly (pinned by
+tests/test_pallas_decode.py).  Series counts that don't divide the
+device count are zero-padded; padded rows decode as errors and are
+sliced off before returning.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from m3_tpu.encoding import m3tsz_jax as codec
+from m3_tpu.parallel.mesh import shard_map_compat
+
+
+def _raw(fn):
+    return getattr(fn, "__wrapped__", fn)
+
+
+@functools.lru_cache(maxsize=8)
+def _sharded_fn(n_dev: int, max_points: int, default_unit: int,
+                chains: str, scan_major: bool):
+    # dtype=object: a Mesh axis of Device objects, not numeric lanes
+    mesh = Mesh(np.array(jax.devices()[:n_dev], dtype=object), ("s",))
+    inner = functools.partial(
+        _raw(codec.decode_batch_device), max_points=max_points,
+        default_unit=default_unit, chains=chains, scan_major=scan_major)
+    out_sp = P(None, "s") if scan_major else P("s", None)
+    return jax.jit(shard_map_compat(
+        inner, mesh,
+        in_specs=(P("s"), P("s")),
+        out_specs=(out_sp, out_sp, out_sp, P("s"), P("s"), P("s"))))
+
+
+def decode_batch_device_sharded(words, nbits, max_points: int,
+                                default_unit: int = 1,
+                                chains: str = "auto",
+                                scan_major: bool = False,
+                                devices: int | None = None):
+    """decode_batch_device over all (or ``devices``) local devices,
+    series-sharded.  Same contract and bit-identical outputs; falls
+    back to the single-device jit when only one device exists."""
+    n_dev = devices or jax.device_count()
+    S = words.shape[0]
+    n_dev = min(n_dev, max(S, 1))
+    if n_dev <= 1:
+        return codec.decode_batch_device(
+            words, nbits, max_points, default_unit=default_unit,
+            chains=chains, scan_major=scan_major)
+    if chains == "auto":
+        chains = codec.resolved_chains()
+    pad = (-S) % n_dev
+    if pad:
+        words = jnp.pad(words, ((0, pad), (0, 0)))
+        nbits = jnp.pad(nbits, (0, pad))
+    out = _sharded_fn(n_dev, max_points, default_unit, chains,
+                      scan_major)(words, nbits)
+    if pad:
+        sl = ((slice(None), slice(None, S)) if scan_major
+              else (slice(None, S), slice(None)))
+        out = (out[0][sl], out[1][sl], out[2][sl],
+               out[3][:S], out[4][:S], out[5][:S])
+    return out

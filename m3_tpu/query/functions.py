@@ -84,7 +84,7 @@ def _segment_reduce(values: np.ndarray, gids: np.ndarray, num_groups: int,
     ones = (~nan).astype(jnp.float64)
 
     if jax.default_backend() == "tpu" and v.shape[0] > 0:
-        from m3_tpu.parallel import sorted_ingest as so
+        from m3_tpu.parallel import segmented as so
 
         order = jnp.argsort(g)
         gs = g[order]

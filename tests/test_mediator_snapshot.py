@@ -410,10 +410,10 @@ mediator: {{enabled: false}}
         try:
             asm = run_node(f"""
 db: {{root: {tmp_path}}}
-coordinator: {{listen_port: 0, arena_ingest: sorted}}
+coordinator: {{listen_port: 0, arena_ingest: pallas}}
 mediator: {{enabled: false}}
 """)
-            assert arena.ingest_impl() == "sorted"
+            assert arena.ingest_impl() == "pallas"
         finally:
             if asm is not None:
                 asm.close()
