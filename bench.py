@@ -243,6 +243,16 @@ def _emit(kind: str, payload: dict) -> None:
     print("RESULT " + json.dumps({kind: payload}), flush=True)
 
 
+def _retrace_verdict(verdict: str, retraces: int) -> str:
+    """Fold a nonzero steady-state retrace count into a stage's
+    validation string — unconditionally, so a stage that both fails
+    validation AND retraces reports both."""
+    if retraces:
+        return (f"RETRACED {retraces}x in steady state (timings polluted "
+                f"by recompiles): " + verdict)
+    return verdict
+
+
 # The pre-rewrite single-scan decoder's round-5 numbers — deleted in
 # round 6 (the two-phase rewrite replaced it wholesale), so the bench's
 # old-vs-new head-to-head reports against these RECORDED baselines.
@@ -277,6 +287,8 @@ def _run_decode_stage(S: int, T: int, platform: str) -> dict:
         return decode_batch_device_sharded(
             words, nbits, max_points, chains=chains, scan_major=True)
 
+    from m3_tpu.x import tracewatch
+
     streams, ts, vals = _encode_corpus(S, T)
     if streams is None:
         # native encoder unavailable: encode on device (slower prep)
@@ -299,8 +311,14 @@ def _run_decode_stage(S: int, T: int, platform: str) -> dict:
 
     run = lambda ch=primary: jax.block_until_ready(
         _decode_to_values(words, nbits, max_points=T + 1, chains=ch))
+    # Compile vs steady-state split: the first call's wall time is the
+    # compile+first-run cost (compile_s); the timed loop below is the
+    # post-warmup number — dps is never polluted by compilation again.
+    t0 = time.perf_counter()
     out = run()  # compile
-    _log(f"stage S={S}: compiled+ran ({primary}), {_left():.0f}s left")
+    compile_s = time.perf_counter() - t0
+    _log(f"stage S={S}: compiled+ran ({primary}) in {compile_s:.1f}s, "
+         f"{_left():.0f}s left")
 
     # Bit-exactness: decoded timestamps and value BIT PATTERNS must match
     # the corpus exactly (immune to any host<->device f64 conversion).
@@ -322,15 +340,40 @@ def _run_decode_stage(S: int, T: int, platform: str) -> dict:
     else:
         verdict = "ok"
 
+    # Steady state, sanitized: zero retraces across the timed
+    # iterations (a retrace regression must FAIL the stage, not
+    # masquerade as a throughput change), and the first timed
+    # iteration runs under the transfer guard — the decode hot loop is
+    # contractually device-resident.
     best = float("inf")
-    for _ in range(5):
+    snap = tracewatch.snapshot()
+    guard_note = None
+    try:
+        with tracewatch.no_transfers():
+            t0 = time.perf_counter()
+            run()
+            best = min(best, time.perf_counter() - t0)
+    except Exception as e:
+        # Catch EVERYTHING the guarded iteration raises, not just our
+        # own TransferError: jax.transfer_guard violations surface as
+        # XlaRuntimeError on real device backends, and a guard trip
+        # must fail this STAGE's validation, not forfeit the stage (a
+        # real non-guard error reproduces in the unguarded loop below
+        # and propagates from there).
+        guard_note = f"{type(e).__name__}: {e}"[:200]
+    for _ in range(4):
         if _left() < 20 and best < float("inf"):
             break
         t0 = time.perf_counter()
         run()
         best = min(best, time.perf_counter() - t0)
+    retraces = tracewatch.retraces_since(snap)
+    verdict = _retrace_verdict(verdict, retraces)
+    if guard_note:
+        verdict = f"transfer in timed region ({guard_note}): " + verdict
     res = {"dps": round(S * T / best), "S": S, "T": T,
            "platform": platform, "validation": verdict,
+           "compile_s": round(compile_s, 2), "retraces": retraces,
            "chains": primary, "layout": "scan_major",
            "devices": jax.device_count()}
     # Old-vs-new: the recorded r05 single-scan number for this backend,
@@ -368,11 +411,14 @@ def _run_device_encode_stage(S: int, T: int, platform: str) -> dict:
     encode side on the accelerator path, validated byte-identical
     against the native encoder (itself pinned to the scalar oracle)."""
     from m3_tpu.encoding.m3tsz_jax import encode_batch
+    from m3_tpu.x import tracewatch
 
     ts, vals, starts = _make_corpus(S, T)
     out_words = T * 40 // 64 + 8
     run = lambda: encode_batch(ts, vals, starts, out_words=out_words)
+    t0 = time.perf_counter()
     streams, fb = run()  # compile + warm
+    compile_s = time.perf_counter() - t0
     if fb.any():
         return {"error": f"device encoder fell back on {int(fb.sum())}/{S}"}
     verdict = "ok"
@@ -389,13 +435,17 @@ def _run_device_encode_stage(S: int, T: int, platform: str) -> dict:
     else:
         verdict = "native unavailable; not compared"
     best = float("inf")
+    snap = tracewatch.snapshot()
     for _ in range(3):
         if best < float("inf") and _left() < 45:
             break
         t0 = time.perf_counter()
         run()  # returns host bytes: device->host sync included
         best = min(best, time.perf_counter() - t0)
+    retraces = tracewatch.retraces_since(snap)
+    verdict = _retrace_verdict(verdict, retraces)
     return {"dps": round(S * T / best), "S": S, "T": T,
+            "compile_s": round(compile_s, 2), "retraces": retraces,
             "platform": platform, "validation": verdict}
 
 
@@ -411,6 +461,7 @@ def _run_agg_bench(kind: str, C: int, N: int, NT: int, platform: str) -> dict:
 
     from m3_tpu.aggregator import arena
     from m3_tpu.native import aggproxy
+    from m3_tpu.x import tracewatch
 
     W = 2
     rng = np.random.default_rng(7)
@@ -444,17 +495,23 @@ def _run_agg_bench(kind: str, C: int, N: int, NT: int, platform: str) -> dict:
         args = (idx, slots, jc, jg, jt)
 
         def time_impl(impl: str, budget_each: float):
-            """(rate, count_ok, total_counts) for one arena ingest
-            impl; re-inits states so runs are independent."""
+            """(rate, count_ok, total_counts, compile_s, retraces) for
+            one arena ingest impl; re-inits states so runs are
+            independent.  Timed iterations are retrace-sanitized: a
+            recompile inside the loop fails the stage's validation
+            instead of deflating samples_per_sec silently."""
             arena.set_ingest_impl(impl)
             step.clear_cache()
             drain.clear_cache()
             reps = 4
             cstate = arena.counter_init(W, C)
             gstate = arena.gauge_init(W, C)
+            t0 = time.perf_counter()
             cstate, gstate = step(cstate, gstate, *args)  # compile+warm
             jax.block_until_ready(drain(cstate, gstate))
+            compile_s = time.perf_counter() - t0
             done = 1  # ingests already applied to the live state
+            snap = tracewatch.snapshot()
             t0 = time.perf_counter()
             for _ in range(reps):
                 cstate, gstate = step(cstate, gstate, *args)
@@ -475,20 +532,26 @@ def _run_agg_bench(kind: str, C: int, N: int, NT: int, platform: str) -> dict:
                 jax.block_until_ready(checks)
                 dev_s = time.perf_counter() - t0
                 done += reps
+            retraces = tracewatch.retraces_since(snap)
             # Counts must equal exactly: every ingest applied to the
             # live state x N samples x 2 metric types; integer lanes
             # are exact on device.
             total_counts = float(checks[2]) + float(checks[3])
             return (reps * 2 * N / dev_s,
-                    total_counts == 2.0 * done * N, total_counts)
+                    total_counts == 2.0 * done * N, total_counts,
+                    compile_s, retraces)
 
         prior_impl = arena.ingest_impl()
         try:
-            dev_rate, count_ok, total_counts = time_impl("scatter", 60)
+            (dev_rate, count_ok, total_counts, compile_s,
+             retraces) = time_impl("scatter", 60)
+            verdict = _retrace_verdict(
+                "ok" if count_ok else
+                f"ingest count mismatch: {total_counts}", retraces)
             out = {"samples_per_sec": round(dev_rate), "C": C, "N": N,
                    "platform": platform,
-                   "validation": "ok" if count_ok else
-                   f"ingest count mismatch: {total_counts}"}
+                   "compile_s": round(compile_s, 2), "retraces": retraces,
+                   "validation": verdict}
             # The pallas kernel exists because TPU scatter measured
             # ~1us/element (window #3); record both on TPU so the flip
             # decision is always re-measurable.  (The sorted impl this
@@ -496,11 +559,13 @@ def _run_agg_bench(kind: str, C: int, N: int, NT: int, platform: str) -> dict:
             # scatter on CPU, never validated faster on TPU.)
             if _left() > 120 and platform == "tpu":
                 try:
-                    prate, pok, pcnt = time_impl("pallas", 60)
+                    prate, pok, pcnt, _pcs, pretr = time_impl("pallas", 60)
+                    pv = _retrace_verdict(
+                        "ok" if pok else f"ingest count mismatch: {pcnt}",
+                        pretr)
                     out.update(
                         samples_per_sec_pallas=round(prate),
-                        pallas_validation="ok" if pok else
-                        f"ingest count mismatch: {pcnt}",
+                        pallas_validation=pv,
                         pallas_vs_scatter=round(prate / dev_rate, 3))
                 except Exception as e:  # record, keep the scatter result
                     out["pallas_validation"] = \
@@ -548,11 +613,15 @@ def _run_agg_bench(kind: str, C: int, N: int, NT: int, platform: str) -> dict:
         return lanes[:, 8:], cnt
 
     # Warm BOTH kernels on a throwaway arena so neither compile lands in
-    # the timed region.
+    # the timed region (compile_s records that cost; the timed loops
+    # below are retrace-sanitized).
+    t0 = time.perf_counter()
     warm = tstep(arena.timer_init(1, C, NTpad), *batches[0], jt)
     jax.block_until_ready(tdrain(warm))
     jax.block_until_ready(tdrain(warm, packed=True))
     del warm
+    compile_s = time.perf_counter() - t0
+    snap = tracewatch.snapshot()
     t0 = time.perf_counter()
     for win, slots, values in batches:
         tstate = tstep(tstate, win, slots, values, jt)
@@ -562,6 +631,7 @@ def _run_agg_bench(kind: str, C: int, N: int, NT: int, platform: str) -> dict:
     qlanes, cnt = tdrain(tstate)
     jax.block_until_ready((qlanes, cnt))
     drain_s = time.perf_counter() - t0
+    retraces = tracewatch.retraces_since(snap)
     dev_s = ingest_s + drain_s
     count_ok = int(jnp.sum(cnt)) == NT
     dev_rate = NT / dev_s
@@ -578,16 +648,19 @@ def _run_agg_bench(kind: str, C: int, N: int, NT: int, platform: str) -> dict:
     p32_err = float(np.max(np.abs(qn[nz] - qpn[nz]) / np.abs(qn[nz]))) if nz.any() else 0.0
     p32_ok = np.array_equal(np.asarray(cnt), np.asarray(cp)) and p32_err < 1e-6
 
+    verdict = _retrace_verdict(
+        "ok" if count_ok else
+        f"sample count mismatch: {int(jnp.sum(cnt))} != {NT}", retraces)
     out = {"samples_per_sec": round(dev_rate), "C": C, "NT": NT,
            "ingest_s": round(ingest_s, 3), "drain_s": round(drain_s, 3),
+           "compile_s": round(compile_s, 2), "retraces": retraces,
            "packed32_drain_s": round(p32_drain_s, 3),
            "samples_per_sec_packed32": round(NT / (ingest_s + p32_drain_s)),
            "packed32_validation":
                ("ok" if p32_ok else f"packed32 mismatch: rel {p32_err:.2e}"),
            "packed32_max_rel_err": p32_err,
            "platform": platform,
-           "validation": "ok" if count_ok else
-           f"sample count mismatch: {int(jnp.sum(cnt))} != {NT}"}
+           "validation": verdict}
     if aggproxy.available():
         tt, host_out = aggproxy.timer_quantiles(ids, vals, C, qs)
         proxy_rate = NT / tt
@@ -680,6 +753,7 @@ def _run_promql_bench(G: int, B: int, platform: str,
     from m3_tpu.query import precision
     from m3_tpu.query.block import RawBlock, SeriesMeta
     from m3_tpu.query.engine import Engine
+    from m3_tpu.x import tracewatch
 
     STEP = 15 * 10**9
     RANGE = 3600 * 10**9          # 1h query window
@@ -738,9 +812,12 @@ def _run_promql_bench(G: int, B: int, platform: str,
     # child would invalidate every later f64 stage).
     precision.set_compute_dtype(dtype)
     try:
+        t0 = time.perf_counter()
         blk = run()  # compile + warm
+        compile_s = time.perf_counter() - t0
         T = blk.num_steps
-        _log(f"promql G={G} B={B} {dtype}: warm run done, {_left():.0f}s left")
+        _log(f"promql G={G} B={B} {dtype}: warm run done "
+             f"({compile_s:.1f}s), {_left():.0f}s left")
 
         # Validate a sampled subset against the scalar oracles.
         step_times = np.asarray(blk.step_times)
@@ -777,6 +854,7 @@ def _run_promql_bench(G: int, B: int, platform: str,
 
         best = float("inf")
         reps = 0
+        snap = tracewatch.snapshot()
         for _ in range(3):
             if reps and _left() < 60:
                 break
@@ -784,8 +862,10 @@ def _run_promql_bench(G: int, B: int, platform: str,
             run()
             best = min(best, time.perf_counter() - t0)
             reps += 1
+        retraces = tracewatch.retraces_since(snap)
     finally:
         precision.set_compute_dtype("f64")
+    verdict = _retrace_verdict(verdict, retraces)
     # dp/s = raw datapoints ingested per evaluation (the decode-side
     # framing); steps*groups/s recorded alongside.
     return {
@@ -793,6 +873,7 @@ def _run_promql_bench(G: int, B: int, platform: str,
         "series": S, "groups": G, "buckets": B, "points_per_series": int(P),
         "steps": T, "step_s": 15, "range_s": 3600, "rate_window_s": 300,
         "seconds_per_eval": round(best, 3), "compute_dtype": dtype,
+        "compile_s": round(compile_s, 2), "retraces": retraces,
         "platform": platform, "validation": verdict,
         "oracle_max_rel_err": max_err,
     }
@@ -887,6 +968,16 @@ def child_main(platform: str) -> None:
         enable_cpu_core_devices()
 
     import m3_tpu  # noqa: F401  (x64 config)
+
+    # Retrace/transfer sanitizer in RECORD mode for every stage: the
+    # stage dicts report compile-vs-steady splits and a `retraces`
+    # count over their timed iterations (asserted zero in validation),
+    # so a retrace regression can never masquerade as a throughput
+    # change again.  Record mode: a budget blowout must fail a STAGE's
+    # validation, not kill the child mid-run.
+    from m3_tpu.x import tracewatch
+
+    tracewatch.install(raise_on_violation=False)
 
     dev = jax.devices()[0]
     kind = dev.device_kind

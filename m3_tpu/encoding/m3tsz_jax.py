@@ -497,10 +497,30 @@ def _encode_step(carry, xs, unit: int, default_unit_is_32bit: bool):
     return new_carry, (w0, w1, w2, w3, ln)
 
 
-@functools.partial(jax.jit, static_argnames=("unit", "out_words"))
+_PLACE_IMPLS = ("scatter", "gather")
+
+
+def resolved_place() -> str:
+    """Which word-placement formulation the encoder uses on this
+    process' backend; ``M3_ENCODE_PLACE`` overrides (parity tests pin
+    both).  Resolved on the HOST, outside the trace, and passed as a
+    static argument — an env read under the tracer is frozen into the
+    first compile and the seam silently stops responding (retrace-risk;
+    exactly how the in-process override was broken until round 7)."""
+    place = os.environ.get("M3_ENCODE_PLACE", "").strip()
+    if place:
+        if place not in _PLACE_IMPLS:
+            raise ValueError(
+                f"M3_ENCODE_PLACE={place!r}: expected one of {_PLACE_IMPLS}")
+        return place
+    return "gather" if jax.default_backend() == "tpu" else "scatter"
+
+
 def encode_batch_device(timestamps, value_bits, start, valid, unit: int = 1,
                         out_words: int = 0, prefix_bits=None):
-    """Encode (S, T) series on device.
+    """Encode (S, T) series on device (host wrapper: resolves the
+    placement seam outside the trace, then dispatches to the jitted
+    implementation with ``place`` as a static argument).
 
     Args:
       timestamps: (S, T) int64 UnixNanos, padded entries arbitrary.
@@ -518,6 +538,16 @@ def encode_batch_device(timestamps, value_bits, start, valid, unit: int = 1,
     Returns dict with packed words (S, W) uint64 (starting with the 64-bit
     start time), total_bits (S,), fallback (S,) bool.
     """
+    return _encode_batch_device(
+        timestamps, value_bits, start, valid, unit=unit,
+        out_words=out_words, prefix_bits=prefix_bits,
+        place=resolved_place())
+
+
+@functools.partial(jax.jit, static_argnames=("unit", "out_words", "place"))
+def _encode_batch_device(timestamps, value_bits, start, valid, unit: int = 1,
+                         out_words: int = 0, prefix_bits=None,
+                         place: str = "scatter"):
     S, T = timestamps.shape
     if out_words == 0:
         out_words = (T * 16) // 64 + 4
@@ -576,9 +606,8 @@ def encode_batch_device(timestamps, value_bits, start, valid, unit: int = 1,
     #             its sum a cumsum difference — exact even with u64
     #             wraparound ((A+B)-A == B mod 2^64).  No scatter; built
     #             for TPU (~1us/element scatter, TPU_RESULTS_r05.json).
-    # M3_ENCODE_PLACE overrides for parity tests.
-    place = os.environ.get("M3_ENCODE_PLACE", "").strip() or (
-        "gather" if jax.default_backend() == "tpu" else "scatter")
+    # ``place`` is STATIC, resolved by the encode_batch_device wrapper
+    # (resolved_place: backend default, M3_ENCODE_PLACE override).
     if place == "gather":
         w_queries = jnp.arange(out_words, dtype=jnp.int64)
         zero_col = jnp.zeros((S, 1), U64)
@@ -899,7 +928,21 @@ def _build_value_ctrl_table() -> np.ndarray:
 _VALUE_CTRL_TBL = _build_value_ctrl_table()
 
 
-def _decode_step(carry, _, words, nbits, unit0, emit_chains: bool = False):
+@functools.lru_cache(maxsize=1)
+def value_ctrl_table():
+    """The 2^18-entry value-control table as a DEVICE array, uploaded
+    once per process and threaded through the decode entry points as an
+    ARGUMENT.  Referencing the numpy module global under the tracer
+    instead would constant-fold ~1MB of table into the HLO of every
+    decode compilation — per shape, per chains tail, per backend
+    (constant-bloat; the finding that motivated the rule).  Uncommitted
+    (plain jnp.asarray, no device pin) so the sharded paths can
+    replicate it across the mesh without a resharding error."""
+    return jnp.asarray(_VALUE_CTRL_TBL, dtype=jnp.uint32)
+
+
+def _decode_step(carry, _, words, nbits, unit0, ctrl_tbl,
+                 emit_chains: bool = False):
     """Phase 1 of the two-phase decode: ONE datapoint slot for every
     series at once ((S,) array ops), resolving ONLY the data-dependent
     minimum — control bits, field widths and the bit cursor — and
@@ -1070,7 +1113,7 @@ def _decode_step(carry, _, words, nbits, unit0, emit_chains: bool = False):
     X = rd3(v0, 16).astype(I32)
     tidx = (X | jnp.where(is_float, _c(1 << 16, I32), _c(0, I32))
               | jnp.where(first, _c(1 << 17, I32), _c(0, I32)))
-    tv = jnp.asarray(_VALUE_CTRL_TBL, jnp.uint32)[tidx].astype(I32)
+    tv = ctrl_tbl[tidx].astype(I32)
 
     ctrl = tv & _c(0x1F, I32)
     sig7 = (tv >> _c(5, I32)) & _c(0x7F, I32)
@@ -1252,7 +1295,7 @@ def _decode_carry0(S: int, base_time=None):
                    jnp.zeros(S, jnp.bool_), jnp.zeros(S, jnp.bool_))
 
 
-def _phase2(wpad, ts_off, p1, val_off, p2, extract_impl: str | None = None):
+def _phase2(wpad, ts_off, p1, val_off, p2, extract_impl: str = "jnp"):
     """Phase 2: fully parallel, branchless field extraction + chain
     reconstruction over the phase-1 lane table.
 
@@ -1297,7 +1340,10 @@ def _phase2(wpad, ts_off, p1, val_off, p2, extract_impl: str | None = None):
     val_w = (p2 & jnp.uint32(0x7F)).astype(I32)
     offs = jnp.concatenate([ts_off, val_off], axis=0)
     widths = jnp.concatenate([ts_w, val_w], axis=0)
-    impl = extract_impl or pallas_decode.resolved_impl()
+    # ``extract_impl`` arrives as a STATIC from the decode wrapper
+    # (resolved on the host — an env/backend read at trace time is
+    # frozen into the first compile; retrace-risk).
+    impl = extract_impl
     wpad_t = wpad.T
     if impl == "pallas":
         w32_t = jnp.stack([(wpad_t >> _c(32)).astype(U32),
@@ -1410,9 +1456,18 @@ def resolved_chains() -> str:
     return "gather" if jax.default_backend() == "tpu" else "fused"
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("max_points", "default_unit", "chains",
-                                    "scan_major"))
+def _resolved_extract(chains: str) -> str:
+    """The phase-2 field-extraction impl for a chains tail, resolved on
+    the host: only the gather tail runs the extraction pass, so the
+    fused tail pins "jnp" (keeps M3_DECODE_EXTRACT flips from
+    needlessly splitting the fused jit cache)."""
+    if chains != "gather":
+        return "jnp"
+    from m3_tpu.parallel import pallas_decode
+
+    return pallas_decode.resolved_impl()
+
+
 def decode_batch_device(words, nbits, max_points: int, default_unit: int = 1,
                         chains: str = "auto", scan_major: bool = False):
     """Decode (S, W+1) padded word arrays in parallel, in two phases:
@@ -1453,12 +1508,33 @@ def decode_batch_device(words, nbits, max_points: int, default_unit: int = 1,
     total decode wall-time); host callers flip axes with free numpy
     views instead, and in-jit callers compose the decode so XLA folds
     the layout change into their own downstream ops.
+
+    This is the HOST wrapper: the chains/extract seams resolve here
+    (env + backend reads are host state — under the tracer they would
+    freeze into the first compile and the env override would silently
+    stop responding), and the value-control table is fetched as a
+    device ARGUMENT (constant-bloat: referenced as a module global it
+    would be re-baked into every compiled HLO).  In-jit callers use
+    ``_decode_batch_device`` (via ``__wrapped__``) and thread the
+    table/statics themselves — see parallel/sharded_decode.py.
     """
     if chains == "auto":
         chains = resolved_chains()
     if chains not in _CHAIN_IMPLS:
         raise ValueError(f"chains={chains!r}: expected one of "
                          f"{_CHAIN_IMPLS + ('auto',)}")
+    return _decode_batch_device(
+        words, nbits, value_ctrl_table(), max_points=max_points,
+        default_unit=default_unit, chains=chains, scan_major=scan_major,
+        extract=_resolved_extract(chains))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("max_points", "default_unit", "chains",
+                                    "scan_major", "extract"))
+def _decode_batch_device(words, nbits, ctrl_tbl, max_points: int,
+                         default_unit: int = 1, chains: str = "fused",
+                         scan_major: bool = False, extract: str = "jnp"):
     S, Wp = words.shape
     # Pad the stream with zero words so the phase-1 register-file gather
     # (4 words at the cursor) and phase 2's 3-word funnels never read
@@ -1477,7 +1553,8 @@ def decode_batch_device(words, nbits, max_points: int, default_unit: int = 1,
     base_time = wpad[:, 0].astype(I64)
     carry0 = _decode_carry0(S, base_time if fused else None)
     step = functools.partial(_decode_step, words=wpad, nbits=nbits32,
-                             unit0=unit0, emit_chains=fused)
+                             unit0=unit0, ctrl_tbl=ctrl_tbl,
+                             emit_chains=fused)
 
     # Decode k datapoints per loop iteration.  Unrolling chains k step
     # bodies inside one iteration, so the narrow carry stays fused
@@ -1504,7 +1581,8 @@ def decode_batch_device(words, nbits, max_points: int, default_unit: int = 1,
         prec, err2 = carry[17], carry[18]
     else:
         ts_off, p1, val_off, p2 = lanes  # scan-major (P, S) — no transpose
-        ts, payload, meta, prec, err2 = _phase2(wpad, ts_off, p1, val_off, p2)
+        ts, payload, meta, prec, err2 = _phase2(wpad, ts_off, p1, val_off,
+                                                p2, extract_impl=extract)
     if not scan_major:
         ts, payload, meta = ts.T, payload.T, meta.T
     return ts, payload, meta, err | err2, prec, ann

@@ -40,16 +40,23 @@ def _raw(fn):
 
 @functools.lru_cache(maxsize=8)
 def _sharded_fn(n_dev: int, max_points: int, default_unit: int,
-                chains: str, scan_major: bool):
+                chains: str, scan_major: bool, extract: str):
     # dtype=object: a Mesh axis of Device objects, not numeric lanes
     mesh = Mesh(np.array(jax.devices()[:n_dev], dtype=object), ("s",))
+    # The raw (unjitted) decode impl: chains/extract arrive as statics
+    # resolved by OUR caller on the host, and the value-control table
+    # rides as a replicated ARGUMENT (P() spec) — the same
+    # constant-bloat/retrace-risk contract the codec's own wrapper
+    # upholds (a module-global reference here would bake ~1MB of table
+    # into this jit's HLO too).
     inner = functools.partial(
-        _raw(codec.decode_batch_device), max_points=max_points,
-        default_unit=default_unit, chains=chains, scan_major=scan_major)
+        _raw(codec._decode_batch_device), max_points=max_points,
+        default_unit=default_unit, chains=chains, scan_major=scan_major,
+        extract=extract)
     out_sp = P(None, "s") if scan_major else P("s", None)
     return jax.jit(shard_map_compat(
         inner, mesh,
-        in_specs=(P("s"), P("s")),
+        in_specs=(P("s"), P("s"), P()),
         out_specs=(out_sp, out_sp, out_sp, P("s"), P("s"), P("s"))))
 
 
@@ -74,8 +81,9 @@ def decode_batch_device_sharded(words, nbits, max_points: int,
     if pad:
         words = jnp.pad(words, ((0, pad), (0, 0)))
         nbits = jnp.pad(nbits, (0, pad))
-    out = _sharded_fn(n_dev, max_points, default_unit, chains,
-                      scan_major)(words, nbits)
+    out = _sharded_fn(n_dev, max_points, default_unit, chains, scan_major,
+                      codec._resolved_extract(chains))(
+        words, nbits, codec.value_ctrl_table())
     if pad:
         sl = ((slice(None), slice(None, S)) if scan_major
               else (slice(None, S), slice(None)))

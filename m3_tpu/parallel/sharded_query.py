@@ -48,7 +48,8 @@ def _raw(fn):
     return getattr(fn, "__wrapped__", fn)
 
 
-def decode_to_step_series(words, nbits, max_points: int):
+def decode_to_step_series(words, nbits, max_points: int, ctrl_tbl,
+                          chains: str = "fused", extract: str = "jnp"):
     """Device decode of packed streams -> padded (ts, float64 values)
     ready for the temporal stencils: invalid slots carry ts = i64 max
     (excluded by the window searchsorted) and NaN values.
@@ -56,10 +57,13 @@ def decode_to_step_series(words, nbits, max_points: int):
     Query math runs in the backend's native f64 (emulated on TPU):
     range-function output is not part of the bit-exactness contract the
     codec upholds — only the decoded payload integers are, and those
-    stay exact.
+    stay exact.  ``ctrl_tbl`` is the codec's value-control table
+    threaded as an argument (``codec.value_ctrl_table()``) and
+    ``chains``/``extract`` are host-resolved statics — the
+    constant-bloat/retrace-risk contract.
     """
-    ts, payload, meta, err, prec, _ann = _raw(codec.decode_batch_device)(
-        words, nbits, max_points
+    ts, payload, meta, err, prec, _ann = _raw(codec._decode_batch_device)(
+        words, nbits, ctrl_tbl, max_points, chains=chains, extract=extract
     )
     valid = (meta & 16) != 0
     isf = (meta & 8) != 0
@@ -74,10 +78,6 @@ def decode_to_step_series(words, nbits, max_points: int):
     return ts_p, vals_p, err | prec
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("topo", "max_points", "num_buckets", "q", "range_nanos"),
-)
 def sharded_decode_rate_hq(
     topo: MeshTopology,
     words: jnp.ndarray,        # u64 (D, S, W) packed streams, shard-sharded
@@ -92,12 +92,44 @@ def sharded_decode_rate_hq(
 ):
     """histogram_quantile(q, sum by (le) (rate(bucket[range]))) over the
     mesh.  Returns (rates (D, S, T) shard-sharded, hq (T,) replicated,
-    errs (D, S))."""
+    errs (D, S)).  Host wrapper: resolves the codec's chains/extract
+    seams and fetches the value-control table as a replicated argument
+    (constant-bloat/retrace-risk contract), then dispatches to the
+    jitted SPMD program."""
+    chains = codec.resolved_chains()
+    return _sharded_decode_rate_hq(
+        topo, words, nbits, bucket_ids, step_times, ubs,
+        codec.value_ctrl_table(), range_nanos=range_nanos, q=q,
+        max_points=max_points, num_buckets=num_buckets, chains=chains,
+        extract=codec._resolved_extract(chains))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("topo", "max_points", "num_buckets", "q", "range_nanos",
+                     "chains", "extract"),
+)
+def _sharded_decode_rate_hq(
+    topo: MeshTopology,
+    words: jnp.ndarray,
+    nbits: jnp.ndarray,
+    bucket_ids: jnp.ndarray,
+    step_times: jnp.ndarray,
+    ubs: jnp.ndarray,
+    ctrl_tbl: jnp.ndarray,     # u32 (2^18,) codec value-control table
+    range_nanos: int,
+    q: float,
+    max_points: int,
+    num_buckets: int,
+    chains: str,
+    extract: str,
+):
     mesh = topo.mesh
 
-    def local(words, nbits, bucket_ids, step_times, ubs):
+    def local(words, nbits, bucket_ids, step_times, ubs, ctrl_tbl):
         w, nb, bid = words[0], nbits[0], bucket_ids[0]
-        ts_p, vals_p, errs = decode_to_step_series(w, nb, max_points)
+        ts_p, vals_p, errs = decode_to_step_series(
+            w, nb, max_points, ctrl_tbl, chains=chains, extract=extract)
         rates = _raw(temporal.rate_family)(
             ts_p, vals_p, step_times, range_nanos, "rate"
         )  # (S, T)
@@ -129,9 +161,10 @@ def sharded_decode_rate_hq(
     return shard_map_compat(
         local,
         mesh,
-        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P(), P()),
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P(), P(),
+                  P()),
         out_specs=(P(SHARD_AXIS), P(), P(SHARD_AXIS)),
-    )(words, nbits, bucket_ids, step_times, ubs)
+    )(words, nbits, bucket_ids, step_times, ubs, ctrl_tbl)
 
 
 def single_device_reference(words, nbits, bucket_ids, step_times, ubs,
@@ -142,8 +175,11 @@ def single_device_reference(words, nbits, bucket_ids, step_times, ubs,
     flat_w = words.reshape(D * S, -1)
     flat_nb = nbits.reshape(D * S)
     flat_bid = np.asarray(bucket_ids).reshape(D * S)
+    chains = codec.resolved_chains()
     ts_p, vals_p, errs = decode_to_step_series(
-        jnp.asarray(flat_w), jnp.asarray(flat_nb), max_points
+        jnp.asarray(flat_w), jnp.asarray(flat_nb), max_points,
+        codec.value_ctrl_table(), chains=chains,
+        extract=codec._resolved_extract(chains)
     )
     rates = temporal.rate_family(ts_p, vals_p, jnp.asarray(step_times),
                                  range_nanos, "rate")

@@ -173,8 +173,27 @@ def lint(args) -> int:
     baseline (tools/lint_baseline.json).  Exit 0 only when the findings
     match the baseline exactly: new findings fail the gate, and so do
     stale baseline entries — a fixed finding must ratchet the baseline
-    down (--update-baseline)."""
+    down (--update-baseline).  ``--explain <rule>`` prints a rule's
+    rationale plus a minimal violating/clean example instead of
+    linting; ``--json`` emits a machine-readable report (findings as
+    structured objects) for CI consumption."""
     from m3_tpu.x import lint as m3lint
+    from m3_tpu.x.lint.core import RULES, explain
+
+    if args.explain:
+        rule = args.explain
+        entry = explain(rule)
+        if entry is None:
+            print(f"lint --explain: unknown rule {rule!r}; rules: "
+                  f"{', '.join(RULES)}", file=sys.stderr)
+            return 2
+        print(f"[{rule}]\n")
+        print(entry["why"].strip() + "\n")
+        print("violates:\n" + "\n".join(
+            "    " + ln for ln in entry["bad"].rstrip().splitlines()) + "\n")
+        print("clean:\n" + "\n".join(
+            "    " + ln for ln in entry["good"].rstrip().splitlines()))
+        return 0
 
     root = Path(args.root).resolve() if args.root else (
         Path(__file__).resolve().parent.parent)
@@ -196,10 +215,14 @@ def lint(args) -> int:
     baseline = m3lint.load_baseline(baseline_path)
     new, fixed = m3lint.diff_baseline(findings, baseline)
     if args.json:
+        def _rec(f):
+            return {"rule": f.rule, "path": f.path, "line": f.line,
+                    "message": f.message}
         _out({
+            "ok": not (new or fixed),
             "findings": len(findings), "baseline": len(baseline),
-            "new": [f.render() for f in new],
-            "fixed": [f.render() for f in fixed],
+            "new": [_rec(f) for f in new],
+            "fixed": [_rec(f) for f in fixed],
         })
     else:
         for f in new:
@@ -278,7 +301,11 @@ def main(argv=None) -> int:
                     dest="update_baseline",
                     help="rewrite the baseline to the current findings")
     li.add_argument("--json", action="store_true",
-                    help="machine-readable summary on stdout")
+                    help="machine-readable report on stdout (structured "
+                         "new/fixed findings + ok flag) for CI")
+    li.add_argument("--explain", metavar="RULE",
+                    help="print RULE's rationale + a minimal violating/"
+                         "clean example and exit")
     li.set_defaults(fn=lint)
 
     args = p.parse_args(argv)

@@ -106,12 +106,12 @@ def _prep(words, nbits):
 
 
 @functools.partial(jax.jit, static_argnames=("max_points",))
-def _capture_cursors(words, nbits, max_points: int):
+def _capture_cursors(words, nbits, ctrl_tbl, max_points: int):
     """Run the real phase-1 step capturing the cursor after every step."""
     S = words.shape[0]
     wpad, nbits32, unit0 = _prep(words, nbits)
     inner = functools.partial(mj._decode_step, words=wpad, nbits=nbits32,
-                              unit0=unit0)
+                              unit0=unit0, ctrl_tbl=ctrl_tbl)
 
     def step(c, x):
         c2, _ = inner(c, x)
@@ -123,16 +123,18 @@ def _capture_cursors(words, nbits, max_points: int):
 
 
 @functools.partial(jax.jit, static_argnames=("mode", "fused"))
-def _proxy_scan(wpad, advances, base_time, mode: str, fused: bool):
+def _proxy_scan(wpad, advances, base_time, tbl, mode: str, fused: bool):
     """Structural proxy: replays true cursor advances through the real
     carry topology (mode='carry') plus the real read machinery
     (mode='reads').  ``fused`` selects the PROFILED decoder's carry
     shape — the 7 chain lanes ride only when the fused tail does (on
     the gather tail the production phase-1 carry is the 12 narrow
-    lanes; carrying the extra 7 would overstate the carry layer)."""
+    lanes; carrying the extra 7 would overstate the carry layer).
+    ``tbl`` is the codec's value-control table threaded as an argument
+    (mj.value_ctrl_table() — referencing the module global here baked
+    ~1MB of constants into this proxy's HLO; constant-bloat)."""
     S = wpad.shape[0]
     carry0 = mj._decode_carry0(S, base_time if fused else None)
-    tbl = jnp.asarray(mj._VALUE_CTRL_TBL, jnp.uint32)
 
     def body(carry, adv):
         cursor = carry[0]
@@ -250,7 +252,8 @@ def profile(S: int, T: int) -> dict:
     t_other = _time(ot, reps=2)
 
     # True per-step advances, replayed by every proxy.
-    cursors = np.asarray(_capture_cursors(words, nbits, max_points))
+    cursors = np.asarray(_capture_cursors(words, nbits,
+                                          mj.value_ctrl_table(), max_points))
     adv = np.diff(np.concatenate(
         [np.zeros((1, cursors.shape[1]), cursors.dtype), cursors]), axis=0)
     advances = jnp.asarray(adv.astype(np.int32))
@@ -260,7 +263,8 @@ def profile(S: int, T: int) -> dict:
 
     layers = {}
     for mode in ("carry", "reads"):
-        fn = lambda m=mode: _proxy_scan(wpad, advances, base_time, m,
+        fn = lambda m=mode: _proxy_scan(wpad, advances, base_time,
+                                        mj.value_ctrl_table(), m,
                                         fused=(chains == "fused"))
         jax.block_until_ready(fn())  # compile
         layers[mode] = _time(fn)
@@ -343,7 +347,8 @@ def profile(S: int, T: int) -> dict:
         wz = jnp.zeros_like(wpad)
         dstep = functools.partial(
             mj._decode_step, words=wz, nbits=nbits.astype(I32),
-            unit0=jnp.zeros(S_, I32), emit_chains=(chains == "fused"))
+            unit0=jnp.zeros(S_, I32), ctrl_tbl=mj.value_ctrl_table(),
+            emit_chains=(chains == "fused"))
         carry0 = mj._decode_carry0(
             S_, base_time if chains == "fused" else None)
         jx = jax.make_jaxpr(dstep)(carry0, None)

@@ -22,9 +22,18 @@
   ``threading.Lock``/``RLock`` behind an env-armed seam
   (``M3_LOCKCHECK``, like ``M3_FAULTPOINTS``) and fails fast on
   acquisition-order cycles; armed by the race/dtest conftest fixture.
+* ``m3_tpu.x.tracewatch`` — runtime retrace/transfer sanitizer: counts
+  XLA compiles per function through the ``jax_log_compiles`` seam and
+  fails fast (with the offending shapes/dtypes) when a jitted function
+  retraces past its budget; ``no_transfers()`` forbids device→host
+  copies in timed/guarded regions.  Env-armed via ``M3_TRACEWATCH``
+  (like lockcheck); bench steady-state loops assert zero retraces
+  through it.
 * ``m3_tpu.x.lint`` — m3lint, the codebase-aware static analyzer
   (``python -m m3_tpu.tools.cli lint``); its rule families are the
-  static mirror of what fault/retry/lockcheck enforce at runtime.
+  static mirror of what fault/retry/lockcheck/tracewatch enforce at
+  runtime (the jax families — retrace-risk, transfer-hygiene,
+  dtype-stability, constant-bloat — are tracewatch's static twin).
 
 ``register_metrics(registry)`` mirrors the fault and retry counters
 into an instrument registry at scrape time, so a node's ``/metrics``
@@ -36,8 +45,11 @@ from __future__ import annotations
 
 # lockcheck first: importing it evaluates the M3_LOCKCHECK env seam, so
 # a node subprocess wraps its locks before fault/retry (or anything
-# else) constructs one.
+# else) constructs one.  tracewatch next, for the same reason: its
+# M3_TRACEWATCH seam must swap the jit factories before any module
+# decorates a hot-path function.
 from m3_tpu.x import lockcheck  # noqa: F401  (env-armed seam)
+from m3_tpu.x import tracewatch  # noqa: F401  (env-armed seam)
 from m3_tpu.x import breaker, deadline, fault, retry
 
 

@@ -1,8 +1,8 @@
 """m3lint: codebase-aware static analysis for the m3-tpu tree.
 
-Nine rule families, each encoding a contract this repo already pays
-for at runtime (race tier, fault tier, bit-exactness goldens) as a
-static gate:
+Thirteen rule families, each encoding a contract this repo already
+pays for at runtime (race tier, fault tier, bit-exactness goldens,
+bench steady-state) as a static gate:
 
 * ``lock-discipline``  — mixed locked/unlocked access to ``self._*``
   state (the race class ``tests/test_race.py`` stress-tests).
@@ -28,10 +28,19 @@ static gate:
   ``server/rpc.py`` client classes, ``client/session.py``) outside a
   deadline-accepting helper (the read-path overload contract: wire
   hops derive their timeouts from ``x.deadline``).
+* ``retrace-risk`` / ``transfer-hygiene`` / ``dtype-stability`` /
+  ``constant-bloat`` — the jax compile-stability families
+  (``jaxlint.py``): traced Python control flow, trace-frozen env
+  reads, host transfers under the tracer, unsynchronized timed
+  regions, weak/narrowing dtype seams, and large arrays
+  constant-folded into jitted HLO.  Static twin of the runtime
+  sanitizer ``m3_tpu/x/tracewatch.py``; see TESTING.md "Compile
+  stability & transfer hygiene".
 
 Run: ``python -m m3_tpu.tools.cli lint`` (gates against
 ``m3_tpu/tools/lint_baseline.json``; see TESTING.md "Static analysis &
-lock sanitizer" for the ratchet workflow and inline suppressions).
+lock sanitizer" for the ratchet workflow and inline suppressions;
+``lint --explain <rule>`` prints any rule's rationale + examples).
 """
 
 from m3_tpu.x.lint.core import (

@@ -44,6 +44,12 @@ RULES = (
     "corruption-typed",
     "placement-cas",
     "deadline-aware",
+    # the jax compile-stability/transfer families (jaxlint.py) — the
+    # static twin of x/tracewatch.py
+    "retrace-risk",
+    "transfer-hygiene",
+    "dtype-stability",
+    "constant-bloat",
 )
 
 _SUPPRESS_RE = re.compile(r"#\s*m3lint:\s*disable=([\w,-]+)")
@@ -102,6 +108,19 @@ class Context:
                              "m3_tpu/server/rpc.py",
                              "m3_tpu/client/session.py")
     deadline_prefixes: tuple = ()
+    # the numeric/device layer the jax families police (transfer-
+    # hygiene's module-scope checks); bench.py sits outside the linted
+    # package and is covered by the runtime twin (tracewatch) instead
+    jax_prefixes: tuple = ("m3_tpu/encoding/", "m3_tpu/parallel/",
+                          "m3_tpu/aggregator/")
+    # declared host boundaries: the scalar codec and the ops tools own
+    # device->host transfers; everything else returns device arrays
+    jax_host_boundary: tuple = ("m3_tpu/tools/", "m3_tpu/encoding/m3tsz.py")
+    # modules whose perf_counter-timed regions must block_until_ready
+    timed_prefixes: tuple = ("m3_tpu/tools/",)
+    # known large host arrays (constant-bloat flags references to these
+    # under the tracer even across modules, where size can't be folded)
+    large_constants: tuple = ("_VALUE_CTRL_TBL",)
 
     def is_wire_module(self, path: str) -> bool:
         return (path in self.wire_files
@@ -112,6 +131,15 @@ class Context:
 
     def is_persist_module(self, path: str) -> bool:
         return any(path.startswith(p) for p in self.persist_prefixes)
+
+    def wants_jax(self, path: str) -> bool:
+        return any(path.startswith(p) for p in self.jax_prefixes)
+
+    def is_host_boundary(self, path: str) -> bool:
+        return any(path.startswith(p) for p in self.jax_host_boundary)
+
+    def wants_timed(self, path: str) -> bool:
+        return any(path.startswith(p) for p in self.timed_prefixes)
 
 
 @dataclass
@@ -164,8 +192,8 @@ def apply_suppressions(unit: FileUnit, findings: Iterable[Finding]) -> List[Find
 
 def default_rules() -> List[Rule]:
     from m3_tpu.x.lint import (
-        corruption, deadline_aware, faultcov, locks, placement, purity,
-        resources, wirecheck,
+        corruption, deadline_aware, faultcov, jaxlint, locks, placement,
+        purity, resources, wirecheck,
     )
 
     return [
@@ -178,7 +206,27 @@ def default_rules() -> List[Rule]:
         corruption.check,
         placement.check,
         deadline_aware.check,
+        jaxlint.check_retrace,
+        jaxlint.check_transfer,
+        jaxlint.check_dtype_stability,
+        jaxlint.check_constant_bloat,
     ]
+
+
+def explain(rule: str) -> dict | None:
+    """{why, bad, good} for a rule name, harvested from the rule
+    modules' EXPLAIN tables (``cli lint --explain`` renders it)."""
+    from m3_tpu.x.lint import (
+        corruption, deadline_aware, faultcov, jaxlint, locks, placement,
+        purity, resources, wirecheck,
+    )
+
+    for mod in (jaxlint, locks, purity, wirecheck, faultcov, resources,
+                corruption, placement, deadline_aware):
+        entry = getattr(mod, "EXPLAIN", {}).get(rule)
+        if entry is not None:
+            return entry
+    return None
 
 
 def lint_file(path: Path, rel_root: Path, ctx: Context,

@@ -93,3 +93,19 @@ def check(unit: FileUnit, ctx: Context) -> List[Finding]:
 
     visit(unit.tree, ())
     return findings
+
+
+EXPLAIN = {
+    "corruption-typed": {
+        "why": (
+            "Digest/checksum/magic verify sites under persist/ must "
+            "raise the typed CorruptionError hierarchy: the quarantine/"
+            "scrub/repair machinery dispatches on it, and a bare "
+            "ValueError turns detected corruption into an undiagnosed "
+            "crash instead of a quarantined volume."),
+        "bad": ("if digest != expect:\n"
+                "    raise ValueError('bad digest')\n"),
+        "good": ("if digest != expect:\n"
+                 "    raise ChecksumMismatch(path, 'digest', expect)\n"),
+    },
+}

@@ -98,3 +98,19 @@ def check(unit: FileUnit, ctx: Context) -> List[Finding]:
             f"plumbing — query-path wire I/O must derive its timeout "
             f"from x.deadline"))
     return findings
+
+
+EXPLAIN = {
+    "deadline-aware": {
+        "why": (
+            "Blocking send_frame/recv_frame/connect in query-path "
+            "modules must derive their socket timeouts from the "
+            "riding x.deadline budget: a wire hop that blocks on its "
+            "own 30s constant keeps burning a peer's time long after "
+            "the caller's deadline expired (the overload contract: "
+            "spent budget maps to 504, not a wedged worker)."),
+        "bad": "frame = recv_frame(sock)         # blocks past the deadline\n",
+        "good": ("sock.settimeout(deadline.current().socket_timeout())\n"
+                 "frame = recv_frame(sock)\n"),
+    },
+}

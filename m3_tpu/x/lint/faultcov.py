@@ -78,3 +78,18 @@ def check(unit: FileUnit, ctx: Context) -> List[Finding]:
             f"raw {what} in {where} outside a faultpoint-wrapped helper "
             f"— wire I/O must stay reachable by m3_tpu.x.fault"))
     return findings
+
+
+EXPLAIN = {
+    "fault-coverage": {
+        "why": (
+            "Raw sendall/recv/fsync in wire modules bypasses the "
+            "faultpoint seams (x/fault.py), so the fault tier cannot "
+            "inject drops/delays/corruption there — the path ships "
+            "untested against the failures it WILL see.  PR 1's "
+            "invariant, made permanent."),
+        "bad": "sock.sendall(frame)              # invisible to fault tier\n",
+        "good": ("protocol.send_frame(sock, frame)  # faultpoint-wrapped "
+                 "helper\n"),
+    },
+}

@@ -1,0 +1,602 @@
+"""Rule families 10-13 — compile stability & transfer hygiene for the
+jit/pjit/shard_map/pallas hot path (the static twin of
+``m3_tpu/x/tracewatch.py``).
+
+PR 6 multiplied the traced surface (two-phase decode, Pallas gather,
+series-sharded decode) and nothing guarded it against the silent perf
+killers: a shape- or dtype-churning argument retraces per call
+(100-10000x the steady-state cost), an ``np.asarray`` in a hot loop
+round-trips device memory through the host, a weak-typed literal
+doubles a funnel's kernel width, and a large closure-captured array is
+constant-folded into the HLO of every compilation.  Each family flags
+one of those classes at the AST level, scoped by the same jit
+reachability propagation ``purity.py`` seeds (extended through
+``functools.partial``/``vmap``/``lax.scan`` function arguments, the
+idiom every scan body in ``encoding/m3tsz_jax.py`` uses):
+
+* ``retrace-risk`` — Python control flow on non-static parameters of a
+  jitted def (data-dependent ``if``/``while`` either dies in trace or
+  forces a retrace-per-value pattern upstream); ``int()``/``bool()``/
+  ``float()`` coercions of non-static parameters and ``.item()`` calls
+  (concretization: a transfer AND a trace-time freeze); non-literal
+  ``static_argnums``/``static_argnames`` specs (a spec that varies per
+  call retraces per call); and ``os.environ`` reads under the tracer —
+  the config seam is FROZEN into the first compile and silently stops
+  responding (the M3_ENCODE_PLACE/M3_DECODE_CHAINS bug this family was
+  built on: flipping the env after the first call changed NOTHING
+  in-process because the jit cache keyed on the static args, not the
+  env).
+* ``transfer-hygiene`` — ``np.*``/``numpy.*`` calls, ``print``,
+  ``jax.device_get`` and ``.tolist()`` under the tracer (host
+  transfers / trace-time constants); ``jax.device_get`` in device
+  modules outside the declared host boundary; and timed regions
+  (functions pairing ``time.perf_counter()`` around jax work) without
+  a ``block_until_ready`` — async dispatch means such a region times
+  the ENQUEUE, not the work.
+* ``dtype-stability`` — same-kind narrowing ``astype`` round-trips
+  (``.astype(i32).astype(i64)`` destroys bits, then hides it);
+  ``jnp.asarray(<literal>)`` without ``dtype=`` (a weak-typed scalar
+  entering funnel arithmetic follows whatever promotion the other
+  operand brings — the x64 flag decides the result width, not the
+  code); float literals in bitwise/shift arithmetic (always a bug: the
+  packed32/funnel paths are integer by contract).
+* ``constant-bloat`` — module-level numpy arrays of >= 4096 elements
+  (sized by const-folding the constructor shape, through one level of
+  builder-function indirection) referenced under the tracer: the array
+  is baked into the jaxpr as a literal and re-materialized in the HLO
+  of EVERY compilation — per shape, per backend — instead of being
+  passed once as a device argument.  ``Context.large_constants`` names
+  known offenders for cross-module references.
+
+Everything is scoped so the committed baseline stays EMPTY: the rules
+encode this repo's contracts, and every real finding they surfaced was
+fixed in the round that introduced them (see TESTING.md "Compile
+stability & transfer hygiene").
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from m3_tpu.x.lint.core import Context, FileUnit, Finding, dotted
+from m3_tpu.x.lint.purity import (
+    _JIT_NAMES, _is_jit_expr, _last_attr, jit_reachable,
+)
+
+# -- shared helpers ----------------------------------------------------------
+
+
+def _param_names(fn: ast.AST) -> set:
+    args = getattr(fn, "args", None)
+    if args is None:
+        return set()
+    out = {a.arg for a in list(args.posonlyargs) + list(args.args)
+           + list(args.kwonlyargs)}
+    if args.vararg:
+        out.add(args.vararg.arg)
+    if args.kwarg:
+        out.add(args.kwarg.arg)
+    return out
+
+
+def _own_statements(fn: ast.AST):
+    """Walk fn's body WITHOUT descending into nested function/lambda
+    bodies (their parameters shadow; rules that reason about fn's own
+    parameters must not misattribute)."""
+    body = getattr(fn, "body", [])
+    stack = list(body) if isinstance(body, list) else [body]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+def _dynamic_names_in(test: ast.AST, dyn: set) -> set:
+    """Non-static parameter names referenced by a branch test,
+    excluding structural uses: ``x is None`` comparisons and
+    ``x.shape``/``x.ndim``/``x.dtype``/``x.size`` attribute reads
+    (static under the tracer)."""
+    skip: set = set()
+    for node in ast.walk(test):
+        if isinstance(node, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            for side in [node.left] + list(node.comparators):
+                if isinstance(side, ast.Name):
+                    skip.add(id(side))
+        elif (isinstance(node, ast.Attribute)
+              and node.attr in ("shape", "ndim", "dtype", "size")
+              and isinstance(node.value, ast.Name)):
+            skip.add(id(node.value))
+    hits = set()
+    for node in ast.walk(test):
+        if (isinstance(node, ast.Name) and node.id in dyn
+                and id(node) not in skip):
+            hits.add(node.id)
+    return hits
+
+
+# -- retrace-risk ------------------------------------------------------------
+
+_COERCIONS = ("int", "bool", "float")
+_ENV_READS = ("os.environ.get", "os.getenv")
+
+
+def check_retrace(unit: FileUnit, ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    tree = unit.tree
+
+    # Non-literal static specs at any jit decorator/callsite.
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = dotted(node.func)
+        is_jit = (fn is not None and _last_attr(fn) in _JIT_NAMES) or (
+            fn is not None and _last_attr(fn) == "partial" and node.args
+            and _is_jit_expr(node.args[0]))
+        if not is_jit:
+            continue
+        for kw in node.keywords:
+            if kw.arg not in ("static_argnums", "static_argnames"):
+                continue
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            if not all(isinstance(e, ast.Constant) for e in elts):
+                findings.append(Finding(
+                    "retrace-risk", unit.path, v.lineno,
+                    f"non-literal {kw.arg} spec: a static spec computed "
+                    f"per call retraces per call (and an array-valued "
+                    f"static is unhashable — TypeError at best, silent "
+                    f"retrace churn at worst)"))
+
+    for fn, statics, direct in jit_reachable(tree,
+                                             include_partial_args=True):
+        fname = getattr(fn, "name", "<lambda>")
+        params = _param_names(fn)
+        dyn = params - statics if statics is not None else params
+
+        # Data-dependent Python control flow: only where the static
+        # set is KNOWN (directly decorated defs) — helpers reached
+        # through partial/call-graph may receive static values.
+        if direct and statics is not None:
+            for node in _own_statements(fn):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                hits = _dynamic_names_in(node.test, dyn)
+                if hits:
+                    findings.append(Finding(
+                        "retrace-risk", unit.path, node.lineno,
+                        f"{fname}() branches on traced argument(s) "
+                        f"{sorted(hits)} in Python control flow — "
+                        f"concretization error under jit, or a "
+                        f"retrace-per-value pattern; use lax.cond/"
+                        f"jnp.where or mark the argument static"))
+
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "item" and not node.args):
+                findings.append(Finding(
+                    "retrace-risk", unit.path, node.lineno,
+                    f"{fname}() calls .item() under the tracer: "
+                    f"device->host concretization per trace"))
+                continue
+            callee = dotted(node.func)
+            if callee is None:
+                continue
+            if callee in _ENV_READS or callee.startswith("os.environ"):
+                findings.append(Finding(
+                    "retrace-risk", unit.path, node.lineno,
+                    f"{fname}() reads os.environ under the tracer: the "
+                    f"value is FROZEN into the first compile and the "
+                    f"env seam silently stops responding — resolve the "
+                    f"config in a host wrapper and pass it as a static "
+                    f"argument"))
+            elif (direct and statics is not None and callee in _COERCIONS
+                    and node.args):
+                hits = _dynamic_names_in(node.args[0], dyn)
+                if hits:
+                    findings.append(Finding(
+                        "retrace-risk", unit.path, node.lineno,
+                        f"{fname}() coerces traced argument(s) "
+                        f"{sorted(hits)} with {callee}(): concretizes "
+                        f"the tracer (host sync + trace-time freeze)"))
+    return findings
+
+
+# -- transfer-hygiene --------------------------------------------------------
+
+_HOST_CALLS = ("jax.device_get",)
+_NP_PREFIXES = ("np.", "numpy.")
+# numpy namespaces that are pure metadata/static math (legal at trace
+# time: they produce Python scalars/dtypes from static values, not
+# array traffic)
+_NP_STATIC_OK = ("np.dtype", "numpy.dtype", "np.iinfo", "numpy.iinfo",
+                 "np.finfo", "numpy.finfo")
+
+
+def check_transfer(unit: FileUnit, ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    tree = unit.tree
+    in_device_scope = ctx.wants_jax(unit.path)
+    host_boundary = ctx.is_host_boundary(unit.path)
+
+    for fn, _statics, _direct in jit_reachable(tree,
+                                               include_partial_args=True):
+        fname = getattr(fn, "name", "<lambda>")
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted(node.func)
+            if callee is None:
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "tolist"):
+                    findings.append(Finding(
+                        "transfer-hygiene", unit.path, node.lineno,
+                        f"{fname}() calls .tolist() under the tracer: "
+                        f"full device->host materialization"))
+                continue
+            if callee in _HOST_CALLS:
+                findings.append(Finding(
+                    "transfer-hygiene", unit.path, node.lineno,
+                    f"{fname}() calls {callee} under the tracer: "
+                    f"device->host transfer at trace time"))
+            elif callee == "print":
+                findings.append(Finding(
+                    "transfer-hygiene", unit.path, node.lineno,
+                    f"{fname}() calls print() under the tracer: runs "
+                    f"once at trace time (and forces a transfer on a "
+                    f"traced value) — use jax.debug.print"))
+            elif (callee.startswith(_NP_PREFIXES)
+                  and not callee.startswith(_NP_STATIC_OK)):
+                findings.append(Finding(
+                    "transfer-hygiene", unit.path, node.lineno,
+                    f"{fname}() calls {callee} under the tracer: numpy "
+                    f"work runs on host at trace time (a traced operand "
+                    f"is a transfer/concretization; a constant belongs "
+                    f"outside the jit or behind jnp)"))
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "tolist":
+                findings.append(Finding(
+                    "transfer-hygiene", unit.path, node.lineno,
+                    f"{fname}() calls .tolist() under the tracer: "
+                    f"full device->host materialization"))
+
+    # device modules must reach the host through the declared boundary
+    if in_device_scope and not host_boundary:
+        reachable_ids = {id(n) for fn, _s, _d in jit_reachable(
+            tree, include_partial_args=True) for n in ast.walk(fn)}
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call) and id(node) not in reachable_ids
+                    and dotted(node.func) in _HOST_CALLS):
+                findings.append(Finding(
+                    "transfer-hygiene", unit.path, node.lineno,
+                    f"jax.device_get outside the declared host-boundary "
+                    f"modules ({', '.join(ctx.jax_host_boundary)}): "
+                    f"device modules return device arrays; the host "
+                    f"boundary owns the transfer"))
+
+    # timed regions must synchronize what they time
+    if ctx.wants_timed(unit.path):
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            perf_lines = []
+            has_sync = False
+            has_jax = False
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    callee = dotted(sub.func)
+                    if callee == "time.perf_counter":
+                        perf_lines.append(sub.lineno)
+                    elif callee is not None and (
+                            callee.startswith(("jax.", "jnp."))
+                            or "block_until_ready" in callee):
+                        has_jax = True
+                        if "block_until_ready" in callee:
+                            has_sync = True
+                    elif (isinstance(sub.func, ast.Attribute)
+                          and sub.func.attr == "block_until_ready"):
+                        has_sync = True
+            if len(perf_lines) >= 2 and has_jax and not has_sync:
+                findings.append(Finding(
+                    "transfer-hygiene", unit.path, min(perf_lines),
+                    f"{node.name}() times jax work between "
+                    f"perf_counter() calls without block_until_ready: "
+                    f"async dispatch means this measures the enqueue, "
+                    f"not the computation"))
+    return findings
+
+
+# -- dtype-stability ---------------------------------------------------------
+
+# dtype token -> (kind, bit width); covers jnp/np spellings and the
+# repo's module aliases (I32/I64/U32/U64 in the codec/kernel modules).
+_DTYPE_TOKENS = {}
+for _k, _pfx in (("i", "int"), ("u", "uint"), ("f", "float")):
+    for _w in (8, 16, 32, 64):
+        _DTYPE_TOKENS[f"{_pfx}{_w}"] = (_k, _w)
+for _alias, _tok in (("I32", ("i", 32)), ("I64", ("i", 64)),
+                     ("U32", ("u", 32)), ("U64", ("u", 64)),
+                     ("F32", ("f", 32)), ("F64", ("f", 64))):
+    _DTYPE_TOKENS[_alias] = _tok
+
+
+def _dtype_of(node: ast.AST):
+    d = dotted(node)
+    if d is None:
+        return None
+    return _DTYPE_TOKENS.get(_last_attr(d)) or _DTYPE_TOKENS.get(d)
+
+
+def _is_literal_scalar(node: ast.AST) -> bool:
+    """A bare Python number (possibly through unary minus / arithmetic
+    of literals): the weak-typed scalar shape."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, float))
+    if isinstance(node, ast.UnaryOp):
+        return _is_literal_scalar(node.operand)
+    if isinstance(node, ast.BinOp):
+        return (_is_literal_scalar(node.left)
+                and _is_literal_scalar(node.right))
+    return False
+
+
+def check_dtype_stability(unit: FileUnit, ctx: Context) -> List[Finding]:
+    if not ctx.wants_dtype(unit.path):
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(unit.tree):
+        # .astype(N).astype(W): same-kind narrowing round-trip
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "astype" and node.args):
+            outer = _dtype_of(node.args[0])
+            inner_call = node.func.value
+            if (outer is not None and isinstance(inner_call, ast.Call)
+                    and isinstance(inner_call.func, ast.Attribute)
+                    and inner_call.func.attr == "astype"
+                    and inner_call.args):
+                inner = _dtype_of(inner_call.args[0])
+                if (inner is not None and inner[0] == outer[0]
+                        and inner[1] < outer[1]):
+                    findings.append(Finding(
+                        "dtype-stability", unit.path, node.lineno,
+                        f"astype round-trip narrows to "
+                        f"{inner[0]}{inner[1]} then widens to "
+                        f"{outer[0]}{outer[1]}: the high bits are "
+                        f"already gone — cast once to the wide type"))
+        # jnp.asarray(<literal>) without dtype: weak-typed scalar
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "asarray"):
+            mod = dotted(node.func.value)
+            if (mod in ("jnp", "jax.numpy") and node.args
+                    and _is_literal_scalar(node.args[0])
+                    and not any(k.arg == "dtype" for k in node.keywords)
+                    and len(node.args) < 2):
+                findings.append(Finding(
+                    "dtype-stability", unit.path, node.lineno,
+                    f"jnp.asarray(<literal>) without dtype= in a "
+                    f"bit-exactness module: a weak-typed scalar takes "
+                    f"whatever width promotion hands it (the x64 flag "
+                    f"decides, not the code)"))
+        # float literal in bitwise/shift arithmetic
+        if isinstance(node, ast.BinOp) and isinstance(
+                node.op, (ast.LShift, ast.RShift, ast.BitAnd, ast.BitOr,
+                          ast.BitXor)):
+            for side in (node.left, node.right):
+                if (isinstance(side, ast.Constant)
+                        and isinstance(side.value, float)):
+                    findings.append(Finding(
+                        "dtype-stability", unit.path, node.lineno,
+                        f"float literal in bitwise/shift arithmetic: "
+                        f"the packed32/funnel paths are integer by "
+                        f"contract"))
+    return findings
+
+
+# -- constant-bloat ----------------------------------------------------------
+
+_BLOAT_ELEMENTS = 4096
+_NP_CTORS = ("zeros", "ones", "empty", "full", "arange")
+
+
+def _const_int(node: ast.AST):
+    """Best-effort constant folding of int expressions (literals,
+    +-*//, <<, **) — enough for np.arange(1 << 18) shapes."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = _const_int(node.operand)
+        return -v if v is not None else None
+    if isinstance(node, ast.BinOp):
+        a, b = _const_int(node.left), _const_int(node.right)
+        if a is None or b is None:
+            return None
+        try:
+            if isinstance(node.op, ast.Add):
+                return a + b
+            if isinstance(node.op, ast.Sub):
+                return a - b
+            if isinstance(node.op, ast.Mult):
+                return a * b
+            if isinstance(node.op, ast.FloorDiv):
+                return a // b if b else None
+            if isinstance(node.op, ast.LShift):
+                return a << b if 0 <= b < 64 else None
+            if isinstance(node.op, ast.Pow):
+                return a ** b if 0 <= b < 64 else None
+        except (OverflowError, ValueError):
+            return None
+    return None
+
+
+def _ctor_elements(call: ast.Call):
+    """Element-count estimate for an np.<ctor>(shape, ...) call."""
+    fn = dotted(call.func)
+    if fn is None or _last_attr(fn) not in _NP_CTORS:
+        return None
+    if not fn.startswith(("np.", "numpy.")):
+        return None
+    if not call.args:
+        return None
+    shape = call.args[0]
+    dims = shape.elts if isinstance(shape, (ast.Tuple, ast.List)) else [shape]
+    total = 1
+    for d in dims:
+        v = _const_int(d)
+        if v is None:
+            return None
+        total *= max(v, 0)
+    return total
+
+
+def _large_module_arrays(tree: ast.AST) -> dict:
+    """{name: estimated elements} for module-level assignments whose
+    RHS is (or builds, through one local builder function) a numpy
+    array of >= _BLOAT_ELEMENTS elements."""
+    builders: dict = {}
+    for node in tree.body if hasattr(tree, "body") else []:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            worst = 0
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    est = _ctor_elements(sub)
+                    if est:
+                        worst = max(worst, est)
+            builders[node.name] = worst
+    out: dict = {}
+    for node in getattr(tree, "body", []):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not isinstance(tgt, ast.Name):
+            continue
+        est = None
+        if isinstance(node.value, ast.Call):
+            est = _ctor_elements(node.value)
+            if est is None:
+                callee = dotted(node.value.func)
+                if callee in builders:
+                    est = builders[callee]
+        if est is not None and est >= _BLOAT_ELEMENTS:
+            out[tgt.id] = est
+    return out
+
+
+def check_constant_bloat(unit: FileUnit, ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    tree = unit.tree
+    large = _large_module_arrays(tree)
+    known = set(ctx.large_constants)
+    if not large and not known:
+        return []
+    for fn, _statics, _direct in jit_reachable(tree,
+                                               include_partial_args=True):
+        fname = getattr(fn, "name", "<lambda>")
+        for node in ast.walk(fn):
+            name = None
+            if isinstance(node, ast.Name) and node.id in large:
+                name, est = node.id, large[node.id]
+            elif (isinstance(node, ast.Attribute) and node.attr in known
+                  and not isinstance(getattr(node, "ctx", None), ast.Store)):
+                name, est = node.attr, None
+            elif isinstance(node, ast.Name) and node.id in known:
+                name, est = node.id, None
+            if name is None:
+                continue
+            size = f"~{est} elements" if est else "a registered large array"
+            findings.append(Finding(
+                "constant-bloat", unit.path, node.lineno,
+                f"{fname}() captures module-level array {name} "
+                f"({size}) under the tracer: constant-folded into the "
+                f"HLO of EVERY compilation (re-baked per shape/backend) "
+                f"— device_put once and pass it as an argument"))
+    return findings
+
+
+# -- rationale + examples for `cli lint --explain` ---------------------------
+
+EXPLAIN = {
+    "retrace-risk": {
+        "why": (
+            "A jitted function recompiles whenever a traced argument's "
+            "shape/dtype changes or a static argument's VALUE changes; "
+            "Python control flow on tracers either dies "
+            "(ConcretizationTypeError) or forces the caller to feed "
+            "concrete values — a retrace per value.  os.environ reads "
+            "under the tracer are the dual failure: the config is "
+            "frozen into the first compile and the seam silently stops "
+            "responding (this repo shipped that bug twice: "
+            "M3_ENCODE_PLACE and M3_DECODE_CHAINS were trace-frozen "
+            "until round 7).  Runtime twin: M3_TRACEWATCH=1 counts "
+            "compiles per function and raises past the budget."),
+        "bad": ("@jax.jit\n"
+                "def f(x, n):\n"
+                "    if n > 4:          # traced arg in Python control flow\n"
+                "        return x * 2\n"
+                "    return x\n"),
+        "good": ("@functools.partial(jax.jit, static_argnames=('n',))\n"
+                 "def f(x, n):\n"
+                 "    if n > 4:          # n is static: branch at trace time\n"
+                 "        return x * 2\n"
+                 "    return x\n"),
+    },
+    "transfer-hygiene": {
+        "why": (
+            "np.asarray/print/.tolist()/jax.device_get on a traced "
+            "value concretizes it: a device->host transfer plus a "
+            "trace-time freeze.  In timed regions the same transfers "
+            "(or a missing block_until_ready) corrupt the measurement "
+            "— async dispatch returns before the work runs, so the "
+            "loop times the enqueue.  Runtime twin: "
+            "tracewatch.no_transfers() raises on device->host copies "
+            "inside guarded/timed regions."),
+        "bad": ("@jax.jit\n"
+                "def f(x):\n"
+                "    return np.asarray(x).sum()   # transfer at trace time\n"),
+        "good": ("@jax.jit\n"
+                 "def f(x):\n"
+                 "    return jnp.sum(x)           # stays on device\n"),
+    },
+    "dtype-stability": {
+        "why": (
+            "The M3TSZ contract is defined over exact 64-bit patterns. "
+            "A weak-typed literal follows whatever promotion the other "
+            "operand brings (the x64 FLAG decides the width, not the "
+            "code), a narrowing astype round-trip silently destroys "
+            "high bits, and a float literal in funnel arithmetic "
+            "promotes an integer lane wholesale — each one doubles or "
+            "corrupts kernel width without a test failing until a "
+            "stream crosses 2^32."),
+        "bad": ("x = jnp.asarray(5)                   # weak: i32 or i64?\n"
+                "y = v.astype(jnp.int32).astype(jnp.int64)  # bits gone\n"),
+        "good": ("x = jnp.asarray(5, jnp.int32)\n"
+                 "y = v.astype(jnp.int64)\n"),
+    },
+    "constant-bloat": {
+        "why": (
+            "A concrete array referenced under the tracer is embedded "
+            "in the jaxpr as a literal and re-materialized in the HLO "
+            "of every compilation — per shape, per backend, per chains "
+            "tail.  For the decode control table that was ~1MB of "
+            "constants re-baked into every decode compile.  Pass large "
+            "arrays as arguments (device_put once, thread through the "
+            "jit signature) so XLA sees a parameter, not a literal."),
+        "bad": ("TBL = np.arange(1 << 18)\n"
+                "@jax.jit\n"
+                "def f(i):\n"
+                "    return jnp.asarray(TBL)[i]   # 1MB baked per compile\n"),
+        "good": ("TBL = np.arange(1 << 18)\n"
+                 "@jax.jit\n"
+                 "def f(tbl, i):\n"
+                 "    return tbl[i]               # parameter, not literal\n"
+                 "# caller: f(jax.device_put(TBL), i)\n"),
+    },
+}

@@ -158,3 +158,28 @@ def check(unit: FileUnit, ctx: Context) -> List[Finding]:
             seen.add(dedup)
             findings.append(Finding("lock-discipline", unit.path, a.line, msg))
     return findings
+
+
+EXPLAIN = {
+    "lock-discipline": {
+        "why": (
+            "A class that guards self._* state with a lock must guard "
+            "EVERY access: one unguarded write (or read-modify-write "
+            "like +=) races every guarded reader, and CPython has no "
+            "-race to catch it.  Runtime twin: M3_LOCKCHECK=1 "
+            "(x/lockcheck.py) catches ordering inversions; this rule "
+            "catches coverage holes."),
+        "bad": ("class C:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "        self._n = 0\n"
+                "    def read(self):\n"
+                "        with self._lock:\n"
+                "            return self._n\n"
+                "    def bump(self):\n"
+                "        self._n += 1      # unguarded RMW vs guarded read\n"),
+        "good": ("    def bump(self):\n"
+                 "        with self._lock:\n"
+                 "            self._n += 1\n"),
+    },
+}

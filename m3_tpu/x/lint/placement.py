@@ -64,3 +64,17 @@ def check(unit: FileUnit, ctx: Context) -> List[Finding]:
             "CAS-retried get→mutate→set) so concurrent mutations and "
             "node cutovers serialize"))
     return findings
+
+
+EXPLAIN = {
+    "placement-cas": {
+        "why": (
+            "Raw kv.set/check_and_set of the placement key outside "
+            "cluster/placement.py bypasses PlacementService's CAS "
+            "retry loop — a concurrent admin edit racing a node "
+            "cutover loses one of the writes and the cluster's shard "
+            "map forks."),
+        "bad": "kv.set(PLACEMENT_KEY, blob)      # clobbers concurrent CAS\n",
+        "good": "PlacementService(kv).update(mutate_fn)  # serialized CAS\n",
+    },
+}

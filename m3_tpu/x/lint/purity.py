@@ -67,15 +67,46 @@ def _is_jit_expr(node: ast.AST) -> bool:
     return False
 
 
+def jit_static_params(call: ast.AST, fn: ast.AST | None) -> frozenset:
+    """Static parameter NAMES declared by a jit decorator/callsite
+    expression (``static_argnames`` strings, plus ``static_argnums``
+    indices resolved against ``fn``'s positional parameters when the
+    def is at hand).  Non-literal specs yield nothing — the
+    retrace-risk rule flags those separately."""
+    names: set = set()
+    if not isinstance(call, ast.Call):
+        return frozenset()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    names.add(e.value)
+        elif kw.arg == "static_argnums" and fn is not None:
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            args = getattr(fn, "args", None)
+            pos = (list(args.posonlyargs) + list(args.args)) if args else []
+            for e in elts:
+                if (isinstance(e, ast.Constant) and isinstance(e.value, int)
+                        and 0 <= e.value < len(pos)):
+                    names.add(pos[e.value].arg)
+    return frozenset(names)
+
+
 def _jit_seeds(tree: ast.AST):
-    """(function name or def node) seeds: decorated defs and Name args
-    of jit/shard_map callsites."""
-    seed_defs = []
+    """(function name or def node) seeds: decorated defs (with their
+    declared static parameter names) and Name args of jit/shard_map
+    callsites."""
+    seed_defs = []   # (def node, frozenset static names | None)
     seed_names = set()
     for node in ast.walk(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            if any(_is_jit_expr(d) for d in node.decorator_list):
-                seed_defs.append(node)
+            for d in node.decorator_list:
+                if _is_jit_expr(d):
+                    seed_defs.append((node, jit_static_params(d, node)))
+                    break
         elif isinstance(node, ast.Call):
             fn = dotted(node.func)
             if fn is None:
@@ -86,43 +117,74 @@ def _jit_seeds(tree: ast.AST):
                     if isinstance(arg, ast.Name):
                         seed_names.add(arg.id)
                     elif isinstance(arg, ast.Lambda):
-                        seed_defs.append(arg)
+                        seed_defs.append((arg, frozenset()))
     return seed_defs, seed_names
 
 
-def _called_names(fn: ast.AST) -> set:
+def _called_names(fn: ast.AST, include_partial_args: bool = False) -> set:
     out = set()
     for node in ast.walk(fn):
-        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Name):
             out.add(node.func.id)
+        if include_partial_args:
+            # functools.partial(helper, ...) / jax.vmap(helper) /
+            # lax.scan(step, ...): the Name args are (or wrap) functions
+            # that will run under the same tracer.
+            callee = dotted(node.func)
+            if callee is not None and _last_attr(callee) in (
+                    "partial", "vmap", "scan", "associative_scan", "cond",
+                    "while_loop", "fori_loop", "checkpoint", "remat"):
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    if isinstance(arg, ast.Name):
+                        out.add(arg.id)
     return out
 
 
-def check_jit_purity(unit: FileUnit, ctx: Context) -> List[Finding]:
-    tree = unit.tree
-    module_defs = {}
+def jit_reachable(tree: ast.AST, include_partial_args: bool = False):
+    """Every function that can run under a jit/shard_map tracer, by
+    module-level call-graph propagation from the jit seeds.
+
+    Returns ``[(fn_node, statics, direct)]`` where ``statics`` is the
+    frozenset of the def's declared static parameter names (only
+    meaningful for ``direct=True`` decorated defs — helpers reached
+    through the call graph get ``None``: their parameters may be
+    static values partial-bound by the caller, so rules must not
+    assume they are traced).  ``include_partial_args=True`` extends
+    propagation through ``functools.partial``/``vmap``/``lax.scan``
+    function arguments (the jax rule families use this; the original
+    jit-purity family keeps the narrower graph its corpus pins)."""
+    module_defs: dict = {}
     for node in ast.walk(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             module_defs.setdefault(node.name, node)
     seed_defs, seed_names = _jit_seeds(tree)
-    reachable = {id(d): d for d in seed_defs}
-    frontier = list(seed_defs)
+    entries: dict = {}
+    frontier = []
+    for d, statics in seed_defs:
+        if id(d) not in entries:
+            entries[id(d)] = (d, statics, True)
+            frontier.append(d)
     for name in seed_names:
         d = module_defs.get(name)
-        if d is not None and id(d) not in reachable:
-            reachable[id(d)] = d
+        if d is not None and id(d) not in entries:
+            entries[id(d)] = (d, frozenset(), True)
             frontier.append(d)
     while frontier:
         fn = frontier.pop()
-        for name in _called_names(fn):
+        for name in _called_names(fn, include_partial_args):
             d = module_defs.get(name)
-            if d is not None and id(d) not in reachable:
-                reachable[id(d)] = d
+            if d is not None and id(d) not in entries:
+                entries[id(d)] = (d, None, False)
                 frontier.append(d)
+    return list(entries.values())
 
+
+def check_jit_purity(unit: FileUnit, ctx: Context) -> List[Finding]:
     findings: List[Finding] = []
     seen = set()
-    for fn in reachable.values():
+    for fn, _statics, _direct in jit_reachable(unit.tree):
         fname = getattr(fn, "name", "<lambda>")
         for node in ast.walk(fn):
             if not isinstance(node, ast.Call):
@@ -183,3 +245,32 @@ def check_explicit_dtype(unit: FileUnit, ctx: Context) -> List[Finding]:
             f"bit-exactness module (the x64 default is a flag, not a "
             f"contract)"))
     return findings
+
+
+EXPLAIN = {
+    "jit-purity": {
+        "why": (
+            "Functions reached from jit/pjit/shard_map callsites run "
+            "under a tracer — once, at trace time, on an arbitrary "
+            "host thread.  A time.time() there bakes one wall-clock "
+            "into the compiled program forever; a lock or socket call "
+            "runs at trace time and never again; np.random silently "
+            "freezes one draw."),
+        "bad": ("@jax.jit\n"
+                "def f(x):\n"
+                "    return x + time.time()   # frozen at trace time\n"),
+        "good": ("@jax.jit\n"
+                 "def f(x, now):              # clock passed as data\n"
+                 "    return x + now\n"),
+    },
+    "explicit-dtype": {
+        "why": (
+            "The M3TSZ contract is defined over float64/int64/uint64 "
+            "BIT PATTERNS.  A constructor that silently follows "
+            "jax_enable_x64's default — or a future change to it — is "
+            "a bit-exactness bug waiting for a flag flip.  asarray and "
+            "*_like preserve their input dtype and are exempt."),
+        "bad": "a = jnp.zeros(n)             # width decided by a flag\n",
+        "good": "a = jnp.zeros(n, jnp.int64)  # width decided by the code\n",
+    },
+}

@@ -143,3 +143,22 @@ def check(unit: FileUnit, ctx: Context) -> List[Finding]:
         in_init = fn.name == "__init__"
         _scan_block(fn.body, fn, in_init, unit, findings)
     return findings
+
+
+EXPLAIN = {
+    "resource-hygiene": {
+        "why": (
+            "A socket/file opened with no owner on the error path leaks "
+            "on every exception between open and the first close — "
+            "under retry storms that exhausts fds exactly when the "
+            "system is least able to afford it."),
+        "bad": ("s = socket.create_connection(addr)\n"
+                "s.sendall(hello)                 # raises -> s leaks\n"),
+        "good": ("s = socket.create_connection(addr)\n"
+                 "try:\n"
+                 "    s.sendall(hello)\n"
+                 "except BaseException:\n"
+                 "    s.close()\n"
+                 "    raise\n"),
+    },
+}

@@ -199,3 +199,23 @@ def _check_protocol_constants(unit: FileUnit) -> List[Finding]:
             f"in m3_tpu/x/lint/wirecheck.py — dispatchers cannot be "
             f"checked for it"))
     return findings
+
+
+EXPLAIN = {
+    "wire-exhaustive": {
+        "why": (
+            "A frame-type dispatcher missing a family member (without "
+            "an explicit default branch) silently drops the frame and "
+            "desyncs the connection — the half-wired-frame-type class "
+            "of bug.  The constant<->family table ratchet keeps new "
+            "wire constants from being declared but never dispatched."),
+        "bad": ("if ftype == MSG_A:\n"
+                "    ...\n"
+                "elif ftype == MSG_B:\n"
+                "    ...                      # MSG_C exists; no default\n"),
+        "good": ("elif ftype == MSG_C:\n"
+                 "    ...\n"
+                 "else:\n"
+                 "    conn.close()             # explicit default\n"),
+    },
+}

@@ -81,3 +81,43 @@ def _lockcheck_race_tiers(request):
             lockcheck.uninstall()
         if prev_env is None:
             os.environ.pop("M3_LOCKCHECK", None)
+
+
+# -- retrace/transfer sanitizer (race/dtest tiers) ---------------------------
+
+_TRACEWATCH_FILES = {"test_race.py", "test_dtest.py"}
+
+
+@pytest.fixture(autouse=True)
+def _tracewatch_race_tiers(request):
+    """Arm m3_tpu.x.tracewatch for the race and dtest tiers (the
+    lockcheck pattern): every XLA compile in the test process is
+    counted per function, a budget violation raises in the offending
+    call, and any recorded finding fails the test even if nothing
+    raised.  The env var is set so dtest NODE subprocesses inherit
+    arming (NodeProcess snapshots os.environ) — a retrace storm inside
+    a node dies loudly there instead of masquerading as a slow node.
+
+    A user who armed the WHOLE suite (``M3_TRACEWATCH=1 pytest ...``)
+    keeps their arming and mode, exactly like the lockcheck fixture."""
+    if request.node.path.name not in _TRACEWATCH_FILES:
+        yield
+        return
+    from m3_tpu.x import tracewatch
+
+    prev_env = os.environ.get("M3_TRACEWATCH")
+    was_installed = tracewatch.installed()
+    if prev_env is None:
+        os.environ["M3_TRACEWATCH"] = "1"
+    tracewatch.reset()
+    tracewatch.install(raise_on_violation=prev_env != "record")
+    try:
+        yield
+        found = tracewatch.findings()
+        assert not found, "retrace budget violations:\n" + "\n".join(
+            str(f) for f in found)
+    finally:
+        if not was_installed:
+            tracewatch.uninstall()
+        if prev_env is None:
+            os.environ.pop("M3_TRACEWATCH", None)

@@ -1,0 +1,123 @@
+"""Seeded jax compile-stability/transfer violations (never imported)."""
+
+import functools
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BIG_TBL = np.arange(1 << 14, dtype=np.uint32)
+SMALL_TBL = np.zeros(16, dtype=np.int64)
+
+
+@jax.jit
+def branch_on_traced(x, n):
+    if n > 4:                             # VIOLATION: retrace-risk (L17)
+        return x * 2
+    return x
+
+
+@jax.jit
+def env_frozen(x):
+    mode = os.environ.get("M3_MODE")      # VIOLATION: retrace-risk (L24)
+    return x if mode else -x
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def static_branch_ok(x, n):
+    if n > 4:                             # ok: n is static
+        return x * 2
+    if x is None:                         # ok: structural None test
+        return x
+    if x.shape[0] > 2:                    # ok: shape is static
+        return x + 1
+    return x
+
+
+@jax.jit
+def coerce_traced(x):
+    k = int(x)                            # VIOLATION: retrace-risk (L41)
+    return x + k
+
+
+@jax.jit
+def item_coercion(x):
+    return x.sum().item()                 # VIOLATION: retrace-risk (L47)
+
+
+@jax.jit
+def host_numpy(x):
+    return np.asarray(x).sum()            # VIOLATION: transfer-hygiene (L52)
+
+
+@jax.jit
+def traced_print(x):
+    print(x)                              # VIOLATION: transfer-hygiene (L57)
+    return x
+
+
+@jax.jit
+def traced_device_get(x):
+    y = jax.device_get(x)                 # VIOLATION: transfer-hygiene (L63)
+    return y
+
+
+def timed_no_sync(x):
+    t0 = time.perf_counter()              # VIOLATION: transfer-hygiene (L68)
+    y = jnp.sum(x) * 2
+    elapsed = time.perf_counter() - t0
+    return y, elapsed
+
+
+def timed_with_sync(x):
+    t0 = time.perf_counter()              # ok: block_until_ready present
+    y = jax.block_until_ready(jnp.sum(x))
+    elapsed = time.perf_counter() - t0
+    return y, elapsed
+
+
+def narrowing_roundtrip(v):
+    return v.astype(jnp.int32).astype(jnp.int64)  # VIOLATION: dtype-stability (L82)
+
+
+def widening_once_ok(v):
+    return v.astype(jnp.int64).astype(jnp.float64)  # ok: cross-kind chain
+
+
+def weak_scalar():
+    return jnp.asarray(5)                 # VIOLATION: dtype-stability (L90)
+
+
+def typed_scalar_ok():
+    return jnp.asarray(5, jnp.int32)      # ok: explicit dtype
+
+
+def float_in_funnel(x):
+    return x & 1.0                        # VIOLATION: dtype-stability (L98)
+
+
+def int_in_funnel_ok(x):
+    return x & 0xFF                       # ok: integer literal mask
+
+
+@jax.jit
+def bloated_closure(i):
+    return jnp.asarray(BIG_TBL)[i]        # VIOLATION: constant-bloat (L107)
+
+
+@jax.jit
+def bloated_direct(i):
+    t = BIG_TBL                           # VIOLATION: constant-bloat (L112)
+    return t[i]
+
+
+@jax.jit
+def small_constant_ok(i):
+    return jnp.asarray(SMALL_TBL, jnp.int64)[i]  # ok: 16 elements
+
+
+@jax.jit
+def table_as_arg_ok(tbl, i):
+    return tbl[i]                         # ok: parameter, not a literal

@@ -28,7 +28,9 @@ CORPUS = Path(__file__).resolve().parent / "data" / "lint_corpus"
 PERMISSIVE = Context(dtype_prefixes=("",), wire_prefixes=("",),
                      wire_files=(), fault_helper_files=(),
                      constant_files=(), persist_prefixes=("",),
-                     deadline_files=(), deadline_prefixes=("",))
+                     deadline_files=(), deadline_prefixes=("",),
+                     jax_prefixes=("",), jax_host_boundary=(),
+                     timed_prefixes=("",))
 
 EXPECTED = {
     ("lock_cases.py", "lock-discipline", 22),
@@ -39,6 +41,22 @@ EXPECTED = {
     ("purity_cases.py", "explicit-dtype", 38),
     ("purity_cases.py", "explicit-dtype", 39),
     ("purity_cases.py", "explicit-dtype", 40),
+    # np.random under the tracer is BOTH impure (frozen draw) and a
+    # host round-trip: the jax transfer family fires on the same seed
+    ("purity_cases.py", "transfer-hygiene", 18),
+    ("jax_cases.py", "retrace-risk", 17),       # if on traced arg
+    ("jax_cases.py", "retrace-risk", 24),       # trace-frozen env read
+    ("jax_cases.py", "retrace-risk", 41),       # int() coercion
+    ("jax_cases.py", "retrace-risk", 47),       # .item()
+    ("jax_cases.py", "transfer-hygiene", 52),   # np.asarray under tracer
+    ("jax_cases.py", "transfer-hygiene", 57),   # print under tracer
+    ("jax_cases.py", "transfer-hygiene", 63),   # jax.device_get
+    ("jax_cases.py", "transfer-hygiene", 68),   # timed region, no sync
+    ("jax_cases.py", "dtype-stability", 82),    # narrowing astype chain
+    ("jax_cases.py", "dtype-stability", 90),    # weak asarray literal
+    ("jax_cases.py", "dtype-stability", 98),    # float in bitwise op
+    ("jax_cases.py", "constant-bloat", 107),    # big table via asarray
+    ("jax_cases.py", "constant-bloat", 112),    # big table, bare name
     ("wire_cases.py", "wire-exhaustive", 8),
     ("wire_cases.py", "wire-exhaustive", 17),
     ("fault_cases.py", "fault-coverage", 10),
@@ -84,7 +102,9 @@ class TestCorpus:
         for rule in ("lock-discipline", "jit-purity", "explicit-dtype",
                      "wire-exhaustive", "fault-coverage",
                      "resource-hygiene", "corruption-typed",
-                     "placement-cas", "deadline-aware"):
+                     "placement-cas", "deadline-aware", "retrace-risk",
+                     "transfer-hygiene", "dtype-stability",
+                     "constant-bloat"):
             assert len(by_rule.get(rule, [])) >= 2, rule
 
 
@@ -171,6 +191,90 @@ class TestDtypeScope:
     def test_out_of_scope_module_stays_clean(self, tmp_path):
         got = self._lint_at(tmp_path, "m3_tpu/query/engine.py")
         assert not any(f.rule == "explicit-dtype" for f in got)
+
+
+class TestJaxScope:
+    """The DEFAULT context must aim the jax families at the numeric
+    layer: constant-bloat/retrace fire anywhere (they key off jit
+    reachability), while the host-boundary and timed-region checks are
+    path-scoped — tools/ own transfers, and only tools/ time."""
+
+    def _lint_at(self, tmp_path, rel, src):
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+        return lint_file(p, tmp_path, Context())
+
+    ENV_IN_JIT = ("import os, jax\n"
+                  "@jax.jit\n"
+                  "def f(x):\n"
+                  "    return x if os.environ.get('M') else -x\n")
+
+    def test_retrace_fires_everywhere(self, tmp_path):
+        got = self._lint_at(tmp_path, "m3_tpu/query/engine.py",
+                            self.ENV_IN_JIT)
+        assert any(f.rule == "retrace-risk" for f in got)
+
+    TIMED = ("import time\nimport jax.numpy as jnp\n"
+             "def bench(x):\n"
+             "    t0 = time.perf_counter()\n"
+             "    y = jnp.sum(x)\n"
+             "    return y, time.perf_counter() - t0\n")
+
+    def test_timed_region_scoped_to_tools(self, tmp_path):
+        got = self._lint_at(tmp_path, "m3_tpu/tools/bisect2.py", self.TIMED)
+        assert any(f.rule == "transfer-hygiene" for f in got)
+        got = self._lint_at(tmp_path, "m3_tpu/query/engine.py", self.TIMED)
+        assert not any(f.rule == "transfer-hygiene" for f in got)
+
+    DEVICE_GET = ("import jax\n"
+                  "def pull(x):\n"
+                  "    return jax.device_get(x)\n")
+
+    def test_host_boundary_scoping(self, tmp_path):
+        got = self._lint_at(tmp_path, "m3_tpu/parallel/foo.py",
+                            self.DEVICE_GET)
+        assert any(f.rule == "transfer-hygiene" for f in got)
+        got = self._lint_at(tmp_path, "m3_tpu/tools/foo.py",
+                            self.DEVICE_GET)
+        assert not any(f.rule == "transfer-hygiene" for f in got)
+
+    def test_registered_large_constant_cross_module(self, tmp_path):
+        src = ("import jax, jax.numpy as jnp\n"
+               "from m3_tpu.encoding import m3tsz_jax as mj\n"
+               "@jax.jit\n"
+               "def f(i):\n"
+               "    return jnp.asarray(mj._VALUE_CTRL_TBL)[i]\n")
+        got = self._lint_at(tmp_path, "m3_tpu/query/engine.py", src)
+        assert any(f.rule == "constant-bloat" for f in got)
+
+
+class TestExplain:
+    def test_every_rule_has_an_explanation(self):
+        from m3_tpu.x.lint.core import RULES, explain
+
+        for rule in RULES:
+            entry = explain(rule)
+            assert entry is not None, rule
+            assert entry["why"] and entry["bad"] and entry["good"], rule
+
+    def test_cli_explain(self, capsys):
+        from m3_tpu.tools.cli import main
+
+        assert main(["lint", "--explain", "retrace-risk"]) == 0
+        out = capsys.readouterr().out
+        assert "retrace-risk" in out and "violates:" in out and "clean:" in out
+        assert main(["lint", "--explain", "no-such-rule"]) == 2
+
+    def test_cli_json_report(self, capsys):
+        import json
+
+        from m3_tpu.tools.cli import main
+
+        assert main(["lint", "--json"]) == 0
+        rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rec["ok"] is True
+        assert rec["new"] == [] and rec["fixed"] == []
 
 
 class TestRepoGate:

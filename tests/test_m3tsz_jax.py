@@ -278,40 +278,74 @@ def test_batched_decode_mixed_unit_streams():
     assert got == pts
 
 
-def test_encode_gather_placement_byte_identical():
+def test_encode_gather_placement_byte_identical(monkeypatch):
     """The TPU (gather/cumsum) word-placement form must produce the
-    SAME bytes as the scatter form — forced via M3_ENCODE_PLACE in a
-    subprocess (the choice binds at trace time).  u64 cumsum-diff is
-    exact under wraparound, so identity must hold bit for bit."""
-    import subprocess
-    import sys
+    SAME bytes as the scatter form, validated against the scalar
+    oracle.  u64 cumsum-diff is exact under wraparound, so identity
+    must hold bit for bit.  This used to need a SUBPROCESS because
+    M3_ENCODE_PLACE was read under the tracer and in-process flips
+    were silently frozen at the first compile; round 7 moved the
+    resolution into the host wrapper (resolved_place -> static arg),
+    so the same coverage now runs in-process with a monkeypatched
+    env."""
+    import numpy as np
 
-    code = """
-import sys; sys.path.insert(0, %r)
-import jax; jax.config.update("jax_platforms", "cpu")
-import numpy as np
-from m3_tpu.encoding.m3tsz import encode_series
-from m3_tpu.encoding.m3tsz_jax import encode_batch
-rng = np.random.default_rng(2)
-S, T = 16, 360
-start = 1_700_000_000 * 10**9
-ts = start + np.cumsum(rng.integers(1, 3, (S, T)), axis=1) * 10**10
-vals = np.round(rng.normal(50, 20, (S, T)), 2)
-streams, fb = encode_batch(ts, vals, np.full(S, start, np.int64),
-                           out_words=T * 40 // 64 + 8)
-assert not fb.any()
-for i in range(S):
-    oracle = encode_series(list(zip(ts[i].tolist(), vals[i].tolist())),
-                           start=start)
-    assert streams[i] == oracle, f"series {i} diverged"
-print("PLACEMENT_OK")
-""" % (str(__import__("pathlib").Path(__file__).resolve().parents[1]),)
-    import os
+    from m3_tpu.encoding.m3tsz import encode_series
+    from m3_tpu.encoding.m3tsz_jax import encode_batch, resolved_place
 
-    env = dict(os.environ, M3_ENCODE_PLACE="gather", JAX_PLATFORMS="cpu")
-    p = subprocess.run([sys.executable, "-c", code], env=env,
-                       capture_output=True, text=True, timeout=420)
-    assert "PLACEMENT_OK" in p.stdout, p.stderr[-1500:]
+    monkeypatch.setenv("M3_ENCODE_PLACE", "gather")
+    assert resolved_place() == "gather"
+    rng = np.random.default_rng(2)
+    S, T = 16, 360
+    start = 1_700_000_000 * 10**9
+    ts = start + np.cumsum(rng.integers(1, 3, (S, T)), axis=1) * 10**10
+    vals = np.round(rng.normal(50, 20, (S, T)), 2)
+    streams, fb = encode_batch(ts, vals, np.full(S, start, np.int64),
+                               out_words=T * 40 // 64 + 8)
+    assert not fb.any()
+    for i in range(S):
+        oracle = encode_series(list(zip(ts[i].tolist(), vals[i].tolist())),
+                               start=start)
+        assert streams[i] == oracle, f"series {i} diverged"
+
+
+def test_encode_place_env_flip_works_in_process(monkeypatch):
+    """Round-7 retrace-risk regression: M3_ENCODE_PLACE used to be
+    read UNDER the tracer, so an in-process env flip after the first
+    encode changed NOTHING (the jit cache keyed on the static args,
+    not the env).  The seam now resolves in the host wrapper and rides
+    as a static argument: flipping the env must actually select the
+    other placement (observable as a fresh compile cache entry) and
+    stay byte-identical."""
+    import numpy as np
+
+    from m3_tpu.encoding import m3tsz_jax as mj
+
+    rng = np.random.default_rng(5)
+    S, T = 4, 48
+    start = 1_700_000_000 * 10**9
+    ts = start + np.cumsum(rng.integers(1, 3, (S, T)), axis=1) * 10**10
+    vals = np.round(rng.normal(50, 20, (S, T)), 2)
+    starts = np.full(S, start, np.int64)
+
+    monkeypatch.delenv("M3_ENCODE_PLACE", raising=False)
+    assert mj.resolved_place() == "scatter"  # tests pin the CPU backend
+    a, fb_a = mj.encode_batch(ts, vals, starts, out_words=T * 40 // 64 + 8)
+    size_scatter = mj._encode_batch_device._cache_size()
+
+    monkeypatch.setenv("M3_ENCODE_PLACE", "gather")
+    assert mj.resolved_place() == "gather"
+    b, fb_b = mj.encode_batch(ts, vals, starts, out_words=T * 40 // 64 + 8)
+    # the flip actually took: the gather form is a new static signature
+    assert mj._encode_batch_device._cache_size() > size_scatter
+    assert not fb_a.any() and not fb_b.any()
+    assert a == b  # placement forms are byte-identical by contract
+
+    monkeypatch.setenv("M3_ENCODE_PLACE", "bogus")
+    import pytest
+
+    with pytest.raises(ValueError, match="M3_ENCODE_PLACE"):
+        mj.resolved_place()
 
 
 def test_encoder_bytes_pinned_across_dtype_hardening():

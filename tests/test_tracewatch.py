@@ -1,0 +1,206 @@
+"""tracewatch tier: the runtime retrace/transfer sanitizer on itself.
+
+The seeded-violation contract: a shape-unstable jit call must fail
+fast with the offending shapes/dtypes in the message, and a
+device→host transfer inside a guarded region must raise with the
+array's dtype/shape — in-process through install(), and end-to-end in
+a subprocess armed only by ``M3_TRACEWATCH=1`` (the env seam dtest
+node processes inherit)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from m3_tpu.x import tracewatch
+
+
+@pytest.fixture()
+def armed():
+    was = tracewatch.installed()
+    tracewatch.reset()
+    tracewatch.install(raise_on_violation=True)
+    try:
+        yield tracewatch
+    finally:
+        if not was:
+            tracewatch.uninstall()
+        tracewatch.reset()
+
+
+class TestRetraceDetection:
+    def test_shape_unstable_jit_fails_fast(self, armed):
+        def churn_shape_fn(x):
+            return x * 2
+
+        f = jax.jit(churn_shape_fn)
+        tracewatch.set_budget("churn_shape_fn", 2)
+        f(jnp.zeros(1, jnp.float64))
+        f(jnp.zeros(2, jnp.float64))
+        with pytest.raises(tracewatch.RetraceError) as ei:
+            f(jnp.zeros(3, jnp.float64))
+        msg = str(ei.value)
+        # actionable diagnostics: the name, the budget, and the
+        # distinct signatures (the churning axis is visible)
+        assert "churn_shape_fn" in msg and "budget 2" in msg
+        assert "float64[1]" in msg and "float64[3]" in msg
+
+    def test_stable_shapes_stay_quiet(self, armed):
+        def stable_fn(x):
+            return x + 1
+
+        f = jax.jit(stable_fn)
+        tracewatch.set_budget("stable_fn", 1)
+        f(jnp.zeros(4, jnp.float64))
+        snap = tracewatch.snapshot()
+        for _ in range(5):
+            f(jnp.zeros(4, jnp.float64))
+        assert tracewatch.retraces_since(snap) == 0
+        assert tracewatch.compiles().get("stable_fn") == 1
+
+    def test_record_mode_collects_findings(self):
+        was = tracewatch.installed()
+        tracewatch.reset()
+        tracewatch.install(raise_on_violation=False)
+        try:
+            def record_mode_fn(x):
+                return x - 1
+
+            f = jax.jit(record_mode_fn)
+            tracewatch.set_budget("record_mode_fn", 1)
+            for n in (1, 2, 3):
+                f(jnp.zeros(n, jnp.float64))
+            found = [fd for fd in tracewatch.findings()
+                     if fd.name == "record_mode_fn"]
+            assert found and found[-1].count == 3
+            assert len(found[-1].signatures) == 3
+        finally:
+            if not was:
+                tracewatch.uninstall()
+            tracewatch.reset()
+
+    def test_retrace_budget_decorator(self, armed):
+        @tracewatch.retrace_budget(1)
+        def budgeted_fn(x):
+            return x * x
+
+        f = jax.jit(budgeted_fn)
+        f(jnp.zeros(2, jnp.float64))
+        with pytest.raises(tracewatch.RetraceError):
+            f(jnp.zeros(3, jnp.float64))
+
+    def test_uninstall_restores_factories(self):
+        import jax as j
+
+        was = tracewatch.installed()
+        if was:
+            tracewatch.uninstall()
+        orig = j.jit
+        tracewatch.install()
+        assert j.jit is not orig
+        tracewatch.uninstall()
+        assert j.jit is orig
+        if was:
+            tracewatch.install()
+
+
+class TestTransferGuard:
+    def test_asarray_blocked_in_guarded_region(self, armed):
+        x = jnp.arange(8, dtype=jnp.int64)
+        with tracewatch.no_transfers():
+            with pytest.raises(tracewatch.TransferError) as ei:
+                np.asarray(x)
+        assert "int64" in str(ei.value) and "[8]" in str(ei.value)
+        # outside the region the seam is open again
+        assert np.asarray(x).shape == (8,)
+
+    def test_device_get_blocked(self, armed):
+        x = jnp.arange(4, dtype=jnp.int64)
+        with tracewatch.no_transfers():
+            with pytest.raises(tracewatch.TransferError):
+                jax.device_get(x)
+        assert jax.device_get(x).shape == (4,)
+
+    def test_allow_transfers_escape(self, armed):
+        x = jnp.arange(4, dtype=jnp.int64)
+        with tracewatch.no_transfers():
+            with tracewatch.allow_transfers():
+                assert np.asarray(x).sum() == 6
+            with pytest.raises(tracewatch.TransferError):
+                np.asarray(x)
+
+    def test_device_compute_allowed_in_region(self, armed):
+        x = jnp.arange(1024, dtype=jnp.int64)
+        f = jax.jit(lambda v: (v * 2).sum())
+        f(x)  # compile outside
+        with tracewatch.no_transfers():
+            y = jax.block_until_ready(f(x))
+        assert int(y) == 1023 * 1024
+
+    def test_guard_without_install(self):
+        was = tracewatch.installed()
+        if was:
+            tracewatch.uninstall()
+        try:
+            x = jnp.arange(3, dtype=jnp.int64)
+            with tracewatch.no_transfers():
+                with pytest.raises(tracewatch.TransferError):
+                    np.asarray(x)
+            assert np.asarray(x).shape == (3,)
+        finally:
+            if was:
+                tracewatch.install()
+
+
+_ENV_SCRIPT = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import m3_tpu.x  # the env seam arms tracewatch at import
+from m3_tpu.x import tracewatch
+assert tracewatch.installed(), "M3_TRACEWATCH env seam did not arm"
+import jax, jax.numpy as jnp, numpy as np
+
+mode = sys.argv[1]
+if mode == "retrace":
+    def unstable(x):
+        return x * 3
+    f = jax.jit(unstable)
+    tracewatch.set_budget("unstable", 2)
+    for n in range(1, 8):
+        f(np.zeros(n, np.float64))     # new shape every call
+    print("NO RAISE")
+elif mode == "transfer":
+    x = jnp.arange(16, dtype=jnp.int64)
+    with tracewatch.no_transfers():
+        np.asarray(x)
+    print("NO RAISE")
+"""
+
+
+class TestEnvSeam:
+    def _run(self, mode: str):
+        env = dict(os.environ, M3_TRACEWATCH="1", JAX_PLATFORMS="cpu")
+        return subprocess.run(
+            [sys.executable, "-c", _ENV_SCRIPT, mode], env=env,
+            capture_output=True, text=True, timeout=180)
+
+    def test_shape_unstable_jit_dies_under_env_arming(self):
+        res = self._run("retrace")
+        assert res.returncode != 0, res.stdout + res.stderr
+        assert "RetraceError" in res.stderr
+        assert "unstable" in res.stderr and "budget 2" in res.stderr
+        # the offending shapes are named
+        assert "float64[3]" in res.stderr
+
+    def test_transfer_in_guarded_region_dies_under_env_arming(self):
+        res = self._run("transfer")
+        assert res.returncode != 0, res.stdout + res.stderr
+        assert "TransferError" in res.stderr
+        assert "int64" in res.stderr and "[16]" in res.stderr
