@@ -131,6 +131,92 @@ def set_ingest_impl(impl: str) -> None:
             pass
 
 
+# ---------------------------------------------------------------------------
+# Arena layout selection, M3_ARENA_LAYOUT=packed|f64|auto (default auto)
+# or set_arena_layout():
+#   packed — the sort/segment formulation + adaptive-width counter state
+#            (aggregator/packed.py): one u64 key sort per ingest batch,
+#            dense merges, no hot-path scatter.  Counter stats exact,
+#            gauge sum/sum_sq within 1e-6 of the f64 path (segmented
+#            tree adds), timer value lanes at f32 (packed32) precision.
+#   f64    — the original scatter arenas in THIS module: the parity
+#            oracle, bit-exact reference semantics throughout.
+#   auto   — packed (faster on both measured backends: CPU avoids the
+#            ~60ns/elt scatter floor, TPU its ~1us/elt scatter).
+# Resolution happens on the HOST at arena construction (tracewatch
+# contract: nothing reads the environment under a tracer) — engine
+# arenas bind their layout at __init__, the sharded program takes it as
+# a static argument.
+# ---------------------------------------------------------------------------
+
+LAYOUTS = ("packed", "f64", "auto")
+_LAYOUT = (os.environ.get("M3_ARENA_LAYOUT", "").strip().lower()
+           or "auto")
+if _LAYOUT not in LAYOUTS:
+    raise ValueError(
+        f"M3_ARENA_LAYOUT={_LAYOUT!r}: must be one of {LAYOUTS} "
+        "(a typo silently running the default would invalidate the very "
+        "comparison the flag exists to make)")
+
+
+def arena_layout() -> str:
+    """The CONFIGURED layout (may be 'auto'); see resolved_arena_layout."""
+    return _LAYOUT
+
+
+def resolved_arena_layout() -> str:
+    """'auto' resolves to 'packed' on every backend: the sort/segment
+    formulation wins on CPU (no scatter floor) and by construction on
+    TPU (scatter measured ~1us/element there).  'f64' remains the
+    explicit parity-oracle escape hatch."""
+    return "packed" if _LAYOUT == "auto" else _LAYOUT
+
+
+def set_arena_layout(layout: str) -> None:
+    """Host-side layout override (bench/tests).  Arenas bind layout at
+    construction, so this affects arenas built AFTER the call."""
+    global _LAYOUT
+    if layout not in LAYOUTS:
+        raise ValueError(f"unknown arena layout {layout!r}")
+    _LAYOUT = layout
+
+
+def resolve_layout_arg(layout: str | None) -> str:
+    """Resolve a per-call/per-engine layout argument to a CONCRETE
+    layout: None/"" follow the configured seam, an explicit "auto"
+    resolves to packed, and anything else must be a known layout — a
+    typo silently selecting some default would invalidate the very
+    comparison the seam exists to make (the env guard's rationale,
+    applied to the programmatic path too)."""
+    if not layout:
+        return resolved_arena_layout()
+    if layout == "auto":
+        return "packed"
+    if layout not in LAYOUTS:
+        raise ValueError(
+            f"unknown arena layout {layout!r}: must be one of {LAYOUTS}")
+    return layout
+
+
+def make_arenas(num_windows: int, capacity: int, sample_capacity: int,
+                quantiles: tuple, timer_packed32: bool = False,
+                layout: str | None = None):
+    """(counter, gauge, timer) arenas for a layout (None = resolved
+    seam) — the one construction seam engine.py and tests share."""
+    layout = resolve_layout_arg(layout)
+    if layout == "packed":
+        from m3_tpu.aggregator import packed
+
+        return (packed.PackedCounterArena(num_windows, capacity),
+                packed.PackedGaugeArena(num_windows, capacity),
+                packed.PackedTimerArena(num_windows, capacity,
+                                        sample_capacity, quantiles))
+    return (CounterArena(num_windows, capacity),
+            GaugeArena(num_windows, capacity),
+            TimerArena(num_windows, capacity, sample_capacity,
+                       quantiles, packed32=timer_packed32))
+
+
 def _seg3(sum_col, sq_col, cnt_col, idx, values):
     """The sum / sum² / count accumulation every arena shares, routed
     through the configured implementation.  ``idx`` >= len(sum_col)
@@ -186,11 +272,41 @@ def _sanitize_slots(slots, capacity: int):
     return jnp.where(slots < 0, capacity, slots)
 
 
+def orderable_f32(v: jnp.ndarray) -> jnp.ndarray:
+    """f64 -> u64 holding order-preserving f32 bits in the low 32
+    (IEEE-754 total order as unsigned; negatives flip entirely,
+    positives flip the sign bit).  One home for the packed32 bit trick
+    — the timer drain here and the packed arena's sample words
+    (aggregator/packed.py) must never diverge."""
+    b = v.astype(jnp.float32).view(jnp.uint32).astype(jnp.uint64)
+    return jnp.where(
+        b >= jnp.uint64(0x80000000),
+        jnp.uint64(0xFFFFFFFF) - b,
+        b | jnp.uint64(0x80000000),
+    )
+
+
+def decode_orderable_f32(bits: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of orderable_f32 -> f64 (carries f32 precision)."""
+    b = jnp.where(
+        bits >= jnp.uint64(0x80000000),
+        bits & jnp.uint64(0x7FFFFFFF),
+        jnp.uint64(0xFFFFFFFF) - bits,
+    )
+    return b.astype(jnp.uint32).view(jnp.float32).astype(jnp.float64)
+
+
 def _stdev(count, sum_sq, sum_):
-    """Sample stdev from moments (reference aggregation/common.go:29-36)."""
+    """Sample stdev from moments (reference aggregation/common.go:29-36).
+
+    ``count*sum_sq - sum^2`` suffers catastrophic cancellation when the
+    mean dwarfs the spread (mean ~1e9, stdev ~1 leaves no mantissa bits
+    for the variance): the true difference can round to a small
+    NEGATIVE number.  Clamp at 0 — the earlier ``abs()`` fabricated a
+    spurious stdev out of the cancellation noise instead."""
     div = count * (count - 1)
-    num = count * sum_sq - sum_ * sum_
-    return jnp.where(div <= 0, 0.0, jnp.sqrt(jnp.abs(num) / jnp.where(div == 0, 1, div)))
+    num = jnp.maximum(count * sum_sq - sum_ * sum_, 0.0)
+    return jnp.where(div <= 0, 0.0, jnp.sqrt(num / jnp.where(div == 0, 1, div)))
 
 
 # ---------------------------------------------------------------------------
@@ -254,7 +370,7 @@ def counter_consume(state: CounterState, window: jnp.ndarray, capacity: int):
     mean = jnp.where(cnt == 0, 0.0, s / jnp.where(cnt == 0, 1, cnt))
     lanes = jnp.stack(
         [
-            jnp.full(capacity, jnp.nan),  # LAST
+            jnp.full(capacity, jnp.nan, jnp.float64),  # LAST
             jnp.where(cnt == 0, 0.0, sl(state.min).astype(jnp.float64)),
             jnp.where(cnt == 0, 0.0, sl(state.max).astype(jnp.float64)),
             mean,
@@ -471,6 +587,34 @@ class TimerState(NamedTuple):
     last_at: jnp.ndarray  # i64 (C,)
 
 
+def timer_append_plan(windows, slots, sample_n, capacity: int, scap: int):
+    """Destination plan for appending a timer batch into per-window
+    sample buffers: (drop mask, flat destination offsets with the drop
+    sentinel num_w*scap, per-window appended counts).
+
+    Buffer order is irrelevant (consume sorts the whole window at
+    drain), so ranks come from one exclusive cumsum per window over the
+    membership mask — W is small and static, and this avoids carrying
+    the value column through a device sort.  ONE home for the plan: the
+    f64 and packed timer ingests (aggregator/packed.py) share it, so
+    overflow accounting can never diverge between the layouts."""
+    num_w = sample_n.shape[0]
+    oob = (windows < 0) | (windows >= num_w)
+    drop = oob | (slots < 0) | (slots >= capacity)
+    order_key = jnp.where(drop, num_w, windows)
+    onehot = order_key[None, :] == jnp.arange(
+        num_w, dtype=order_key.dtype)[:, None]
+    ranks_all = jnp.cumsum(onehot.astype(jnp.int64), axis=1) - 1  # (W, N)
+    w_clip = jnp.clip(order_key, 0, num_w - 1)
+    rank = jnp.take_along_axis(ranks_all, w_clip[None, :], axis=0)[0]
+    dst = sample_n[w_clip] + rank
+    flat = jnp.where(
+        ~drop & (dst < scap), w_clip.astype(jnp.int64) * scap + dst,
+        num_w * scap)
+    per_w_counts = onehot.sum(axis=1, dtype=sample_n.dtype)
+    return drop, flat, per_w_counts
+
+
 def timer_init(num_windows: int, capacity: int, sample_capacity: int) -> TimerState:
     n = num_windows * capacity
     return TimerState(
@@ -502,33 +646,15 @@ def timer_ingest(
     via sample_n overflow).
     """
     num_w, scap = state.sample_slot.shape
-    idx = windows * capacity + slots
-    oob = (windows < 0) | (windows >= num_w)
     # Out-of-range SLOTS must drop too: w*C + slot with slot >= C would
     # otherwise land in window w+1's region (fuzz-caught).  The
-    # combined mask also gates the sample APPEND below — a dropped
-    # sample must not consume quantile-buffer capacity or inflate
-    # sample_n's overflow accounting.
-    drop = oob | (slots < 0) | (slots >= capacity)
-    idx = jnp.where(drop, num_w * capacity, idx)
-
-    # Rank of each sample within its window for this batch.  Buffer
-    # order is irrelevant (consume lex-sorts the whole window at
-    # drain), so ranks come from one exclusive cumsum per window over
-    # the membership mask — W is small and static, and this avoids
-    # carrying the f64 value column through a device sort (f64 compute
-    # is software-emulated on TPU; the sort was the ingest hot spot).
-    order_key = jnp.where(drop, num_w, windows)
-    onehot = order_key[None, :] == jnp.arange(num_w, dtype=order_key.dtype)[:, None]
-    ranks_all = jnp.cumsum(onehot.astype(jnp.int64), axis=1) - 1  # (W, N)
-    w_clip = jnp.clip(order_key, 0, num_w - 1)
-    rank = jnp.take_along_axis(ranks_all, w_clip[None, :], axis=0)[0]
-    base = state.sample_n[w_clip]
-    dst = base + rank
-    flat = jnp.where(
-        ~drop & (dst < scap), w_clip.astype(jnp.int64) * scap + dst, num_w * scap
-    )
-    per_w_counts = onehot.sum(axis=1, dtype=state.sample_n.dtype)
+    # combined mask also gates the sample APPEND — a dropped sample
+    # must not consume quantile-buffer capacity or inflate sample_n's
+    # overflow accounting (timer_append_plan owns both contracts).
+    drop, flat, per_w_counts = timer_append_plan(
+        windows, slots, state.sample_n, capacity, scap)
+    idx = jnp.where(drop, num_w * capacity,
+                    windows * capacity + slots)
 
     t_s, t_sq, t_c = _seg3(state.sum, state.sum_sq, state.count, idx, values)
     slot_safe = _sanitize_slots(slots, capacity)
@@ -587,24 +713,11 @@ def timer_consume(
     slots_w = jax.lax.dynamic_index_in_dim(state.sample_slot, window, keepdims=False)
     vals_w = jax.lax.dynamic_index_in_dim(state.sample_val, window, keepdims=False)
     if packed32:
-        v32 = vals_w.astype(jnp.float32).view(jnp.uint32).astype(jnp.uint64)
-        # Order-preserving f32 bits: negatives flip entirely, positives
-        # flip the sign bit (IEEE-754 totally ordered as unsigned).
-        v32 = jnp.where(
-            v32 >= jnp.uint64(0x80000000),
-            jnp.uint64(0xFFFFFFFF) - v32,
-            v32 | jnp.uint64(0x80000000),
-        )
         keys = jax.lax.sort(
-            (slots_w.astype(jnp.uint64) << jnp.uint64(32)) | v32)
+            (slots_w.astype(jnp.uint64) << jnp.uint64(32))
+            | orderable_f32(vals_w))
         s_slot = (keys >> jnp.uint64(32)).astype(jnp.int32)
-        vbits = (keys & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint64)
-        vbits = jnp.where(
-            vbits >= jnp.uint64(0x80000000),
-            vbits & jnp.uint64(0x7FFFFFFF),
-            jnp.uint64(0xFFFFFFFF) - vbits,
-        )
-        s_val = vbits.astype(jnp.uint32).view(jnp.float32).astype(jnp.float64)
+        s_val = decode_orderable_f32(keys & jnp.uint64(0xFFFFFFFF))
     else:
         s_slot, s_val = jax.lax.sort((slots_w, vals_w), num_keys=2)
 
@@ -629,7 +742,7 @@ def timer_consume(
 
     lanes = jnp.stack(
         [
-            jnp.full(capacity, jnp.nan),  # LAST (invalid for timers)
+            jnp.full(capacity, jnp.nan, jnp.float64),  # LAST (invalid for timers)
             mn,
             mx,
             mean,
@@ -717,6 +830,36 @@ class _ScalarLanesMixin:
         return SCALAR_LANES.index(t) if t in SCALAR_LANES else None
 
 
+class _TimerLanesMixin:
+    """Quantile-extended lane mapping shared by the f64 and packed
+    timer arenas (requires a ``quantiles`` tuple attribute)."""
+
+    @property
+    def lane_types(self):
+        """Primary type per lane; quantile-aliased types (e.g. MEDIAN ==
+        P50) resolve through lane_for_type."""
+        qtypes = []
+        for q in self.quantiles:
+            primary = next(
+                (
+                    t
+                    for t in AggregationType
+                    if t is not AggregationType.MEDIAN and t.quantile() == q
+                ),
+                AggregationType.UNKNOWN,
+            )
+            qtypes.append(primary)
+        return SCALAR_LANES + tuple(qtypes)
+
+    def lane_for_type(self, t: AggregationType) -> int | None:
+        if t in SCALAR_LANES:
+            return SCALAR_LANES.index(t)
+        q = t.quantile()
+        if q is not None and q in self.quantiles:
+            return len(SCALAR_LANES) + self.quantiles.index(q)
+        return None
+
+
 class CounterArena(_ScalarLanesMixin):
     """Counter slots over a W-window ring (reference counter.go semantics)."""
 
@@ -769,7 +912,7 @@ class GaugeArena(_ScalarLanesMixin):
         )
 
 
-class TimerArena:
+class TimerArena(_TimerLanesMixin):
     DEFAULT_QUANTILES = (0.5, 0.95, 0.99)
 
     def __init__(
@@ -855,28 +998,3 @@ class TimerArena:
             self.num_windows,
             self.capacity,
         )
-
-    @property
-    def lane_types(self):
-        """Primary type per lane; quantile-aliased types (e.g. MEDIAN ==
-        P50) resolve through lane_for_type."""
-        qtypes = []
-        for q in self.quantiles:
-            primary = next(
-                (
-                    t
-                    for t in AggregationType
-                    if t is not AggregationType.MEDIAN and t.quantile() == q
-                ),
-                AggregationType.UNKNOWN,
-            )
-            qtypes.append(primary)
-        return SCALAR_LANES + tuple(qtypes)
-
-    def lane_for_type(self, t: AggregationType) -> int | None:
-        if t in SCALAR_LANES:
-            return SCALAR_LANES.index(t)
-        q = t.quantile()
-        if q is not None and q in self.quantiles:
-            return len(SCALAR_LANES) + self.quantiles.index(q)
-        return None

@@ -35,7 +35,7 @@ from typing import Callable, Dict, List, Sequence
 import jax.numpy as jnp
 import numpy as np
 
-from m3_tpu.aggregator.arena import CounterArena, GaugeArena, TimerArena
+from m3_tpu.aggregator.arena import make_arenas
 from m3_tpu.core.hash import shard_for
 from m3_tpu.metrics.aggregation import AggregationID, AggregationType
 from m3_tpu.metrics.policy import StoragePolicy
@@ -83,6 +83,13 @@ class AggregatorOptions:
     # below ~1.2e-38 flush; see arena.timer_consume), moments stay
     # f64-exact.
     timer_packed32: bool = False
+    # Arena layout: "packed" (sort/segment formulation + adaptive-width
+    # counters, aggregator/packed.py), "f64" (the scatter arenas — the
+    # bit-exact parity oracle), or None = the M3_ARENA_LAYOUT seam
+    # (auto -> packed).  Packed counter stats are exact; gauge
+    # sum/sum_sq and timer value lanes carry the documented <=1e-6
+    # envelopes (see arena.resolved_arena_layout).
+    layout: str | None = None
     storage_policies: tuple = (StoragePolicy.parse("10s:2d"),)
     # New-metric creation rate cap, entries/sec across the aggregator
     # (reference entry.go rate limits; 0 = unlimited).  Samples whose
@@ -320,10 +327,9 @@ class MetricList:
                 opts.new_series_limit_per_sec)
         self.new_series_limiter = new_series_limiter
         self.new_series_rejected = 0
-        self.counters = CounterArena(W, C)
-        self.gauges = GaugeArena(W, C)
-        self.timers = TimerArena(W, C, opts.timer_sample_capacity,
-                                 opts.quantiles, packed32=opts.timer_packed32)
+        self.counters, self.gauges, self.timers = make_arenas(
+            W, C, opts.timer_sample_capacity, opts.quantiles,
+            timer_packed32=opts.timer_packed32, layout=opts.layout)
         self.maps = {
             MetricType.COUNTER: MetricMap(C, limiter=new_series_limiter),
             MetricType.GAUGE: MetricMap(C, limiter=new_series_limiter),

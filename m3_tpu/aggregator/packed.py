@@ -1,0 +1,1153 @@
+"""Packed arena: sort/segment ingest + adaptive-width counter state.
+
+The scatter arenas (``arena.py``) pay one XLA scatter per statistic lane
+— ~11 random-access passes plus a 3-key lex sort per ingest batch.  On
+XLA-CPU a scatter has a ~40-60ns/element floor regardless of dtype, and
+on TPU it measured ~1us/element (TPU_RESULTS_r05.json window #3).  This
+module reformulates the whole hot path around ONE u64 key sort per
+batch and otherwise touches memory only with the primitives XLA runs at
+streaming speed (gather ~4.5ns/elt, cumsum ~6ns, dense ~4ns on the r07
+box):
+
+    key    = flat_idx << AB | arrival          (AB = batch-size bits)
+    sorted -> permutation + per-slot segment boundaries
+    sum/sum_sq/count  = cumulative-sum differences at the boundaries
+    min/max/last      = one segmented associative scan
+    state update      = DENSE merge over the (W*C,) arena — no scatter
+
+Boundaries come from one monotone scatter-min (`indices_are_sorted`)
+plus a reverse cummin — no searchsorted on the ingest path.  The only
+remaining scatters are the timer sample append (one packed word) and
+the bounded-K overflow-pool promotion below.
+
+Counter state adopts the SALSA / Counter Pools layout
+(arXiv:2102.12531, arXiv:2502.14699): narrow base lanes packed per
+(window, slot) —
+
+    base   u64: count:CB | sum:SB (biased)   (default 16/48)
+    sq     i64: sum of squares               (full width: squares grow
+                with value^2 and saturate any narrow lane in minutes —
+                the round-8 bench caught a 24-bit sq lane doing so)
+    minmax u32: o16(min) << 16 | o16(max)    (int16-exact)
+
+— with a shared overflow pool of full-width i64 rows.  A slot whose
+count or sum lane would saturate, or that sees a value outside the
+int16 min/max range, PROMOTES: its exact running stats move to a pool
+row and later batches add deltas there.  Promotion and spill are
+branchless bounded-K scatters (``jnp.nonzero(size=K)``) under a
+``lax.cond`` that costs nothing while no slot is promoted.  Per-slot
+memory is 24B (base 8 + sq 8 + minmax 4 + pool index 4) vs the f64
+arena's 40B — 1.67x, plus P*48B of pool (default P = C/16); narrower
+CB/SB widths trade promotion rate for memory.  Packed counter stats
+are EXACT: count/sum/sum_sq accumulate in (wrapping) i64 exactly like
+the scatter path, min/max are int16-exact in the base and i64-exact
+once promoted.
+
+Gauge state keeps f64 sum/sum_sq/min/max/last (the parity contract
+pins count/min/max/last bit-exact); the packed win for gauges is the
+formulation: batch sums ride the segmented scan as tree-order f64 adds
+— rounding stays at ~log2(N) ulps of each segment's OWN magnitude (a
+cumsum-diff form was tried and rejected: its quantum scales with the
+batch max, which blows the relative bound for tiny segments) and
++/-inf / NaN flow through with the scatter path's exact semantics —
+replacing the 3-key lex sort + 8 scatters.
+
+Timer state is one u64 word per buffered sample (slot<<32 |
+orderable-f32(value)) — the packed32 drain representation extended to
+ingest, so ingest is ONE scatter (append) and drain sorts the words
+directly.  Moments are recovered at drain from the sorted buffer via
+the same segmented scan (values carry f32 precision, within the
+established packed32 1e-6 bound; counts are exact).
+
+Everything here is jit-pure: the layout choice (M3_ARENA_LAYOUT) is
+resolved on the host in arena.py and selects these ops at arena
+construction — nothing reads the environment under a tracer.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from m3_tpu.aggregator.arena import (
+    I64_MAX,
+    I64_MIN,
+    SCALAR_LANES,
+    _ScalarLanesMixin,
+    _TimerLanesMixin,
+    _sanitize_slots,
+    _stdev,
+    decode_orderable_f32,
+    orderable_f32,
+    pad_slots,
+    timer_append_plan,
+)
+
+# Default adaptive-width lane split for the counter base word
+# (count:16 | sum:24 | sq:24) and the int16 min/max word.  Tests pass
+# narrower widths to exercise promotion; widths are STATIC jit args.
+# (count:16 | sum:48) in one u64 word; sum_sq keeps a dedicated i64
+# column — squares grow with value^2 and would saturate any packed
+# lane in minutes of real traffic (the round-8 bench caught exactly
+# that with a 24-bit sq lane), and a full i64 sq column keeps packed
+# counter moments BIT-exact vs the scatter path (mod-2^64 wrap
+# included) instead of merely within 1e-6.
+DEFAULT_WIDTHS = (16, 48)
+# Bounded promotion fan-out per ingest batch: more than K promotions or
+# pool-active slots in one batch sets the sticky `err` lane (the host
+# wrapper raises at the next consume).  K scatters are ~micro-seconds.
+PROMOTE_K = 4096
+# int16-exact min/max range of the base minmax word.
+_MM_LO = -(1 << 15)
+_MM_HI = (1 << 15) - 1
+
+_ERR_PROMOTE_K = 1  # more than K promotions/active pool slots in a batch
+_ERR_POOL_FULL = 2  # overflow pool exhausted
+# timer sample-buffer overflow: OWNED here so the err-bit namespace has
+# one home, but only RAISED by the sharded step's lanes["err"] (the
+# host PackedTimerArena grows its buffer and cannot overflow)
+_ERR_TIMER_OVERFLOW = 4
+
+
+# ---------------------------------------------------------------------------
+# Shared sort/segment machinery
+# ---------------------------------------------------------------------------
+
+
+class _Segments(NamedTuple):
+    """One sorted batch view: permutation + dense per-slot boundaries."""
+
+    perm: jnp.ndarray   # i32 (N,) original position of sorted element
+    sslot: jnp.ndarray  # i32 (N,) flat (window*C+slot) index, ascending
+    head: jnp.ndarray   # bool (N,) first element of its segment
+    start: jnp.ndarray  # i32 (WC,) first sorted position per dense slot
+    end: jnp.ndarray    # i32 (WC,) one past last sorted position
+    cnt: jnp.ndarray    # i64 (WC,) segment length (0 for empty slots)
+    has: jnp.ndarray    # bool (WC,)
+    ab: int             # arrival bits (static)
+
+
+def _arrival_bits(n: int) -> int:
+    return max(1, (max(n - 1, 1)).bit_length())
+
+
+def packed_flat_index(windows, slots, num_windows: int, capacity: int):
+    """Flat index for the packed ingest ops, with a slot-only GHOST
+    region: [0, W*C) carries stats; [W*C, W*C+C) holds samples whose
+    slot is valid but whose window dropped — they contribute only the
+    per-slot ``last_at`` expiry time, mirroring the scatter arenas
+    (whose last_at scatter-max is gated on the slot alone); W*C+C is
+    the full drop sentinel."""
+    valid_s = (slots >= 0) & (slots < capacity)
+    valid_w = (windows >= 0) & (windows < num_windows)
+    wc = num_windows * capacity
+    base = windows.astype(jnp.int64) * capacity + slots
+    return jnp.where(
+        valid_w & valid_s, base,
+        jnp.where(valid_s, wc + slots.astype(jnp.int64),
+                  jnp.int64(wc + capacity)))
+
+
+def _segment_view(idx: jnp.ndarray, n_flat: int) -> _Segments:
+    """Sort a batch of flat indices into dense per-slot segments.
+
+    ``idx`` values == n_flat are the drop sentinel: they sort to the
+    tail and fall outside every dense slot's [start, end) range."""
+    n = idx.shape[0]
+    ab = _arrival_bits(n)
+    if (n_flat + 1).bit_length() + ab > 63:
+        raise ValueError(
+            f"arena of {n_flat} flat slots with batches of {n} needs "
+            f"{(n_flat + 1).bit_length() + ab} key bits > 63; shrink the "
+            "batch or the arena")
+    key = (idx.astype(jnp.uint64) << jnp.uint64(ab)) | jnp.arange(
+        n, dtype=jnp.uint64)
+    ks = jax.lax.sort(key)
+    perm = (ks & jnp.uint64((1 << ab) - 1)).astype(jnp.int32)
+    sslot = (ks >> jnp.uint64(ab)).astype(jnp.int32)
+    head = jnp.concatenate(
+        [jnp.ones(1, bool), sslot[1:] != sslot[:-1]])
+    # Dense boundaries: one monotone scatter-min marks each slot's first
+    # sorted position; a reverse cummin over the NEXT slots' starts
+    # yields the ends (empty slots collapse to start > end -> cnt 0).
+    bpos = jnp.full(n_flat + 1, n, jnp.int32).at[sslot].min(
+        jnp.arange(n, dtype=jnp.int32), mode="drop",
+        indices_are_sorted=True)
+    start = bpos[:n_flat]
+    end = jax.lax.cummin(bpos[1:], reverse=True)
+    cnt = jnp.maximum(end - start, 0).astype(jnp.int64)
+    return _Segments(perm, sslot, head, start, end, cnt, cnt > 0, ab)
+
+
+def _seg_sum_i64(seg: _Segments, v_sorted: jnp.ndarray) -> jnp.ndarray:
+    """Exact (mod-2^64-wrapping) per-slot sums via cumsum differences —
+    identical arithmetic to the scatter path's i64 accumulate."""
+    cs = jnp.concatenate([jnp.zeros(1, jnp.int64), jnp.cumsum(v_sorted)])
+    return jnp.where(seg.has, cs[seg.end] - cs[seg.start], jnp.int64(0))
+
+
+def _seg_flag_counts(seg: _Segments, flags: tuple) -> tuple:
+    """Per-slot counts for up to three boolean lanes, packed into ONE
+    i64 cumsum.  Each lane gets ``seg.ab + 1`` bits: a whole batch can
+    land in ONE segment, so a count reaches n == 2^ab exactly at
+    power-of-two batch sizes — ab bits alone would carry into the next
+    lane.  Falls back to one cumsum per lane when the lanes don't fit
+    63 bits."""
+    lb = seg.ab + 1
+    k = len(flags)
+    if k * lb <= 63:
+        word = flags[0].astype(jnp.int64)
+        for i, f in enumerate(flags[1:], start=1):
+            word = word + (f.astype(jnp.int64) << jnp.int64(i * lb))
+        cs = jnp.concatenate([jnp.zeros(1, jnp.int64), jnp.cumsum(word)])
+        d = jnp.where(seg.has, cs[seg.end] - cs[seg.start], jnp.int64(0))
+        out = []
+        for i in range(k):
+            lane = (d >> jnp.int64(i * lb))
+            if i < k - 1:
+                lane = lane & jnp.int64((1 << lb) - 1)
+            out.append(lane)
+        return tuple(out)
+    return tuple(_seg_sum_i64(seg, f.astype(jnp.int64)) for f in flags)
+
+
+def _seg_scan(seg: _Segments, lanes: tuple, combine) -> tuple:
+    """Segmented associative scan over the sorted batch: ``combine``
+    merges two within-segment prefixes; segment heads reset the carry.
+    Returns the RAW scanned lanes — gather per-slot reductions at each
+    segment's end-1 with ``_at_ends`` (over whichever dense view the
+    caller needs, so stats gathers stay on the [0, W*C) region while
+    the time lane also covers the ghost region)."""
+    def op(a, b):
+        fa, va = a[0], a[1:]
+        fb, vb = b[0], b[1:]
+        merged = combine(va, vb)
+        out = tuple(jnp.where(fb, nb, m) for nb, m in zip(vb, merged))
+        return (fa | fb,) + out
+
+    res = jax.lax.associative_scan(op, (seg.head,) + lanes)
+    return res[1:]
+
+
+def _at_ends(end: jnp.ndarray, lane: jnp.ndarray) -> jnp.ndarray:
+    """Per-slot scan reduction: the scanned lane at each segment's
+    last element (callers mask empty slots via their ``has``)."""
+    lp = jnp.clip(end.astype(jnp.int64) - 1, 0, lane.shape[0] - 1)
+    return lane[lp]
+
+
+def _stats_view(seg: _Segments, wc: int) -> _Segments:
+    """The [0, W*C) stats region of a ghost-extended segment view."""
+    return seg._replace(start=seg.start[:wc], end=seg.end[:wc],
+                        cnt=seg.cnt[:wc], has=seg.has[:wc])
+
+
+# ---------------------------------------------------------------------------
+# Packed counter arena (SALSA/Counter-Pools layout).  The orderable-f32
+# word encoding is shared with the timer drain's packed32 form and lives
+# in arena.py (one home; imported above).
+# ---------------------------------------------------------------------------
+
+
+class PackedCounterState(NamedTuple):
+    base: jnp.ndarray      # u64 (W*C,) count | sum (biased) lanes
+    sq: jnp.ndarray        # i64 (W*C,) sum of squares (wraps mod 2^64)
+    minmax: jnp.ndarray    # u32 (W*C,) o16(min)<<16 | o16(max)
+    pool_cnt: jnp.ndarray  # i64 (P,)
+    pool_sum: jnp.ndarray  # i64 (P,)
+    pool_sq: jnp.ndarray   # i64 (P,)
+    pool_min: jnp.ndarray  # i64 (P,)
+    pool_max: jnp.ndarray  # i64 (P,)
+    pool_owner: jnp.ndarray  # i32 (P,) flat owner idx, -1 free
+    pool_idx: jnp.ndarray  # i32 (W*C,) pool row, -1 unpromoted
+    pool_n: jnp.ndarray    # i32 () live pool rows (derived from
+    #                        pool_owner at every producer; carried for
+    #                        cheap host observability — allocation
+    #                        itself is the free-row scan in
+    #                        _counter_merge, NOT a bump pointer)
+    err: jnp.ndarray       # i32 () sticky error bits
+    last_at: jnp.ndarray   # i64 (C,)
+
+
+def _neutral_base(widths: tuple) -> int:
+    cb, sb = widths
+    return 1 << (sb - 1)  # cnt 0, sum at bias (python int: trace-safe)
+
+
+_MM_NEUTRAL = np.uint32(0xFFFF0000)  # min lane 0xFFFF (+32767), max 0
+
+
+def _unpack_base(base: jnp.ndarray, widths: tuple):
+    cb, sb = widths
+    cnt = (base >> jnp.uint64(sb)).astype(jnp.int64)
+    s = (base & jnp.uint64((1 << sb) - 1)).astype(
+        jnp.int64) - jnp.int64(1 << (sb - 1))
+    return cnt, s
+
+
+def _pack_base(cnt, s, widths: tuple) -> jnp.ndarray:
+    cb, sb = widths
+    return ((cnt.astype(jnp.uint64) << jnp.uint64(sb))
+            | (s + jnp.int64(1 << (sb - 1))).astype(jnp.uint64))
+
+
+def _unpack_minmax(mm: jnp.ndarray):
+    mn = (mm >> jnp.uint32(16)).astype(jnp.int64) - jnp.int64(1 << 15)
+    mx = (mm & jnp.uint32(0xFFFF)).astype(jnp.int64) - jnp.int64(1 << 15)
+    return mn, mx
+
+
+def _pack_minmax(mn, mx) -> jnp.ndarray:
+    bias = jnp.int64(1 << 15)
+    return (((mn + bias).astype(jnp.uint32) << jnp.uint32(16))
+            | (mx + bias).astype(jnp.uint32))
+
+
+def counter_init(num_windows: int, capacity: int,
+                 pool_capacity: int | None = None,
+                 widths: tuple = DEFAULT_WIDTHS) -> PackedCounterState:
+    n = num_windows * capacity
+    P = pool_capacity if pool_capacity is not None else max(64, n // 16)
+    return PackedCounterState(
+        base=jnp.full(n, _neutral_base(widths), jnp.uint64),
+        sq=jnp.zeros(n, jnp.int64),
+        minmax=jnp.full(n, _MM_NEUTRAL, jnp.uint32),
+        pool_cnt=jnp.zeros(P, jnp.int64),
+        pool_sum=jnp.zeros(P, jnp.int64),
+        pool_sq=jnp.zeros(P, jnp.int64),
+        pool_min=jnp.full(P, I64_MAX, jnp.int64),
+        pool_max=jnp.full(P, I64_MIN, jnp.int64),
+        pool_owner=jnp.full(P, -1, jnp.int32),
+        pool_idx=jnp.full(n, -1, jnp.int32),
+        pool_n=jnp.int32(0),
+        err=jnp.int32(0),
+        last_at=jnp.zeros(capacity, jnp.int64),
+    )
+
+
+def _merge_last_at(last_at, d_tmax, num_windows: int, capacity: int):
+    """Fold per-flat-slot batch max-times (including the ghost region's
+    window-dropped samples) into the per-slot expiry column."""
+    return jnp.maximum(
+        last_at,
+        jnp.max(d_tmax.reshape(num_windows + 1, capacity), axis=0))
+
+
+def _counter_sums(seg: _Segments, v: jnp.ndarray):
+    """(d_sum, d_sq, wide flags) for a sorted counter value column."""
+    d_sum = _seg_sum_i64(seg, v)
+    d_sq = _seg_sum_i64(seg, v * v)
+    wide = (v < jnp.int64(_MM_LO)) | (v > jnp.int64(_MM_HI))
+    return d_sum, d_sq, wide
+
+
+def _counter_batch_segments(sview: _Segments, seg: _Segments,
+                            values: jnp.ndarray, times: jnp.ndarray):
+    """Per-dense-slot batch aggregates for a counter-style i64 batch:
+    stats over the (W*C,) region, max-time over the full ghost-extended
+    domain (the last_at column)."""
+    v = values[seg.perm]
+    t = times[seg.perm]
+    d_sum, d_sq, wide = _counter_sums(sview, v)
+    (d_wide,) = _seg_flag_counts(sview, (wide,))
+    s_min, s_max, s_t = _seg_scan(
+        seg, (v, v, t),
+        lambda a, b: (jnp.minimum(a[0], b[0]), jnp.maximum(a[1], b[1]),
+                      jnp.maximum(a[2], b[2])))
+    d_min = jnp.where(sview.has, _at_ends(sview.end, s_min), I64_MAX)
+    d_max = jnp.where(sview.has, _at_ends(sview.end, s_max), I64_MIN)
+    d_tmax = jnp.where(seg.has, _at_ends(seg.end, s_t), I64_MIN)
+    return (sview.cnt, d_sum, d_sq, d_min, d_max, d_wide), d_tmax
+
+
+def _counter_merge(state: PackedCounterState, segs, last_at,
+                   num_windows: int, capacity: int, widths: tuple,
+                   promote_k: int):
+    """Dense merge of batch aggregates into the packed counter state,
+    with bounded-K overflow-pool promotion."""
+    cb, sb = widths
+    d_cnt, d_sum, d_sq, d_min, d_max, d_wide = segs
+    wc = num_windows * capacity
+    K = min(promote_k, wc)
+    P = state.pool_cnt.shape[0]
+
+    b_cnt, b_sum = _unpack_base(state.base, widths)
+    b_min, b_max = _unpack_minmax(state.minmax)
+    # a slot with no base samples holds the int16 NEUTRAL sentinels
+    # (32767/-32768) — mask them to the true identities before merging,
+    # or a virgin slot promoting on an all-wide first batch would seed
+    # its pool row with the sentinel as an "observed" min/max
+    b_min = jnp.where(b_cnt > 0, b_min, I64_MAX)
+    b_max = jnp.where(b_cnt > 0, b_max, I64_MIN)
+    n_cnt = b_cnt + d_cnt
+    n_sum = b_sum + d_sum
+    n_sq = state.sq + d_sq  # full-width column: never a promote trigger
+    n_min = jnp.minimum(b_min, d_min)
+    n_max = jnp.maximum(b_max, d_max)
+
+    promoted = state.pool_idx >= 0
+    lane_over = ((n_cnt >= jnp.int64(1 << cb))
+                 | (n_sum >= jnp.int64(1 << (sb - 1)))
+                 | (n_sum < jnp.int64(-(1 << (sb - 1))))
+                 | (d_wide > 0))
+    seg_has = d_cnt > 0
+    to_pool = seg_has & ~promoted & lane_over
+    active = seg_has & promoted
+
+    def with_pool(op):
+        (pool_cnt, pool_sum, pool_sq, pool_min, pool_max, pool_owner,
+         pool_idx, pool_n, err) = op
+        num_new = to_pool.sum().astype(jnp.int32)
+        kn = jnp.nonzero(to_pool, size=K, fill_value=wc)[0]
+        valid = jnp.arange(K, dtype=jnp.int32) < num_new
+        # Allocate from FREE rows (owner < 0): the scan over P reuses
+        # rows released by clear_slots, so slot churn cannot
+        # permanently exhaust the pool the way a bump pointer did.  A
+        # candidate with no free row left keeps pool_idx == -1 (its
+        # base lanes clip — flagged by err, but never aliased onto
+        # another slot's pool row).
+        free = jnp.nonzero(pool_owner < 0, size=K,
+                           fill_value=P)[0].astype(jnp.int32)
+        room = free < P
+        take = valid & room
+        pids = jnp.where(take, free, jnp.int32(P))
+        pool_idx = pool_idx.at[kn].set(
+            jnp.where(take, pids, jnp.int32(-1)), mode="drop")
+        pool_owner = pool_owner.at[pids].set(kn.astype(jnp.int32),
+                                             mode="drop")
+        kc = jnp.clip(kn, 0, wc - 1)
+        pool_cnt = pool_cnt.at[pids].set(n_cnt[kc], mode="drop")
+        pool_sum = pool_sum.at[pids].set(n_sum[kc], mode="drop")
+        pool_sq = pool_sq.at[pids].set(n_sq[kc], mode="drop")
+        pool_min = pool_min.at[pids].set(n_min[kc], mode="drop")
+        pool_max = pool_max.at[pids].set(n_max[kc], mode="drop")
+        # already-promoted slots with batch data: add deltas to rows
+        num_act = active.sum().astype(jnp.int32)
+        ka = jnp.nonzero(active, size=K, fill_value=wc)[0]
+        kac = jnp.clip(ka, 0, wc - 1)
+        pid_a = jnp.where(ka < wc, pool_idx[kac], jnp.int32(P))
+        pool_cnt = pool_cnt.at[pid_a].add(d_cnt[kac], mode="drop")
+        pool_sum = pool_sum.at[pid_a].add(d_sum[kac], mode="drop")
+        pool_sq = pool_sq.at[pid_a].add(d_sq[kac], mode="drop")
+        pool_min = pool_min.at[pid_a].min(d_min[kac], mode="drop")
+        pool_max = pool_max.at[pid_a].max(d_max[kac], mode="drop")
+        err = err | jnp.where(num_new > K, _ERR_PROMOTE_K, 0)
+        err = err | jnp.where(num_act > K, _ERR_PROMOTE_K, 0)
+        err = err | jnp.where((valid & ~room).any(), _ERR_POOL_FULL, 0)
+        pool_n = (pool_owner >= 0).sum().astype(jnp.int32)
+        return (pool_cnt, pool_sum, pool_sq, pool_min, pool_max,
+                pool_owner, pool_idx, pool_n, err.astype(jnp.int32))
+
+    pool_ops = (state.pool_cnt, state.pool_sum, state.pool_sq,
+                state.pool_min, state.pool_max, state.pool_owner,
+                state.pool_idx, state.pool_n, state.err)
+    (pool_cnt, pool_sum, pool_sq, pool_min, pool_max, pool_owner,
+     pool_idx, pool_n, err) = jax.lax.cond(
+        to_pool.any() | active.any(), with_pool, lambda op: op, pool_ops)
+
+    in_pool = pool_idx >= 0
+    # pooled slots keep a neutral base word; the rest repack
+    base = jnp.where(
+        in_pool, jnp.uint64(_neutral_base(widths)),
+        _pack_base(jnp.clip(n_cnt, 0, (1 << cb) - 1),
+                   jnp.clip(n_sum, -(1 << (sb - 1)), (1 << (sb - 1)) - 1),
+                   widths))
+    minmax = jnp.where(
+        in_pool, jnp.uint32(_MM_NEUTRAL),
+        _pack_minmax(jnp.clip(n_min, _MM_LO, _MM_HI),
+                     jnp.clip(n_max, _MM_LO, _MM_HI)))
+
+    return PackedCounterState(
+        base=base, sq=jnp.where(in_pool, jnp.int64(0), n_sq),
+        minmax=minmax,
+        pool_cnt=pool_cnt, pool_sum=pool_sum, pool_sq=pool_sq,
+        pool_min=pool_min, pool_max=pool_max, pool_owner=pool_owner,
+        pool_idx=pool_idx, pool_n=pool_n, err=err, last_at=last_at)
+
+
+@functools.partial(
+    jax.jit, donate_argnums=0,
+    static_argnames=("num_windows", "capacity", "widths", "promote_k"))
+def counter_ingest(
+    state: PackedCounterState,
+    idx: jnp.ndarray,     # i64 (N,) flat window*C+slot; == W*C drops
+    values: jnp.ndarray,  # i64 (N,)
+    times: jnp.ndarray,   # i64 (N,)
+    num_windows: int,
+    capacity: int,
+    widths: tuple = DEFAULT_WIDTHS,
+    promote_k: int = PROMOTE_K,
+) -> PackedCounterState:
+    wc = num_windows * capacity
+    seg = _segment_view(idx, wc + capacity)
+    d, d_tmax = _counter_batch_segments(_stats_view(seg, wc), seg,
+                                        values, times)
+    last_at = _merge_last_at(state.last_at, d_tmax, num_windows, capacity)
+    return _counter_merge(state, d, last_at, num_windows, capacity,
+                          widths, promote_k)
+
+
+def _counter_lanes(state: PackedCounterState, widths: tuple):
+    """Dense (W*C,) full-precision stat lanes merging base and pool."""
+    b_cnt, b_sum = _unpack_base(state.base, widths)
+    b_min, b_max = _unpack_minmax(state.minmax)
+    in_pool = state.pool_idx >= 0
+    P = state.pool_cnt.shape[0]
+    pidx = jnp.clip(state.pool_idx, 0, P - 1)
+    cnt = jnp.where(in_pool, state.pool_cnt[pidx], b_cnt)
+    s = jnp.where(in_pool, state.pool_sum[pidx], b_sum)
+    sq = jnp.where(in_pool, state.pool_sq[pidx], state.sq)
+    mn = jnp.where(in_pool, state.pool_min[pidx],
+                   jnp.where(b_cnt > 0, b_min, I64_MAX))
+    mx = jnp.where(in_pool, state.pool_max[pidx],
+                   jnp.where(b_cnt > 0, b_max, I64_MIN))
+    return cnt, s, sq, mn, mx
+
+
+@functools.partial(jax.jit, static_argnames=("capacity", "widths"))
+def counter_consume(state: PackedCounterState, window: jnp.ndarray,
+                    capacity: int, widths: tuple = DEFAULT_WIDTHS):
+    cnt_a, s_a, sq_a, mn_a, mx_a = _counter_lanes(state, widths)
+    off = window * capacity
+    sl = lambda a: jax.lax.dynamic_slice_in_dim(a, off, capacity)
+    cnt = sl(cnt_a)
+    s = sl(s_a).astype(jnp.float64)
+    ssq = sl(sq_a).astype(jnp.float64)
+    cntf = cnt.astype(jnp.float64)
+    mean = jnp.where(cnt == 0, 0.0, s / jnp.where(cnt == 0, 1, cnt))
+    lanes = jnp.stack(
+        [
+            jnp.full(capacity, jnp.nan, jnp.float64),  # LAST
+            jnp.where(cnt == 0, 0.0, sl(mn_a).astype(jnp.float64)),
+            jnp.where(cnt == 0, 0.0, sl(mx_a).astype(jnp.float64)),
+            mean,
+            cntf,
+            s,
+            ssq,
+            _stdev(cntf, ssq, s),
+        ],
+        axis=1,
+    )
+    return lanes, cnt
+
+
+@functools.partial(
+    jax.jit, donate_argnums=0,
+    static_argnames=("num_windows", "capacity", "widths"))
+def counter_reset_window(state: PackedCounterState, window: jnp.ndarray,
+                         num_windows: int, capacity: int,
+                         widths: tuple = DEFAULT_WIDTHS
+                         ) -> PackedCounterState:
+    off = window * capacity
+    upd = lambda a, v: jax.lax.dynamic_update_slice_in_dim(
+        a, jnp.full(capacity, v, a.dtype), off, 0)
+    # pool rows owned by this window reset densely over P (no scatter)
+    own_w = jnp.where(state.pool_owner >= 0,
+                      state.pool_owner // capacity, -1)
+    hit = own_w == window.astype(jnp.int32)
+    return state._replace(
+        base=upd(state.base, _neutral_base(widths)),
+        sq=upd(state.sq, 0),
+        minmax=upd(state.minmax, _MM_NEUTRAL),
+        pool_cnt=jnp.where(hit, jnp.int64(0), state.pool_cnt),
+        pool_sum=jnp.where(hit, jnp.int64(0), state.pool_sum),
+        pool_sq=jnp.where(hit, jnp.int64(0), state.pool_sq),
+        pool_min=jnp.where(hit, I64_MAX, state.pool_min),
+        pool_max=jnp.where(hit, I64_MIN, state.pool_max),
+    )
+
+
+@functools.partial(
+    jax.jit, donate_argnums=0,
+    static_argnames=("num_windows", "capacity", "widths"))
+def counter_clear_slots(state: PackedCounterState, slots: jnp.ndarray,
+                        num_windows: int, capacity: int,
+                        widths: tuple = DEFAULT_WIDTHS
+                        ) -> PackedCounterState:
+    idx = (jnp.arange(num_windows, dtype=jnp.int64)[:, None] * capacity
+           + slots[None, :]).ravel()
+    idx = jnp.where(
+        (slots[None, :] >= capacity).repeat(num_windows, 0).ravel(),
+        num_windows * capacity, idx)
+    # pool rows whose owner slot is cleared are RELEASED (owner -1)
+    # via a sorted membership probe — the free-list allocator in
+    # _counter_merge reuses them, so recycling slots can't leak the
+    # pool dry (slots is small and host-sorted by pad_slots' caller;
+    # sort again defensively)
+    sorted_slots = jnp.sort(slots.astype(jnp.int32))
+    own_slot = jnp.where(state.pool_owner >= 0,
+                         state.pool_owner % capacity, -1)
+    pos = jnp.clip(jnp.searchsorted(sorted_slots, own_slot), 0,
+                   sorted_slots.shape[0] - 1)
+    hit = (sorted_slots[pos] == own_slot) & (state.pool_owner >= 0)
+    pool_owner = jnp.where(hit, jnp.int32(-1), state.pool_owner)
+    return state._replace(
+        base=state.base.at[idx].set(_neutral_base(widths), mode="drop"),
+        sq=state.sq.at[idx].set(0, mode="drop"),
+        minmax=state.minmax.at[idx].set(_MM_NEUTRAL, mode="drop"),
+        pool_cnt=jnp.where(hit, jnp.int64(0), state.pool_cnt),
+        pool_sum=jnp.where(hit, jnp.int64(0), state.pool_sum),
+        pool_sq=jnp.where(hit, jnp.int64(0), state.pool_sq),
+        pool_min=jnp.where(hit, I64_MAX, state.pool_min),
+        pool_max=jnp.where(hit, I64_MIN, state.pool_max),
+        pool_owner=pool_owner,
+        pool_idx=state.pool_idx.at[idx].set(-1, mode="drop"),
+        pool_n=(pool_owner >= 0).sum().astype(jnp.int32),
+        last_at=state.last_at.at[slots].set(0, mode="drop"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Packed gauge arena (sort-formulation ingest; f64 lanes stay bit-exact
+# for count/min/max/last, fixed-point batch sums for sum/sum_sq)
+# ---------------------------------------------------------------------------
+
+
+class PackedGaugeState(NamedTuple):
+    sum: jnp.ndarray        # f64 (W*C,)
+    sum_sq: jnp.ndarray     # f64
+    count: jnp.ndarray      # i64
+    min: jnp.ndarray        # f64, identity +inf
+    max: jnp.ndarray        # f64, identity -inf
+    last_bits: jnp.ndarray  # i64 (W*C,) f64 bit pattern of `last`
+    last_time: jnp.ndarray  # i64
+    last_at: jnp.ndarray    # i64 (C,)
+
+
+def gauge_init(num_windows: int, capacity: int) -> PackedGaugeState:
+    n = num_windows * capacity
+    return PackedGaugeState(
+        sum=jnp.zeros(n, jnp.float64),
+        sum_sq=jnp.zeros(n, jnp.float64),
+        count=jnp.zeros(n, jnp.int64),
+        min=jnp.full(n, jnp.inf, jnp.float64),
+        max=jnp.full(n, -jnp.inf, jnp.float64),
+        last_bits=jnp.zeros(n, jnp.int64),
+        last_time=jnp.zeros(n, jnp.int64),
+        last_at=jnp.zeros(capacity, jnp.int64),
+    )
+
+
+def _gauge_scan_lanes(v: jnp.ndarray, t: jnp.ndarray):
+    """Scan input lanes for a gauge value column: (sum, sum_sq, min,
+    max, tmax, last-bits).  Sum lanes exclude NaN (count still carries
+    it) but pass +/-inf through — tree-order f64 addition reproduces
+    the scatter path's inf/NaN semantics natively and keeps the
+    within-segment rounding at ~log2(N) ulps of the segment's own
+    magnitude (no cross-segment prefix cancellation)."""
+    nan = jnp.isnan(v)
+    safe = jnp.where(nan, 0.0, v)
+    return (safe, safe * safe, jnp.where(nan, jnp.inf, v),
+            jnp.where(nan, -jnp.inf, v), t, v.view(jnp.int64))
+
+
+def _gauge_scan_combine(a, b):
+    """(sum, sum_sq, min, max, tmax, last-bits) segmented combine; last
+    is the value of the strictly-greatest time (sorted ties = first
+    arrival wins)."""
+    return (
+        a[0] + b[0],
+        a[1] + b[1],
+        jnp.minimum(a[2], b[2]),
+        jnp.maximum(a[3], b[3]),
+        jnp.maximum(a[4], b[4]),
+        jnp.where(b[4] > a[4], b[5], a[5]),
+    )
+
+
+def _gauge_gather(sview: _Segments, seg: _Segments, scanned: tuple):
+    """Per-slot gauge aggregates from the raw scanned lanes."""
+    s_sum, s_sq, s_min, s_max, s_t, s_lastb = scanned
+    d_sum = jnp.where(sview.has, _at_ends(sview.end, s_sum), 0.0)
+    d_sq = jnp.where(sview.has, _at_ends(sview.end, s_sq), 0.0)
+    d_min = jnp.where(sview.has, _at_ends(sview.end, s_min), jnp.inf)
+    d_max = jnp.where(sview.has, _at_ends(sview.end, s_max), -jnp.inf)
+    d_t = jnp.where(sview.has, _at_ends(sview.end, s_t), I64_MIN)
+    d_lastb = _at_ends(sview.end, s_lastb)
+    d_tmax = jnp.where(seg.has, _at_ends(seg.end, s_t), I64_MIN)
+    return (sview.cnt, d_sum, d_sq, d_min, d_max, d_t, d_lastb), d_tmax
+
+
+def _gauge_batch_segments(sview: _Segments, seg: _Segments,
+                          values: jnp.ndarray, times: jnp.ndarray):
+    v = values[seg.perm]
+    t = times[seg.perm]
+    scanned = _seg_scan(seg, _gauge_scan_lanes(v, t),
+                        _gauge_scan_combine)
+    return _gauge_gather(sview, seg, scanned)
+
+
+def _gauge_merge(state: PackedGaugeState, segs, last_at,
+                 num_windows: int, capacity: int) -> PackedGaugeState:
+    d_cnt, d_sum, d_sq, d_min, d_max, d_t, d_lastb = segs
+    has = d_cnt > 0
+    replace = has & (d_t > state.last_time)
+    return PackedGaugeState(
+        sum=jnp.where(has, state.sum + d_sum, state.sum),
+        sum_sq=jnp.where(has, state.sum_sq + d_sq, state.sum_sq),
+        count=state.count + d_cnt,
+        min=jnp.minimum(state.min, d_min),
+        max=jnp.maximum(state.max, d_max),
+        last_bits=jnp.where(replace, d_lastb, state.last_bits),
+        last_time=jnp.where(replace, d_t, state.last_time),
+        last_at=last_at,
+    )
+
+
+@functools.partial(
+    jax.jit, donate_argnums=0,
+    static_argnames=("num_windows", "capacity"))
+def gauge_ingest(
+    state: PackedGaugeState,
+    idx: jnp.ndarray,     # i64 (N,) flat; == W*C drops
+    values: jnp.ndarray,  # f64 (N,)
+    times: jnp.ndarray,   # i64 (N,)
+    num_windows: int,
+    capacity: int,
+) -> PackedGaugeState:
+    wc = num_windows * capacity
+    seg = _segment_view(idx, wc + capacity)
+    d, d_tmax = _gauge_batch_segments(_stats_view(seg, wc), seg,
+                                      values, times)
+    last_at = _merge_last_at(state.last_at, d_tmax, num_windows, capacity)
+    return _gauge_merge(state, d, last_at, num_windows, capacity)
+
+
+@functools.partial(jax.jit, static_argnames=("capacity",))
+def gauge_consume(state: PackedGaugeState, window: jnp.ndarray,
+                  capacity: int):
+    off = window * capacity
+    sl = lambda a: jax.lax.dynamic_slice_in_dim(a, off, capacity)
+    s, ssq, cnt = sl(state.sum), sl(state.sum_sq), sl(state.count)
+    cntf = cnt.astype(jnp.float64)
+    mx, mn = sl(state.max), sl(state.min)
+    mean = jnp.where(cnt == 0, 0.0, s / jnp.where(cnt == 0, 1, cnt))
+    lanes = jnp.stack(
+        [
+            sl(state.last_bits).view(jnp.float64),
+            jnp.where(jnp.isinf(mn), jnp.nan, mn),
+            jnp.where(jnp.isinf(mx), jnp.nan, mx),
+            mean,
+            cntf,
+            s,
+            ssq,
+            _stdev(cntf, ssq, s),
+        ],
+        axis=1,
+    )
+    return lanes, cnt
+
+
+@functools.partial(jax.jit, donate_argnums=0, static_argnames=("capacity",))
+def gauge_reset_window(state: PackedGaugeState, window: jnp.ndarray,
+                       capacity: int) -> PackedGaugeState:
+    off = window * capacity
+    upd = lambda a, v: jax.lax.dynamic_update_slice_in_dim(
+        a, jnp.full(capacity, v, a.dtype), off, 0)
+    return state._replace(
+        sum=upd(state.sum, 0.0),
+        sum_sq=upd(state.sum_sq, 0.0),
+        count=upd(state.count, 0),
+        min=upd(state.min, jnp.inf),
+        max=upd(state.max, -jnp.inf),
+        last_bits=upd(state.last_bits, 0),
+        last_time=upd(state.last_time, 0),
+    )
+
+
+@functools.partial(
+    jax.jit, donate_argnums=0,
+    static_argnames=("num_windows", "capacity"))
+def gauge_clear_slots(state: PackedGaugeState, slots: jnp.ndarray,
+                      num_windows: int, capacity: int) -> PackedGaugeState:
+    idx = (jnp.arange(num_windows, dtype=jnp.int64)[:, None] * capacity
+           + slots[None, :]).ravel()
+    idx = jnp.where(
+        (slots[None, :] >= capacity).repeat(num_windows, 0).ravel(),
+        num_windows * capacity, idx)
+    return state._replace(
+        sum=state.sum.at[idx].set(0.0, mode="drop"),
+        sum_sq=state.sum_sq.at[idx].set(0.0, mode="drop"),
+        count=state.count.at[idx].set(0, mode="drop"),
+        min=state.min.at[idx].set(jnp.inf, mode="drop"),
+        max=state.max.at[idx].set(-jnp.inf, mode="drop"),
+        last_bits=state.last_bits.at[idx].set(0, mode="drop"),
+        last_time=state.last_time.at[idx].set(0, mode="drop"),
+        last_at=state.last_at.at[slots].set(0, mode="drop"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fused counter+gauge rollup ingest (one sort serves both arenas — the
+# sharded step / bench shape, where one routed batch feeds every type)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit, donate_argnums=(0, 1),
+    static_argnames=("num_windows", "capacity", "widths", "promote_k"))
+def rollup_ingest(
+    cstate: PackedCounterState,
+    gstate: PackedGaugeState,
+    idx: jnp.ndarray,      # i64 (N,) flat; == W*C drops
+    cvalues: jnp.ndarray,  # i64 (N,)
+    gvalues: jnp.ndarray,  # f64 (N,)
+    times: jnp.ndarray,    # i64 (N,)
+    num_windows: int,
+    capacity: int,
+    widths: tuple = DEFAULT_WIDTHS,
+    promote_k: int = PROMOTE_K,
+):
+    wc = num_windows * capacity
+    seg = _segment_view(idx, wc + capacity)
+    sview = _stats_view(seg, wc)
+    cv = cvalues[seg.perm]
+    gv = gvalues[seg.perm]
+    t = times[seg.perm]
+    c_sum, c_sq, wide = _counter_sums(sview, cv)
+    (d_wide,) = _seg_flag_counts(sview, (wide,))
+
+    # ONE scan serves both arenas: counter min/max lanes prepended to
+    # the gauge lane set (which shares the time column for last/last_at)
+    def combine(a, b):
+        return (jnp.minimum(a[0], b[0]), jnp.maximum(a[1], b[1])) \
+            + _gauge_scan_combine(a[2:], b[2:])
+
+    scanned = _seg_scan(seg, (cv, cv) + _gauge_scan_lanes(gv, t),
+                        combine)
+    c_min = jnp.where(sview.has, _at_ends(sview.end, scanned[0]),
+                      I64_MAX)
+    c_max = jnp.where(sview.has, _at_ends(sview.end, scanned[1]),
+                      I64_MIN)
+    gd, d_tmax = _gauge_gather(sview, seg, scanned[2:])
+
+    c_last = _merge_last_at(cstate.last_at, d_tmax, num_windows, capacity)
+    g_last = _merge_last_at(gstate.last_at, d_tmax, num_windows, capacity)
+    cd = (sview.cnt, c_sum, c_sq, c_min, c_max, d_wide)
+    return (_counter_merge(cstate, cd, c_last, num_windows, capacity,
+                           widths, promote_k),
+            _gauge_merge(gstate, gd, g_last, num_windows, capacity))
+
+
+# ---------------------------------------------------------------------------
+# Packed timer arena: u64 sample words, moments recovered at drain
+# ---------------------------------------------------------------------------
+
+
+class PackedTimerState(NamedTuple):
+    sample: jnp.ndarray    # u64 (W, S) slot<<32 | orderable_f32(value)
+    sample_n: jnp.ndarray  # i64 (W,) write offsets (> S = overflow)
+    last_at: jnp.ndarray   # i64 (C,)
+
+
+def _timer_empty_word(capacity: int) -> int:
+    """The empty-sentinel sample word: slot == capacity sorts past every
+    real slot (python int: safe under the tracer)."""
+    return capacity << 32
+
+
+def timer_init(num_windows: int, capacity: int,
+               sample_capacity: int) -> PackedTimerState:
+    empty = _timer_empty_word(capacity)
+    return PackedTimerState(
+        sample=jnp.full((num_windows, sample_capacity), empty, jnp.uint64),
+        sample_n=jnp.zeros(num_windows, jnp.int64),
+        last_at=jnp.zeros(capacity, jnp.int64),
+    )
+
+
+@functools.partial(jax.jit, donate_argnums=0, static_argnames=("capacity",))
+def timer_ingest(
+    state: PackedTimerState,
+    windows: jnp.ndarray,  # i32 (N,) ring index; OOB drops
+    slots: jnp.ndarray,    # i32 (N,)
+    values: jnp.ndarray,   # f64 (N,)
+    times: jnp.ndarray,    # i64 (N,)
+    capacity: int,
+) -> PackedTimerState:
+    """Append a batch as packed words — ONE scatter.  Moments are
+    recovered at drain from the sorted buffer, so the only other work
+    is the shared append plan (arena.timer_append_plan) and the
+    last_at expiry column."""
+    num_w, scap = state.sample.shape
+    _drop, flat, per_w_counts = timer_append_plan(
+        windows, slots, state.sample_n, capacity, scap)
+    word = (slots.astype(jnp.uint64) << jnp.uint64(32)) | orderable_f32(
+        values)
+    slot_safe = _sanitize_slots(slots, capacity)
+    return PackedTimerState(
+        sample=state.sample.ravel().at[flat].set(
+            word, mode="drop").reshape(num_w, scap),
+        sample_n=state.sample_n + per_w_counts,
+        last_at=state.last_at.at[slot_safe].max(times, mode="drop"),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("capacity", "quantiles"))
+def timer_consume(
+    state: PackedTimerState,
+    window: jnp.ndarray,
+    capacity: int,
+    quantiles: tuple,
+):
+    """Drain one window: sort the packed words (slot asc, value asc in
+    f32 order), then counts from boundaries, sum/sum_sq from an exact
+    fixed-point cumsum of the decoded values (f32 value precision — the
+    packed32 1e-6 envelope), min/max/quantiles from rank positions."""
+    num_w, scap = state.sample.shape
+    words = jax.lax.dynamic_index_in_dim(state.sample, window,
+                                         keepdims=False)
+    keys = jax.lax.sort(words)
+    s_slot = (keys >> jnp.uint64(32)).astype(jnp.int32)
+    s_val = decode_orderable_f32(keys & jnp.uint64(0xFFFFFFFF))
+
+    qs = jnp.arange(capacity, dtype=jnp.int32)
+    seg_start = jnp.searchsorted(s_slot, qs)
+    seg_end = jnp.searchsorted(s_slot, qs, side="right")
+    seg_n = (seg_end - seg_start).astype(jnp.int64)
+    empty = seg_n == 0
+
+    # Moments from a segmented scan over the sorted words: tree-order
+    # f64 adds keep rounding at ~log2(S) ulps of each segment's own
+    # magnitude, and real non-finite samples flow through with the f64
+    # semantics (inf sums, NaN poisons).  Empty-sentinel words decode
+    # to NaN and are masked out.
+    valid = s_slot < capacity
+    v = jnp.where(valid, s_val, 0.0)
+    head = jnp.concatenate(
+        [jnp.ones(1, bool), s_slot[1:] != s_slot[:-1]])
+
+    def op(a, b):
+        fa, sa, qa = a
+        fb, sb, qb = b
+        return (fa | fb, jnp.where(fb, sb, sa + sb),
+                jnp.where(fb, qb, qa + qb))
+
+    _, s_sums, s_sqs = jax.lax.associative_scan(
+        op, (head, v, v * v))
+    lp = jnp.clip(seg_end.astype(jnp.int64) - 1, 0, scap - 1)
+    s = jnp.where(empty, 0.0, s_sums[lp])
+    ssq = jnp.where(empty, 0.0, s_sqs[lp])
+    cntf = seg_n.astype(jnp.float64)
+    mean = jnp.where(empty, 0.0, s / jnp.where(empty, 1.0, cntf))
+
+    mn = jnp.where(empty, 0.0, s_val[jnp.clip(seg_start, 0, scap - 1)])
+    mx = jnp.where(empty, 0.0, s_val[jnp.clip(seg_end - 1, 0, scap - 1)])
+
+    qlanes = []
+    for q in quantiles:
+        ranks = jnp.ceil(q * cntf).astype(jnp.int64) - 1
+        ranks = jnp.clip(ranks, 0, jnp.maximum(seg_n - 1, 0))
+        qv = s_val[jnp.clip(seg_start + ranks, 0, scap - 1)]
+        qlanes.append(jnp.where(empty, 0.0, qv))
+
+    lanes = jnp.stack(
+        [
+            jnp.full(capacity, jnp.nan, jnp.float64),  # LAST
+            mn,
+            mx,
+            mean,
+            cntf,
+            s,
+            ssq,
+            _stdev(cntf, ssq, s),
+            *qlanes,
+        ],
+        axis=1,
+    )
+    return lanes, seg_n
+
+
+@functools.partial(jax.jit, donate_argnums=0, static_argnames=("capacity",))
+def timer_reset_window(state: PackedTimerState, window: jnp.ndarray,
+                       capacity: int) -> PackedTimerState:
+    num_w, scap = state.sample.shape
+    empty = _timer_empty_word(capacity)
+    return PackedTimerState(
+        sample=jax.lax.dynamic_update_slice(
+            state.sample,
+            jnp.full((1, scap), empty, jnp.uint64),
+            (window.astype(jnp.int32), jnp.int32(0)),
+        ),
+        sample_n=state.sample_n.at[window].set(0),
+        last_at=state.last_at,
+    )
+
+
+@functools.partial(
+    jax.jit, donate_argnums=0,
+    static_argnames=("num_windows", "capacity"))
+def timer_clear_slots(state: PackedTimerState, slots: jnp.ndarray,
+                      num_windows: int, capacity: int) -> PackedTimerState:
+    """Retarget cleared slots' buffered words to the empty sentinel so a
+    recycled slot's quantiles can't include the previous occupant."""
+    empty = jnp.uint64(_timer_empty_word(capacity))
+    sorted_slots = jnp.sort(slots.astype(jnp.int32))
+    flat = state.sample.ravel()
+    wslot = (flat >> jnp.uint64(32)).astype(jnp.int32)
+    pos = jnp.clip(jnp.searchsorted(sorted_slots, wslot), 0,
+                   sorted_slots.shape[0] - 1)
+    hit = sorted_slots[pos] == wslot
+    return PackedTimerState(
+        sample=jnp.where(hit, empty, flat).reshape(state.sample.shape),
+        sample_n=state.sample_n,
+        last_at=state.last_at.at[slots].set(0, mode="drop"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host wrappers (drop-in for arena.CounterArena / GaugeArena / TimerArena)
+# ---------------------------------------------------------------------------
+
+
+class PackedCounterArena(_ScalarLanesMixin):
+    """Packed counter slots: adaptive-width base + overflow pool."""
+
+    def __init__(self, num_windows: int, capacity: int,
+                 pool_capacity: int | None = None,
+                 widths: tuple = DEFAULT_WIDTHS,
+                 promote_k: int = PROMOTE_K):
+        self.num_windows = num_windows
+        self.capacity = capacity
+        self.widths = tuple(widths)
+        self.promote_k = promote_k
+        self.state = counter_init(num_windows, capacity, pool_capacity,
+                                  self.widths)
+
+    def _check_err(self):
+        err = int(self.state.err)
+        if err:
+            what = []
+            if err & _ERR_PROMOTE_K:
+                what.append(f"more than promote_k={self.promote_k} pool "
+                            "promotions/updates in one batch")
+            if err & _ERR_POOL_FULL:
+                what.append("overflow pool exhausted")
+            # Raise ONCE, then clear: the flag marks stats since the
+            # last check as unreliable; the window ring's drain+reset
+            # cycle washes the clipped rows out within W drains, so a
+            # transient burst must not wedge every later flush forever.
+            # A recurring condition re-sets the flag and raises again.
+            self.state = self.state._replace(err=jnp.int32(0))
+            raise RuntimeError(
+                "packed counter arena overflow-pool error: "
+                + "; ".join(what)
+                + " — grow pool_capacity/promote_k or use the f64 layout"
+                " (M3_ARENA_LAYOUT=f64); stats since the previous "
+                "consume are unreliable (flag cleared: the window ring "
+                "washes the damage out over the next drains)")
+
+    def ingest(self, windows, slots, values, times):
+        idx = packed_flat_index(jnp.asarray(windows), jnp.asarray(slots),
+                                self.num_windows, self.capacity)
+        self.state = counter_ingest(
+            self.state, idx, jnp.asarray(values).astype(jnp.int64),
+            jnp.asarray(times), self.num_windows, self.capacity,
+            self.widths, self.promote_k)
+
+    def consume(self, window: int):
+        self._check_err()
+        return counter_consume(self.state, jnp.int32(window),
+                               self.capacity, self.widths)
+
+    def reset_window(self, window: int):
+        self.state = counter_reset_window(
+            self.state, jnp.int32(window), self.num_windows,
+            self.capacity, self.widths)
+
+    def clear_slots(self, slots):
+        self.state = counter_clear_slots(
+            self.state,
+            jnp.asarray(pad_slots(np.asarray(slots), self.capacity)),
+            self.num_windows, self.capacity, self.widths)
+
+
+class PackedGaugeArena(_ScalarLanesMixin):
+    def __init__(self, num_windows: int, capacity: int):
+        self.num_windows = num_windows
+        self.capacity = capacity
+        self.state = gauge_init(num_windows, capacity)
+
+    def ingest(self, windows, slots, values, times):
+        idx = packed_flat_index(jnp.asarray(windows), jnp.asarray(slots),
+                                self.num_windows, self.capacity)
+        self.state = gauge_ingest(
+            self.state, idx, jnp.asarray(values).astype(jnp.float64),
+            jnp.asarray(times), self.num_windows, self.capacity)
+
+    def consume(self, window: int):
+        return gauge_consume(self.state, jnp.int32(window), self.capacity)
+
+    def reset_window(self, window: int):
+        self.state = gauge_reset_window(self.state, jnp.int32(window),
+                                        self.capacity)
+
+    def clear_slots(self, slots):
+        self.state = gauge_clear_slots(
+            self.state,
+            jnp.asarray(pad_slots(np.asarray(slots), self.capacity)),
+            self.num_windows, self.capacity)
+
+
+class PackedTimerArena(_TimerLanesMixin):
+    DEFAULT_QUANTILES = (0.5, 0.95, 0.99)
+
+    def __init__(self, num_windows: int, capacity: int,
+                 sample_capacity: int,
+                 quantiles: tuple = DEFAULT_QUANTILES):
+        self.num_windows = num_windows
+        self.capacity = capacity
+        self.sample_capacity = sample_capacity
+        self.quantiles = tuple(quantiles)
+        self.state = timer_init(num_windows, capacity, sample_capacity)
+        self._sample_n_host = np.zeros(num_windows, np.int64)
+
+    def ingest(self, windows, slots, values, times):
+        windows_np = np.asarray(windows)
+        slots_np = np.asarray(slots)
+        in_range = ((windows_np >= 0) & (windows_np < self.num_windows)
+                    & (slots_np >= 0) & (slots_np < self.capacity))
+        per_w = np.bincount(windows_np[in_range],
+                            minlength=self.num_windows)
+        self._sample_n_host += per_w
+        needed = int(self._sample_n_host.max())
+        if needed > self.sample_capacity:
+            self._grow(needed)
+        self.state = timer_ingest(
+            self.state, jnp.asarray(windows_np.astype(np.int32)),
+            jnp.asarray(slots_np.astype(np.int32)),
+            jnp.asarray(values).astype(jnp.float64),
+            jnp.asarray(times), self.capacity)
+
+    def _grow(self, needed: int) -> None:
+        new_cap = self.sample_capacity
+        while new_cap < needed:
+            new_cap *= 2
+        pad = new_cap - self.sample_capacity
+        empty = np.uint64(_timer_empty_word(self.capacity))
+        self.state = PackedTimerState(
+            sample=jnp.pad(self.state.sample, ((0, 0), (0, pad)),
+                           constant_values=empty),
+            sample_n=self.state.sample_n,
+            last_at=self.state.last_at,
+        )
+        self.sample_capacity = new_cap
+
+    def consume(self, window: int):
+        return timer_consume(self.state, jnp.int32(window),
+                             self.capacity, self.quantiles)
+
+    def reset_window(self, window: int):
+        self.state = timer_reset_window(self.state, jnp.int32(window),
+                                        self.capacity)
+        self._sample_n_host[window] = 0
+
+    def clear_slots(self, slots):
+        self.state = timer_clear_slots(
+            self.state,
+            jnp.asarray(pad_slots(np.asarray(slots), self.capacity)),
+            self.num_windows, self.capacity)

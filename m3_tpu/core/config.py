@@ -262,6 +262,12 @@ class CoordinatorConfig:
     # scatter | pallas | auto select explicitly (auto resolves scatter
     # on CPU, pallas on TPU — see aggregator/arena.py).
     arena_ingest: str = ""
+    # Aggregation-arena state layout for this process:
+    # "" = leave the global default (M3_ARENA_LAYOUT env / auto);
+    # packed | f64 | auto select explicitly (auto -> packed, the
+    # round-8 sort/segment formulation; f64 = the scatter-arena parity
+    # oracle — see aggregator/arena.py + aggregator/packed.py).
+    arena_layout: str = ""
 
     def validate(self, errs: list) -> None:
         if not (0 <= self.listen_port < 65536):
@@ -277,6 +283,13 @@ class CoordinatorConfig:
                 errs.append(
                     f"coordinator.arena_ingest: {self.arena_ingest!r} not "
                     f"one of {arena.INGEST_IMPLS}")
+        if self.arena_layout:
+            from m3_tpu.aggregator import arena
+
+            if self.arena_layout not in arena.LAYOUTS:
+                errs.append(
+                    f"coordinator.arena_layout: {self.arena_layout!r} not "
+                    f"one of {arena.LAYOUTS}")
 
 
 @dataclasses.dataclass

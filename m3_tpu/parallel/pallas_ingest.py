@@ -188,6 +188,102 @@ def pallas_segment_moments(slots: jnp.ndarray, values: jnp.ndarray,
     return s, c, sq
 
 
+def _minmax_kernel(slots_ref, values_ref, out_min_ref, out_max_ref):
+    """Min/max sibling of ``_ingest_kernel``: same (slot tile, batch
+    slab) grid and hit mask, min/max accumulate instead of sum.  Serves
+    the packed arena's min/max stage on TPU as the binned alternative
+    to its segmented associative scan (aggregator/packed.py) — same
+    two-pass structure as the moments form, so the flip decision can be
+    measured per backend with the existing bench machinery."""
+    base = pl.program_id(0) * TILE
+    j = pl.program_id(1)
+    lane_slots = base + jax.lax.broadcasted_iota(jnp.int32, (1, TILE), 1)
+    sl = slots_ref[0, :]
+    va = values_ref[0, :]
+    hit = sl[:, None] == lane_slots                    # (SLAB, TILE)
+    if jnp.issubdtype(va.dtype, jnp.floating):
+        lo = jnp.array(-jnp.inf, va.dtype)
+        hi = jnp.array(jnp.inf, va.dtype)
+    else:
+        info = jnp.iinfo(va.dtype)
+        lo = jnp.array(info.min, va.dtype)
+        hi = jnp.array(info.max, va.dtype)
+    p_min = jnp.min(jnp.where(hit, va[:, None], hi), axis=0,
+                    keepdims=True)
+    p_max = jnp.max(jnp.where(hit, va[:, None], lo), axis=0,
+                    keepdims=True)
+
+    @pl.when(j == 0)
+    def _init():
+        out_min_ref[:, :] = p_min
+        out_max_ref[:, :] = p_max
+
+    @pl.when(j > 0)
+    def _accumulate():
+        out_min_ref[:, :] = jnp.minimum(out_min_ref[:, :], p_min)
+        out_max_ref[:, :] = jnp.maximum(out_max_ref[:, :], p_max)
+
+
+@functools.partial(jax.jit, static_argnames=("capacity", "interpret"))
+def pallas_segment_minmax(slots, values, capacity: int,
+                          interpret: bool = False):
+    """Per-slot (min, max) with the binned Pallas grid.  Empty slots
+    return the identities (+inf/-inf or integer extremes) — callers
+    mask by their own counts, exactly the arena contract.  Slots out
+    of [0, capacity) drop."""
+    if not HAVE_PALLAS:  # pragma: no cover
+        raise RuntimeError("pallas unavailable in this jax build")
+    C = capacity
+    Cpad = ((C + TILE - 1) // TILE) * TILE
+    n = values.shape[0]
+    if n > MAX_BATCH:
+        raise ValueError(
+            f"batch of {n} exceeds MAX_BATCH={MAX_BATCH}: chunk the "
+            "batch (segment_minmax_chunked)")
+    npad = max(SLAB, ((n + SLAB - 1) // SLAB) * SLAB)
+    slots_p = jnp.full(npad, Cpad, jnp.int32).at[:n].set(
+        jnp.where((slots < 0) | (slots >= C), Cpad, slots).astype(jnp.int32))
+    # pad values are never selected: pad slots point at no tile
+    values_p = jnp.zeros(npad, values.dtype).at[:n].set(values)
+    nslabs = npad // SLAB
+    ntiles = Cpad // TILE
+    grid = (ntiles, nslabs)
+    outs = pl.pallas_call(
+        _minmax_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, SLAB), lambda i, j: (j, 0)),
+            pl.BlockSpec((1, SLAB), lambda i, j: (j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, TILE), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, TILE), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((ntiles, TILE), values.dtype),
+            jax.ShapeDtypeStruct((ntiles, TILE), values.dtype),
+        ],
+        interpret=interpret,
+    )(slots_p.reshape(nslabs, SLAB), values_p.reshape(nslabs, SLAB))
+    return tuple(o.reshape(-1)[:C] for o in outs)
+
+
+def segment_minmax_chunked(slots, values, capacity: int,
+                           interpret: bool | None = None):
+    """`pallas_segment_minmax` over arbitrarily large batches."""
+    if interpret is None:
+        interpret = auto_interpret()
+    n = values.shape[0]
+    mn = mx = None
+    for lo in range(0, max(n, 1), MAX_BATCH):
+        m1, x1 = pallas_segment_minmax(
+            slots[lo:lo + MAX_BATCH], values[lo:lo + MAX_BATCH],
+            capacity, interpret=interpret)
+        mn = m1 if mn is None else jnp.minimum(mn, m1)
+        mx = x1 if mx is None else jnp.maximum(mx, x1)
+    return mn, mx
+
+
 def auto_interpret() -> bool:
     """Pallas runs compiled (Mosaic) only on a real TPU backend;
     everywhere else the kernel executes in interpret mode — identical

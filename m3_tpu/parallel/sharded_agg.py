@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from m3_tpu.aggregator import arena as _arena
+from m3_tpu.aggregator import packed as _packed
 from m3_tpu.parallel.mesh import (
     REPLICA_AXIS, SHARD_AXIS, MeshTopology, shard_map_compat,
 )
@@ -43,9 +44,12 @@ _raw = _arena.raw
 
 
 class ShardedAggregatorState(NamedTuple):
-    counters: _arena.CounterState  # arrays with leading (num_shards,) axis
-    gauges: _arena.GaugeState
-    timers: _arena.TimerState
+    # f64 layout: arena.CounterState/GaugeState/TimerState; packed
+    # layout: packed.Packed*State.  All arrays carry a leading
+    # (num_shards,) axis over the mesh's shard axis.
+    counters: NamedTuple
+    gauges: NamedTuple
+    timers: NamedTuple
 
 
 def sharded_init(
@@ -53,10 +57,14 @@ def sharded_init(
     num_windows: int,
     capacity: int,
     sample_capacity: int,
+    layout: str | None = None,
 ) -> ShardedAggregatorState:
     """Per-shard arenas, placed: shard axis over the mesh's shard axis,
-    replicated over the replica axis."""
+    replicated over the replica axis.  ``layout`` follows the
+    M3_ARENA_LAYOUT seam (None = resolved; "auto" -> packed; unknown
+    strings raise — see arena.resolve_layout_arg)."""
     D = topo.num_shards
+    layout = _arena.resolve_layout_arg(layout)
 
     def rep(state):
         return jax.tree.map(
@@ -66,6 +74,13 @@ def sharded_init(
             state,
         )
 
+    if layout == "packed":
+        return ShardedAggregatorState(
+            counters=rep(_packed.counter_init(num_windows, capacity)),
+            gauges=rep(_packed.gauge_init(num_windows, capacity)),
+            timers=rep(_packed.timer_init(num_windows, capacity,
+                                          sample_capacity)),
+        )
     return ShardedAggregatorState(
         counters=rep(_arena.counter_init(num_windows, capacity)),
         gauges=rep(_arena.gauge_init(num_windows, capacity)),
@@ -84,21 +99,45 @@ class ShardedBatch(NamedTuple):
     times: jnp.ndarray  # i64 (D, N)
 
 
+def sharded_ingest_consume(
+    topo: MeshTopology,
+    state: ShardedAggregatorState,
+    batch: ShardedBatch,
+    window: jnp.ndarray,
+    num_windows: int,
+    capacity: int,
+    quantiles: tuple = (0.5, 0.95, 0.99),
+    timer_packed32: bool = False,
+    layout: str | None = None,
+):
+    """Host wrapper: resolves the arena-layout seam (None = the
+    M3_ARENA_LAYOUT resolution, matching sharded_init's default;
+    "auto" -> packed, unknown strings raise) and rides it into the
+    jitted step as a STATIC argument — a layout flip via
+    set_arena_layout retraces instead of silently running the old
+    trace (the jaxlint retrace-risk / trace-frozen-config contract)."""
+    layout = _arena.resolve_layout_arg(layout)
+    return _sharded_ingest_consume(topo, state, batch, window,
+                                   num_windows, capacity, quantiles,
+                                   timer_packed32, layout)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("topo", "num_windows", "capacity", "quantiles",
-                     "timer_packed32"),
+                     "timer_packed32", "layout"),
     donate_argnums=(1,),
 )
-def sharded_ingest_consume(
+def _sharded_ingest_consume(
     topo: MeshTopology,
     state: ShardedAggregatorState,
     batch: ShardedBatch,
     window: jnp.ndarray,  # i32 scalar: ring index to drain after ingest
     num_windows: int,
     capacity: int,
-    quantiles: tuple = (0.5, 0.95, 0.99),
-    timer_packed32: bool = False,
+    quantiles: tuple,
+    timer_packed32: bool,
+    layout: str,
 ):
     """The framework's "training step": ingest a routed batch into every
     shard's arenas, drain one window (then reset its ring row, as the
@@ -120,29 +159,73 @@ def sharded_ingest_consume(
         st = ShardedAggregatorState(*map(sq, state))
         b = ShardedBatch(*(a[0] for a in batch))
 
-        idx = _arena.flat_window_index(b.windows, b.slots, num_windows, capacity)
+        if layout == "packed":
+            # One fused sort serves the counter+gauge arenas; the timer
+            # appends packed words (see aggregator/packed.py).
+            pidx = _packed.packed_flat_index(
+                b.windows, b.slots, num_windows, capacity)
+            counters, gauges = _raw(_packed.rollup_ingest)(
+                st.counters, st.gauges, pidx, b.counter_values,
+                b.gauge_values, b.times, num_windows, capacity)
+            timers = _raw(_packed.timer_ingest)(
+                st.timers, b.windows, b.slots, b.timer_values, b.times,
+                capacity)
+            # The packed states can only degrade LOUDLY: the engine
+            # path raises from the host wrapper, so the sharded step
+            # must surface the same conditions — the counter overflow-
+            # pool err bits, plus timer sample-buffer overflow (the
+            # fixed-capacity sharded buffer silently loses MOMENTS as
+            # well as quantiles past sample_capacity, unlike the f64
+            # arenas whose scatter moments survive buffer overflow).
+            scap = st.timers.sample.shape[1]
+            shard_err = (counters.err
+                         | jnp.where((timers.sample_n > scap).any(),
+                                     jnp.int32(_packed._ERR_TIMER_OVERFLOW),
+                                     jnp.int32(0)))
+            c_lanes, c_cnt = _raw(_packed.counter_consume)(
+                counters, window, capacity)
+            g_lanes, g_cnt = _raw(_packed.gauge_consume)(
+                gauges, window, capacity)
+            t_lanes, t_cnt = _raw(_packed.timer_consume)(
+                timers, window, capacity, quantiles)
+            counters = _raw(_packed.counter_reset_window)(
+                counters, window, num_windows, capacity)
+            gauges = _raw(_packed.gauge_reset_window)(
+                gauges, window, capacity)
+            timers = _raw(_packed.timer_reset_window)(
+                timers, window, capacity)
+        else:
+            idx = _arena.flat_window_index(
+                b.windows, b.slots, num_windows, capacity)
 
-        counters = _raw(_arena.counter_ingest)(
-            st.counters, idx, b.slots, b.counter_values, b.times
-        )
-        gauges = _raw(_arena.gauge_ingest)(
-            st.gauges, idx, b.slots, b.gauge_values, b.times
-        )
-        timers = _raw(_arena.timer_ingest)(
-            st.timers, b.windows, b.slots, b.timer_values, b.times, capacity
-        )
+            counters = _raw(_arena.counter_ingest)(
+                st.counters, idx, b.slots, b.counter_values, b.times
+            )
+            gauges = _raw(_arena.gauge_ingest)(
+                st.gauges, idx, b.slots, b.gauge_values, b.times
+            )
+            timers = _raw(_arena.timer_ingest)(
+                st.timers, b.windows, b.slots, b.timer_values, b.times,
+                capacity
+            )
 
-        c_lanes, c_cnt = _raw(_arena.counter_consume)(counters, window, capacity)
-        g_lanes, g_cnt = _raw(_arena.gauge_consume)(gauges, window, capacity)
-        t_lanes, t_cnt = _raw(_arena.timer_consume)(
-            timers, window, capacity, quantiles, timer_packed32
-        )
+            c_lanes, c_cnt = _raw(_arena.counter_consume)(
+                counters, window, capacity)
+            g_lanes, g_cnt = _raw(_arena.gauge_consume)(
+                gauges, window, capacity)
+            t_lanes, t_cnt = _raw(_arena.timer_consume)(
+                timers, window, capacity, quantiles, timer_packed32
+            )
 
-        # The drained window's ring row resets for reuse (engine.py
-        # consume() pairs every drain with reset_window).
-        counters = _raw(_arena.counter_reset_window)(counters, window, capacity)
-        gauges = _raw(_arena.gauge_reset_window)(gauges, window, capacity)
-        timers = _raw(_arena.timer_reset_window)(timers, window, capacity)
+            # The drained window's ring row resets for reuse (engine.py
+            # consume() pairs every drain with reset_window).
+            counters = _raw(_arena.counter_reset_window)(
+                counters, window, capacity)
+            gauges = _raw(_arena.gauge_reset_window)(
+                gauges, window, capacity)
+            timers = _raw(_arena.timer_reset_window)(
+                timers, window, capacity)
+            shard_err = jnp.int32(0)  # f64 arenas have no degraded mode
 
         # Cross-shard rollup stage: the multi-stage pipeline's second hop.
         # Sum/count roll up by psum; min/max by pmin/pmax over real values,
@@ -168,6 +251,11 @@ def sharded_ingest_consume(
             "gauge": (g_lanes[None], g_cnt[None]),
             "timer": (t_lanes[None], t_cnt[None]),
             "rollup": rollup,
+            # per-shard degraded-state flags: nonzero means the packed
+            # layout's stats are unreliable (overflow-pool truncation /
+            # timer sample overflow) — callers MUST check, the raise
+            # that guards the engine path cannot fire inside shard_map
+            "err": shard_err[None],
         }
         return ShardedAggregatorState(*map(ex, new_state)), lanes
 
@@ -178,6 +266,7 @@ def sharded_ingest_consume(
         "gauge": (P(SHARD_AXIS), P(SHARD_AXIS)),
         "timer": (P(SHARD_AXIS), P(SHARD_AXIS)),
         "rollup": P(),
+        "err": P(SHARD_AXIS),
     }
     return shard_map_compat(
         local_step,
@@ -190,4 +279,4 @@ def sharded_ingest_consume(
 # The sharded program composes raw(ingest) ops, whose scatter-vs-pallas
 # choice binds at trace time — register so arena.set_ingest_impl can
 # invalidate this cache too.
-_arena.register_ingest_consumer(sharded_ingest_consume)
+_arena.register_ingest_consumer(_sharded_ingest_consume)

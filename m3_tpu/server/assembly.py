@@ -170,6 +170,12 @@ def run_node(source, start_mediator: bool | None = None,
         from m3_tpu.aggregator import arena
 
         arena.set_ingest_impl(cfg.coordinator.arena_ingest)
+    if cfg.coordinator is not None and cfg.coordinator.arena_layout:
+        from m3_tpu.aggregator import arena
+
+        # Must land BEFORE any MetricList is built: arenas bind their
+        # layout at construction (aggregator/arena.py layout seam).
+        arena.set_arena_layout(cfg.coordinator.arena_layout)
     registry = instrument.new_registry()
     scope = registry.scope(cfg.metrics_prefix)
     # Mirror the process-global fault/retry counters onto this node's

@@ -80,7 +80,11 @@ class Context:
     tests pass permissive prefixes so every rule fires on the seeded
     violations regardless of where the corpus lives."""
 
-    dtype_prefixes: tuple = ("m3_tpu/encoding/", "m3_tpu/parallel/")
+    # round 8: aggregator/ joined the dtype scope — the packed arena's
+    # word formats (u64 lanes, orderable-f32 words, o16 minmax) are
+    # bit-layout contracts exactly like the codec's
+    dtype_prefixes: tuple = ("m3_tpu/encoding/", "m3_tpu/parallel/",
+                             "m3_tpu/aggregator/")
     wire_prefixes: tuple = ("m3_tpu/server/", "m3_tpu/client/",
                             "m3_tpu/cluster/", "m3_tpu/msg/")
     wire_files: tuple = ("m3_tpu/persist/commitlog.py",)

@@ -1,0 +1,32 @@
+"""Seeded aggregator-scope violations (round 8; never imported).
+
+The packed arena's word formats are bit-layout contracts: a dtype-less
+constructor or a module-level lane table folded into every compile are
+exactly the classes explicit-dtype / constant-bloat exist for, so the
+families' scope now covers aggregator/ (core.Context.dtype_prefixes)
+and these seeds keep the rules honest there."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# a packed-arena-sized decode table: large enough that folding it into
+# the HLO of every consumer bloats each compilation
+O16_DECODE_TBL = np.arange(1 << 16, dtype=np.int64)
+
+
+def packed_word_init(n):
+    base = jnp.zeros(n)                  # VIOLATION: explicit-dtype (L19)
+    ok = jnp.zeros(n, jnp.uint64)        # ok: positional dtype
+    return base, ok
+
+
+@jax.jit
+def consume_minmax(mm):
+    return jnp.asarray(O16_DECODE_TBL)[mm]  # VIOLATION: constant-bloat (L26)
+
+
+@jax.jit
+def consume_minmax_clean(mm, tbl):
+    # clean: the table arrives as a device ARGUMENT, not a baked constant
+    return tbl[mm]

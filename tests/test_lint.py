@@ -57,6 +57,9 @@ EXPECTED = {
     ("jax_cases.py", "dtype-stability", 98),    # float in bitwise op
     ("jax_cases.py", "constant-bloat", 107),    # big table via asarray
     ("jax_cases.py", "constant-bloat", 112),    # big table, bare name
+    # round 8: aggregator/packed-layout scope seeds (one per family)
+    ("agg_cases.py", "explicit-dtype", 19),     # dtype-less packed word
+    ("agg_cases.py", "constant-bloat", 26),     # baked o16 decode table
     ("wire_cases.py", "wire-exhaustive", 8),
     ("wire_cases.py", "wire-exhaustive", 17),
     ("fault_cases.py", "fault-coverage", 10),
@@ -186,6 +189,14 @@ class TestDtypeScope:
 
     def test_fires_in_encoding(self, tmp_path):
         got = self._lint_at(tmp_path, "m3_tpu/encoding/m3tsz_jax.py")
+        assert any(f.rule == "explicit-dtype" for f in got)
+
+    def test_fires_in_aggregator_packed(self, tmp_path):
+        # round 8: the packed arena's word formats are bit-layout
+        # contracts — aggregator/ joined the dtype scope
+        got = self._lint_at(tmp_path, "m3_tpu/aggregator/packed.py")
+        assert any(f.rule == "explicit-dtype" for f in got)
+        got = self._lint_at(tmp_path, "m3_tpu/aggregator/arena.py")
         assert any(f.rule == "explicit-dtype" for f in got)
 
     def test_out_of_scope_module_stays_clean(self, tmp_path):

@@ -398,6 +398,16 @@ mediator: {{enabled: false}}
         cfg.validate()
         assert cfg.coordinator.arena_ingest == "auto"
 
+    def test_arena_layout_validated(self):
+        with pytest.raises(ConfigError, match="arena_layout"):
+            load_config(
+                "db: {root: /tmp/x}\n"
+                "coordinator: {arena_layout: packd}\n").validate()
+        cfg = load_config(
+            "db: {root: /tmp/x}\ncoordinator: {arena_layout: f64}\n")
+        cfg.validate()
+        assert cfg.coordinator.arena_layout == "f64"
+
     def test_arena_ingest_applied_at_boot(self, tmp_path):
         from m3_tpu.aggregator import arena
 

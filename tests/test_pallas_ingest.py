@@ -132,3 +132,54 @@ class TestArenaIngestImplFlip:
 
         with pytest.raises(ValueError, match="unknown ingest impl"):
             arena.set_ingest_impl("magic")
+
+
+class TestPallasMinMax:
+    """Round-8 kernel: per-slot (min, max) with the binned grid — the
+    TPU-side alternative to the packed arena's segmented min/max scan.
+    Interpret mode on CPU: semantics only."""
+
+    def _oracle(self, slots, vals, C, lo, hi):
+        mn = np.full(C, hi)
+        mx = np.full(C, lo)
+        ok = (slots >= 0) & (slots < C)
+        np.minimum.at(mn, slots[ok], vals[ok])
+        np.maximum.at(mx, slots[ok], vals[ok])
+        return mn, mx
+
+    def test_f64_matches_oracle_with_oob(self):
+        from m3_tpu.parallel.pallas_ingest import pallas_segment_minmax
+
+        rng = np.random.default_rng(21)
+        C, N = 300, 4000
+        slots = rng.integers(-3, C + 5, N).astype(np.int32)
+        vals = np.round(rng.normal(0, 100, N), 3)
+        mn, mx = pallas_segment_minmax(
+            jnp.asarray(slots), jnp.asarray(vals), C, interpret=True)
+        wmn, wmx = self._oracle(slots, vals, C, -np.inf, np.inf)
+        np.testing.assert_array_equal(np.asarray(mn), wmn)
+        np.testing.assert_array_equal(np.asarray(mx), wmx)
+
+    def test_i64_identities_for_empty_slots(self):
+        from m3_tpu.parallel.pallas_ingest import pallas_segment_minmax
+
+        C = 64
+        slots = jnp.asarray([3, 3, 10], jnp.int32)
+        vals = jnp.asarray([-7, 9, 2], jnp.int64)
+        mn, mx = pallas_segment_minmax(slots, vals, C, interpret=True)
+        info = np.iinfo(np.int64)
+        assert int(mn[3]) == -7 and int(mx[3]) == 9
+        assert int(mn[10]) == 2 and int(mx[10]) == 2
+        assert int(mn[0]) == info.max and int(mx[0]) == info.min
+
+    def test_chunked_matches_single_call(self):
+        from m3_tpu.parallel import pallas_ingest as pi
+
+        rng = np.random.default_rng(23)
+        C, N = 128, 5000
+        slots = jnp.asarray(rng.integers(0, C, N).astype(np.int32))
+        vals = jnp.asarray(np.round(rng.uniform(-5, 5, N), 3))
+        a = pi.pallas_segment_minmax(slots, vals, C, interpret=True)
+        b = pi.segment_minmax_chunked(slots, vals, C, interpret=True)
+        np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
+        np.testing.assert_array_equal(np.asarray(a[1]), np.asarray(b[1]))

@@ -34,7 +34,8 @@ def test_sharded_step_matches_single_device(shards, replicas):
         topo, state, batch, jnp.int32(0), W, C, (0.5, 0.95, 0.99)
     )
 
-    # Oracle: run each shard through the single-device arenas.
+    # Oracle: run each shard through the single-device arenas of the
+    # SAME layout the sharded step resolved (the M3_ARENA_LAYOUT seam).
     windows = np.asarray(batch.windows)
     slots = np.asarray(batch.slots)
     cvals = np.asarray(batch.counter_values)
@@ -42,7 +43,7 @@ def test_sharded_step_matches_single_device(shards, replicas):
     c_lanes = np.asarray(lanes["counter"][0])
     assert c_lanes.shape == (shards, C, 8)
     for d in range(shards):
-        a = _arena.CounterArena(W, C)
+        a, _g, _t = _arena.make_arenas(W, C, 4 * N, (0.5, 0.95, 0.99))
         a.ingest(
             jnp.asarray(windows[d]),
             jnp.asarray(slots[d]),
@@ -52,6 +53,10 @@ def test_sharded_step_matches_single_device(shards, replicas):
         want, _ = a.consume(0)
         np.testing.assert_allclose(c_lanes[d], np.asarray(want), rtol=0, atol=0)
 
+    # Packed degraded-state flags must be clean on a healthy run (the
+    # engine path raises; the sharded path surfaces the same bits here).
+    assert int(np.asarray(lanes["err"]).sum()) == 0
+
     # Global rollup = sum of per-shard sums for window 0.
     rollup = np.asarray(lanes["rollup"])
     gsum_want = 0.0
@@ -60,8 +65,19 @@ def test_sharded_step_matches_single_device(shards, replicas):
         gsum_want += np.nan_to_num(gl[d, :, 5]) + c_lanes[d, :, 5]
     np.testing.assert_allclose(rollup[:, 0], gsum_want, rtol=1e-12)
 
-    # The drained window's ring row was reset; only window-1 samples remain.
-    assert np.asarray(new_state.counters.count).sum() == (windows == 1).sum()
+    # The drained window's ring row was reset; only window-1 samples
+    # remain.  Counts live in a plain column on the f64 layout and in
+    # the packed base word's count lane on the packed layout.
+    if "count" in new_state.counters._fields:
+        remaining = np.asarray(new_state.counters.count).sum()
+    else:
+        from m3_tpu.aggregator import packed as _packed
+
+        cnt, _ = _packed._unpack_base(
+            jnp.asarray(np.asarray(new_state.counters.base)),
+            _packed.DEFAULT_WIDTHS)
+        remaining = int(np.asarray(cnt).sum())
+    assert remaining == (windows == 1).sum()
 
 
 def test_graft_entry_single_chip():
@@ -78,3 +94,29 @@ def test_graft_entry_dryrun_multichip():
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(8)
+
+
+def test_sharded_packed_err_surfaces_timer_overflow():
+    """Review fix: a fixed-capacity sharded timer buffer that overflows
+    loses MOMENTS (not just quantiles) on the packed layout — the step
+    must flag it per shard instead of silently publishing wrong lanes."""
+    topo = make_mesh(num_shards=1, num_replicas=1,
+                     devices=jax.devices()[:1])
+    W, C, N = 2, 16, 64
+    state = sharded_init(topo, W, C, sample_capacity=8, layout="packed")
+    batch = _mk_batch(topo, W, C, N, seed=3)
+    _state, lanes = sharded_ingest_consume(
+        topo, state, batch, jnp.int32(0), W, C, (0.5,), layout="packed")
+    from m3_tpu.aggregator.packed import _ERR_TIMER_OVERFLOW
+
+    err = np.asarray(lanes["err"])
+    assert (err & _ERR_TIMER_OVERFLOW).any()
+
+
+def test_sharded_layout_arg_validated():
+    topo = make_mesh(num_shards=1, num_replicas=1,
+                     devices=jax.devices()[:1])
+    import pytest
+
+    with pytest.raises(ValueError, match="unknown arena layout"):
+        sharded_init(topo, 2, 8, 32, layout="packd")

@@ -1,0 +1,145 @@
+"""Direct property tests for the host CM (CKMS) quantile stream.
+
+``quantile_cm.Stream.add_batch`` is a per-sample Python loop over a
+linked sample list — it had only transitive coverage through the
+device-arena parity test before round 8.  These tests pin the CKMS
+eps contract directly against numpy order statistics so the packed
+arena rewrite (and any future reformulation of the stream) has an
+oracle to stand on: for quantile q over n values, the returned value's
+RANK must lie within [(q - eps)n - 1, (q + eps)n + 1].
+
+Streams covered: uniform, duplicate-heavy (few distinct values — the
+compress path collapses most samples), sorted ascending/descending
+(adversarial for the insertion cursor), and batch-boundary shapes.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from m3_tpu.aggregator.quantile_cm import DEFAULT_EPS, Stream
+
+QUANTILES = (0.5, 0.95, 0.99)
+
+
+def _rank_bounds_ok(values: np.ndarray, q: float, got: float,
+                    eps: float) -> bool:
+    """CKMS guarantee as a rank check: got must sit between the
+    order statistics at ranks floor((q-eps)n) and ceil((q+eps)n)."""
+    n = len(values)
+    s = np.sort(values)
+    lo_rank = max(int(math.floor((q - eps) * n)) - 1, 0)
+    hi_rank = min(int(math.ceil((q + eps) * n)) + 1, n - 1)
+    return s[lo_rank] <= got <= s[hi_rank]
+
+
+def _run(values: np.ndarray, batch: int = 997,
+         eps: float = DEFAULT_EPS) -> Stream:
+    st = Stream(QUANTILES, eps=eps)
+    for lo in range(0, len(values), batch):
+        st.add_batch([float(v) for v in values[lo:lo + batch]])
+    st.flush()
+    return st
+
+
+class TestCKMSEpsBound:
+    N = 10_000
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_uniform_stream(self, seed):
+        rng = np.random.default_rng(seed)
+        values = rng.uniform(0.0, 1000.0, self.N)
+        st = _run(values)
+        for q in QUANTILES:
+            got = st.quantile(q)
+            assert _rank_bounds_ok(values, q, got, DEFAULT_EPS), \
+                (q, got, np.percentile(values, q * 100))
+
+    def test_duplicate_heavy_stream(self):
+        # few distinct values: rank spans collapse, compress merges
+        # aggressively; every answer must still be one of the values
+        # within the eps rank window
+        rng = np.random.default_rng(3)
+        values = rng.choice([1.0, 2.0, 5.0, 100.0], self.N,
+                            p=[0.6, 0.3, 0.05, 0.05])
+        st = _run(values)
+        for q in QUANTILES:
+            got = st.quantile(q)
+            assert _rank_bounds_ok(values, q, got, DEFAULT_EPS), (q, got)
+
+    def test_sorted_ascending_adversarial(self):
+        # sorted input keeps every insert at the cursor's tail — the
+        # worst case for the insertion walk and for biased compression
+        values = np.linspace(0.0, 1.0, self.N)
+        st = _run(values)
+        for q in QUANTILES:
+            got = st.quantile(q)
+            assert _rank_bounds_ok(values, q, got, DEFAULT_EPS), (q, got)
+
+    def test_sorted_descending_adversarial(self):
+        values = np.linspace(1.0, 0.0, self.N)
+        st = _run(values)
+        for q in QUANTILES:
+            got = st.quantile(q)
+            assert _rank_bounds_ok(values, q, got, DEFAULT_EPS), (q, got)
+
+    def test_gamma_vs_numpy_percentile(self):
+        # the shape the timer benches use; compare against numpy's
+        # exact percentile with the eps rank window
+        rng = np.random.default_rng(7)
+        values = rng.gamma(2.0, 50.0, self.N)
+        st = _run(values)
+        for q in QUANTILES:
+            got = st.quantile(q)
+            assert _rank_bounds_ok(values, q, got, DEFAULT_EPS), \
+                (q, got, np.percentile(values, q * 100))
+
+
+class TestStreamMechanics:
+    def test_min_max_exact(self):
+        rng = np.random.default_rng(11)
+        values = rng.normal(0.0, 10.0, 5000)
+        st = _run(values)
+        assert st.min() == values.min()
+        assert st.max() == values.max()
+
+    def test_incremental_flush_then_more_adds(self):
+        # flush mid-stream, keep adding: the buffers must re-open
+        rng = np.random.default_rng(13)
+        a = rng.uniform(0, 1, 4000)
+        b = rng.uniform(0, 1, 6000)
+        st = Stream(QUANTILES)
+        st.add_batch([float(v) for v in a])
+        st.flush()
+        st.add_batch([float(v) for v in b])
+        st.flush()
+        both = np.concatenate([a, b])
+        for q in QUANTILES:
+            assert _rank_bounds_ok(both, q, st.quantile(q), DEFAULT_EPS)
+
+    def test_single_and_tiny_streams(self):
+        st = Stream(QUANTILES)
+        st.add(42.0)
+        st.flush()
+        for q in QUANTILES:
+            assert st.quantile(q) == 42.0
+        st2 = Stream(QUANTILES)
+        st2.add_batch([3.0, 1.0, 2.0])
+        st2.flush()
+        assert st2.quantile(0.5) in (1.0, 2.0, 3.0)
+
+    def test_batch_boundaries_equivalent_to_single_adds(self):
+        rng = np.random.default_rng(17)
+        values = rng.uniform(0, 100, 3000)
+        st_batch = _run(values, batch=277)
+        st_single = Stream(QUANTILES)
+        for v in values:
+            st_single.add(float(v))
+        st_single.flush()
+        # not bit-identical orders, but both within eps of the truth
+        for q in QUANTILES:
+            assert _rank_bounds_ok(values, q, st_batch.quantile(q),
+                                   DEFAULT_EPS)
+            assert _rank_bounds_ok(values, q, st_single.quantile(q),
+                                   DEFAULT_EPS)
