@@ -75,20 +75,29 @@ def _relay_open(timeout: float = 3.0) -> bool:
     A closed port means backend init would hang (the plugin retries
     forever), so don't spend subprocess-probe budget on it.
 
+    The probe is a plain TCP connect — it never touches JAX, so it
+    runs UNCONDITIONALLY.  (BENCH_r07's round was mis-reported here: a
+    box-profile ``JAX_PLATFORMS=cpu`` pin used to short-circuit this
+    function, so ``tpu_probe.ok`` reflected the parent's env, not the
+    relay — the post-run unpinned re-probe had to be done by hand.
+    The pin now only means TPU CHILDREN must strip it from their env
+    before backend init — see _run_child.)
+
     EVERY probe is recorded in PROBE_TIMELINE (t-offset seconds +
     outcome/errno) and lands in the final JSON: when a round's TPU
     evidence is lost to a dead relay, the artifact must prove the loss
     was environmental for the whole run, not just at t=0 (round-4
     VERDICT weak #5)."""
     t_off = round(time.monotonic() - _T0, 1)
-    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        PROBE_TIMELINE.append({"t": t_off, "result": "skipped: cpu pin"})
-        return False
+    pinned = os.environ.get("JAX_PLATFORMS", "") == "cpu"
     s = socket.socket()
     s.settimeout(timeout)
     try:
         s.connect(("127.0.0.1", RELAY_PORT))
-        PROBE_TIMELINE.append({"t": t_off, "result": "open"})
+        PROBE_TIMELINE.append(
+            {"t": t_off,
+             "result": "open" + (" (parent cpu-pinned; tpu children "
+                                 "strip the pin)" if pinned else "")})
         return True
     except OSError as e:
         PROBE_TIMELINE.append(
@@ -406,47 +415,150 @@ def _run_decode_stage(S: int, T: int, platform: str) -> dict:
     return res
 
 
+# The pre-rewrite wide-carry encode scan's round-7 number — deleted in
+# round 9 (the two-phase lane-emission rewrite replaced it wholesale),
+# so the bench's old-vs-new head-to-head reports against this RECORDED
+# baseline.  Source: BENCH_r07.json encode.cpu_jax (S=512 — the old
+# scan was so slow the stage could not afford corpus scale; its per-dp
+# cost was batch-size-flat, so the comparison is honest).
+OLD_R07_ENCODE_DPS = {"cpu": 492_919}
+
+
 def _run_device_encode_stage(S: int, T: int, platform: str) -> dict:
-    """Device (JAX) encode on the corpus shape: BASELINE config #1's
-    encode side on the accelerator path, validated byte-identical
-    against the native encoder (itself pinned to the scalar oracle)."""
-    from m3_tpu.encoding.m3tsz_jax import encode_batch
+    """Device (JAX) encode at corpus SCALE (decode-stage methodology:
+    S=10000x720 on CPU): the round-9 two-phase encode, series-sharded
+    across every local device (parallel/sharded_encode.py — the native
+    yardstick threads across cores too), validated byte-identical
+    against the native encoder (itself pinned to the scalar oracle).
+    Reports machine-level dps, the single-device number alongside
+    (r07-methodology-comparable), the old-vs-new head-to-head, the
+    compile-vs-steady split and the non-default placement tail."""
+    import jax
+    import jax.numpy as jnp
+
+    from m3_tpu.encoding.m3tsz_jax import finalize_streams, resolved_place
+    from m3_tpu.parallel.sharded_encode import encode_batch_device_sharded
     from m3_tpu.x import tracewatch
 
     ts, vals, starts = _make_corpus(S, T)
     out_words = T * 40 // 64 + 8
-    run = lambda: encode_batch(ts, vals, starts, out_words=out_words)
+    jts = jnp.asarray(ts)
+    jvb = jnp.asarray(vals.view(np.uint64))
+    jst = jnp.asarray(starts)
+    jva = jnp.asarray(np.ones((S, T), bool))
+    place = resolved_place()
+
+    def run(p=place, devices=None):
+        return jax.block_until_ready(encode_batch_device_sharded(
+            jts, jvb, jst, jva, out_words=out_words, place=p,
+            devices=devices))
+
     t0 = time.perf_counter()
-    streams, fb = run()  # compile + warm
+    res = run()  # compile + warm
     compile_s = time.perf_counter() - t0
+    fb = np.asarray(res["fallback"])
     if fb.any():
         return {"error": f"device encoder fell back on {int(fb.sum())}/{S}"}
+    _log(f"encode S={S}: compiled+ran ({place}) in {compile_s:.1f}s, "
+         f"{_left():.0f}s left")
+    # Byte-identity, untimed: finalize to host bytes and compare
+    # against the native encoder (the timed region is the DEVICE
+    # encode alone — the decode-stage convention; finalize/EOS is host
+    # validation plumbing).
     verdict = "ok"
     from m3_tpu import native
 
+    streams = finalize_streams(np.asarray(res["words"]),
+                               np.asarray(res["total_bits"]))
     if native.available():
-        nstreams, nfb = native.encode_batch(ts, vals, starts)
-        if nfb.any():
+        nout = native.encode_batch(ts, vals, starts)
+        if nout is None or nout[1].any():
             verdict = "native fell back; not compared"
         else:
-            bad = sum(1 for a, b in zip(streams, nstreams) if a != b)
+            bad = sum(1 for a, b in zip(streams, nout[0]) if a != b)
             if bad:
                 verdict = f"byte mismatch vs native on {bad}/{S}"
     else:
         verdict = "native unavailable; not compared"
+
+    # Steady state, sanitized: zero retraces across the timed
+    # iterations, first timed iteration under the transfer guard (the
+    # encode hot loop is contractually device-resident; the input
+    # uploads happened above).
     best = float("inf")
     snap = tracewatch.snapshot()
+    guard_note = None
+    try:
+        with tracewatch.no_transfers():
+            t0 = time.perf_counter()
+            run()
+            best = min(best, time.perf_counter() - t0)
+    except Exception as e:
+        guard_note = f"{type(e).__name__}: {e}"[:200]
     for _ in range(3):
         if best < float("inf") and _left() < 45:
             break
         t0 = time.perf_counter()
-        run()  # returns host bytes: device->host sync included
+        run()
         best = min(best, time.perf_counter() - t0)
     retraces = tracewatch.retraces_since(snap)
     verdict = _retrace_verdict(verdict, retraces)
-    return {"dps": round(S * T / best), "S": S, "T": T,
-            "compile_s": round(compile_s, 2), "retraces": retraces,
-            "platform": platform, "validation": verdict}
+    if guard_note:
+        verdict = f"transfer in timed region ({guard_note}): " + verdict
+    stage = {"dps": round(S * T / best), "S": S, "T": T,
+             "compile_s": round(compile_s, 2), "retraces": retraces,
+             "place": place, "devices": jax.device_count(),
+             "platform": platform, "validation": verdict}
+    # Single-device number: methodology-comparable to r07 and to the
+    # decode stage's full_1device convention.  On a budget-cut
+    # multi-device child the key is OMITTED — reporting the sharded
+    # number under this label would inflate it by ~device_count.
+    if jax.device_count() == 1:
+        stage["dps_1device"] = stage["dps"]
+    elif _left() > 60:
+        try:
+            run(devices=1)  # compile
+            best1 = float("inf")
+            for _ in range(2):
+                t0 = time.perf_counter()
+                run(devices=1)
+                best1 = min(best1, time.perf_counter() - t0)
+            stage["dps_1device"] = round(S * T / best1)
+        except Exception as e:
+            stage["dps_1device"] = f"{type(e).__name__}: {e}"[:120]
+    # Old-vs-new: the recorded r07 wide-carry scan number for this
+    # backend (deleted in round 9 — see OLD_R07_ENCODE_DPS).  The r07
+    # measurement was SINGLE-device, so the ratio is methodology-
+    # matched to dps_1device and omitted when that number is (the
+    # sharded dps would inflate it by ~device_count).
+    old = OLD_R07_ENCODE_DPS.get(platform)
+    if old:
+        stage["old_r07_dps"] = old
+        stage["old_r07_note"] = "old scan measured at S=512 (BENCH_r07)"
+        if isinstance(stage.get("dps_1device"), int):
+            stage["vs_old_r07"] = round(stage["dps_1device"] / old, 2)
+    # The non-default placement tail, so the seam's flip decision stays
+    # re-measurable every round (all tails are byte-parity-pinned by
+    # tests/test_encode_fuzz.py — only speed can differ).  NEVER
+    # auto-time scatter on the pallas-default (TPU) backend: the ~1us/
+    # element TPU scatter floor (TPU_RESULTS_r05 — the reason the
+    # scatter-free forms exist) would burn the whole relay window on
+    # ~47M fragment scatters; the decision-relevant TPU comparison is
+    # pallas vs gather.
+    other = "scatter" if place == "gather" else "gather"
+    if _left() > 60:
+        try:
+            run(other)  # compile
+            best2 = float("inf")
+            for _ in range(2):
+                t0 = time.perf_counter()
+                run(other)
+                best2 = min(best2, time.perf_counter() - t0)
+            stage[f"dps_{other}"] = round(S * T / best2)
+            stage[f"{other}_vs_{place}"] = round(best / best2, 3)
+        except Exception as e:
+            stage[f"dps_{other}"] = f"{type(e).__name__}: {e}"[:120]
+    return stage
 
 
 def _run_agg_bench(kind: str, C: int, N: int, NT: int, platform: str) -> dict:
@@ -1197,7 +1309,11 @@ def _run_agg_scaling(platform: str) -> dict:
 
 def child_main(platform: str) -> None:
     """Run decode stages + aggregator benches under one JAX backend,
-    streaming RESULT lines.  ``platform``: "tpu" or "cpu"."""
+    streaming RESULT lines.  ``platform``: "tpu", "cpu", "cpu_scale",
+    or "tpu_backlog" (the accumulated on-chip backlog — decode, full
+    north stars, agg scaling, the new encode — in one shot, driven by
+    `python -m m3_tpu.tools.cli tpu_backlog` when a live relay window
+    finally opens)."""
     if platform in ("cpu", "cpu_scale"):
         os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
@@ -1240,7 +1356,7 @@ def child_main(platform: str) -> None:
     _emit("backend", {"platform": dev.platform, "kind": kind})
     _log("child backend up:", dev.platform, kind)
 
-    is_tpu = platform == "tpu"
+    is_tpu = platform in ("tpu", "tpu_backlog")
     # Validation-first: a small decode stage whose verdict survives even
     # if the big stage or the deadline kills us.
     stages = [2_000, 100_000] if is_tpu else [2_000, 10_000]
@@ -1276,6 +1392,25 @@ def child_main(platform: str) -> None:
         guarded("agg_scaling", 60, _run_agg_scaling, "cpu")
         return
 
+    if platform == "tpu_backlog":
+        # The accumulated on-chip backlog, highest-evidence-value
+        # first: every stage below has been waiting on a live relay
+        # window since round 6 (decode rewrite), round 8 (packed
+        # arena / agg_scaling) and round 9 (two-phase encode).
+        res = guarded("decode", 90, _run_decode_stage, stages[0],
+                      T_POINTS, "tpu")
+        if res is not None and res["validation"] != "ok":
+            return  # diverging backend: record, stop
+        guarded("decode", 60 + stages[1] // 1_500, _run_decode_stage,
+                stages[1], T_POINTS, "tpu")
+        run_aggs(FULL, "_full")
+        guarded("encode_device", 90, _run_device_encode_stage, 8_192,
+                T_POINTS, "tpu")
+        guarded("pallas", 90, _run_pallas_compare, "tpu")
+        if jax.device_count() > 1:
+            guarded("agg_scaling", 120, _run_agg_scaling, "tpu")
+        return
+
     # Stage order = evidence priority: (1) small decode for the
     # bit-exactness verdict, (2) the FULL-scale decode — the headline
     # number (window #3 measured 18.75M dp/s at S=100K; larger batches
@@ -1300,12 +1435,12 @@ def child_main(platform: str) -> None:
                 "f32")
     if not is_tpu:
         run_aggs(SMOKE, "")
-    # CPU size kept small: the XLA-CPU encode scan runs ~13K dp/s (the
-    # step is ~7.8K element-ops/dp of u64 emulation — see
-    # PROFILE_decode_r05.json), and the stage's CPU value is its
-    # byte-identity verdict, not its speed.
+    # Corpus scale on every backend (round 9): the two-phase encode is
+    # fast enough to measure at the decode stage's S=10000x720; the
+    # pre-rewrite scan could only afford S=512 (BENCH_r07) and its
+    # recorded number is the stage's old-vs-new baseline.
     guarded("encode_device", 90, _run_device_encode_stage,
-            8_192 if is_tpu else 512, T_POINTS, platform)
+            8_192 if is_tpu else 10_000, T_POINTS, platform)
     if is_tpu:
         guarded("pallas", 90, _run_pallas_compare, platform)
         if jax.device_count() > 1:
@@ -1327,6 +1462,11 @@ def _run_child(platform: str, budget: float) -> dict:
     deadline = time.monotonic() + budget
     env = dict(os.environ)
     env["M3_BENCH_DEADLINE_SEC"] = str(max(30, int(budget - 10)))
+    if platform in ("tpu", "tpu_backlog"):
+        # A box-profile JAX_PLATFORMS=cpu pin must not leak into a TPU
+        # child: with the pin the child would init the CPU backend and
+        # report it as "tpu" numbers (the r07 probe bug's sibling).
+        env.pop("JAX_PLATFORMS", None)
     p = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "--child", platform],
         stdout=subprocess.PIPE, stderr=sys.stderr, env=env)
@@ -1569,9 +1709,9 @@ def main() -> None:
         compose_and_log("cpu-scale")
 
     # ---- stage 4: TPU re-probe loop with the remaining budget ----
-    # (pointless under an explicit CPU pin: _relay_open is always False)
-    while (not tpu_ok and _left() > 120
-           and os.environ.get("JAX_PLATFORMS", "") != "cpu"):
+    # (the probe is a plain TCP connect and TPU children strip any
+    # JAX_PLATFORMS pin, so the loop runs regardless of the box env)
+    while not tpu_ok and _left() > 120:
         if _relay_open():
             _log(f"relay now up; TPU child budget {_left() - 45:.0f}s")
             res = _run_child("tpu", _left() - 45)

@@ -4,12 +4,16 @@ The reference codec is an inherently sequential per-series bit-stream
 state machine (``src/dbnode/encoding/m3tsz/encoder.go``,
 ``iterator.go``).  The TPU-native formulation:
 
-* **Encode** — ``lax.scan`` over timesteps carrying the codec state
-  (timestamp delta, XOR window, sig-bit tracker), ``vmap``'d across the
-  series axis.  Each step emits a fixed-width staging buffer (4 x uint64
-  words + bit length); a cumulative-sum over lengths then assigns every
-  datapoint its bit offset and a scatter-add packs the payload words into
-  the output stream (disjoint bit ranges make add equivalent to or).
+* **Encode** — two phases, the mirror of decode (round 9).  Phase 1 is
+  a ``lax.scan`` over timesteps carrying ONLY the narrow codec control
+  state (timestamp delta, XOR hysteresis, sig-bit tracker), emitting
+  per-datapoint lane tables: four (value, width) fields per point,
+  composed with static shift-ors — no bit assembly rides the scan.
+  Phase 2 computes every datapoint's absolute output bit offset with
+  ONE exclusive prefix sum over the widths and assembles output words
+  scatter-free (cumsum-interval gathers, or the Pallas placement
+  kernel on TPU — ``M3_ENCODE_PLACE``; disjoint bit ranges make add
+  equivalent to or).
 * **Decode** — ``lax.scan`` over datapoint slots operating on (S,)
   arrays, with a dynamic bit-cursor per series.  Bit reads never touch
   memory: each lane carries a 32-word (2048-bit) window of its stream
@@ -54,16 +58,15 @@ I64 = jnp.int64
 I32 = jnp.int32
 MASK64 = (1 << 64) - 1
 
-STAGE_WORDS = 4  # 256 bits of staging per datapoint (worst case ~227)
-
-# Datapoints decoded/encoded per scan-loop iteration (lax.scan unroll):
-# larger amortizes per-step overhead and keeps the carry fused between
-# chained bodies, but MULTIPLIES compile time of the already-large step
-# body (unroll=4 took the S=2000 decode compile from ~40s to 9+ minutes
-# on XLA-CPU — measured round 4).  Round-5 measurement: on XLA-CPU
-# unroll=2 DECODES 13x SLOWER than unroll=1 (161K vs 2.09M dp/s at
-# S=10K — the duplicated step body spills the carry out of registers);
-# do not raise this on CPU.  Default 1; the TPU tradeoff is separately
+# Datapoints encoded per scan-loop iteration (lax.scan unroll): larger
+# amortizes per-step overhead and keeps the carry fused between chained
+# bodies, but MULTIPLIES compile time of the step body (unroll=4 took
+# the S=2000 decode compile from ~40s to 9+ minutes on XLA-CPU —
+# measured round 4; the round-5 "unroll=2 decodes 13x slower" spill
+# was the old WIDE-carry formulations' — both are gone since the
+# two-phase splits).  Round-9 measurement on the narrow-carry encode
+# scan: unroll=2 is compile-slower and within noise at steady state on
+# XLA-CPU, so the default stays 1; the TPU tradeoff is separately
 # measured by the watcher's decode_u* stages.
 try:
     _SCAN_UNROLL = max(1, int(os.environ.get("M3_SCAN_UNROLL", "1")))
@@ -122,6 +125,29 @@ def _sign_extend(v, nbits):
 # ---------------------------------------------------------------------------
 
 
+def _mul10_me(mant, exp2):
+    """Exact IEEE float64 multiply by 10 in the (mantissa, exp2)
+    representation: value = mant * 2^exp2, mant < 2^53 (mant in
+    [2^52, 2^53) for normals, unnormalized with exp2 == -1074 for
+    subnormals).  Equivalent to ``fe.mul10(bits)`` without the
+    pack/unpack round-trip through the bit representation — the
+    classify loop below runs this 7 times per datapoint, and the
+    full ``_pack`` (msb search, subnormal clamps, carry fixes) was
+    ~3x the ops of this direct form (round-9 encode profiling)."""
+    p = mant * _c(10)  # < 2^57: never overflows
+    L = fe.msb_index(jnp.maximum(p, _c(1)))
+    sh = jnp.maximum(L, _c(52)) - _c(52)
+    # sh > 0 only when p >= 2^53, i.e. the result is normal and RNE
+    # rounds at its 53-bit ulp; p < 2^53 stays exact at the carried
+    # exp2 granularity (subnormals keep their fixed 2^-1074 ulp, and
+    # exp2 + sh can never sink below -1074 since sh >= 0).
+    q = fe._round_shift_right_even(p, sh)
+    carried = q >= _c(1 << 53)
+    q = jnp.where(carried, q >> _c(1), q)
+    exp2p = exp2 + sh.astype(I64) + carried.astype(I64)
+    return q, jnp.where(mant == _c(0), exp2, exp2p)
+
+
 def classify_value(v_bits, cur_mult):
     """Returns (val int64 scaled, mult int32, is_float bool, precision_flag bool).
 
@@ -144,28 +170,51 @@ def classify_value(v_bits, cur_mult):
     quick_mag = jnp.where(sat, _c(_I64_MIN, I64), ipart0.astype(I64))
     quick_val = jnp.where(sign & ~sat, -quick_mag, quick_mag)
 
-    # Multiplier loop: val = v * 10^cur, then *10 per iteration, looking for
-    # a value within 1 ulp of an integer (see scalar codec for the ulp
-    # reduction of the Modf/Nextafter conditions).
-    val_bits = fe.mul_pow10(abs_b, cur_mult)
+    # Multiplier loop: val = v * 10^cur, then *10 per iteration, looking
+    # for a value within 1 ulp of an integer.  The loop runs in the
+    # (mantissa, exp2) domain: with s = -exp2 and frac = mant & (2^s-1),
+    # the reference's Modf/Nextafter conditions (see the scalar codec's
+    # ulp reduction, and the bits-domain forms this replaced:
+    # ``val_bits <= bits(ip)+1`` / ``val_bits+1 >= bits(ip+1)``) reduce
+    # EXACTLY to ``frac <= 1`` / ``frac >= 2^s - 1``: positive float
+    # bit patterns are value-ordered and increment across binades, so
+    # "within one ulp of an integer" is a pure property of the fraction
+    # field.  This cuts the two uint_to_f64_bits packs + floor_parts +
+    # full mul10 per iteration (~110 ops) to ~50, and every byte is
+    # still pinned by the oracle/corpus/fuzz suites.
+    val_bits0 = fe.mul_pow10(abs_b, cur_mult)
+    mant, exp2 = fe._mantissa_and_exp2(val_bits0)
     found = jnp.zeros_like(sign)
     res_i = jnp.zeros_like(abs_b)
     res_mult = jnp.zeros_like(cur_mult)
     for k in range(7):
+        # current value's bit pattern (monotone compare key): normals
+        # re-pack from (mant, exp2); subnormals (unnormalized mant,
+        # exp2 == -1074) ARE their bit pattern.
+        vb_cur = jnp.where(
+            mant < _c(fe.IMPLICIT), mant,
+            ((exp2 + _c(1075, I64)).astype(U64) << _c(52))
+            | (mant & _c(fe.MASK52)))
         active = (~quick_ok) & (~found) & (_c(k, I32) >= cur_mult) & (
-            val_bits < _c(_BITS_1E13)) & ~special
-        ip, fz = fe.floor_parts(val_bits)
-        bi = fe.uint_to_f64_bits(ip)
-        bi1 = fe.uint_to_f64_bits(ip + _c(1))
-        take_i = fz | (val_bits <= bi + _c(1))
-        take_i1 = (~take_i) & (val_bits + _c(1) >= bi1)
+            vb_cur < _c(_BITS_1E13)) & ~special
+        s = jnp.clip(-exp2, 0, 63).astype(U64)
+        big_s = -exp2 > _c(63, I64)  # val << 1: ip == 0, frac == mant
+        frac = mant & ((_c(1) << s) - _c(1))
+        frac = jnp.where(big_s, mant, frac)
+        ip = jnp.where(big_s, _c(0), mant >> s)
+        # active lanes have val < 1e13 < 2^53 => exp2 <= 0, so the
+        # s == -exp2 clamp only ever bites inactive lanes (discarded).
+        take_i = frac <= _c(1)
+        take_i1 = (~take_i) & (frac >= ((_c(1) << s) - _c(1)))
         hit = active & (take_i | take_i1)
         chosen = jnp.where(take_i, ip, ip + _c(1))
         res_i = jnp.where(hit, chosen, res_i)
         res_mult = jnp.where(hit, _c(k, I32), res_mult)
         found = found | hit
         advance = active & ~hit
-        val_bits = jnp.where(advance, fe.mul10(val_bits), val_bits)
+        m10, e10 = _mul10_me(mant, exp2)
+        mant = jnp.where(advance, m10, mant)
+        exp2 = jnp.where(advance, e10, exp2)
 
     loop_val = jnp.where(sign, -(res_i.astype(I64)), res_i.astype(I64))
 
@@ -179,106 +228,113 @@ def classify_value(v_bits, cur_mult):
 
 
 # ---------------------------------------------------------------------------
-# Bit builder: append fields into 4x uint64 staging words
+# Encoder phase 1: branchless per-datapoint lane emission
 # ---------------------------------------------------------------------------
+#
+# The round-9 mirror of the two-phase decode: the sequential scan no
+# longer ASSEMBLES bits (the old formulation threaded a 4-word staging
+# buffer through ~25 dynamic-offset `_bb_append` funnels per step —
+# ~7.8K element-ops/datapoint, and the reason encode compiled in ~11s
+# and ran at ~0.5M dp/s while decode did 7M).  Phase 1 only RESOLVES
+# the format: each datapoint's emission is a concatenation of a
+# bounded set of variable-width fields, and every path's fields fold
+# into at most FOUR value lanes, each <= 64 bits, composed with plain
+# shift-ors (static in-lane offsets — no funnel):
+#
+#   t0  timestamp control+payload: the dod opcode fused with its
+#       payload when it fits a word (<= 36 bits), or the 19-bit
+#       TU-marker prefix / 4-bit default-bucket opcode otherwise
+#   t1  the 64-bit dod payload (TU path / default bucket), else empty
+#   v0  value control: mode/update/sig/mult/sign or XOR opcode+lead/
+#       meaningful fields (<= 16 bits)
+#   v1  value payload: full float, XOR window, or int diff (<= 64)
+#
+# Widths ride four i32 lanes beside the values; the scan stacks both
+# as (T, 4, S) tables whose (4T, S) stream-order reshape is free.
+# Phase 2 turns the widths into absolute bit offsets with ONE
+# exclusive prefix sum and assembles output words from the
+# (value, offset, width) lanes — see `_encode_batch_device`.  The lane
+# table is format-agnostic on purpose: a DeXOR-class codec (ROADMAP
+# item 5) emits through the same (value, width) contract with its own
+# field resolution.
 
 
-def _bb_new():
-    return (jnp.zeros((), U64), jnp.zeros((), U64), jnp.zeros((), U64),
-            jnp.zeros((), U64), jnp.zeros((), I32))
-
-
-def _bb_append(bb, value, nbits, enable=None):
-    """Append the low ``nbits`` of value. nbits may be a traced int32; when
-    ``enable`` is False (or nbits == 0) this is a no-op."""
-    w0, w1, w2, w3, ln = bb
-    nbits = _c(nbits, I32)
+def _cat(acc, add_val, add_n, enable=None):
+    """Append the low ``add_n`` (< 64, possibly traced) bits of
+    ``add_val`` to the (value, nbits) accumulator — MSB-first: earlier
+    fields land in higher bits, matching OStream order."""
+    val, n = acc
+    add_n = _c(add_n, I32)
     if enable is not None:
-        nbits = jnp.where(enable, nbits, _c(0, I32))
-    value = _c(value) & jnp.where(nbits >= _c(64, I32), _c(MASK64),
-                                  (_shl(_c(1), nbits.astype(U64)) - _c(1)))
-    pos = ln.astype(U64)
-    n = nbits.astype(U64)
-    off = pos & _c(63)
-    widx = (pos >> _c(6)).astype(I32)
-    in_first = jnp.minimum(n, _c(64) - off)
-    rest = n - in_first
-    first_chunk = _shl(_shr(value, rest), _c(64) - off - in_first)
-    second_chunk = _shl(value & (_shl(_c(1), rest) - _c(1)), _c(64) - rest)
-    nonzero = nbits > _c(0, I32)
-    first_chunk = jnp.where(nonzero, first_chunk, _c(0))
-    second_chunk = jnp.where(nonzero & (rest > _c(0)), second_chunk, _c(0))
-    ws = [w0, w1, w2, w3]
-    out = []
-    for j in range(STAGE_WORDS):
-        wj = ws[j]
-        wj = wj | jnp.where(widx == j, first_chunk, _c(0))
-        wj = wj | jnp.where(widx == j - 1, second_chunk, _c(0))
-        out.append(wj)
-    return (out[0], out[1], out[2], out[3], ln + nbits)
-
-
-# ---------------------------------------------------------------------------
-# Encoder scan
-# ---------------------------------------------------------------------------
+        add_n = jnp.where(enable, add_n, _c(0, I32))
+    sh = add_n.astype(U64)
+    val = (val << sh) | (_c(add_val) & ((_c(1) << sh) - _c(1)))
+    return val, n + add_n
 
 
 # Non-default delta-of-delta buckets: (opcode, num_opcode_bits, num_value_bits).
 _DOD_BUCKETS = ((0b10, 2, 7), (0b110, 3, 9), (0b1110, 4, 12))
 
 
-def _append_dod(bb, dod, unit_is_32bit):
-    """Append a bucketed delta-of-delta (already unit-normalized).
-
-    Returns (bb, overflow) where overflow marks a dod that does not fit the
-    32-bit default bucket of second/millisecond units (the reference raises
-    OverflowError there: timestamp_encoder.go:213-221)."""
+def _dod_lanes(dod, default_unit_is_32bit: bool):
+    """Bucketed delta-of-delta (timestamp_encoder.go:131-221) as lane
+    fields: (t0, n_t0, need64, overflow).  Opcode and payload compose
+    into the single <= 36-bit t0 field except the 64-bit default
+    bucket, whose payload rides the t1 lane (``need64``); ``overflow``
+    marks a dod outside the 32-bit default bucket of second/
+    millisecond units (the reference raises OverflowError there)."""
+    d = dod.astype(U64)
     is_zero = dod == _c(0, I64)
-    bb = _bb_append(bb, _c(0), _c(1, I32), enable=is_zero)
-    done = is_zero
-    for opcode, nob, nvb in _DOD_BUCKETS:
+    fits = []
+    for _, _, nvb in _DOD_BUCKETS:
         lo, hi = -(1 << (nvb - 1)), (1 << (nvb - 1)) - 1
-        fits = (~done) & (dod >= _c(lo, I64)) & (dod <= _c(hi, I64))
-        bb = _bb_append(bb, _c(opcode), _c(nob, I32), enable=fits)
-        bb = _bb_append(bb, dod.astype(U64), _c(nvb, I32), enable=fits)
-        done = done | fits
-    # default bucket: 32-bit (s/ms) or 64-bit (us/ns) value
-    take_def = ~done
-    bb = _bb_append(bb, _c(0b1111), _c(4, I32), enable=take_def)
-    nvb = jnp.where(unit_is_32bit, _c(32, I32), _c(64, I32))
-    bb = _bb_append(bb, dod.astype(U64), nvb, enable=take_def)
-    overflow = take_def & unit_is_32bit & (
-        (dod < _c(-(2**31), I64)) | (dod > _c(2**31 - 1, I64)))
-    return bb, overflow
+        fits.append((dod >= _c(lo, I64)) & (dod <= _c(hi, I64)))
+    t1_ = (~is_zero) & fits[0]
+    t2_ = (~is_zero) & ~fits[0] & fits[1]
+    t3_ = (~is_zero) & ~fits[1] & fits[2]
+    take_def = (~is_zero) & ~fits[2]
+    if default_unit_is_32bit:
+        t0_def = (_c(0b1111) << _c(32)) | (d & _c(0xFFFFFFFF))
+        n_def = _c(36, I32)
+        need64 = jnp.zeros_like(is_zero)
+        overflow = take_def & ((dod < _c(-(2**31), I64))
+                               | (dod > _c(2**31 - 1, I64)))
+    else:
+        t0_def = _c(0b1111)
+        n_def = _c(4, I32)
+        need64 = take_def
+        overflow = jnp.zeros_like(is_zero)
+    t0 = jnp.where(
+        is_zero, _c(0),
+        jnp.where(t1_, (_c(0b10) << _c(7)) | (d & _c(0x7F)),
+        jnp.where(t2_, (_c(0b110) << _c(9)) | (d & _c(0x1FF)),
+        jnp.where(t3_, (_c(0b1110) << _c(12)) | (d & _c(0xFFF)), t0_def))))
+    n_t0 = jnp.where(
+        is_zero, _c(1, I32),
+        jnp.where(t1_, _c(9, I32),
+        jnp.where(t2_, _c(12, I32),
+        jnp.where(t3_, _c(16, I32), n_def))))
+    return t0, n_t0, need64, overflow
 
 
-def _append_xor(bb, state, cur_xor):
-    """Gorilla XOR emit (float_encoder_iterator.go:82-103). Returns (bb, new prev_xor)."""
-    prev_xor = state
-    is_zero = cur_xor == _c(0)
-    bb = _bb_append(bb, _c(0), _c(1, I32), enable=is_zero)
-
-    pl = jnp.where(prev_xor == _c(0), _c(64, I32),
-                   lax.clz(prev_xor.astype(I64)).astype(I32))
-    # trailing zeros = index of lowest set bit
-    pt = jnp.where(prev_xor == _c(0), _c(0, I32),
-                   (_num_sig(prev_xor & (~prev_xor + _c(1))) - _c(1, I32)))
-    cl = lax.clz(jnp.maximum(cur_xor, _c(1)).astype(I64)).astype(I32)
-    ct = _num_sig(cur_xor & (~cur_xor + _c(1))) - _c(1, I32)
-
-    contained = (~is_zero) & (cl >= pl) & (ct >= pt)
-    bb = _bb_append(bb, _c(0b10), _c(2, I32), enable=contained)
-    bb = _bb_append(bb, _shr(cur_xor, pt.astype(U64)),
-                    _c(64, I32) - pl - pt, enable=contained)
-
-    uncont = (~is_zero) & (~contained)
-    meaningful = _c(64, I32) - cl - ct
-    bb = _bb_append(bb, _c(0b11), _c(2, I32), enable=uncont)
-    bb = _bb_append(bb, cl.astype(U64), _c(6, I32), enable=uncont)
-    bb = _bb_append(bb, (meaningful - _c(1, I32)).astype(U64), _c(6, I32), enable=uncont)
-    bb = _bb_append(bb, _shr(cur_xor, ct.astype(U64)), meaningful, enable=uncont)
-    new_prev_xor = jnp.where(is_zero, _c(0), cur_xor)
-    return bb, new_prev_xor
+def _int_sig_mult_ctrl(acc, num_sig_st, max_mult, sig, mult, float_changed):
+    """writeIntSigMult (encoder.go:235-250) as control-field
+    composition onto ``acc``: the sig-change cascade
+    (sb1 [sb2 sig6]) then the multiplier update (mb1 [mult3]).
+    Returns (acc, new num_sig, new max_mult)."""
+    sig_changed = num_sig_st != sig
+    zero_sig = sig == _c(0, I32)
+    acc = _cat(acc, jnp.where(sig_changed, _c(1), _c(0)), 1)
+    acc = _cat(acc, jnp.where(zero_sig, _c(0), _c(1)), 1, enable=sig_changed)
+    acc = _cat(acc, (sig - _c(1, I32)).astype(U64), 6,
+               enable=sig_changed & ~zero_sig)
+    mult_up = mult > max_mult
+    # after WriteIntSig num_sig == sig, so condition reduces to:
+    float_only = (~mult_up) & (max_mult == mult) & float_changed
+    wr = mult_up | float_only
+    acc = _cat(acc, jnp.where(wr, _c(1), _c(0)), 1)
+    acc = _cat(acc, mult.astype(U64), 3, enable=wr)
+    return acc, sig, jnp.where(mult_up, mult, max_mult)
 
 
 def _track_new_sig(num_sig_st, cur_hl, num_lower, sig):
@@ -304,113 +360,97 @@ def _track_new_sig(num_sig_st, cur_hl, num_lower, sig):
     return new_sig, chl, nl
 
 
-def _append_int_sig_mult(bb, num_sig_st, max_mult, sig, mult, float_changed):
-    """writeIntSigMult (encoder.go:235-250). Returns (bb, new num_sig, new max_mult)."""
-    # WriteIntSig
-    sig_changed = num_sig_st != sig
-    bb = _bb_append(bb, _c(1), _c(1, I32), enable=sig_changed)
-    zero_sig = sig == _c(0, I32)
-    bb = _bb_append(bb, _c(0), _c(1, I32), enable=sig_changed & zero_sig)
-    bb = _bb_append(bb, _c(1), _c(1, I32), enable=sig_changed & ~zero_sig)
-    bb = _bb_append(bb, (sig - _c(1, I32)).astype(U64), _c(6, I32),
-                    enable=sig_changed & ~zero_sig)
-    bb = _bb_append(bb, _c(0), _c(1, I32), enable=~sig_changed)
-    new_num_sig = sig
-    # mult update
-    mult_up = mult > max_mult
-    # after WriteIntSig num_sig == sig, so condition reduces to:
-    float_only = (~mult_up) & (max_mult == mult) & float_changed
-    bb = _bb_append(bb, _c(1), _c(1, I32), enable=mult_up | float_only)
-    bb = _bb_append(bb, mult.astype(U64), _c(3, I32), enable=mult_up | float_only)
-    bb = _bb_append(bb, _c(0), _c(1, I32), enable=~(mult_up | float_only))
-    new_max_mult = jnp.where(mult_up, mult, max_mult)
-    return bb, new_num_sig, new_max_mult
-
-
-def _append_int_val_diff(bb, num_sig_st, diff_bits, neg):
-    bb = _bb_append(bb, jnp.where(neg, _c(1), _c(0)), _c(1, I32))
-    bb = _bb_append(bb, diff_bits, num_sig_st)
-    return bb
-
-
 def _encode_step(carry, xs, unit: int, default_unit_is_32bit: bool):
-    """One datapoint for one series. carry is the full codec state."""
+    """One datapoint for one series: resolve the format (field values
+    and widths) WITHOUT assembling bits.  The carry is only the narrow
+    codec control state; the step emits the four value lanes
+    (t0, t1, v0, v1) plus their packed widths — see the lane-table
+    comment above — and phase 2 (`_encode_batch_device`) places them
+    into the output stream with one prefix sum.  The body is one
+    branch-free straight line, mirroring the decode step's contract."""
     (prev_time, prev_delta, tu_none, int_val, max_mult, is_float,
      prev_fbits, prev_xor, num_sig_st, cur_hl, num_lower, is_first,
      fallback) = carry
     t, v_bits, valid = xs
 
-    bb = _bb_new()
-
     # ---- timestamp (timestamp_encoder.go:72-129) ----
-    # first datapoint of the stream: 64-bit start already emitted by caller
-    # via the start word (prev_time holds start). Time-unit change marker if
-    # the initial unit was None (unaligned start).
+    # first datapoint of the stream: 64-bit start already emitted by the
+    # caller via the start word (prev_time holds start).  Time-unit
+    # change marker if the initial unit was None (unaligned start):
+    # 0x100 marker(9) + TU opcode(2) + unit byte(8) — one 19-bit static
+    # constant — then the full 64-bit nanosecond dod on the t1 lane.
     emit_tu = is_first & tu_none
-    bb = _bb_append(bb, _c(0x100), _c(9, I32), enable=emit_tu)
-    bb = _bb_append(bb, _c(2), _c(2, I32), enable=emit_tu)  # time-unit marker
-    bb = _bb_append(bb, _c(unit), _c(8, I32), enable=emit_tu)
-
     time_delta = t - prev_time
     dod_ns = time_delta - prev_delta
-    # after a TU write: full 64-bit nanosecond dod, delta resets to 0
-    bb = _bb_append(bb, dod_ns.astype(U64), _c(64, I32), enable=emit_tu)
     unit_nanos = int(Unit(unit).nanos())
-    dod_units = dod_ns // _c(unit_nanos, I64)  # deltas divisible (checked by caller)
+    dod_units = dod_ns // _c(unit_nanos, I64)  # deltas divisible (checked below)
     div_ok = (dod_ns % _c(unit_nanos, I64)) == _c(0, I64)
-    bb_dod, dod_overflow = _append_dod(bb, dod_units,
-                                       _c(default_unit_is_32bit, jnp.bool_))
-    # Only one of the two paths appended bits (enable flags), so select:
-    bb = tuple(jnp.where(emit_tu, a, b) for a, b in zip(bb, bb_dod))
+    t0_b, n_t0_b, need64, dod_overflow = _dod_lanes(dod_units,
+                                                    default_unit_is_32bit)
+    tu_const = (0x100 << 10) | (0b10 << 8) | (unit & 0xFF)
+    t0 = jnp.where(emit_tu, _c(tu_const), t0_b)
+    n_t0 = jnp.where(emit_tu, _c(19, I32), n_t0_b)
+    t1_64 = emit_tu | (need64 & ~emit_tu)
+    t1 = jnp.where(emit_tu, dod_ns.astype(U64), dod_units.astype(U64))
+    n_t1 = jnp.where(t1_64, _c(64, I32), _c(0, I32))
     new_prev_delta = jnp.where(emit_tu, _c(0, I64), time_delta)
     new_prev_time = t
     new_tu_none = tu_none & ~emit_tu
 
     # ---- value ----
     val, mult, v_is_float, prec = classify_value(v_bits, max_mult)
+    acc0 = (_c(0), _c(0, I32))
 
     # ---------- first value (encoder.go:112-146) ----------
-    bb_f = bb
-    bb_f = _bb_append(bb_f, jnp.where(v_is_float, _c(1), _c(0)), _c(1, I32))
-    # float mode
-    bb_ff = _bb_append(bb_f, v_bits, _c(64, I32))
-    # int mode
+    # float mode: '1' + the raw 64 bits; int mode: '0' + sig/mult
+    # cascade + sign on v0, the magnitude (sig_f bits) on v1.  The
+    # cascade itself is emitted by the SHARED _int_sig_mult_ctrl call
+    # below (first-value and to-int-update paths run the identical
+    # writeIntSigMult; only the opcode prefix, the candidate sig and
+    # the float_changed flag differ, so the inputs select per path
+    # instead of running the ~60-op cascade twice).
     neg_diff = val >= _c(0, I64)  # inverted: diff = 0 - val
     mag = jnp.abs(val).astype(U64)
     sig_f = _num_sig(mag)
-    bb_fi, ns_fi, mm_fi = _append_int_sig_mult(
-        bb_f, num_sig_st, max_mult, sig_f, mult, _c(False, jnp.bool_))
-    bb_fi = _append_int_val_diff(bb_fi, ns_fi, mag, neg_diff)
-    bb_first = tuple(jnp.where(v_is_float, a, b) for a, b in zip(bb_ff, bb_fi))
-    st_first = dict(
-        int_val=jnp.where(v_is_float, int_val, val),
-        is_float=v_is_float,
-        prev_fbits=jnp.where(v_is_float, v_bits, prev_fbits),
-        prev_xor=jnp.where(v_is_float, v_bits, prev_xor),
-        num_sig=jnp.where(v_is_float, num_sig_st, ns_fi),
-        max_mult_i=jnp.where(v_is_float, mult, mm_fi),
-        cur_hl=cur_hl, num_lower=num_lower,
-    )
 
     # ---------- next value (encoder.go:148-231) ----------
     val_diff = int_val - val
     # float path trigger (diff overflow impossible: flagged by prec limit)
     go_float = v_is_float
-    # writeFloatVal
     was_int = ~is_float
-    bb_n = bb
-    #   int->float: '0''0''1' + full float
-    bb_nf1 = _bb_append(bb_n, _c(0b001), _c(3, I32))
-    bb_nf1 = _bb_append(bb_nf1, v_bits, _c(64, I32))
-    #   float repeat: '0''1'
+
+    # writeFloatVal: int->float '001'+float64; repeat '01'; else '1' +
+    # Gorilla XOR (float_encoder_iterator.go:82-103) — zero '0',
+    # contained '10'+window, uncontained '11'+lead6+meaningful6+window
+    # (the leading '1' value bit fuses into each opcode below).
     repeat_f = is_float & (v_bits == prev_fbits)
-    bb_nf2 = _bb_append(bb_n, _c(0b01), _c(2, I32))
-    #   float next: '1' + xor
-    bb_nf3 = _bb_append(bb_n, _c(1), _c(1, I32))
-    bb_nf3, nxor = _append_xor(bb_nf3, prev_xor, prev_fbits ^ v_bits)
-    bb_float = tuple(
-        jnp.where(was_int, a, jnp.where(repeat_f, b, c))
-        for a, b, c in zip(bb_nf1, bb_nf2, bb_nf3))
+    cur_xor = prev_fbits ^ v_bits
+    xor_zero = cur_xor == _c(0)
+    pl = jnp.where(prev_xor == _c(0), _c(64, I32),
+                   lax.clz(prev_xor.astype(I64)).astype(I32))
+    # trailing zeros = index of lowest set bit
+    pt = jnp.where(prev_xor == _c(0), _c(0, I32),
+                   (_num_sig(prev_xor & (~prev_xor + _c(1))) - _c(1, I32)))
+    cl = lax.clz(jnp.maximum(cur_xor, _c(1)).astype(I64)).astype(I32)
+    ct = _num_sig(cur_xor & (~cur_xor + _c(1))) - _c(1, I32)
+    contained = (~xor_zero) & (cl >= pl) & (ct >= pt)
+    meaningful = _c(64, I32) - cl - ct
+    v0_unc = ((_c(0b111) << _c(12)) | (cl.astype(U64) << _c(6))
+              | (meaningful - _c(1, I32)).astype(U64))
+    v0_f = jnp.where(was_int, _c(0b001),
+           jnp.where(repeat_f, _c(0b01),
+           jnp.where(xor_zero, _c(0b10),
+           jnp.where(contained, _c(0b110), v0_unc))))
+    n_v0_f = jnp.where(was_int, _c(3, I32),
+             jnp.where(repeat_f | xor_zero, _c(2, I32),
+             jnp.where(contained, _c(3, I32), _c(15, I32))))
+    v1_f = jnp.where(was_int, v_bits,
+           jnp.where(contained, _shr(cur_xor, pt.astype(U64)),
+                     _shr(cur_xor, ct.astype(U64))))
+    n_v1_f = jnp.where(was_int, _c(64, I32),
+             jnp.where(repeat_f | xor_zero, _c(0, I32),
+             jnp.where(contained, _c(64, I32) - pl - pt, meaningful)))
+    nxor = jnp.where(xor_zero, _c(0), cur_xor)
     st_float = dict(
         int_val=int_val,
         is_float=_c(True, jnp.bool_),
@@ -420,26 +460,49 @@ def _encode_step(carry, xs, unit: int, default_unit_is_32bit: bool):
         num_sig=num_sig_st, cur_hl=cur_hl, num_lower=num_lower,
     )
 
-    # writeIntVal
+    # writeIntVal: repeat '01'; update '000'+cascade+sign+diff;
+    # no-update '1'+sign+diff
     repeat_i = (val_diff == _c(0, I64)) & (~is_float) & (mult == max_mult)
-    bb_ir = _bb_append(bb_n, _c(0b01), _c(2, I32))
     neg = val_diff < _c(0, I64)
     diff_mag = jnp.abs(val_diff).astype(U64)
     sig_n = _num_sig(diff_mag)
     new_sig, t_chl, t_nl = _track_new_sig(num_sig_st, cur_hl, num_lower, sig_n)
     float_changed = is_float  # is_float state true means mode changes to int
     need_update = (mult > max_mult) | (num_sig_st != new_sig) | float_changed
-    #   update: '1'? no: opcodeUpdate=0 -> bits '0''0''0'
-    bb_iu = _bb_append(bb_n, _c(0b000), _c(3, I32))
-    bb_iu, ns_iu, mm_iu = _append_int_sig_mult(
-        bb_iu, num_sig_st, max_mult, new_sig, mult, float_changed)
-    bb_iu = _append_int_val_diff(bb_iu, ns_iu, diff_mag, neg)
-    #   no-update: '1' + diff
-    bb_in = _bb_append(bb_n, _c(1), _c(1, I32))
-    bb_in = _append_int_val_diff(bb_in, num_sig_st, diff_mag, neg)
-    bb_int = tuple(
-        jnp.where(repeat_i, a, jnp.where(need_update, b, c))
-        for a, b, c in zip(bb_ir, bb_iu, bb_in))
+
+    # THE shared writeIntSigMult cascade: both opcode prefixes are
+    # zero-valued ('0' first-value mode bit / '000' update escape), so
+    # only the prefix WIDTH and the cascade inputs select per path.
+    acc_sh = _cat(acc0, _c(0), jnp.where(is_first, _c(1, I32), _c(3, I32)))
+    acc_sh, ns_sh, mm_sh = _int_sig_mult_ctrl(
+        acc_sh, num_sig_st, max_mult,
+        jnp.where(is_first, sig_f, new_sig), mult,
+        (~is_first) & float_changed)
+    acc_sh = _cat(acc_sh, jnp.where(jnp.where(is_first, neg_diff, neg),
+                                    _c(1), _c(0)), 1)
+
+    v0_first = jnp.where(v_is_float, _c(1), acc_sh[0])
+    n_v0_first = jnp.where(v_is_float, _c(1, I32), acc_sh[1])
+    v1_first = jnp.where(v_is_float, v_bits, mag)
+    n_v1_first = jnp.where(v_is_float, _c(64, I32), ns_sh)
+    st_first = dict(
+        int_val=jnp.where(v_is_float, int_val, val),
+        is_float=v_is_float,
+        prev_fbits=jnp.where(v_is_float, v_bits, prev_fbits),
+        prev_xor=jnp.where(v_is_float, v_bits, prev_xor),
+        num_sig=jnp.where(v_is_float, num_sig_st, ns_sh),
+        max_mult_i=jnp.where(v_is_float, mult, mm_sh),
+        cur_hl=cur_hl, num_lower=num_lower,
+    )
+
+    ns_iu, mm_iu = ns_sh, mm_sh
+    v0_i = jnp.where(repeat_i, _c(0b01),
+           jnp.where(need_update, acc_sh[0],
+                     _c(0b10) | jnp.where(neg, _c(1), _c(0))))
+    n_v0_i = jnp.where(repeat_i | ~need_update, _c(2, I32), acc_sh[1])
+    v1_i = diff_mag
+    n_v1_i = jnp.where(repeat_i, _c(0, I32),
+             jnp.where(need_update, ns_iu, num_sig_st))
     st_int = dict(
         int_val=jnp.where(repeat_i, int_val, val),
         is_float=jnp.where(repeat_i, is_float, _c(False, jnp.bool_)),
@@ -452,27 +515,30 @@ def _encode_step(carry, xs, unit: int, default_unit_is_32bit: bool):
         num_lower=jnp.where(repeat_i, num_lower, t_nl),
     )
 
-    bb_next = tuple(
-        jnp.where(go_float, a, b) for a, b in zip(bb_float, bb_int))
+    v0_next = jnp.where(go_float, v0_f, v0_i)
+    n_v0_next = jnp.where(go_float, n_v0_f, n_v0_i)
+    v1_next = jnp.where(go_float, v1_f, v1_i)
+    n_v1_next = jnp.where(go_float, n_v1_f, n_v1_i)
     st_next = {
         k: jnp.where(go_float, st_float[k], st_int[k])
         for k in st_float
     }
 
-    bb_out = tuple(jnp.where(is_first, a, b) for a, b in zip(bb_first, bb_next))
+    v0 = jnp.where(is_first, v0_first, v0_next)
+    n_v0 = jnp.where(is_first, n_v0_first, n_v0_next)
+    v1 = jnp.where(is_first, v1_first, v1_next)
+    n_v1 = jnp.where(is_first, n_v1_first, n_v1_next)
     st = {
         k: jnp.where(is_first, st_first[k], st_next[k])
         for k in st_first
     }
 
-    # inactive (padding) steps emit nothing and keep state
-    w0, w1, w2, w3, ln = bb_out
-    ln = jnp.where(valid, ln, _c(0, I32))
-    zeros = _c(0)
-    w0 = jnp.where(valid, w0, zeros)
-    w1 = jnp.where(valid, w1, zeros)
-    w2 = jnp.where(valid, w2, zeros)
-    w3 = jnp.where(valid, w3, zeros)
+    # inactive (padding) steps emit nothing (all widths 0) and keep state
+    zero_w = _c(0, I32)
+    n_t0 = jnp.where(valid, n_t0, zero_w)
+    n_t1 = jnp.where(valid, n_t1, zero_w)
+    n_v0 = jnp.where(valid, n_v0, zero_w)
+    n_v1 = jnp.where(valid, n_v1, zero_w)
 
     def keep(new, old):
         return jnp.where(valid, new, old)
@@ -494,30 +560,48 @@ def _encode_step(carry, xs, unit: int, default_unit_is_32bit: bool):
         is_first & ~valid,
         fallback,
     )
-    return new_carry, (w0, w1, w2, w3, ln)
+    return new_carry, (t0, t1, v0, v1, n_t0, n_t1, n_v0, n_v1)
 
 
-_PLACE_IMPLS = ("scatter", "gather")
+_PLACE_IMPLS = ("scatter", "gather", "pallas")
 
 
 def resolved_place() -> str:
-    """Which word-placement formulation the encoder uses on this
-    process' backend; ``M3_ENCODE_PLACE`` overrides (parity tests pin
-    both).  Resolved on the HOST, outside the trace, and passed as a
-    static argument — an env read under the tracer is frozen into the
-    first compile and the seam silently stops responding (retrace-risk;
-    exactly how the in-process override was broken until round 7)."""
+    """Which phase-2 word-placement formulation the encoder uses on
+    this process' backend; ``M3_ENCODE_PLACE`` overrides (parity tests
+    pin all of them).  Resolved on the HOST, outside the trace, and
+    passed as a static argument — an env read under the tracer is
+    frozen into the first compile and the seam silently stops
+    responding (retrace-risk; exactly how the in-process override was
+    broken until round 7).  auto = ``pallas`` only on a real TPU
+    backend (the clean-fallback contract tier-1 pins, like
+    M3_DECODE_EXTRACT), ``gather`` everywhere else."""
     place = os.environ.get("M3_ENCODE_PLACE", "").strip()
     if place:
         if place not in _PLACE_IMPLS:
             raise ValueError(
                 f"M3_ENCODE_PLACE={place!r}: expected one of {_PLACE_IMPLS}")
         return place
-    return "gather" if jax.default_backend() == "tpu" else "scatter"
+    return "pallas" if jax.default_backend() == "tpu" else "gather"
+
+
+def _lane_frags(valq, pos, n):
+    """One (value, bit offset, width) lane class -> its two word
+    fragments.  ``valq`` holds the field right-aligned (low ``n``
+    bits); the MSB-aligned 64-bit image splits across stream words
+    ``pos >> 6`` and ``pos >> 6 + 1``.  Returns (hi, lo, gw)."""
+    vm = jnp.where(n > _c(0, I32),
+                   valq << ((_c(64, I32) - n) & _c(63, I32)).astype(U64),
+                   _c(0))
+    sh = (pos & _c(63, I32)).astype(U64)
+    hi = vm >> sh
+    lo = jnp.where(sh > _c(0), vm << ((_c(64) - sh) & _c(63)), _c(0))
+    return hi, lo, pos >> _c(6, I32)
 
 
 def encode_batch_device(timestamps, value_bits, start, valid, unit: int = 1,
-                        out_words: int = 0, prefix_bits=None):
+                        out_words: int = 0, prefix_bits=None,
+                        place: str = "auto"):
     """Encode (S, T) series on device (host wrapper: resolves the
     placement seam outside the trace, then dispatches to the jitted
     implementation with ``place`` as a static argument).
@@ -534,29 +618,28 @@ def encode_batch_device(timestamps, value_bits, start, valid, unit: int = 1,
         word for a host-composed prefix (the first datapoint's
         annotation marker+varint+bytes, spliced in by ``encode_batch``);
         all emitted fields shift right by this amount.
+      place: phase-2 placement impl (see ``resolved_place``); "auto"
+        resolves per backend/env here on the host.
 
     Returns dict with packed words (S, W) uint64 (starting with the 64-bit
     start time), total_bits (S,), fallback (S,) bool.
     """
+    if place == "auto":
+        place = resolved_place()
+    if place not in _PLACE_IMPLS:
+        raise ValueError(f"place={place!r}: expected one of "
+                         f"{_PLACE_IMPLS + ('auto',)}")
     return _encode_batch_device(
         timestamps, value_bits, start, valid, unit=unit,
-        out_words=out_words, prefix_bits=prefix_bits,
-        place=resolved_place())
+        out_words=out_words, prefix_bits=prefix_bits, place=place)
 
 
-@functools.partial(jax.jit, static_argnames=("unit", "out_words", "place"))
-def _encode_batch_device(timestamps, value_bits, start, valid, unit: int = 1,
-                         out_words: int = 0, prefix_bits=None,
-                         place: str = "scatter"):
-    S, T = timestamps.shape
-    if out_words == 0:
-        out_words = (T * 16) // 64 + 4
-    u = Unit(unit)
-    default_32 = u in (Unit.SECOND, Unit.MILLISECOND)
-
-    tu_none = (start % jnp.asarray(u.nanos(), I64)) != 0
-
-    carry0 = (
+def _encode_carry0(S: int, start, unit: int):
+    """Phase-1 initial carry (shared with the profile harness — the
+    decode side's ``_decode_carry0`` precedent: one owner for the
+    carry layout, so a layout change can't silently desync a proxy)."""
+    tu_none = (start % jnp.asarray(int(Unit(unit).nanos()), I64)) != 0
+    return (
         start.astype(I64),                      # prev_time
         jnp.zeros(S, I64),                      # prev_delta
         tu_none,                                # initial unit None?
@@ -572,83 +655,140 @@ def _encode_batch_device(timestamps, value_bits, start, valid, unit: int = 1,
         jnp.zeros(S, jnp.bool_),                # fallback
     )
 
+
+@functools.partial(jax.jit, static_argnames=("unit", "out_words", "place"))
+def _encode_batch_device(timestamps, value_bits, start, valid, unit: int = 1,
+                         out_words: int = 0, prefix_bits=None,
+                         place: str = "gather"):
+    S, T = timestamps.shape
+    if out_words == 0:
+        out_words = (T * 16) // 64 + 4
+    u = Unit(unit)
+    default_32 = u in (Unit.SECOND, Unit.MILLISECOND)
+
+    carry0 = _encode_carry0(S, start, unit)
+
     step = functools.partial(_encode_step, unit=unit,
                              default_unit_is_32bit=default_32)
     vstep = jax.vmap(step)
 
     def scan_fn(carry, xs):
-        return vstep(carry, xs)
+        c2, (t0, t1, v0, v1, n0, n1, n2, n3) = vstep(carry, xs)
+        # Stack the four lanes in STREAM ORDER: the scan then yields
+        # (T, 4, S) tables whose (4T, S) reshape is free, and in that
+        # interleaved order the fragment word keys are GLOBALLY
+        # non-decreasing per series — the property the scatter-free
+        # placement below rides.
+        return c2, (jnp.stack([t0, t1, v0, v1]),
+                    jnp.stack([n0, n1, n2, n3]))
 
     xs = (timestamps.T, value_bits.T, valid.T)  # scan over T
-    carry, (w0, w1, w2, w3, lens) = lax.scan(scan_fn, carry0, xs,
-                                             unroll=_SCAN_UNROLL)
-    # outputs are (T, S); transpose to (S, T)
-    w0, w1, w2, w3 = (w.T for w in (w0, w1, w2, w3))
-    lens = lens.T.astype(jnp.int64)
+    carry, (lv, lw) = lax.scan(scan_fn, carry0, xs, unroll=_SCAN_UNROLL)
+    # Lane tables stay SCAN-MAJOR — (T, 4, S), no transpose.  All
+    # offset arithmetic is pinned i32 (sum/cumsum would silently
+    # promote to i64 — double the traffic of the placement stages).
+    lens = lw.sum(axis=1, dtype=I32)  # (T, S) per-datapoint bit counts
 
-    # bit offsets: 64 bits for the start word (+ any host prefix), then
-    # cumulative lengths
-    base = 64 if prefix_bits is None else (
-        64 + prefix_bits.astype(jnp.int64)[:, None])
-    offsets = jnp.cumsum(lens, axis=1) - lens + base
-    total_bits = offsets[:, -1] + lens[:, -1]
+    # Absolute bit offsets: ONE exclusive prefix sum over per-datapoint
+    # bit counts (the only cross-datapoint dependence left after the
+    # scan), based at the 64-bit start word (+ any host prefix); each
+    # lane's offset adds its in-datapoint exclusive width sum.
+    base = _c(64, I32) if prefix_bits is None else (
+        _c(64, I32) + prefix_bits.astype(I32)[None, :])
+    off_dp = jnp.cumsum(lens, axis=0, dtype=I32) - lens + base
+    total_bits = (off_dp[-1] + lens[-1]).astype(jnp.int64)
+    pos = off_dp[:, None, :] + (jnp.cumsum(lw, axis=1, dtype=I32) - lw)
+
+    F = 4 * T
+    val4 = lv.reshape(F, S)
+    pos4 = pos.reshape(F, S)
+    n4 = lw.reshape(F, S)
+    hi, lo, gw = _lane_frags(val4, pos4, n4)  # (F, S), gw non-decreasing
 
     out = jnp.zeros((S, out_words), U64)
     # start word first
     out = out.at[:, 0].set(start.astype(U64))
 
-    # Word placement: every step contributes (hi, lo) word fragments at
-    # per-series word indices gw / gw+1.  Two formulations:
-    #   scatter — 8 scatter-adds over (S, T); fine on XLA-CPU.
-    #   gather  — per-series word indices are NON-DECREASING along T
-    #             (offsets are cumulative), so for each output word the
-    #             contributing step range is a searchsorted interval and
-    #             its sum a cumsum difference — exact even with u64
-    #             wraparound ((A+B)-A == B mod 2^64).  No scatter; built
-    #             for TPU (~1us/element scatter, TPU_RESULTS_r05.json).
+    # Word placement: every lane contributes (hi, lo) word fragments at
+    # per-series word indices gw / gw+1 (disjoint bit ranges make add
+    # equivalent to or).  Three formulations behind the static seam:
+    #   scatter — two scatter-adds over the (F, S) fragments; the
+    #             XLA-CPU scatter floor (~43ns/elt, BENCH_r07) makes it
+    #             the SLOW tail at corpus scale but the cheapest
+    #             compile.
+    #   gather  — scatter-free: the stream-order fragment keys are
+    #             NON-DECREASING along F, so each output word's
+    #             contribution is a rank interval of the fragment
+    #             cumsum — exact even under u64 wraparound ((A+B)-A ==
+    #             B mod 2^64).  One branchless binary search serves
+    #             both classes: the lo-class keys are gw+1, so its
+    #             rank table is the hi-class's shifted one query down.
+    #             The same segmented idiom as parallel/segmented.py.
+    #   pallas  — the hand-scheduled TPU kernel: the masked-sum
+    #             scatter inversion of the decode gather kernel
+    #             (parallel/pallas_encode.py); interpret mode off-TPU.
     # ``place`` is STATIC, resolved by the encode_batch_device wrapper
     # (resolved_place: backend default, M3_ENCODE_PLACE override).
-    if place == "gather":
-        w_queries = jnp.arange(out_words, dtype=jnp.int64)
+    if place == "pallas":
+        from m3_tpu.parallel import pallas_encode
+
+        frags = jnp.concatenate([hi.T, lo.T], axis=1)   # (S, 2F)
+        keys = jnp.concatenate([gw.T, gw.T + _c(1, I32)], axis=1)
+        out = out + pallas_encode.place_words(frags, keys, out_words)
+    elif place == "gather":
+        # Series-major for the gather stages: axis-1 gathers walk
+        # contiguous rows; the axis-0 formulation's column-strided
+        # accesses measured ~3x slower on XLA-CPU.
         zero_col = jnp.zeros((S, 1), U64)
-        for j, wj in enumerate((w0, w1, w2, w3)):
-            pos = offsets + j * 64
-            sh = (pos & 63).astype(U64)
-            in_range = (j * 64) < lens
-            hi = jnp.where(in_range, _shr(wj, sh), _c(0))
-            lo_shift = _c(64) - sh
-            lo = jnp.where(in_range & (sh > _c(0)), _shl(wj, lo_shift),
-                           _c(0))
-            for delta, frag in ((0, hi), (1, lo)):
-                keys = (pos >> 6) + delta  # (S, T) non-decreasing rows
-                cum = jnp.concatenate(
-                    [zero_col, jnp.cumsum(frag, axis=1)], axis=1)
-                p_hi = jax.vmap(
-                    lambda row: jnp.searchsorted(row, w_queries,
-                                                 side="right"))(keys)
-                # For contiguous integer queries, left(w) == right(w-1):
-                # one sweep serves both interval bounds.  Keys are >= 1
-                # (offsets start at base >= 64), so left(0) == 0.
-                p_lo = jnp.concatenate(
-                    [jnp.zeros((S, 1), p_hi.dtype), p_hi[:, :-1]], axis=1)
-                out = out + (jnp.take_along_axis(cum, p_hi, axis=1)
-                             - jnp.take_along_axis(cum, p_lo, axis=1))
+
+        def _lane_cumsum_t(frag):
+            # Inclusive lane cumsum, HIERARCHICALLY: 3 adds within
+            # each datapoint's 4 lanes + one 4x-shorter dp-level
+            # cumsum (XLA-CPU lowers a long cumsum to log-depth
+            # full-array passes, so the (F, S) form paid ~4x this
+            # traffic; exact either way — u64 adds commute).
+            r = frag.reshape(T, 4, S)
+            within = jnp.cumsum(r, axis=1)
+            dp_sums = within[:, 3]
+            dp_pre = jnp.cumsum(dp_sums, axis=0) - dp_sums
+            return (dp_pre[:, None, :] + within).reshape(F, S).T
+
+        cum_hi = jnp.concatenate([zero_col, _lane_cumsum_t(hi)], axis=1)
+        cum_lo = jnp.concatenate([zero_col, _lane_cumsum_t(lo)], axis=1)
+        keys = gw.T  # (S, F), non-decreasing rows
+        # rank[s, w] = #lanes with key <= w, all output words at once:
+        # one branchless binary search (cand-1 stays in range via the
+        # min; the cand <= F guard rejects the clamped probes).
+        wq = jnp.arange(out_words, dtype=I32)[None, :]  # (1, W)
+        rank = jnp.zeros((S, out_words), I32)
+        # 2^k > F so the greedy bit descent can reach rank == F exactly
+        # (every lane before the word): (F-1).bit_length() tops out at
+        # 2^k - 1 = F - 1 and silently drops the LAST lane's fragment
+        # from the final stream word.
+        for b in reversed(range(max(F, 1).bit_length())):
+            cand = rank + _c(1 << b, I32)
+            kv = jnp.take_along_axis(
+                keys, jnp.minimum(cand, _c(F, I32)) - _c(1, I32), axis=1)
+            rank = jnp.where((cand <= _c(F, I32)) & (kv <= wq), cand, rank)
+        # Contiguous integer queries: rank(w-1) is rank shifted one
+        # column (keys are >= 1 — offsets start at base >= 64 — so
+        # rank(0) == 0 and the shifted-in zero column is exact).  The
+        # lo-class keys are gw+1, so its rank table is the hi-class's
+        # shifted once more: no second search.
+        zc = jnp.zeros((S, 1), I32)
+        rank_m1 = jnp.concatenate([zc, rank[:, :-1]], axis=1)
+        rank_m2 = jnp.concatenate([zc, rank_m1[:, :-1]], axis=1)
+        out = out + (jnp.take_along_axis(cum_hi, rank, axis=1)
+                     - jnp.take_along_axis(cum_hi, rank_m1, axis=1)
+                     + jnp.take_along_axis(cum_lo, rank_m1, axis=1)
+                     - jnp.take_along_axis(cum_lo, rank_m2, axis=1))
     else:
-        series_idx = jnp.broadcast_to(jnp.arange(S, dtype=I64)[:, None],
-                                      (S, T))
-        for j, wj in enumerate((w0, w1, w2, w3)):
-            pos = offsets + j * 64
-            gw = (pos >> 6).astype(I32)
-            sh = (pos & 63).astype(U64)
-            in_range = (j * 64) < lens  # word j carries bits iff len > 64j
-            hi = jnp.where(in_range, _shr(wj, sh), _c(0))
-            lo_shift = _c(64) - sh
-            lo = jnp.where(in_range & (sh > _c(0)), _shl(wj, lo_shift),
-                           _c(0))
-            out = out.at[series_idx, jnp.clip(gw, 0, out_words - 1)].add(
-                jnp.where(gw < out_words, hi, _c(0)))
-            out = out.at[series_idx, jnp.clip(gw + 1, 0, out_words - 1)].add(
-                jnp.where(gw + 1 < out_words, lo, _c(0)))
+        series_idx = jnp.broadcast_to(jnp.arange(S, dtype=I32)[None, :],
+                                      (F, S))
+        out = out.at[series_idx, jnp.clip(gw, 0, out_words - 1)].add(
+            jnp.where(gw < out_words, hi, _c(0)))
+        out = out.at[series_idx, jnp.clip(gw + 1, 0, out_words - 1)].add(
+            jnp.where(gw + 1 < out_words, lo, _c(0)))
 
     fallback = carry[12] | (total_bits > (out_words * 64))
     return {"words": out, "total_bits": total_bits, "fallback": fallback}
@@ -710,7 +850,7 @@ def _annotation_prefix(ann: bytes):
 
 
 def encode_batch(timestamps, values, start, counts=None, unit: Unit = Unit.SECOND,
-                 out_words: int = 0, annotations=None):
+                 out_words: int = 0, annotations=None, place: str = "auto"):
     """Host-facing batched encode.
 
     Returns (streams: list[bytes], fallback: np.ndarray[bool]); fallback
@@ -743,7 +883,7 @@ def encode_batch(timestamps, values, start, counts=None, unit: Unit = Unit.SECON
     res = encode_batch_device(
         jnp.asarray(timestamps), jnp.asarray(vb), jnp.asarray(start, dtype=jnp.int64),
         jnp.asarray(valid), unit=int(unit), out_words=out_words,
-        prefix_bits=prefix_bits)
+        prefix_bits=prefix_bits, place=place)
     fallback = np.asarray(res["fallback"])
     words_out = np.asarray(res["words"])
     if prefix_words:

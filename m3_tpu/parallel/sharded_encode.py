@@ -1,0 +1,99 @@
+"""Series-axis sharded M3TSZ encode: all local devices, one call.
+
+The two-phase encode (encoding/m3tsz_jax.py, round 9) is embarrassingly
+parallel across the series axis — phase 1's sequential scan is
+per-series and phase 2's prefix sum runs along time — but XLA-CPU runs
+each (S,) element op single-threaded (the per-op arrays sit below its
+intra-op parallelization threshold), so a single-device encode uses ONE
+core no matter how many the host has.  The native C++ yardstick
+(bench.py) threads across cores; this helper makes the comparison fair
+by sharding the series axis over every local device with the repo's
+shard_map seam (parallel/mesh.py) — the exact mirror of
+sharded_decode.py, and on a TPU pod slice the same call spreads series
+across chips (ROADMAP item 3's ingest axis).
+
+Bit-identity: each shard runs the IDENTICAL per-series program, so
+outputs equal the single-device encode exactly (pinned by
+tests/test_encode_fuzz.py).  Series counts that don't divide the device
+count are zero-padded; padded rows emit nothing (their valid masks are
+all-False) and are sliced off before returning.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from m3_tpu.encoding import m3tsz_jax as codec
+
+
+def _raw(fn):
+    return getattr(fn, "__wrapped__", fn)
+
+
+@functools.lru_cache(maxsize=8)
+def _sharded_fn(n_dev: int, unit: int, out_words: int, place: str,
+                has_prefix: bool):
+    # dtype=object: a Mesh axis of Device objects, not numeric lanes
+    mesh = Mesh(np.array(jax.devices()[:n_dev], dtype=object), ("s",))
+    # The raw (unjitted) encode impl: unit/out_words/place arrive as
+    # statics resolved by OUR caller on the host (the same retrace-risk
+    # contract the codec's own wrapper upholds).
+    if has_prefix:
+        def inner(ts, vb, st, va, pb):
+            return _raw(codec._encode_batch_device)(
+                ts, vb, st, va, unit=unit, out_words=out_words,
+                prefix_bits=pb, place=place)
+        in_specs = (P("s"), P("s"), P("s"), P("s"), P("s"))
+    else:
+        def inner(ts, vb, st, va):
+            return _raw(codec._encode_batch_device)(
+                ts, vb, st, va, unit=unit, out_words=out_words,
+                prefix_bits=None, place=place)
+        in_specs = (P("s"), P("s"), P("s"), P("s"))
+    from m3_tpu.parallel.mesh import shard_map_compat
+
+    out_specs = {"words": P("s"), "total_bits": P("s"), "fallback": P("s")}
+    return jax.jit(shard_map_compat(inner, mesh, in_specs=in_specs,
+                                    out_specs=out_specs))
+
+
+def encode_batch_device_sharded(timestamps, value_bits, start, valid,
+                                unit: int = 1, out_words: int = 0,
+                                prefix_bits=None, place: str = "auto",
+                                devices: int | None = None):
+    """encode_batch_device over all (or ``devices``) local devices,
+    series-sharded.  Same contract and bit-identical outputs; falls
+    back to the single-device jit when only one device exists."""
+    n_dev = devices or jax.device_count()
+    S, T = timestamps.shape
+    n_dev = min(n_dev, max(S, 1))
+    if n_dev <= 1:
+        return codec.encode_batch_device(
+            timestamps, value_bits, start, valid, unit=unit,
+            out_words=out_words, prefix_bits=prefix_bits, place=place)
+    if place == "auto":
+        place = codec.resolved_place()
+    if out_words == 0:
+        out_words = (T * 16) // 64 + 4  # the codec's own default, pinned
+    pad = (-S) % n_dev
+    if pad:
+        timestamps = jnp.pad(timestamps, ((0, pad), (0, 0)))
+        value_bits = jnp.pad(value_bits, ((0, pad), (0, 0)))
+        start = jnp.pad(start, (0, pad))
+        valid = jnp.pad(valid, ((0, pad), (0, 0)))
+        if prefix_bits is not None:
+            prefix_bits = jnp.pad(prefix_bits, (0, pad))
+    fn = _sharded_fn(n_dev, unit, out_words, place, prefix_bits is not None)
+    args = (timestamps, value_bits, start, valid)
+    if prefix_bits is not None:
+        args = args + (prefix_bits,)
+    out = fn(*args)
+    if pad:
+        out = {k: v[:S] for k, v in out.items()}
+    return out

@@ -168,6 +168,60 @@ def scrub(args) -> int:
     return 1 if bad else 0
 
 
+def tpu_backlog(args) -> int:
+    """Probe the axon TPU relay and, when it answers, run the
+    accumulated on-chip benchmark backlog (decode, rollup_full,
+    timer_full, agg_scaling, the round-9 encode) in one shot via
+    bench.py's ``tpu_backlog`` child.
+
+    The probe is a plain TCP connect and the child runs with any
+    ``JAX_PLATFORMS`` pin STRIPPED from its env — the box profile pins
+    cpu so an unpinned import can't hang the shell, and that pin both
+    short-circuited the bench's in-run probe (BENCH_r07's tpu_probe
+    bug) and would make a "tpu" child silently measure the CPU
+    backend.  Exit 0 with stage JSON lines when the backlog ran; exit
+    1 with a probe record when the relay is down (the cron shape:
+    retry next window)."""
+    bench_py = Path(__file__).resolve().parents[2] / "bench.py"
+    if not bench_py.exists():
+        print(f"tpu_backlog: bench driver not found at {bench_py}",
+              file=sys.stderr)
+        return 2
+    # Reuse bench.py wholesale: its probe (port default, errno record
+    # shape, timeline format) AND its budget-enforced child driver —
+    # `_run_child` owns the watchdog that kills a child wedged in TPU
+    # backend init (a half-up relay can accept the TCP probe yet still
+    # hang PJRT init forever; a plain stdout read would block with it).
+    if str(bench_py.parent) not in sys.path:
+        sys.path.insert(0, str(bench_py.parent))
+    import bench as _bench
+
+    ok = _bench._relay_open(args.probe_timeout)
+    probe = {"ok": ok, "port": _bench.RELAY_PORT,
+             "detail": _bench.PROBE_TIMELINE[-1]["result"]}
+    _out({"tpu_probe": probe})
+    if not ok:
+        return 1
+
+    # _run_child strips any JAX_PLATFORMS pin for tpu children, sets
+    # M3_BENCH_DEADLINE_SEC, merges RESULT lines, and kills on budget.
+    merged = _bench._run_child("tpu_backlog", float(args.budget))
+    stages = 0
+    for kind, payload in merged.items():
+        if kind == "errors":
+            for msg in payload:
+                _out({"error": msg})
+            continue
+        for st in payload if isinstance(payload, list) else [payload]:
+            _out({kind: st})
+            stages += 1
+    _out({"tpu_backlog": {"stages": stages,
+                          "errors": len(merged.get("errors", []))}})
+    # A mostly-lost window must read as failure — the cron-shaped
+    # caller retries next window on rc != 0.
+    return 0 if stages and not merged.get("errors") else 1
+
+
 def lint(args) -> int:
     """Run m3lint over the package and gate against the committed
     baseline (tools/lint_baseline.json).  Exit 0 only when the findings
@@ -291,6 +345,17 @@ def main(argv=None) -> int:
     sc.add_argument("--inventory", action="store_true",
                     help="also dump the quarantine inventory")
     sc.set_defaults(fn=scrub)
+
+    tb = sub.add_parser(
+        "tpu_backlog",
+        help="probe the TPU relay and run the accumulated on-chip "
+             "bench backlog (decode/rollup/timer/agg_scaling/encode) "
+             "in one shot when it answers")
+    tb.add_argument("--budget", type=int, default=780,
+                    help="child deadline in seconds (default 780)")
+    tb.add_argument("--probe-timeout", type=float, default=3.0,
+                    dest="probe_timeout")
+    tb.set_defaults(fn=tpu_backlog)
 
     li = sub.add_parser(
         "lint", help="codebase-aware static analysis, baseline-gated")

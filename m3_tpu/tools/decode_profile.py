@@ -1,4 +1,19 @@
-"""Decode hot-loop cost breakdown: where does JAX decode time go?
+"""Codec hot-loop cost breakdown: where does JAX decode/ENCODE time go?
+
+Round 9 made this the SHARED profile harness: ``--mode decode`` (the
+default, unchanged) decomposes the two-phase decoder exactly as in
+round 6; ``--mode encode`` decomposes the round-9 two-phase encoder
+into its three structural stages — the phase-1 lane-emission scan
+(with a ``carry``/``classify`` sub-attribution: the scan skeleton vs
+the convertToIntFloat decimal search that dominates it), the phase-2
+exclusive prefix sum + fragment computation, and the word PLACEMENT
+tail (M3_ENCODE_PLACE) — so a round's acceptance accounting can say
+exactly where the time went.
+
+    JAX_PLATFORMS=cpu python -m m3_tpu.tools.decode_profile \
+        --mode encode [-S 10000] [-T 720] [-o PROFILE_encode.json]
+
+The decode attribution method, unchanged since round 4:
 
 Round-4 VERDICT weak #1/#3 established the method: decompose the decode
 into structural layers by timing PROXY scans that share the real
@@ -363,13 +378,237 @@ def profile(S: int, T: int) -> dict:
     return out
 
 
+def _count_ops(j):
+    n = 0
+    for e in j.eqns:
+        n += 1
+        for v in e.params.values():
+            if hasattr(v, "jaxpr"):
+                n += _count_ops(v.jaxpr)
+    return n
+
+
+def profile_encode(S: int, T: int) -> dict:
+    """Two-phase ENCODE attribution: phase-1 scan (carry/classify
+    sub-layers) -> prefix-sum+fragments -> placement.  Each proxy jit
+    is a PREFIX of the real pipeline (same scan, same lane tables), so
+    consecutive deltas attribute the stages; the final layer is the
+    production encode_batch_device."""
+    import jax.numpy as jnp
+
+    ts_np, vals_np, _starts = _corpus(S, T)
+    starts = np.full(S, ts_np[0, 0] - 10 * 10**9, np.int64)
+    out_words = T * 40 // 64 + 8
+    jts = jnp.asarray(ts_np)
+    jvb = jnp.asarray(vals_np.view(np.uint64))
+    jst = jnp.asarray(starts)
+    jva = jnp.asarray(np.ones((S, T), bool))
+
+    dev = jax.devices()[0]
+    place = mj.resolved_place()
+    out: dict = {
+        "S": S, "T": T, "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "total_datapoints": S * T,
+        "encoder": "two-phase lane emission (round 9)",
+        "place": place,
+    }
+
+    step = functools.partial(mj._encode_step, unit=1,
+                             default_unit_is_32bit=True)
+    vstep = jax.vmap(step)
+    # THE codec's own carry initializer (one owner for the layout —
+    # a carry change must not silently desync these proxies).
+    carry0 = lambda: mj._encode_carry0(S, jst, 1)
+
+    @functools.partial(jax.jit, static_argnames=("mode",))
+    def proxy(a, b, v, mode):
+        def body_carry(c, x):
+            # scan skeleton: the narrow carry round-trips untouched;
+            # the lane outputs are live (folded from the inputs) so
+            # XLA cannot DCE the output buffers.
+            t, vb, _va = x
+            z = (t + vb.astype(I64)).astype(U64)
+            zi = jnp.zeros(S, I32)
+            return c, (jnp.stack([z, z, z, z]),
+                       jnp.stack([zi, zi, zi, zi]))
+
+        def body_classify(c, x):
+            t, vb, _va = x
+            val, mult, isf, prec = mj.classify_value(vb, c[4])
+            z = (t + val).astype(U64)
+            zi = mult + jnp.where(isf | prec, 1, 0)
+            return c, (jnp.stack([z, z, z, z]),
+                       jnp.stack([zi, zi, zi, zi]))
+
+        body = {"carry": body_carry, "classify": body_classify,
+                "phase1": lambda c, x: (lambda c2, l:
+                    (c2, (jnp.stack(l[:4]), jnp.stack(l[4:]))))(
+                        *vstep(c, x))}[mode]
+        carry, (lv, lw) = lax.scan(body, carry0(),
+                                   (a.T, b.T, v.T), unroll=mj._SCAN_UNROLL)
+        return lv.astype(U64).sum() + lw.sum(dtype=I32) + carry[0].sum()
+
+    layers: dict = {}
+    compile_s: dict = {}
+    for mode in ("carry", "classify", "phase1"):
+        fn = lambda m=mode: proxy(jts, jvb, jva, m)
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        compile_s[mode] = round(time.perf_counter() - t0, 1)
+        layers[mode] = _time(fn, reps=3)
+
+    # prefix+frags: the real pipeline minus placement — phase 1 plus
+    # the exclusive prefix sums and fragment computation.
+    @jax.jit
+    def prefix_frags(a, b, v):
+        def scan_fn(c, x):
+            c2, (t0_, t1_, v0_, v1_, n0, n1, n2, n3) = vstep(c, x)
+            return c2, (jnp.stack([t0_, t1_, v0_, v1_]),
+                        jnp.stack([n0, n1, n2, n3]))
+        carry, (lv, lw) = lax.scan(scan_fn, carry0(), (a.T, b.T, v.T),
+                                   unroll=mj._SCAN_UNROLL)
+        lens = lw.sum(axis=1, dtype=I32)
+        off_dp = jnp.cumsum(lens, axis=0, dtype=I32) - lens + jnp.asarray(64, I32)
+        pos = off_dp[:, None, :] + (jnp.cumsum(lw, axis=1, dtype=I32) - lw)
+        F = 4 * T
+        hi, lo, gw = mj._lane_frags(lv.reshape(F, S), pos.reshape(F, S),
+                                    lw.reshape(F, S))
+        return hi.sum() + lo.sum() + gw.sum(dtype=I32)
+
+    t0 = time.perf_counter()
+    jax.block_until_ready(prefix_frags(jts, jvb, jva))
+    compile_s["prefix_frags"] = round(time.perf_counter() - t0, 1)
+    layers["prefix_frags"] = _time(lambda: prefix_frags(jts, jvb, jva),
+                                   reps=3)
+
+    # the production encode, single device (the run the attribution
+    # decomposes) and series-sharded (the machine number).
+    full1 = lambda p=place: mj.encode_batch_device(
+        jts, jvb, jst, jva, out_words=out_words, place=p)
+    t0 = time.perf_counter()
+    res = jax.block_until_ready(full1())
+    compile_s["full"] = round(time.perf_counter() - t0, 1)
+    assert not np.asarray(res["fallback"]).any()
+    layers["full"] = _time(full1, reps=3)
+
+    from m3_tpu.parallel.sharded_encode import encode_batch_device_sharded
+
+    n_dev = jax.device_count()
+    if n_dev > 1:
+        fullN = lambda: encode_batch_device_sharded(
+            jts, jvb, jst, jva, out_words=out_words, place=place)
+        jax.block_until_ready(fullN())
+        t_full = _time(fullN, reps=3)
+        out["devices"] = n_dev
+    else:
+        t_full = layers["full"]
+
+    # the other placement tails, for the seam's flip decision (pallas
+    # is skipped off-TPU: interpret mode has no perf meaning)
+    for other in mj._PLACE_IMPLS:
+        if other == place or (other == "pallas"
+                              and dev.platform != "tpu"):
+            continue
+        try:
+            jax.block_until_ready(full1(other))
+            layers[f"full_{other}"] = _time(lambda: full1(other), reps=2)
+        except Exception as exc:  # noqa: BLE001 — record, keep going
+            out[f"full_{other}_error"] = f"{type(exc).__name__}: {exc}"
+
+    t_carry = layers["carry"]
+    t_classify = layers["classify"] - layers["carry"]
+    t_emit = layers["phase1"] - layers["classify"]
+    t_prefix = layers["prefix_frags"] - layers["phase1"]
+    t_place = layers["full"] - layers["prefix_frags"]
+    out["seconds"] = {k: round(v, 4) for k, v in layers.items()}
+    out["seconds"]["full_all_devices"] = round(t_full, 4)
+    out["compile_s"] = compile_s
+    out["attribution_s"] = {
+        "scan_carry_roundtrip": round(t_carry, 4),
+        "classify_decimal_search": round(t_classify, 4),
+        "lane_emission_rest_of_step": round(t_emit, 4),
+        "prefix_sum_and_fragments": round(t_prefix, 4),
+        "word_placement": round(t_place, 4),
+    }
+    out["attribution_pct"] = {
+        k: round(100 * v / layers["full"], 1)
+        for k, v in (("scan_carry_roundtrip", t_carry),
+                     ("classify_decimal_search", t_classify),
+                     ("lane_emission_rest_of_step", t_emit),
+                     ("prefix_sum_and_fragments", t_prefix),
+                     ("word_placement", t_place))
+    }
+    out["dps"] = {
+        "full": round(S * T / t_full),
+        "full_1device": round(S * T / layers["full"]),
+        "ceiling_if_placement_free": round(S * T / layers["prefix_frags"]),
+        "ceiling_if_scan_only": round(S * T / layers["phase1"]),
+        "ceiling_if_classify_free": round(
+            S * T / max(layers["phase1"] - t_classify, 1e-9)),
+    }
+    for k, v in layers.items():
+        if k.startswith("full_"):
+            out["dps"][k] = round(S * T / v)
+    # Old-vs-new against bench.py's RECORDED r07 baseline (one owner —
+    # a drifting second copy of the constant would skew every future
+    # comparison), methodology-matched: the r07 number was single-
+    # device on this backend, so the ratio uses full_1device and is
+    # emitted only where a baseline exists for the platform.
+    import bench as _bench
+
+    old = _bench.OLD_R07_ENCODE_DPS.get(dev.platform)
+    if old:
+        out["dps"]["old_r07_wide_carry_scan"] = old
+        out["dps"]["vs_old_r07"] = round(
+            out["dps"]["full_1device"] / old, 2)
+    out["dps_note"] = (
+        "full = series-sharded across all local devices "
+        "(parallel/sharded_encode.py), comparable to the THREADED "
+        "native yardstick; full_1device is the r07-methodology-"
+        "comparable single-core number (r07 measured the old scan at "
+        "S=512 — its per-dp cost was batch-size-flat)")
+
+    # native C++ yardstick on the same corpus
+    try:
+        from m3_tpu import native
+
+        if native.available():
+            t0 = time.perf_counter()
+            enc = native.encode_batch(ts_np, vals_np, starts)
+            if enc is not None and not enc[1].any():
+                out["native_cpp_dps"] = round(
+                    S * T / (time.perf_counter() - t0))
+    except Exception:
+        pass
+
+    # structural op counts (branchless SIMD: every lane pays every path)
+    try:
+        xs1 = (jts.T[0], jvb.T[0], jva.T[0])
+        jx = jax.make_jaxpr(step)(carry0(), xs1)
+        ops = _count_ops(jx.jaxpr)
+        out["step_ops"] = ops
+        out["element_ops_per_datapoint_phase1"] = ops
+        jc = jax.make_jaxpr(
+            lambda vb, m: mj.classify_value(vb, m))(jvb[:, 0],
+                                                    jnp.zeros(S, I32))
+        out["classify_ops"] = _count_ops(jc.jaxpr)
+        out["element_ops_r07_wide_carry"] = 7800  # ~25 _bb_append funnels
+    except Exception as exc:  # noqa: BLE001 — analysis is best-effort
+        out["step_ops_error"] = f"{type(exc).__name__}: {exc}"
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=("decode", "encode"),
+                    default="decode")
     ap.add_argument("-S", type=int, default=10_000)
     ap.add_argument("-T", type=int, default=720)
     ap.add_argument("-o", default=None, help="also write JSON here")
     args = ap.parse_args(argv)
-    res = profile(args.S, args.T)
+    res = (profile(args.S, args.T) if args.mode == "decode"
+           else profile_encode(args.S, args.T))
     line = json.dumps(res, indent=2)
     print(line)
     if args.o:

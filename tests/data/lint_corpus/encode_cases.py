@@ -1,0 +1,44 @@
+"""Seeded encode-scope violations (round 9; never imported).
+
+The two-phase encoder's lane tables and placement fragments are
+bit-layout contracts like decode's (ISSUE 10): a dtype-less lane
+constructor silently promotes (an i32 width lane reaching i64 doubles
+placement traffic AND breaks the Pallas kernel's u32 split), a
+module-level lane table >= 4096 elements referenced under the tracer
+is re-baked into every compiled HLO (the PR 7 _VALUE_CTRL_TBL lesson),
+and a placement-seam env read under the tracer freezes the
+M3_ENCODE_PLACE choice into the first compile.  These line-exact seeds
+keep the jaxlint families honest over the round-9 module scope
+(parallel/sharded_encode.py, parallel/pallas_encode.py)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# a dod-bucket-sized control table: >= 4096 elements means it must ride
+# as a device ARGUMENT, never an HLO constant
+DOD_CTRL_TBL = np.arange(1 << 12, dtype=np.uint32)
+
+
+def lane_widths_init(n):
+    lanes = jnp.zeros(n)                 # VIOLATION: explicit-dtype (L26)
+    ok = jnp.zeros(n, jnp.int32)         # ok: positional dtype
+    return lanes, ok
+
+
+@jax.jit
+def place_with_baked_table(i):
+    return jnp.asarray(DOD_CTRL_TBL)[i]  # VIOLATION: constant-bloat (L33)
+
+
+@jax.jit
+def place_env_frozen(frags):
+    impl = os.environ.get("M3_ENCODE_PLACE")  # VIOLATION: retrace-risk (L38)
+    return frags if impl else -frags
+
+
+@jax.jit
+def place_with_arg_table_ok(tbl, i):
+    return tbl[i]                        # ok: parameter, not a literal

@@ -1,0 +1,350 @@
+"""Round-9 two-phase encode: fuzz/property suite — the encode-side
+mirror of tests/test_decode_fuzz.py.
+
+Three layers of byte-identity evidence for the lane-emission rewrite
+(ISSUE 10), all against the golden-validated scalar codec (m3tsz.py):
+
+* corpus — the decode suite's pinned real-shape streams re-derived as
+  ENCODE inputs: scalar-decode each pinned stream, re-encode the
+  device-eligible ones through the batched encoder, and require the
+  exact original bytes back.  Streams the device encoder contractually
+  rejects (mid-stream time-unit changes, mid-stream annotations) must
+  flag ``fallback`` — never emit wrong bytes.
+* fuzz — random series families through the batched encoder under
+  EVERY placement impl (scatter / gather / pallas-interpret), byte-
+  equal to the scalar Encoder and round-tripping through the batched
+  decoder bit-exactly.
+* properties — targeted edges: every dod bucket, XOR contained/
+  uncontained flips, int<->float mode churn, first-datapoint
+  annotations, unaligned starts (the TU-marker path).
+
+Plus the parallel seams: the Pallas placement kernel (interpret mode)
+vs its scatter-add reference on random fragments, and sharded-encode
+parity on an uneven S that exercises the zero-pad path.
+"""
+
+import base64
+import json
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from tests.conftest import DATA_DIR  # noqa: E402
+from tests.test_decode_fuzz import _fuzz_batch  # noqa: E402
+from m3_tpu.core.xtime import Unit  # noqa: E402
+from m3_tpu.encoding.m3tsz import (  # noqa: E402
+    Datapoint, Encoder, decode_series)
+from m3_tpu.encoding.m3tsz_jax import (  # noqa: E402
+    decode_batch, encode_batch, encode_batch_device, pack_streams)
+
+START = 1_600_000_000 * 10**9
+SEC = 10**9
+# Placement impls: every tail must emit identical bytes ("pallas" runs
+# the kernel in interpret mode on this CPU-only tier — slow, small
+# batches only).
+PLACES = ("scatter", "gather", "pallas")
+
+
+def _oracle_bytes(ts_row, vals_row, start, unit=Unit.SECOND, ann=None):
+    enc = Encoder(int(start))
+    first = True
+    for t, v in zip(ts_row.tolist(), vals_row.tolist()):
+        enc.encode(Datapoint(int(t), float(v), unit,
+                             ann if (first and ann) else b""))
+        first = False
+    return enc.stream()
+
+
+def _assert_bytes_match_oracle(streams, ts, vals, starts, anns=None):
+    for i, got in enumerate(streams):
+        want = _oracle_bytes(ts[i], vals[i], starts[i],
+                             ann=None if anns is None else anns[i])
+        assert got == want, f"series {i}: bytes diverge from oracle"
+
+
+class TestFuzzEncode:
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_encode_bytes_vs_scalar(self, seed):
+        """Fuzz families -> batched encode under the default placement
+        must be byte-identical to the scalar Encoder, and round-trip
+        through the batched decoder bit-exactly."""
+        S, T = 12, 120
+        ts, vals, starts = _fuzz_batch(seed, S, T)
+        streams, fb = encode_batch(ts, vals, starts, out_words=256)
+        assert not fb.any()
+        _assert_bytes_match_oracle(streams, ts, vals, starts)
+        dts, dvals, counts, dfb = decode_batch(
+            [bytes(s) for s in streams], T + 1)
+        assert not dfb.any() and (counts == T).all()
+        np.testing.assert_array_equal(dts[:, :T], ts)
+        # Value (not bit) equality: the int-optimized path canonicalizes
+        # -0.0 to +0.0 (Go's int64(v) does too) — BYTE identity above is
+        # the exact contract; the scalar-decode bit pin lives in
+        # test_decode_fuzz.py.
+        got = dvals[:, :T]
+        agree = (got == vals) | (np.isnan(got) & np.isnan(vals))
+        assert agree.all(), f"round-trip values diverge at {np.argwhere(~agree)[:4]}"
+
+    @pytest.mark.parametrize("place", PLACES)
+    def test_placement_tails_byte_identical(self, place):
+        """All three placement impls must produce the same bytes (the
+        seam's contract: only speed may differ)."""
+        S, T = 8, 48 if place == "pallas" else 96
+        ts, vals, starts = _fuzz_batch(7, S, T)
+        streams, fb = encode_batch(ts, vals, starts, out_words=128,
+                                   place=place)
+        assert not fb.any()
+        _assert_bytes_match_oracle(streams, ts, vals, starts)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seed", range(4, 12))
+    def test_encode_bytes_vs_scalar_deep(self, seed):
+        S, T = 12, 120
+        ts, vals, starts = _fuzz_batch(seed, S, T)
+        streams, fb = encode_batch(ts, vals, starts, out_words=256)
+        assert not fb.any()
+        _assert_bytes_match_oracle(streams, ts, vals, starts)
+
+
+class TestPinnedCorpusEncode:
+    @pytest.fixture(scope="class")
+    def corpus(self):
+        with open(DATA_DIR / "decode_corpus.json") as f:
+            doc = json.load(f)
+        return doc, [base64.b64decode(s) for s in doc["streams"]]
+
+    def test_reencode_pinned_corpus(self, corpus):
+        """Scalar-decode every pinned stream and push the datapoints
+        back through the batched encoder AT THE STREAM'S INITIAL UNIT
+        (the corpus generator's scalar Encoder default, SECOND):
+
+        * device-eligible streams must reproduce the EXACT original
+          bytes;
+        * streams whose deltas leave the fixed unit (the mid-stream /
+          first-delta TU-switch family: ``unit_change``, ``jitter``)
+          must flag fallback — never emit different bytes;
+        * mid-stream ANNOTATION streams are outside the contract by
+          caller policy (encode_batch documents they stay on the
+          scalar path), so their re-encode only has to round-trip the
+          numeric content bit-exactly.
+        """
+        doc, streams = corpus
+        reencoded = 0
+        flagged = 0
+        for blob in streams:
+            pts = decode_series(blob)
+            T = len(pts)
+            ts = np.array([p.timestamp for p in pts], np.int64)[None, :]
+            vals = np.array([p.value for p in pts], np.float64)[None, :]
+            # the start word IS the first 8 stream bytes
+            words, _ = pack_streams([blob])
+            start = words[:1, 0].astype(np.int64)
+            anns = [pts[0].annotation or None]
+            mid_ann = any(p.annotation for p in pts[1:])
+            out, fb = encode_batch(ts, vals, start, unit=Unit.SECOND,
+                                   out_words=4096,
+                                   annotations=anns if anns[0] else None)
+            if fb.any():
+                flagged += 1
+                assert out[0] == b""  # never wrong bytes, only refusal
+                continue
+            if mid_ann:
+                dts, dvals, counts, _ = decode_batch(
+                    [bytes(out[0])], T + 1, annotations_fallback=False)
+                assert int(counts[0]) == T
+                np.testing.assert_array_equal(dts[0, :T], ts[0])
+                np.testing.assert_array_equal(
+                    dvals[0, :T].copy().view(np.uint64),
+                    vals[0].view(np.uint64))
+                continue
+            assert out[0] == blob, "re-encode diverged from pinned bytes"
+            reencoded += 1
+        # the corpus must keep exercising BOTH sides of the contract
+        assert reencoded >= 6, f"only {reencoded} streams re-encoded"
+        assert flagged >= 2, "corpus lost its fallback-edge streams"
+
+
+class TestEncodeProperties:
+    def _roundtrip(self, ts, vals, starts, unit=Unit.SECOND, anns=None,
+                   out_words=256):
+        for place in PLACES:
+            streams, fb = encode_batch(
+                ts, vals, starts, unit=unit, out_words=out_words,
+                annotations=anns, place=place)
+            assert not fb.any(), f"fallback under place={place}"
+            _assert_bytes_match_oracle(streams, ts, vals, starts,
+                                       anns=anns)
+
+    def test_every_dod_bucket_width(self):
+        """Deltas hitting each timestamp opcode bucket (0/7/9/12-bit
+        and the 32-bit default escape) in one stream."""
+        deltas = [10, 10, 10, 25, 10, 300, 10, 4000, 10, 2_000_000,
+                  10, 10]
+        ts = (START + np.cumsum(deltas) * SEC)[None, :].astype(np.int64)
+        vals = np.arange(len(deltas), dtype=np.float64)[None, :]
+        self._roundtrip(ts, vals, np.full(1, START, np.int64))
+
+    def test_xor_contained_uncontained_flips(self):
+        vs = [1.5, 1.5, 1.25, 1.2500000001, -1.25, 1.5e300, 1.5e-300,
+              0.1, 0.1, 0.30000000000000004, 2.0**52, 1.0]
+        ts = (START + np.arange(1, len(vs) + 1) * SEC)[None, :].astype(np.int64)
+        self._roundtrip(ts, np.array(vs)[None, :],
+                        np.full(1, START, np.int64))
+
+    def test_int_float_mode_churn(self):
+        vs = [3.0, 4.0, 4.5, 4.75, 5.0, 6.0, 0.125, 7.0, 7.25, 8.0]
+        ts = (START + np.arange(1, len(vs) + 1) * SEC)[None, :].astype(np.int64)
+        self._roundtrip(ts, np.array(vs)[None, :],
+                        np.full(1, START, np.int64))
+
+    def test_nan_inf_specials(self):
+        vs = [1.0, np.nan, np.inf, -np.inf, np.nan, 2.5, np.nan]
+        ts = (START + np.arange(1, len(vs) + 1) * SEC)[None, :].astype(np.int64)
+        self._roundtrip(ts, np.array(vs)[None, :],
+                        np.full(1, START, np.int64))
+
+    def test_unaligned_start_tu_marker(self):
+        """An unaligned encoder start writes the TU-marker prefix +
+        full 64-bit nanosecond dod on the first datapoint (the t1
+        lane's only steady-state use on second-unit streams)."""
+        T = 40
+        start = START + 123  # not second-aligned
+        ts = (start + np.arange(1, T + 1) * SEC)[None, :].astype(np.int64)
+        vals = np.arange(T, dtype=np.float64)[None, :]
+        self._roundtrip(ts, vals, np.full(1, start, np.int64))
+
+    def test_first_datapoint_annotation_prefix(self):
+        T = 24
+        ts = np.tile(START + np.arange(1, T + 1) * SEC, (3, 1)).astype(np.int64)
+        vals = np.round(np.arange(3)[:, None] + np.arange(T)[None, :] * 0.5, 1)
+        anns = [b"proto-schema-A", None, b"x" * 100]
+        self._roundtrip(ts, vals, np.full(3, START, np.int64), anns=anns)
+
+    def test_mid_stream_unit_change_flags_fallback(self):
+        """Timestamps whose deltas stop dividing the unit force the
+        scalar encoder into a mid-stream TU switch; the device encoder
+        must refuse (fallback), never emit different bytes."""
+        ts = np.array([[START + SEC, START + 2 * SEC,
+                        START + 3 * SEC + 7]])  # 7ns off the grid
+        vals = np.ones((1, 3))
+        for place in PLACES:
+            streams, fb = encode_batch(ts, vals, np.full(1, START, np.int64),
+                                       out_words=64, place=place)
+            assert fb.all()
+            assert streams[0] == b""
+
+    def test_variable_counts_and_empty(self):
+        ts, vals, starts = _fuzz_batch(5, 6, 80)
+        counts = np.array([80, 40, 1, 0, 77, 3])
+        streams, fb = encode_batch(ts, vals, starts, counts=counts,
+                                   out_words=256)
+        assert not fb.any()
+        assert streams[3] == b""
+        for i, n in enumerate(counts):
+            if n == 0:
+                continue
+            want = _oracle_bytes(ts[i, :n], vals[i, :n], starts[i])
+            assert streams[i] == want
+
+
+class TestPallasPlacementParity:
+    """place_words (interpret mode = Mosaic semantics without a TPU)
+    vs the scatter-add reference, on random disjoint-bit fragments
+    including out-of-range keys and the zero fragment."""
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_kernel_matches_reference(self, seed):
+        from m3_tpu.parallel import pallas_encode as pe
+
+        rng = np.random.default_rng(seed)
+        S, F, W = 3, 40, 11
+        keys = rng.integers(0, W + 3, (S, F)).astype(np.int32)  # some OOR
+        # DISJOINT-BIT fragments (the lane contract the kernel's u32
+        # sums rely on): F <= 64 lanes each own one global bit slot,
+        # so colliding keys can never carry — u64 scatter-adds and
+        # split-u32 sums must agree bit for bit.
+        assert F <= 64
+        frags = np.uint64(1) << np.arange(F, dtype=np.uint64)[None, :]
+        frags = np.where(rng.random((S, F)) < 0.2, np.uint64(0),
+                         np.broadcast_to(frags, (S, F)))
+        a = pe.place_words(jnp.asarray(frags), jnp.asarray(keys), W,
+                           interpret=True)
+        b = pe.place_words_jnp(jnp.asarray(frags), jnp.asarray(keys), W)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_real_lane_fragments(self):
+        """Disjoint REAL fragments (an actual encode's): kernel output
+        must equal the jnp scatter reference bit for bit."""
+        from m3_tpu.parallel import pallas_encode as pe
+
+        ts, vals, starts = _fuzz_batch(3, 4, 40)
+        a, _ = encode_batch(ts, vals, starts, out_words=64,
+                            place="pallas")
+        b, _ = encode_batch(ts, vals, starts, out_words=64,
+                            place="gather")
+        assert a == b
+
+
+class TestShardedEncodeParity:
+    """parallel/sharded_encode: the series-sharded encode (one scan
+    per local device) must be bit-identical to the single-device jit,
+    on an uneven S that exercises the zero-pad path (conftest provides
+    8 virtual CPU devices)."""
+
+    @pytest.mark.parametrize("with_prefix", [False, True])
+    def test_bit_identical_with_padding(self, with_prefix):
+        from m3_tpu.parallel.sharded_encode import (
+            encode_batch_device_sharded)
+
+        assert jax.device_count() > 1  # conftest's virtual mesh
+        S, T = 11, 40  # 11 % 8 != 0 -> pad rows encode + get sliced
+        rng = np.random.default_rng(3)
+        ts = np.tile(START + np.arange(1, T + 1) * SEC,
+                     (S, 1)).astype(np.int64)
+        vals = np.round(rng.normal(50, 5, (S, T)), 2)
+        starts = np.full(S, START, np.int64)
+        valid = np.ones((S, T), bool)
+        prefix = (jnp.asarray(rng.integers(0, 40, S).astype(np.int32) * 8)
+                  if with_prefix else None)
+        kw = dict(out_words=64, prefix_bits=prefix)
+        a = encode_batch_device(jnp.asarray(ts),
+                                jnp.asarray(vals.view(np.uint64)),
+                                jnp.asarray(starts), jnp.asarray(valid),
+                                **kw)
+        b = encode_batch_device_sharded(
+            jnp.asarray(ts), jnp.asarray(vals.view(np.uint64)),
+            jnp.asarray(starts), jnp.asarray(valid), **kw)
+        for k in ("words", "total_bits", "fallback"):
+            np.testing.assert_array_equal(np.asarray(a[k]),
+                                          np.asarray(b[k]), err_msg=k)
+
+    def test_single_device_falls_through(self):
+        from m3_tpu.parallel.sharded_encode import (
+            encode_batch_device_sharded)
+
+        ts, vals, starts = _fuzz_batch(1, 4, 30)
+        out = encode_batch_device_sharded(
+            jnp.asarray(ts), jnp.asarray(vals.view(np.uint64)),
+            jnp.asarray(starts), jnp.asarray(np.ones((4, 30), bool)),
+            out_words=64, devices=1)
+        assert not np.asarray(out["fallback"]).any()
+
+
+class TestPlaceSeamValidation:
+    def test_bad_place_env_rejected(self, monkeypatch):
+        from m3_tpu.encoding.m3tsz_jax import resolved_place
+
+        monkeypatch.setenv("M3_ENCODE_PLACE", "magic")
+        with pytest.raises(ValueError, match="M3_ENCODE_PLACE"):
+            resolved_place()
+
+    def test_bad_place_arg_rejected(self):
+        ts, vals, starts = _fuzz_batch(0, 2, 10)
+        with pytest.raises(ValueError, match="place="):
+            encode_batch_device(
+                jnp.asarray(ts), jnp.asarray(vals.view(np.uint64)),
+                jnp.asarray(starts), jnp.asarray(np.ones((2, 10), bool)),
+                out_words=32, place="magic")

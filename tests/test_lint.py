@@ -60,6 +60,10 @@ EXPECTED = {
     # round 8: aggregator/packed-layout scope seeds (one per family)
     ("agg_cases.py", "explicit-dtype", 19),     # dtype-less packed word
     ("agg_cases.py", "constant-bloat", 26),     # baked o16 decode table
+    # round 9: two-phase-encode scope seeds (lane tables / placement)
+    ("encode_cases.py", "explicit-dtype", 26),  # dtype-less lane widths
+    ("encode_cases.py", "constant-bloat", 33),  # baked >=4096 lane table
+    ("encode_cases.py", "retrace-risk", 38),    # placement env under trace
     ("wire_cases.py", "wire-exhaustive", 8),
     ("wire_cases.py", "wire-exhaustive", 17),
     ("fault_cases.py", "fault-coverage", 10),
@@ -197,6 +201,18 @@ class TestDtypeScope:
         got = self._lint_at(tmp_path, "m3_tpu/aggregator/packed.py")
         assert any(f.rule == "explicit-dtype" for f in got)
         got = self._lint_at(tmp_path, "m3_tpu/aggregator/arena.py")
+        assert any(f.rule == "explicit-dtype" for f in got)
+
+    def test_fires_in_encode_parallel_modules(self, tmp_path):
+        # round 9: the two-phase encode's lane tables / placement
+        # fragments are bit-layout contracts exactly like decode's —
+        # a silent promotion (the lw.sum i32->i64 slip this round's
+        # review caught at birth) doubles placement traffic AND breaks
+        # the Pallas kernel's u32 split; both new modules must sit in
+        # the explicit-dtype scope.
+        got = self._lint_at(tmp_path, "m3_tpu/parallel/sharded_encode.py")
+        assert any(f.rule == "explicit-dtype" for f in got)
+        got = self._lint_at(tmp_path, "m3_tpu/parallel/pallas_encode.py")
         assert any(f.rule == "explicit-dtype" for f in got)
 
     def test_out_of_scope_module_stays_clean(self, tmp_path):

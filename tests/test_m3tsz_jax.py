@@ -329,15 +329,17 @@ def test_encode_place_env_flip_works_in_process(monkeypatch):
     starts = np.full(S, start, np.int64)
 
     monkeypatch.delenv("M3_ENCODE_PLACE", raising=False)
-    assert mj.resolved_place() == "scatter"  # tests pin the CPU backend
-    a, fb_a = mj.encode_batch(ts, vals, starts, out_words=T * 40 // 64 + 8)
-    size_scatter = mj._encode_batch_device._cache_size()
-
-    monkeypatch.setenv("M3_ENCODE_PLACE", "gather")
+    # tests pin the CPU backend: auto = the scatter-free gather form
+    # (pallas only ever auto-resolves on a real TPU backend)
     assert mj.resolved_place() == "gather"
+    a, fb_a = mj.encode_batch(ts, vals, starts, out_words=T * 40 // 64 + 8)
+    size_gather = mj._encode_batch_device._cache_size()
+
+    monkeypatch.setenv("M3_ENCODE_PLACE", "scatter")
+    assert mj.resolved_place() == "scatter"
     b, fb_b = mj.encode_batch(ts, vals, starts, out_words=T * 40 // 64 + 8)
-    # the flip actually took: the gather form is a new static signature
-    assert mj._encode_batch_device._cache_size() > size_scatter
+    # the flip actually took: the scatter form is a new static signature
+    assert mj._encode_batch_device._cache_size() > size_gather
     assert not fb_a.any() and not fb_b.any()
     assert a == b  # placement forms are byte-identical by contract
 
