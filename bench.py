@@ -252,6 +252,29 @@ def _emit(kind: str, payload: dict) -> None:
     print("RESULT " + json.dumps({kind: payload}), flush=True)
 
 
+def _hop_snap():
+    """Transfer-ledger marker (x/hopwatch) for a stage's timed region;
+    None when the accountant is not armed (e.g. a stage fn driven
+    outside child_main)."""
+    from m3_tpu.x import hopwatch
+
+    return hopwatch.snapshot() if hopwatch.installed() else None
+
+
+def _hop_delta(snap) -> dict | None:
+    """Per-stage transfer stats since ``snap``: host<->device copy
+    counts/bytes + jitted dispatches over the timed iterations — the
+    steady-state loop should move ZERO bytes (the same contract the
+    tracewatch transfer guard enforces on iteration one)."""
+    if snap is None:
+        return None
+    from m3_tpu.x import hopwatch
+
+    d = hopwatch.since(snap)
+    return {k: d[k] for k in ("h2d_count", "h2d_bytes", "d2h_count",
+                              "d2h_bytes", "dispatches")}
+
+
 def _retrace_verdict(verdict: str, retraces: int) -> str:
     """Fold a nonzero steady-state retrace count into a stage's
     validation string — unconditionally, so a stage that both fails
@@ -356,6 +379,7 @@ def _run_decode_stage(S: int, T: int, platform: str) -> dict:
     # contractually device-resident.
     best = float("inf")
     snap = tracewatch.snapshot()
+    hsnap = _hop_snap()
     guard_note = None
     try:
         with tracewatch.no_transfers():
@@ -383,6 +407,7 @@ def _run_decode_stage(S: int, T: int, platform: str) -> dict:
     res = {"dps": round(S * T / best), "S": S, "T": T,
            "platform": platform, "validation": verdict,
            "compile_s": round(compile_s, 2), "retraces": retraces,
+           "transfers": _hop_delta(hsnap),
            "chains": primary, "layout": "scan_major",
            "devices": jax.device_count()}
     # Old-vs-new: the recorded r05 single-scan number for this backend,
@@ -487,6 +512,7 @@ def _run_device_encode_stage(S: int, T: int, platform: str) -> dict:
     # uploads happened above).
     best = float("inf")
     snap = tracewatch.snapshot()
+    hsnap = _hop_snap()
     guard_note = None
     try:
         with tracewatch.no_transfers():
@@ -507,6 +533,7 @@ def _run_device_encode_stage(S: int, T: int, platform: str) -> dict:
         verdict = f"transfer in timed region ({guard_note}): " + verdict
     stage = {"dps": round(S * T / best), "S": S, "T": T,
              "compile_s": round(compile_s, 2), "retraces": retraces,
+             "transfers": _hop_delta(hsnap),
              "place": place, "devices": jax.device_count(),
              "platform": platform, "validation": verdict}
     # Single-device number: methodology-comparable to r07 and to the
@@ -624,6 +651,7 @@ def _run_agg_bench(kind: str, C: int, N: int, NT: int, platform: str) -> dict:
             compile_s = time.perf_counter() - t0
             done = 1  # ingests already applied to the live state
             snap = tracewatch.snapshot()
+            hsnap = _hop_snap()
             t0 = time.perf_counter()
             for _ in range(reps):
                 st = step_fn(st)
@@ -647,7 +675,7 @@ def _run_agg_bench(kind: str, C: int, N: int, NT: int, platform: str) -> dict:
             retraces = tracewatch.retraces_since(snap)
             total_f = float(total)
             return (reps * 2 * N / dev_s, total_f == 2.0 * done * N,
-                    total_f, compile_s, retraces)
+                    total_f, compile_s, retraces, _hop_delta(hsnap))
 
         def time_impl(impl: str, budget_each: float):
             """Rate for one f64-arena ingest impl (scatter/pallas)."""
@@ -724,7 +752,7 @@ def _run_agg_bench(kind: str, C: int, N: int, NT: int, platform: str) -> dict:
         try:
             # NEW: the packed layout (round 8) is the headline number.
             (p_rate, p_count_ok, p_counts, p_compile_s,
-             p_retraces) = time_packed(60)
+             p_retraces, p_hops) = time_packed(60)
             parity_err = packed_parity()
             p_verdict = "ok"
             if not p_count_ok:
@@ -735,7 +763,7 @@ def _run_agg_bench(kind: str, C: int, N: int, NT: int, platform: str) -> dict:
             # OLD: the f64 scatter arenas — the r05-methodology number,
             # kept as the head-to-head baseline.
             (dev_rate, count_ok, total_counts, compile_s,
-             retraces) = time_impl("scatter", 60)
+             retraces, _hops_f64) = time_impl("scatter", 60)
             verdict = _retrace_verdict(
                 "ok" if count_ok else
                 f"ingest count mismatch: {total_counts}", retraces)
@@ -743,6 +771,7 @@ def _run_agg_bench(kind: str, C: int, N: int, NT: int, platform: str) -> dict:
                    "layout": "packed", "platform": platform,
                    "compile_s": round(p_compile_s, 2),
                    "retraces": p_retraces,
+                   "transfers": p_hops,
                    "parity_max_rel_err": parity_err,
                    "validation": p_verdict,
                    "samples_per_sec_f64": round(dev_rate),
@@ -756,7 +785,8 @@ def _run_agg_bench(kind: str, C: int, N: int, NT: int, platform: str) -> dict:
             # scatter on CPU, never validated faster on TPU.)
             if _left() > 120 and platform == "tpu":
                 try:
-                    prate, pok, pcnt, _pcs, pretr = time_impl("pallas", 60)
+                    (prate, pok, pcnt, _pcs, pretr,
+                     _ph) = time_impl("pallas", 60)
                     pv = _retrace_verdict(
                         "ok" if pok else f"ingest count mismatch: {pcnt}",
                         pretr)
@@ -820,6 +850,7 @@ def _run_agg_bench(kind: str, C: int, N: int, NT: int, platform: str) -> dict:
     del warm
     compile_s = time.perf_counter() - t0
     snap = tracewatch.snapshot()
+    hsnap = _hop_snap()
     t0 = time.perf_counter()
     for win, slots, values in batches:
         tstate = tstep(tstate, win, slots, values, jt)
@@ -877,6 +908,7 @@ def _run_agg_bench(kind: str, C: int, N: int, NT: int, platform: str) -> dict:
            "packed32_validation":
                ("ok" if p32_ok else f"packed32 mismatch: rel {p32_err:.2e}"),
            "packed32_max_rel_err": p32_err,
+           "transfers": _hop_delta(hsnap),
            "platform": platform,
            "validation": verdict}
     # Packed end-to-end validation: exact counts, quantile lanes within
@@ -1347,9 +1379,15 @@ def child_main(platform: str) -> None:
     # so a retrace regression can never masquerade as a throughput
     # change again.  Record mode: a budget blowout must fail a STAGE's
     # validation, not kill the child mid-run.
-    from m3_tpu.x import tracewatch
+    from m3_tpu.x import hopwatch, tracewatch
 
     tracewatch.install(raise_on_violation=False)
+    # Hop accountant alongside the sanitizer: stages bracket their
+    # timed loops with _hop_snap()/_hop_delta() and report per-stage
+    # host<->device transfer counts/bytes next to compile_s/retraces —
+    # "zero added steady-state transfers" becomes a recorded number,
+    # not an assumption.
+    hopwatch.install()
 
     dev = jax.devices()[0]
     kind = dev.device_kind

@@ -1031,6 +1031,9 @@ class Aggregator:
         # samples skip the arenas and go straight here.
         self.passthrough_handler = passthrough_handler
         self.passthrough_samples = 0
+        # rollup-drain latency histogram, attached by
+        # instrument_aggregator (None = uninstrumented)
+        self._hist_drain = None
 
     def shard_index(self, mid: bytes) -> int:
         # murmur3(id) % numShards, matching the reference router
@@ -1121,10 +1124,15 @@ class Aggregator:
         self.passthrough_handler(batch)
 
     def consume(self, target_nanos: int, flush_handler=None):
+        import time as _time
+
+        t0 = _time.perf_counter()
         out = []
         for sh in self.shards:
             out.extend(sh.consume(target_nanos, flush_handler,
                                   forward_sink=self._route_forwards))
+        if self._hist_drain is not None:
+            self._hist_drain.record(_time.perf_counter() - t0)
         return out
 
     def counters(self) -> dict:
@@ -1163,6 +1171,9 @@ def instrument_aggregator(instrument, aggregator: "Aggregator"):
     ``registry.unregister_collector`` at shutdown (the registry holds
     a strong reference to the aggregator through it)."""
     scope = instrument.scope("aggregator")
+    # window-drain latency (hot path: every flush-manager tick) —
+    # interned once here, recorded inside Aggregator.consume
+    aggregator._hist_drain = scope.histogram("drain_seconds")
 
     def collect():
         for name, v in aggregator.counters().items():

@@ -24,6 +24,7 @@ import numpy as np
 
 from m3_tpu.cluster.placement import Placement, ShardState
 from m3_tpu.core.hash import shard_for
+from m3_tpu.instrument import tracing
 from m3_tpu.msg import protocol as wire
 from m3_tpu.x import fault
 from m3_tpu.x.retry import Retrier, RetryOptions
@@ -93,9 +94,21 @@ class InstanceQueue:
         self.dropped = 0
         self.sent = 0
         self.backoffs = 0
+        # Trace-preamble compat state (guarded by _io_lock): a
+        # pre-round-10 server kills the connection on the INGEST_TRACE
+        # frame type, so if this connection dies after sending one we
+        # permanently stop sending preambles on this queue — a mixed
+        # fleet degrades to untraced delivery instead of a reconnect
+        # loop (the batch itself is retried by the normal park/flush
+        # machinery).
+        self._trace_disabled = False
+        self._sock_sent_trace = False
 
     def _connect(self) -> socket.socket:
         if self._sock is None:
+            # fresh socket, no preamble yet; _connect only runs from
+            # _send_one, which holds _io_lock
+            self._sock_sent_trace = False  # m3lint: disable=lock-discipline
             s = wire.connect(self.address)
             try:
                 if self.want_acks:
@@ -142,6 +155,19 @@ class InstanceQueue:
                 raise fault.FaultInjected("ingest_tcp.send: frame dropped")
             sock = self._connect()
             try:
+                # Sampled caller (a bound trace context at SEND time —
+                # e.g. a coordinator's api.write span): the context
+                # rides an INGEST_TRACE preamble so the server's batch
+                # span joins the trace.  Unsampled traffic sends
+                # nothing extra; a queue whose connection previously
+                # died after a preamble has tracing disabled (legacy
+                # server — see protocol.encode_ingest_trace).
+                tctx_wire = (b"" if self._trace_disabled
+                             else tracing.current_wire())
+                if tctx_wire:
+                    wire.send_frame(sock, wire.INGEST_TRACE,
+                                    wire.encode_ingest_trace(tctx_wire))
+                    self._sock_sent_trace = True
                 wire.send_frame(sock, ftype, payload)
                 if self.want_acks:
                     resp = wire.recv_frame(sock)
@@ -153,7 +179,15 @@ class InstanceQueue:
                     if rtype != wire.INGEST_ACK:
                         raise wire.ProtocolError(
                             f"unexpected frame {rtype} awaiting ingest ack")
+                    # a completed exchange proves the server speaks the
+                    # preamble: clear the suspicion marker
+                    self._sock_sent_trace = False
             except (OSError, wire.ProtocolError):
+                if self._sock_sent_trace:
+                    # the connection died with a preamble outstanding —
+                    # assume a legacy server rejected the frame type
+                    # and stop tracing this queue (delivery first)
+                    self._trace_disabled = True
                 self._drop_sock()
                 raise
 

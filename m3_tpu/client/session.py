@@ -26,6 +26,8 @@ import numpy as np
 
 from m3_tpu.cluster.placement import Placement, ShardState
 from m3_tpu.core.hash import shard_for
+from m3_tpu.instrument import tracing
+from m3_tpu.instrument.tracing import NOOP_SPAN, NOOP_TRACER, Tracepoint
 from m3_tpu.storage.database import ShardNotOwnedError
 from m3_tpu.storage.series_merge import merge_point_sources
 from m3_tpu.x import deadline as xdeadline
@@ -82,7 +84,12 @@ class ReplicatedSession:
         write_level: ConsistencyLevel = ConsistencyLevel.MAJORITY,
         read_level: ConsistencyLevel = ConsistencyLevel.UNSTRICT_MAJORITY,
         retry_options: RetryOptions | None = None,
+        tracer=None,
     ):
+        # Per-replica fan-out spans (session.writeReplica) are opened
+        # only inside an already-sampled trace; with no tracer or no
+        # bound context the fan-out pays one None-check per replica.
+        self.tracer = tracer if tracer is not None else NOOP_TRACER
         # (placement, connections) swap together in ONE attribute so a
         # topology change mid-fan-out can never pair a new placement
         # with old handles (reference session.go:527-544 rebuilds its
@@ -297,6 +304,13 @@ class ReplicatedSession:
                 errors.append(f"{iid}: down")
                 continue
             br = self._breaker(iid) if for_read else None
+            # the replica hop span: parents on the caller's active
+            # span (api.write / a test's root), and every wire call
+            # under it propagates ITS context (RPC_REQ_TR)
+            span = (self.tracer.start_span(
+                Tracepoint.SESSION_WRITE, {"replica": iid, "op": op})
+                if not for_read and tracing.current() is not None
+                else NOOP_SPAN)
             try:
                 if br is not None:
                     # budget already spent: the query's failure, raised
@@ -308,8 +322,9 @@ class ReplicatedSession:
                         lambda: self.retrier.run(lambda: fn(conn),
                                                  abort=abort)))
                 else:
-                    results.append(self.retrier.run(lambda: fn(conn),
-                                                    abort=abort))
+                    with span:
+                        results.append(self.retrier.run(
+                            lambda: fn(conn), abort=abort))
             except xdeadline.DeadlineExceeded:
                 # The SHARED query budget is spent (or the query was
                 # cancelled): not this replica's failure — surface

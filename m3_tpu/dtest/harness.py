@@ -135,3 +135,44 @@ class NodeProcess:
         if out.get("status") != "success":
             raise RuntimeError(out)
         return out["data"]["result"]
+
+
+# -- cross-process observability collection ---------------------------------
+#
+# The read side of round 10's tracing/histogram substrate: pull every
+# process's span ring / metric scrape over HTTP and join them, so a
+# scenario can assert on ONE stitched trace or ONE fleet-merged p99
+# instead of per-process fragments.
+
+
+def collect_traces(ports, local_spans=None, timeout_s: float = 30.0):
+    """Fetch every node's span ring (``/api/v1/debug/traces``) and join
+    with any in-test spans (``Span.to_dict`` rows, e.g. from the
+    driving process's own Tracer) → {trace_id: [span dicts]}, each
+    trace parent-before-child.  ``ports`` are HTTP (or admin) ports on
+    127.0.0.1."""
+    from m3_tpu.instrument.tracing import join_traces
+
+    spans = list(local_spans or [])
+    for port in ports:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/v1/debug/traces",
+                timeout=timeout_s) as r:
+            spans.extend(json.load(r)["data"])
+    return join_traces(spans)
+
+
+def merged_histogram(ports, base: str, timeout_s: float = 30.0):
+    """Scrape every node's /metrics, strict-parse, and vector-add one
+    histogram's bucket lanes across the fleet.  Returns the merged
+    {le: cumulative count} map — feed it to
+    ``exposition.merged_quantile(merged, q)`` for fleet p50/p99.
+    Exact because every Histogram shares instrument.HISTOGRAM_BOUNDS."""
+    from m3_tpu.instrument import exposition
+
+    scrapes = []
+    for port in ports:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=timeout_s) as r:
+            scrapes.append(exposition.parse_text(r.read().decode()))
+    return exposition.merge_histograms(scrapes, base)

@@ -48,6 +48,9 @@ FORWARDED_BATCH = 13    # stage-N pipeline outputs for the next stage
 INGEST_HELLO = 10       # client opts into per-frame acks (flags u32)
 INGEST_ACK = 14         # server: frame fully ingested (sample count u32)
 INGEST_BACKOFF = 15     # server shed the frame: retry after (ms u32)
+INGEST_TRACE = 21       # trace-context preamble: applies to the NEXT
+                        # batch frame on this connection (17-byte
+                        # instrument.tracing.TraceContext wire form)
 
 
 class ProtocolError(ConnectionError):
@@ -291,6 +294,29 @@ def encode_ingest_backoff(retry_after_ms: int) -> bytes:
 
 def decode_ingest_backoff(raw: bytes) -> int:
     return struct.unpack_from("<I", raw, 0)[0]
+
+
+def encode_ingest_trace(ctx_wire: bytes) -> bytes:
+    """INGEST_TRACE payload: the packed TraceContext itself.  Sent by a
+    sampled client immediately BEFORE a batch frame; a preamble frame
+    (rather than a batch-payload trailer) keeps the four batch codecs'
+    exact-length contracts untouched.  NOTE a pre-round-10 SERVER still
+    drops the connection on the unknown frame type (and would equally
+    reject a batch trailer — the batch decoders raise on trailing
+    bytes), so there is no fully-compatible in-band carrier: upgrade
+    servers before enabling sampled ingest tracing, and the client
+    (InstanceQueue) auto-disables its preamble on a connection that
+    dies after one — a mixed fleet degrades to untraced, never to a
+    reconnect loop."""
+    return bytes(ctx_wire)
+
+
+def decode_ingest_trace(raw: bytes):
+    from m3_tpu.instrument.tracing import TraceContext
+
+    if len(raw) < TraceContext.WIRE_SIZE:
+        raise ProtocolError("short ingest trace frame")
+    return TraceContext.from_wire(raw, 0)
 
 
 # -- bus transport payloads -------------------------------------------------

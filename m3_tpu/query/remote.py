@@ -42,6 +42,8 @@ import threading
 
 import numpy as np
 
+from m3_tpu.instrument import tracing
+from m3_tpu.instrument.tracing import NOOP_TRACER, TraceContext, Tracepoint
 from m3_tpu.msg import protocol as wire
 from m3_tpu.query.block import RawBlock, SeriesMeta
 from m3_tpu.x import deadline as xdeadline
@@ -56,7 +58,7 @@ QUERY_RESULT = 9
 
 
 def encode_fetch(name: bytes | None, matchers, start: int, end: int,
-                 deadline_ms: int = -1) -> bytes:
+                 deadline_ms: int = -1, trace_ctx: bytes = b"") -> bytes:
     parts = [struct.pack("<qq", start, end)]
     parts.append(struct.pack("<H", len(name) if name is not None else 0xFFFF))
     if name is not None:
@@ -69,8 +71,12 @@ def encode_fetch(name: bytes | None, matchers, start: int, end: int,
         parts.append(m.name)
         parts.append(m.value)
     # trailer: the query's REMAINING budget (relative ms; -1 = none) so
-    # the server stops work once the client's deadline is spent
+    # the server stops work once the client's deadline is spent, then —
+    # for sampled queries only — the caller's packed TraceContext (the
+    # same grow-at-the-tail pattern the deadline trailer used: old
+    # decoders read their prefix and ignore the rest)
     parts.append(struct.pack("<q", deadline_ms))
+    parts.append(trace_ctx)
     return b"".join(parts)
 
 
@@ -101,7 +107,11 @@ def decode_fetch(raw: bytes):
     deadline_ms = -1
     if pos + 8 <= len(raw):  # pre-deadline encoders have no trailer
         (deadline_ms,) = struct.unpack_from("<q", raw, pos)
-    return name, tuple(matchers), start, end, deadline_ms
+        pos += 8
+    tctx = None
+    if pos + TraceContext.WIRE_SIZE <= len(raw):  # sampled caller
+        tctx = TraceContext.from_wire(raw, pos)
+    return name, tuple(matchers), start, end, deadline_ms, tctx
 
 
 def encode_result(block: RawBlock) -> bytes:
@@ -179,15 +189,22 @@ class _QueryHandler(socketserver.BaseRequestHandler):
             if frame is None or frame[0] != QUERY_FETCH:
                 return
             try:
-                name, matchers, start, end, dl_ms = decode_fetch(frame[1])
+                name, matchers, start, end, dl_ms, tctx = decode_fetch(
+                    frame[1])
                 # The client's remaining budget becomes THIS side's
                 # deadline: storage stops work (typed) once the caller
                 # has given up, instead of computing an answer nobody
-                # will read.
+                # will read.  A sampled caller's TraceContext binds the
+                # same way, so the fetch span joins its trace.
                 dl = Deadline(dl_ms / 1000.0) if dl_ms >= 0 else None
-                with xdeadline.bind(dl):
+                with xdeadline.bind(dl), tracing.bind(tctx):
                     xdeadline.check_current("remote fetch")
-                    block = srv.storage.fetch_raw(name, matchers, start, end)
+                    span = (srv.tracer.start_span(
+                        Tracepoint.REMOTE_FETCH, {"matchers": len(matchers)})
+                        if tctx is not None else tracing.NOOP_SPAN)
+                    with span:
+                        block = srv.storage.fetch_raw(name, matchers,
+                                                      start, end)
                 wire.send_frame(sock, QUERY_RESULT, encode_result(block))
             except Exception as e:  # noqa: BLE001 — report, don't die
                 try:
@@ -203,8 +220,10 @@ class QueryServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
 
-    def __init__(self, storage, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, storage, host: str = "127.0.0.1", port: int = 0,
+                 tracer=None):
         self.storage = storage
+        self.tracer = tracer if tracer is not None else NOOP_TRACER
         super().__init__((host, port), _QueryHandler)
 
     @property
@@ -213,8 +232,8 @@ class QueryServer(socketserver.ThreadingTCPServer):
 
 
 def serve_query_background(storage, host: str = "127.0.0.1",
-                           port: int = 0) -> QueryServer:
-    srv = QueryServer(storage, host, port)
+                           port: int = 0, tracer=None) -> QueryServer:
+    srv = QueryServer(storage, host, port, tracer=tracer)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     return srv
@@ -334,9 +353,11 @@ class RemoteStorage:
 
         def payload() -> bytes:
             # encoded per attempt: the trailer must carry the budget
-            # REMAINING at send time, not at first-attempt time
+            # REMAINING at send time, not at first-attempt time; a
+            # sampled query's bound TraceContext rides the tail
             return encode_fetch(name, matchers, start_nanos, end_nanos,
-                                deadline_ms=xdeadline.remaining_ms())
+                                deadline_ms=xdeadline.remaining_ms(),
+                                trace_ctx=tracing.current_wire())
 
         try:
             try:

@@ -85,7 +85,7 @@ def _parse_dur_nanos(s) -> int:
 
 class AdminContext:
     def __init__(self, kv: KVStore, db=None, aggregator=None, scrubber=None,
-                 migrator=None):
+                 migrator=None, tracer=None):
         self.kv = kv
         self.namespaces = NamespaceRegistry(kv)
         self.placements = PlacementService(kv)
@@ -94,6 +94,11 @@ class AdminContext:
         self.aggregator = aggregator
         self.scrubber = scrubber
         self.migrator = migrator  # storage.migration.ShardMigrator | None
+        # span-ring debug surface: defaults to the database's tracer so
+        # the admin port serves the same ring as the main API's
+        # /api/v1/debug/traces (dtest trace collection hits either)
+        self.tracer = (tracer if tracer is not None
+                       else getattr(db, "tracer", None))
         if db is not None:
             self.namespaces.attach(db)
 
@@ -126,6 +131,23 @@ class _AdminHandler(BaseHTTPRequestHandler):
     def do_GET(self):
         try:
             path = self.path.split("?")[0].rstrip("/")
+            if path == "/api/v1/debug/traces":
+                # the same ring + filters the main API serves, through
+                # the ONE shared response builder (tracing.
+                # traces_response): trace collection must work through
+                # whichever port a harness has (dtest joins spans from
+                # every process) and the two handlers must not drift
+                from urllib.parse import parse_qs, urlparse
+
+                from m3_tpu.instrument.tracing import traces_response
+
+                tr = self.ctx.tracer
+                if tr is None:
+                    return self._json(404, {"error": "no tracer configured"})
+                q = parse_qs(urlparse(self.path).query)
+                return self._json(200, traces_response(
+                    tr, trace_id=q.get("trace_id", [None])[0],
+                    name=q.get("name", [None])[0]))
             if path == "/api/v1/services/m3db/namespace":
                 return self._json(200, {
                     "registry": {

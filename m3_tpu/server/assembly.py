@@ -298,6 +298,7 @@ def run_node(source, start_mediator: bool | None = None,
                 host=(cfg.coordinator.listen_host
                       if cfg.coordinator is not None else "127.0.0.1"),
                 port=cfg.query.listen_port,
+                tracer=tracer,
             )
         if cfg.query.remotes:
             from m3_tpu.query.remote import RemoteStorage
@@ -360,6 +361,7 @@ def run_node(source, start_mediator: bool | None = None,
             )
             ctx = ApiContext(
                 db, namespace=cfg.coordinator.namespace, registry=registry,
+                metrics_scope=scope,
                 downsampler=downsampler, tracer=tracer,
                 migrator=asm.migrator,
                 admission=admission,

@@ -46,6 +46,7 @@ import numpy as np
 
 from m3_tpu.index.doc import Document
 from m3_tpu.index.search import All, FieldExists, Term
+from m3_tpu.instrument.tracing import NOOP_TRACER, Tracepoint, traces_response
 from m3_tpu.query.engine import Engine
 from m3_tpu.query.fanout import FederatedStorage, PartialResultError
 from m3_tpu.query.storage_adapter import DatabaseStorage
@@ -141,8 +142,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._health()
             if u.path == "/metrics":
                 return self._metrics()
-            if u.path == "/debug/traces":
-                return self._traces()
+            if u.path in ("/debug/traces", "/api/v1/debug/traces"):
+                return self._traces(q)
             if u.path == "/debug/dump":
                 return self._debug_dump(q)
             if u.path in ("/api/v1/query_range", "/api/v1/query"):
@@ -219,6 +220,21 @@ class _Handler(BaseHTTPRequestHandler):
                 out["topology"] = self.ctx.migrator.status()
             except Exception:  # noqa: BLE001 — health must never 500
                 pass
+        # Hot-path latency (windowed histogram summaries, NOT lifetime
+        # reservoirs): merged p50/p99 per surface — ingest batches,
+        # query phases, flush/snapshot, drains.  Omitted while no
+        # histogram has recorded anything.
+        try:
+            if self.ctx.registry is not None:
+                lat = {name: {k: round(v, 6) if isinstance(v, float) else v
+                              for k, v in s.items()}
+                       for name, s in
+                       self.ctx.registry.histogram_summaries().items()
+                       if s["count"]}
+                if lat:
+                    out["latency"] = lat
+        except Exception:  # noqa: BLE001 — health must never 500
+            pass
         # Read-path overload visibility: admission gauges, the slow-
         # query log tail, and per-peer breaker states — the operator's
         # window into WHY queries are shedding/504ing.  Omitted while
@@ -312,16 +328,23 @@ class _Handler(BaseHTTPRequestHandler):
         ]
         return self._json(200, out)
 
-    def _traces(self):
-        """Recent finished spans (reference x/debug's introspection
-        bundles; jaeger exporter seam collapses to JSON-over-HTTP)."""
+    def _traces(self, q=None):
+        """Span-ring debug surface (reference x/debug's introspection
+        bundles; jaeger exporter seam collapses to JSON-over-HTTP).
+
+        ``/api/v1/debug/traces``            — ring inventory (one row
+                                              per trace) + raw spans
+        ``?trace_id=<id>``                  — that trace's spans,
+                                              parent-before-child
+        ``?name=<tracepoint>``              — spans of one tracepoint
+        """
         tr = self.ctx.tracer
         if tr is None:
             return self._error(404, "no tracer configured")
-        return self._json(200, {
-            "status": "success",
-            "data": [s.to_dict() for s in tr.finished()],
-        })
+        q = q or {}
+        return self._json(200, traces_response(
+            tr, trace_id=q.get("trace_id", [None])[0],
+            name=q.get("name", [None])[0]))
 
     @staticmethod
     def _series_id(tags: dict) -> bytes:
@@ -334,27 +357,38 @@ class _Handler(BaseHTTPRequestHandler):
         """Shared downsample-then-write tail of every write handler.
         Returns (written, rejected): rejected = samples whose series
         creation hit the new-series rate limit — the typed
-        back-pressure signal, surfaced so HTTP writers can back off."""
+        back-pressure signal, surfaced so HTTP writers can back off.
+
+        Opens the ``api.write`` root span (the coordinator-ingest end
+        of a cross-process trace: downstream session/rpc hops join it
+        through the bound context) and records the batch into the
+        windowed ingest-latency histogram."""
         ctx = self.ctx
-        keep = np.ones(len(docs), bool)
-        if ctx.downsampler is not None:
-            keep = ctx.downsampler.write_batch(
-                docs, np.asarray(ts, np.int64), np.asarray(vals)
-            )
-        idx = np.nonzero(keep)[0]
-        rejected = not_owned = 0
-        if len(idx):
-            res = ctx.db.write_tagged_batch(
-                ctx.namespace,
-                [docs[i] for i in idx],
-                np.asarray(ts, np.int64)[idx],
-                np.asarray(vals)[idx],
-            )
-            rejected = getattr(res, "rejected", 0)
-            # samples whose shard this node does not own (placement-
-            # scoped node fed directly): dropped, not written — the
-            # correct ingest path for a scoped cluster is the session
-            not_owned = getattr(res, "not_owned", 0)
+        t0 = time.perf_counter()
+        with (ctx.tracer or NOOP_TRACER).start_span(
+                Tracepoint.API_WRITE, {"n": len(docs)}):
+            keep = np.ones(len(docs), bool)
+            if ctx.downsampler is not None:
+                keep = ctx.downsampler.write_batch(
+                    docs, np.asarray(ts, np.int64), np.asarray(vals)
+                )
+            idx = np.nonzero(keep)[0]
+            rejected = not_owned = 0
+            if len(idx):
+                res = ctx.db.write_tagged_batch(
+                    ctx.namespace,
+                    [docs[i] for i in idx],
+                    np.asarray(ts, np.int64)[idx],
+                    np.asarray(vals)[idx],
+                )
+                rejected = getattr(res, "rejected", 0)
+                # samples whose shard this node does not own
+                # (placement-scoped node fed directly): dropped, not
+                # written — the correct ingest path for a scoped
+                # cluster is the session
+                not_owned = getattr(res, "not_owned", 0)
+        if ctx.hist_ingest is not None:
+            ctx.hist_ingest.record(time.perf_counter() - t0)
         return int(len(idx)) - rejected - not_owned, rejected
 
     def _prom_remote_write(self):
@@ -572,7 +606,8 @@ class ApiContext:
                  migrator=None, admission: AdmissionController | None = None,
                  query_timeout_s: float = 30.0,
                  slow_query_fraction: float = 0.75,
-                 remotes=None, remotes_required: bool = False):
+                 remotes=None, remotes_required: bool = False,
+                 metrics_scope=None):
         self.db = db
         self.namespace = namespace
         self.downsampler = downsampler
@@ -587,6 +622,26 @@ class ApiContext:
         self.slow_query_total = 0
         self._slow_mu = threading.Lock()
         self.slow_queries = collections.deque(maxlen=32)
+        # Hot-path latency histograms, interned ONCE (per-request
+        # intern is the metric-hygiene waste): coordinator ingest, and
+        # query end-to-end + per-phase (fetch = storage time recorded
+        # by the deadline's phase accumulator, eval = the rest).
+        self.hist_ingest = self.hist_query = None
+        self._hist_query_phase = {}
+        if registry is not None:
+            # under the node's metrics prefix (assembly passes its
+            # prefixed scope) so the series merge across a fleet
+            base = (metrics_scope if metrics_scope is not None
+                    else registry.scope(""))
+            self.hist_ingest = base.scope("ingest").histogram("seconds")
+            qscope = base.scope("query")
+            self.hist_query = qscope.histogram("seconds")
+            self._hist_query_phase = {
+                "fetch": qscope.tagged({"phase": "fetch"}).histogram(
+                    "phase_seconds"),
+                "eval": qscope.tagged({"phase": "eval"}).histogram(
+                    "phase_seconds"),
+            }
         # cross-coordinator federation: remote stores (query/remote
         # RemoteStorage) merged best-effort with the local database
         # unless remotes_required
@@ -606,10 +661,19 @@ class ApiContext:
 
     def observe_query(self, kind: str, query: str, dl: Deadline,
                       error: Exception | None = None) -> None:
-        """Slow-query log: a query that spent more than
-        ``slow_query_fraction`` of its deadline (or died trying) is
-        recorded with matchers and per-phase timings — the operator's
-        view of WHAT is eating the budget (`/health` ``query.slow``)."""
+        """Slow-query log + latency histograms: every query lands in
+        the windowed query histograms (end-to-end + fetch/eval phase
+        split); queries that spent more than ``slow_query_fraction`` of
+        their deadline (or died trying) additionally land in the
+        slow-query log with matchers and per-phase timings — the
+        operator's view of WHAT is eating the budget (`/health`
+        ``query.slow``)."""
+        elapsed = dl.elapsed()
+        if self.hist_query is not None:
+            self.hist_query.record(elapsed)
+            fetch_s = dl.phases.get("fetch", 0.0)
+            self._hist_query_phase["fetch"].record(fetch_s)
+            self._hist_query_phase["eval"].record(max(0.0, elapsed - fetch_s))
         if self.slow_query_fraction <= 0 or dl.timeout_s <= 0:
             return
         frac = dl.elapsed() / dl.timeout_s

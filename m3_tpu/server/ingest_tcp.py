@@ -45,10 +45,36 @@ import time
 
 import numpy as np
 
+from m3_tpu.instrument import tracing
+from m3_tpu.instrument.tracing import NOOP_TRACER, Tracepoint
 from m3_tpu.metrics.policy import StoragePolicy
 from m3_tpu.metrics.types import MetricType
 from m3_tpu.msg import protocol as wire
 from m3_tpu.x import fault
+
+
+class _IngestMetrics:
+    """The server's instruments, interned ONCE at construction: the
+    handler/worker loops run per frame, and a per-call registry
+    intern (name lookup under the registry lock) is exactly the
+    hot-path waste m3lint's metric-hygiene rule rejects."""
+
+    __slots__ = ("decode_errors", "unknown_frames", "fault_errors",
+                 "shed_frames", "shed_samples", "sink_errors", "samples",
+                 "queue_depth", "batch_seconds")
+
+    def __init__(self, scope):
+        self.decode_errors = scope.counter("decode_errors")
+        self.unknown_frames = scope.counter("unknown_frames")
+        self.fault_errors = scope.counter("fault_errors")
+        self.shed_frames = scope.counter("shed_frames")
+        self.shed_samples = scope.counter("shed_samples")
+        self.sink_errors = scope.counter("sink_errors")
+        self.samples = scope.counter("samples")
+        self.queue_depth = scope.gauge("queue_depth")
+        # hot-path latency: windowed log-bucket histogram (mergeable
+        # across nodes), NOT a lifetime-reservoir Timer
+        self.batch_seconds = scope.histogram("batch_seconds")
 
 
 def aggregator_sink(aggregator, lock: threading.Lock | None = None,
@@ -101,14 +127,17 @@ _BATCH_FRAMES = (wire.METRIC_BATCH, wire.TIMED_BATCH,
 class _ConnState:
     """Per-connection book-keeping shared by the handler thread (recv,
     shed replies) and the ingest worker (acks): the write lock keeps a
-    BACKOFF and an ACK from interleaving mid-frame on the socket."""
+    BACKOFF and an ACK from interleaving mid-frame on the socket.
+    ``pending_trace`` is handler-thread-only: set by an INGEST_TRACE
+    preamble frame, attached to the NEXT batch frame enqueued."""
 
-    __slots__ = ("want_acks", "inflight", "wlock")
+    __slots__ = ("want_acks", "inflight", "wlock", "pending_trace")
 
     def __init__(self):
         self.want_acks = False
         self.inflight = 0  # frames queued; guarded by server._q_lock
         self.wlock = threading.Lock()
+        self.pending_trace = None
 
 
 class _IngestHandler(socketserver.BaseRequestHandler):
@@ -117,12 +146,13 @@ class _IngestHandler(socketserver.BaseRequestHandler):
         sock = self.request
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         conn = _ConnState()
+        mx = srv.metrics
         while True:
             try:
                 frame = wire.recv_frame(sock)
             except (wire.ProtocolError, OSError):
-                if srv.scope is not None:
-                    srv.scope.counter("decode_errors").inc()
+                if mx is not None:
+                    mx.decode_errors.inc()
                 break
             if frame is None:
                 break
@@ -133,13 +163,23 @@ class _IngestHandler(socketserver.BaseRequestHandler):
                         wire.decode_ingest_hello(payload)
                         & wire.HELLO_WANT_ACKS)
                 except Exception:  # noqa: BLE001
-                    if srv.scope is not None:
-                        srv.scope.counter("decode_errors").inc()
+                    if mx is not None:
+                        mx.decode_errors.inc()
+                    break
+                continue
+            if ftype == wire.INGEST_TRACE:
+                # sampled client: the context rides a preamble frame
+                # and stitches the NEXT batch's span into its trace
+                try:
+                    conn.pending_trace = wire.decode_ingest_trace(payload)
+                except Exception:  # noqa: BLE001
+                    if mx is not None:
+                        mx.decode_errors.inc()
                     break
                 continue
             if ftype not in _BATCH_FRAMES:
-                if srv.scope is not None:
-                    srv.scope.counter("unknown_frames").inc()
+                if mx is not None:
+                    mx.unknown_frames.inc()
                 break
             # Socket-boundary faultpoint: drop kills the connection
             # (the lost-frame case rawtcp clients must survive), error
@@ -148,8 +188,8 @@ class _IngestHandler(socketserver.BaseRequestHandler):
             try:
                 act, payload = fault.mangle("ingest_tcp.frame", payload)
             except fault.FaultInjected:
-                if srv.scope is not None:
-                    srv.scope.counter("fault_errors").inc()
+                if mx is not None:
+                    mx.fault_errors.inc()
                 break
             if act == "drop":
                 break
@@ -164,19 +204,20 @@ class _IngestHandler(socketserver.BaseRequestHandler):
                     batch = wire.decode_metric_batch(payload)
                     n = len(batch.ids)
             except (wire.ProtocolError, Exception):  # noqa: BLE001
-                if srv.scope is not None:
-                    srv.scope.counter("decode_errors").inc()
+                if mx is not None:
+                    mx.decode_errors.inc()
                 break
-            if not srv._try_enqueue(conn, sock, ftype, batch, n):
+            tctx, conn.pending_trace = conn.pending_trace, None
+            if not srv._try_enqueue(conn, sock, ftype, batch, n, tctx):
                 # Load shed: explicit BACKOFF, connection stays up.
                 # Writability-probed: a fire-and-forget client that
                 # never reads its socket eventually closes the TCP
                 # window, and a blocking send here would wedge this
                 # handler (it must keep reading) — such a client gets
                 # dropped instead.
-                if srv.scope is not None:
-                    srv.scope.counter("shed_frames").inc()
-                    srv.scope.counter("shed_samples").inc(n)
+                if mx is not None:
+                    mx.shed_frames.inc()
+                    mx.shed_samples.inc(n)
                 with conn.wlock:
                     try:
                         _, writable, _ = select.select(
@@ -207,13 +248,18 @@ class IngestServer(socketserver.ThreadingTCPServer):
     def __init__(self, sink, host: str = "127.0.0.1", port: int = 0,
                  instrument=None, aggregator=None,
                  max_queue_frames: int = 256, per_conn_inflight: int = 64,
-                 backoff_hint_ms: int = 50, ack_send_timeout_s: float = 5.0):
+                 backoff_hint_ms: int = 50, ack_send_timeout_s: float = 5.0,
+                 tracer=None):
         self.sink = sink
         self.ack_send_timeout_s = ack_send_timeout_s
         self._closing = False
         self.scope = (
             instrument.scope("ingest_tcp") if instrument is not None else None
         )
+        # instruments interned once (hot path: per-frame loops)
+        self.metrics = (_IngestMetrics(self.scope)
+                        if self.scope is not None else None)
+        self.tracer = tracer if tracer is not None else NOOP_TRACER
         self.max_queue_frames = max_queue_frames
         self.per_conn_inflight = per_conn_inflight
         self.backoff_hint_ms = backoff_hint_ms
@@ -238,7 +284,7 @@ class IngestServer(socketserver.ThreadingTCPServer):
 
     # -- ingest queue ------------------------------------------------------
 
-    def _try_enqueue(self, conn, sock, ftype, batch, n) -> bool:
+    def _try_enqueue(self, conn, sock, ftype, batch, n, tctx=None) -> bool:
         with self._q_lock:
             # A server mid-shutdown sheds (explicit BACKOFF) rather
             # than enqueueing onto a queue whose worker is stopping —
@@ -250,13 +296,13 @@ class IngestServer(socketserver.ThreadingTCPServer):
                 return False
             self._inflight += 1
             conn.inflight += 1
-            if self.scope is not None:
-                self.scope.gauge("queue_depth").update(self._inflight)
+            if self.metrics is not None:
+                self.metrics.queue_depth.update(self._inflight)
             # put() under the lock (never blocks: the Queue is
             # unbounded; the watermark above is the real bound) so an
             # accepted frame can never land AFTER the shutdown
             # sentinel, which is enqueued under this same lock.
-            self._queue.put((conn, sock, ftype, batch, n))
+            self._queue.put((conn, sock, ftype, batch, n, tctx))
         return True
 
     def _drain(self) -> None:
@@ -264,29 +310,41 @@ class IngestServer(socketserver.ThreadingTCPServer):
             item = self._queue.get()
             if item is None:
                 return
-            conn, sock, ftype, batch, n = item
+            conn, sock, ftype, batch, n, tctx = item
+            t0 = time.perf_counter()
             try:
-                if ftype == wire.METRIC_BATCH:
-                    # one-arg call: custom sinks keep working
-                    self.sink(batch)
-                else:
-                    self.sink(batch, ftype)
+                # The worker thread never inherits a binding
+                # (contextvar rule): the frame's own context is bound
+                # here — BEFORE the span opens, so the batch span
+                # parents on the SENDER's span, joining its trace.
+                with tracing.bind(tctx):
+                    span = (self.tracer.start_span(
+                        Tracepoint.INGEST_TCP_BATCH,
+                        {"n": n, "frame": ftype})
+                        if tctx is not None else tracing.NOOP_SPAN)
+                    with span:
+                        if ftype == wire.METRIC_BATCH:
+                            # one-arg call: custom sinks keep working
+                            self.sink(batch)
+                        else:
+                            self.sink(batch, ftype)
             except Exception:  # noqa: BLE001 — a sink fault (e.g. no
                 # passthrough handler configured, or a one-arg custom
                 # sink receiving a timed frame) must close THIS
                 # connection with a counter, not kill the worker
                 # thread with an unrecorded traceback.
                 self._dec_inflight(conn)
-                if self.scope is not None:
-                    self.scope.counter("sink_errors").inc()
+                if self.metrics is not None:
+                    self.metrics.sink_errors.inc()
                 try:
                     sock.shutdown(socket.SHUT_RDWR)
                 except OSError:
                     pass
                 continue
             self._dec_inflight(conn)
-            if self.scope is not None:
-                self.scope.counter("samples").inc(n)
+            if self.metrics is not None:
+                self.metrics.samples.inc(n)
+                self.metrics.batch_seconds.record(time.perf_counter() - t0)
             if conn.want_acks:
                 with conn.wlock:
                     # The lone drain worker must never wedge on one
@@ -309,8 +367,8 @@ class IngestServer(socketserver.ThreadingTCPServer):
         with self._q_lock:
             self._inflight -= 1
             conn.inflight -= 1
-            if self.scope is not None:
-                self.scope.gauge("queue_depth").update(self._inflight)
+            if self.metrics is not None:
+                self.metrics.queue_depth.update(self._inflight)
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -43,6 +43,8 @@ import numpy as np
 
 from m3_tpu.index import search
 from m3_tpu.index.doc import Document, Field
+from m3_tpu.instrument import tracing
+from m3_tpu.instrument.tracing import NOOP_TRACER, TraceContext, Tracepoint
 from m3_tpu.msg.protocol import (
     ProtocolError, connect as wire_connect, recv_frame, send_frame,
 )
@@ -56,6 +58,9 @@ RPC_REQ = 16     # legacy request: [method u8][body]
 RPC_OK = 17
 RPC_ERR = 18
 RPC_REQ_DL = 19  # deadline-carrying request: [method u8][budget ms i64][body]
+RPC_REQ_TR = 20  # + trace context: [method u8][budget ms i64]
+                 # [TraceContext 17B][body] — sent only for SAMPLED
+                 # requests, so unsampled traffic stays RPC_REQ_DL-sized
 
 
 class RemoteError(RuntimeError):
@@ -254,7 +259,8 @@ class _RpcHandler(socketserver.BaseRequestHandler):
                 frame = recv_frame(sock)
             except (ProtocolError, OSError):
                 return
-            if frame is None or frame[0] not in (RPC_REQ, RPC_REQ_DL):
+            if frame is None or frame[0] not in (RPC_REQ, RPC_REQ_DL,
+                                                 RPC_REQ_TR):
                 return
             payload = frame[1]
             try:
@@ -264,16 +270,24 @@ class _RpcHandler(socketserver.BaseRequestHandler):
                 act, payload = fault.mangle("rpc.server", payload)
                 if act == "drop":
                     return
-                if frame[0] == RPC_REQ_DL:
+                tctx = None
+                if frame[0] in (RPC_REQ_DL, RPC_REQ_TR):
                     # [method u8][remaining-deadline ms i64][body]: bind
                     # the client's surviving budget so the server stops
                     # work (typed DeadlineExceeded → RPC_ERR) once the
-                    # caller has given up; -1 = no deadline.
-                    if len(payload) < 9:
+                    # caller has given up; -1 = no deadline.  RPC_REQ_TR
+                    # additionally carries the caller's TraceContext
+                    # between the budget and the body.
+                    hdr = 9
+                    if frame[0] == RPC_REQ_TR:
+                        hdr += TraceContext.WIRE_SIZE
+                    if len(payload) < hdr:
                         raise ProtocolError("short rpc request")
                     (dl_ms,) = struct.unpack_from("<q", payload, 1)
                     dl = Deadline(dl_ms / 1000.0) if dl_ms >= 0 else None
-                    body = payload[9:]
+                    if frame[0] == RPC_REQ_TR:
+                        tctx = TraceContext.from_wire(payload, 9)
+                    body = payload[hdr:]
                 else:
                     # legacy [method u8][body] frame from a pre-deadline
                     # client (rolling upgrade): no budget, full service
@@ -281,9 +295,18 @@ class _RpcHandler(socketserver.BaseRequestHandler):
                         raise ProtocolError("empty rpc request")
                     dl = None
                     body = payload[1:]
-                with xdeadline.bind(dl):
+                with xdeadline.bind(dl), tracing.bind(tctx):
                     xdeadline.check_current("rpc dispatch")
-                    resp = self._dispatch(srv.db, payload[0], body)
+                    # The server-side hop span: opened only for SAMPLED
+                    # requests (a bound context), joining the caller's
+                    # trace; everything _dispatch opens (db.writeBatch
+                    # etc.) parents on it.  Untraced traffic pays one
+                    # None-check, never a root span per request.
+                    span = (srv.tracer.start_span(
+                        Tracepoint.RPC_SERVER, {"method": int(payload[0])})
+                        if tctx is not None else tracing.NOOP_SPAN)
+                    with span:
+                        resp = self._dispatch(srv.db, payload[0], body)
                 send_frame(sock, RPC_OK, resp)
             except Exception as e:  # application error -> typed error frame
                 try:
@@ -382,8 +405,13 @@ class DbNodeRpcServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
 
-    def __init__(self, db, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, db, host: str = "127.0.0.1", port: int = 0,
+                 tracer=None):
         self.db = db
+        # default to the database's tracer so rpc.server spans land in
+        # the same ring the debug endpoint serves
+        self.tracer = (tracer if tracer is not None
+                       else getattr(db, "tracer", None) or NOOP_TRACER)
         super().__init__((host, port), _RpcHandler)
 
     @property
@@ -392,8 +420,8 @@ class DbNodeRpcServer(socketserver.ThreadingTCPServer):
 
 
 def serve_rpc_background(db, host: str = "127.0.0.1",
-                         port: int = 0) -> DbNodeRpcServer:
-    srv = DbNodeRpcServer(db, host, port)
+                         port: int = 0, tracer=None) -> DbNodeRpcServer:
+    srv = DbNodeRpcServer(db, host, port, tracer=tracer)
     threading.Thread(target=srv.serve_forever, daemon=True).start()
     return srv
 
@@ -449,7 +477,14 @@ class RemoteDatabase:
 
     def _call_inner(self, method: int, body: bytes) -> bytes:
         dl = xdeadline.current()
-        header = bytes([method]) + struct.pack("<q", xdeadline.remaining_ms())
+        # Sampled callers (a bound trace context — e.g. the session's
+        # replica fan-out span) upgrade the frame to RPC_REQ_TR so the
+        # server's dispatch joins their trace; everyone else stays on
+        # the deadline-only frame.  One contextvar read per call.
+        tctx_wire = tracing.current_wire()
+        ftype = RPC_REQ_TR if tctx_wire else RPC_REQ_DL
+        header = (bytes([method]) + struct.pack("<q", xdeadline.remaining_ms())
+                  + tctx_wire)
         with self._mu:
             try:
                 # Socket-boundary faultpoint: drop/error surface as the
@@ -464,7 +499,7 @@ class RemoteDatabase:
                 # the budget is already spent)
                 self._sock.settimeout(
                     xdeadline.socket_timeout(self.timeout_s))
-                send_frame(self._sock, RPC_REQ_DL, header + body)
+                send_frame(self._sock, ftype, header + body)
                 frame = recv_frame(self._sock)
             except DeadlineExceeded:
                 raise  # budget spent BEFORE I/O: the socket is intact

@@ -569,6 +569,13 @@ class Database:
 
         self.opts = opts or DatabaseOptions()
         self._scope = instrument.scope("db") if instrument is not None else None
+        # flush/snapshot latency: windowed mergeable histograms (the
+        # /health ``latency`` section), interned once — these paths run
+        # per mediator tick and must not pay a registry intern each time
+        self._hist_tick = (self._scope.histogram("tick_seconds")
+                           if self._scope is not None else None)
+        self._hist_snapshot = (self._scope.histogram("snapshot_seconds")
+                               if self._scope is not None else None)
         self.tracer = tracer if tracer is not None else NOOP_TRACER
         self.limits = limits if limits is not None else NO_LIMITS
         # One engine-wide reentrant lock serializing state mutation:
@@ -816,11 +823,16 @@ class Database:
         return pts
 
     def tick(self, now_nanos: int) -> dict:
+        import time as _time
+
+        t0 = _time.perf_counter()
         with self._mu, self.tracer.start_span(Tracepoint.DB_TICK):
             stats = {}
             for name, ns in self.namespaces.items():
                 stats[name] = ns.tick(now_nanos)
-            return stats
+        if self._hist_tick is not None:
+            self._hist_tick.record(_time.perf_counter() - t0)
+        return stats
 
     # ---- block-level replication surface -------------------------------
     # The handle interface repair and peers bootstrap run against; the
@@ -894,6 +906,9 @@ class Database:
         log rotates first so the snapshot covers everything in the
         now-inactive logs — recovery then replays only seq >= the active
         log (`snapshot_metadata_write.go` commitlog-identifier role)."""
+        import time as _time
+
+        t0 = _time.perf_counter()
         with self._mu, self.tracer.start_span(Tracepoint.DB_SNAPSHOT):
             seq = snap.next_snapshot_seq(self.opts.root)
             if self.commitlog is not None:
@@ -909,7 +924,9 @@ class Database:
                     written += shard.snapshot_blocks(snap_root)
                 index_segs += ns.index.snapshot_mutable(snap_root)
             snap.commit_snapshot(self.opts.root, seq, cl_seq)
-            return {"seq": seq, "series_blocks": written, "index_segments": index_segs}
+        if self._hist_snapshot is not None:
+            self._hist_snapshot.record(_time.perf_counter() - t0)
+        return {"seq": seq, "series_blocks": written, "index_segments": index_segs}
 
     def cleanup(self, now_nanos: int) -> dict:
         """Expired-data cleanup (reference `storage/cleanup.go`):

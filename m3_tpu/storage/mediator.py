@@ -68,6 +68,14 @@ class Mediator:
         self._scope = (
             instrument.scope("mediator") if instrument is not None else None
         )
+        # Timer (lifetime reservoir) is the RIGHT instrument here and
+        # deliberately kept: the mediator ticks every few seconds, so a
+        # windowed histogram would mostly be empty, and "how have ticks
+        # behaved over the process's life" is the question an operator
+        # asks.  Hot paths (ingest/query/flush) use Histogram instead —
+        # see instrument.Timer's staleness caveat.
+        self._timer_tick = (self._scope.timer("tick_wall_seconds")
+                            if self._scope is not None else None)
         # Optional condition-triggered profiler (reference
         # triggering_profile.go): observe() gets each pass's wall
         # duration, so a slow tick auto-captures a debug bundle.
@@ -109,6 +117,8 @@ class Mediator:
                         ns_stats.get("cold_flushed", 0)
                     )
             stats["duration_s"] = time.monotonic() - t0
+            if self._timer_tick is not None:
+                self._timer_tick.record(stats["duration_s"])
             if self.profiler is not None:
                 stats["profile"] = self.profiler.observe(stats["duration_s"])
             return stats

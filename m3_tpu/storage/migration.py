@@ -83,6 +83,10 @@ class ShardMigrator:
         self._scope = (
             instrument.scope("topology") if instrument is not None else None
         )
+        # per-shard stream-pass latency (hot during a node replace):
+        # windowed histogram, interned once
+        self._hist_stream = (self._scope.histogram("stream_seconds")
+                             if self._scope is not None else None)
         self._mu = threading.Lock()
         # Serializes whole tick() passes: the admin's on-demand
         # POST /topology/migrate racing the mediator tick would stream
@@ -235,6 +239,17 @@ class ShardMigrator:
         """Pull missing flushed blocks for one INITIALIZING shard.
         Returns True when the shard is KNOWN fully copied (some source
         answered and nothing is missing) — the cutover precondition."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        try:
+            return self._stream_shard_inner(view, shard, budget, stats)
+        finally:
+            if self._hist_stream is not None:
+                self._hist_stream.record(_time.perf_counter() - t0)
+
+    def _stream_shard_inner(self, view: TopologyView, shard: int,
+                            budget: int, stats: dict) -> bool:
         complete = True
         answered = False
         copied = total = 0

@@ -222,6 +222,47 @@ def tpu_backlog(args) -> int:
     return 0 if stages and not merged.get("errors") else 1
 
 
+def hops(args) -> int:
+    """Profile the wire→arena→drain→encode→fileset ingest pipeline
+    under x/hopwatch (per-hop transfers, bytes, compile-vs-steady wall,
+    host-time fraction) and emit the PIPELINE artifact JSON.
+
+    ``--out PIPELINE_rNN.json`` writes the artifact (the committed
+    before-state ROADMAP item 1's device-resident rebuild is judged
+    against); ``--check [BASELINE]`` re-runs the profile and exits
+    nonzero if the steady pipeline moves more transfer bytes than the
+    committed baseline allows (±tolerance) or picks up steady-state
+    compiles — the hot path must not quietly regress to MORE host
+    hops."""
+    from m3_tpu.tools.hops import check_against_baseline, run_pipeline
+
+    baseline = None
+    if args.check is not None:
+        # resolve + validate the baseline BEFORE the multi-minute
+        # profile run: a typo'd path must fail in milliseconds
+        baseline = args.check or str(
+            Path(__file__).resolve().parents[2] / "PIPELINE_r09.json")
+        if not Path(baseline).exists():
+            print(f"hops --check: no baseline at {baseline}",
+                  file=sys.stderr)
+            return 2
+    artifact = run_pipeline(S=args.series, T=args.samples)
+    if baseline is not None:
+        errs = check_against_baseline(artifact, baseline,
+                                      tolerance=args.tolerance)
+        _out({"hops_check": {"ok": not errs, "baseline": baseline,
+                             "violations": errs,
+                             "pipeline": artifact["pipeline"]}})
+        return 1 if errs else 0
+    text = json.dumps(artifact, indent=1)
+    if args.out:
+        Path(args.out).write_text(text + "\n")
+        print(f"hops: artifact written to {args.out}", file=sys.stderr)
+    else:
+        sys.stdout.write(text + "\n")
+    return 0
+
+
 def lint(args) -> int:
     """Run m3lint over the package and gate against the committed
     baseline (tools/lint_baseline.json).  Exit 0 only when the findings
@@ -356,6 +397,27 @@ def main(argv=None) -> int:
     tb.add_argument("--probe-timeout", type=float, default=3.0,
                     dest="probe_timeout")
     tb.set_defaults(fn=tpu_backlog)
+
+    hp = sub.add_parser(
+        "hops",
+        help="profile the wire→arena→drain→encode→fileset pipeline's "
+             "host↔device hops (x/hopwatch) and emit/check the "
+             "PIPELINE artifact")
+    hp.add_argument("--series", type=int, default=1024,
+                    help="corpus series count (default 1024 — the "
+                         "pinned artifact shape)")
+    hp.add_argument("--samples", type=int, default=320,
+                    help="samples per series (default 320)")
+    hp.add_argument("--out", help="write the artifact JSON here")
+    hp.add_argument("--check", nargs="?", const="", default=None,
+                    metavar="BASELINE",
+                    help="gate against a committed PIPELINE artifact "
+                         "(default: repo PIPELINE_r09.json); exit 1 on "
+                         "transfer-byte/compile regression")
+    hp.add_argument("--tolerance", type=float, default=0.25,
+                    help="allowed transfer-byte growth vs baseline "
+                         "(default 0.25)")
+    hp.set_defaults(fn=hops)
 
     li = sub.add_parser(
         "lint", help="codebase-aware static analysis, baseline-gated")

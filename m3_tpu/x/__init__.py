@@ -29,6 +29,12 @@
   copies in timed/guarded regions.  Env-armed via ``M3_TRACEWATCH``
   (like lockcheck); bench steady-state loops assert zero retraces
   through it.
+* ``m3_tpu.x.hopwatch`` — tracewatch's counting sibling: per-named-hop
+  host↔device transfer (count + bytes), compile and dispatch
+  accounting behind the same env-seam arming (``M3_HOPWATCH``);
+  ``cli hops`` drives the wire→arena→drain→encode→fileset path under
+  it and commits the PIPELINE artifact ROADMAP item 1 rebuilds
+  against.
 * ``m3_tpu.x.lint`` — m3lint, the codebase-aware static analyzer
   (``python -m m3_tpu.tools.cli lint``); its rule families are the
   static mirror of what fault/retry/lockcheck/tracewatch enforce at
@@ -47,9 +53,12 @@ from __future__ import annotations
 # a node subprocess wraps its locks before fault/retry (or anything
 # else) constructs one.  tracewatch next, for the same reason: its
 # M3_TRACEWATCH seam must swap the jit factories before any module
-# decorates a hot-path function.
+# decorates a hot-path function.  hopwatch (the counting sibling,
+# M3_HOPWATCH) follows the same rule: its jit proxy only sees functions
+# jitted after arming.
 from m3_tpu.x import lockcheck  # noqa: F401  (env-armed seam)
 from m3_tpu.x import tracewatch  # noqa: F401  (env-armed seam)
+from m3_tpu.x import hopwatch  # noqa: F401  (env-armed seam)
 from m3_tpu.x import breaker, deadline, fault, retry
 
 

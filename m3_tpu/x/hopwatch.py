@@ -1,0 +1,437 @@
+"""Device-hop profiler: per-stage host↔device transfer/compile/dispatch
+accounting — tracewatch's COUNTING sibling.
+
+``x/tracewatch.py`` is the sanitizer: it forbids transfers and raises
+on retraces.  This module is the accountant: while armed it counts
+every host↔device transfer (count + bytes), every XLA compile, and
+every jitted dispatch, attributing each to the innermost named **hop**
+(``with hopwatch.hop("arena_ingest"): ...``).  ROADMAP item 1 claims
+the node hot path pays five host hops — wire parse → arena ingest →
+drain → encoder re-upload → fileset bytes; ``cli hops`` drives the
+pinned corpus through exactly that path under this profiler and commits
+the per-hop ledger (PIPELINE_r09.json), turning the claim into the
+before-artifact the pipeline rebuild will be judged against.
+
+Interception points (each a wrapper that counts and delegates — never
+raises, never copies):
+
+* **device→host** — ``jax.device_get``, the ``np.asarray``/``np.array``
+  /``np.ascontiguousarray``/``np.asanyarray`` module entry points, and
+  ``ArrayImpl.__array__`` (the same seams tracewatch guards, for the
+  same reason: numpy's buffer-protocol fast path bypasses anything
+  less).  Bytes = the source array's ``nbytes``.
+* **host→device** — ``jax.device_put`` plus the ``jnp.asarray``/
+  ``jnp.array`` runtime path (a numpy/scalar operand OUTSIDE a trace is
+  a real upload; tracer operands are symbolic and skipped).
+* **compiles** — the ``jax_log_compiles`` pxla logging record, exactly
+  tracewatch's seam, counted per hop (compile-vs-steady wall time falls
+  out of running a pipeline twice: pass 1 pays compiles, pass 2 is
+  steady state — ``cli hops`` reports both).
+* **dispatches** — the armed ``jax.jit`` factory returns a counting
+  proxy whose ``__call__`` bumps the current hop before delegating
+  (``__wrapped__``/``lower``/``clear_cache`` pass through).
+
+Arming mirrors tracewatch/lockcheck: code — ``install()``/
+``uninstall()``; env — ``M3_HOPWATCH=1`` arms at import (``m3_tpu.x``
+imports this module, so bench children and dtest node subprocesses
+inherit arming through their environment).  Totals accumulate process-
+wide whether or not a hop is open (unattributed work lands on the
+``"(unattributed)"`` hop); ``snapshot()``/``since()`` bracket a timed
+region the way tracewatch's retrace snapshot does, which is how bench
+stages record per-stage transfer deltas next to ``compile_s``/
+``retraces``.
+
+Honesty notes:
+
+* Wrappers compose with tracewatch's (each saves whatever was current
+  at install time); install order only affects which wrapper runs
+  first, not the counts.
+* ``nbytes`` of a sharded array counts the LOGICAL bytes, not
+  per-device replicas.
+* Dispatch counting only sees functions jitted while armed — arm
+  before importing/jitting the code under test (the env seam does).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import re
+import threading
+from dataclasses import dataclass, field
+from typing import Dict
+
+__all__ = [
+    "HopStats", "install", "uninstall", "installed", "reset", "hop",
+    "stats", "totals", "snapshot", "since", "current_hop",
+]
+
+_UNATTRIBUTED = "(unattributed)"
+
+_mu = threading.Lock()
+_installed = False
+_tls = threading.local()
+_ORIG: dict = {}
+
+_COMPILE_RE = re.compile(r"^Compiling ([^\s]+) with global shapes and types")
+_PXLA_LOGGER = "jax._src.interpreters.pxla"
+_NP_SEAMS = ("asarray", "array", "ascontiguousarray", "asanyarray")
+
+
+@dataclass
+class HopStats:
+    """One named hop's ledger (all counters process-lifetime while
+    armed; wall_s accumulates over every ``hop()`` entry)."""
+
+    wall_s: float = 0.0
+    entries: int = 0
+    h2d_count: int = 0
+    h2d_bytes: int = 0
+    d2h_count: int = 0
+    d2h_bytes: int = 0
+    compiles: int = 0
+    dispatches: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "wall_s": round(self.wall_s, 6), "entries": self.entries,
+            "h2d_count": self.h2d_count, "h2d_bytes": self.h2d_bytes,
+            "d2h_count": self.d2h_count, "d2h_bytes": self.d2h_bytes,
+            "compiles": self.compiles, "dispatches": self.dispatches,
+        }
+
+
+_hops: Dict[str, HopStats] = {}
+_totals = HopStats()
+
+
+def current_hop() -> str:
+    stack = getattr(_tls, "hops", None)
+    return stack[-1] if stack else _UNATTRIBUTED
+
+
+def _stat(name: str) -> HopStats:
+    # caller holds _mu
+    st = _hops.get(name)
+    if st is None:
+        st = _hops[name] = HopStats()
+    return st
+
+
+def _count(kind: str, n: int = 1, nbytes: int = 0) -> None:
+    if not _installed:
+        return
+    name = current_hop()
+    with _mu:
+        for st in (_stat(name), _totals):
+            if kind == "h2d":
+                st.h2d_count += n
+                st.h2d_bytes += nbytes
+            elif kind == "d2h":
+                st.d2h_count += n
+                st.d2h_bytes += nbytes
+            elif kind == "compile":
+                st.compiles += n
+            elif kind == "dispatch":
+                st.dispatches += n
+
+
+@contextlib.contextmanager
+def hop(name: str):
+    """Attribute everything in this thread to ``name`` for the scope
+    (nestable: the innermost hop wins, like a span stack)."""
+    import time
+
+    stack = getattr(_tls, "hops", None)
+    if stack is None:
+        stack = _tls.hops = []
+    stack.append(name)
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        stack.pop()
+        with _mu:
+            st = _stat(name)
+            st.wall_s += dt
+            st.entries += 1
+
+
+# -- interception seams ------------------------------------------------------
+
+
+def _nbytes(x) -> int:
+    try:
+        return int(getattr(x, "nbytes", 0) or 0)
+    except Exception:  # noqa: BLE001 — accounting must never raise
+        return 0
+
+
+def _tree_nbytes(x) -> int:
+    try:
+        import jax
+
+        return sum(_nbytes(leaf) for leaf in jax.tree_util.tree_leaves(x))
+    except Exception:  # noqa: BLE001
+        return _nbytes(x)
+
+
+def _is_device_array(x) -> bool:
+    cls = _ORIG.get("_array_cls")
+    return cls is not None and isinstance(x, cls)
+
+
+def _is_host_operand(x) -> bool:
+    """A real host→device upload operand: numpy array (or nested
+    list/tuple of them) — NOT a tracer (symbolic, inside a trace) and
+    NOT already a device array."""
+    import numpy as np
+
+    if isinstance(x, np.ndarray):
+        return True
+    return False
+
+
+class _CompileHandler(logging.Handler):
+    def emit(self, record: logging.LogRecord) -> None:
+        if _COMPILE_RE.match(record.getMessage()):
+            _count("compile")
+
+
+_handler = _CompileHandler(level=logging.WARNING)
+
+
+class _CountingJit:
+    """Transparent proxy over a jitted callable: ``__call__`` counts a
+    dispatch on the current hop, everything else delegates."""
+
+    __slots__ = ("_fn",)
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __call__(self, *a, **kw):
+        _count("dispatch")
+        return self._fn(*a, **kw)
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+
+def _patch() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if "device_get" in _ORIG:
+        return
+
+    try:
+        import jaxlib.xla_extension as xe
+
+        _ORIG["_array_cls"] = xe.ArrayImpl
+    except Exception:  # pragma: no cover - exotic jaxlib layout
+        _ORIG["_array_cls"] = jax.Array
+
+    _ORIG["device_get"] = jax.device_get
+
+    def counting_device_get(x):
+        _count("d2h", 1, _tree_nbytes(x))
+        return _ORIG["device_get"](x)
+
+    jax.device_get = counting_device_get
+
+    _ORIG["device_put"] = jax.device_put
+
+    def counting_device_put(x, *a, **kw):
+        # skip when reached THROUGH a counted jnp.asarray/jnp.array
+        # call — one upload, one count
+        if not getattr(_tls, "in_jnp", False):
+            _count("h2d", 1, _tree_nbytes(x))
+        return _ORIG["device_put"](x, *a, **kw)
+
+    jax.device_put = counting_device_put
+
+    def _wrap_np(name: str):
+        orig = getattr(np, name)
+
+        def counting(a, *args, **kw):
+            if _is_device_array(a):
+                _count("d2h", 1, _nbytes(a))
+            return orig(a, *args, **kw)
+
+        counting.__name__ = name
+        counting.__wrapped__ = orig
+        return orig, counting
+
+    for name in _NP_SEAMS:
+        orig, counting = _wrap_np(name)
+        _ORIG[f"np.{name}"] = orig
+        setattr(np, name, counting)
+
+    try:
+        arr = _ORIG["_array_cls"]
+        _ORIG["__array__"] = arr.__array__
+
+        def counting_array(self, *a, **kw):
+            _count("d2h", 1, _nbytes(self))
+            return _ORIG["__array__"](self, *a, **kw)
+
+        arr.__array__ = counting_array
+    except Exception:  # pragma: no cover
+        _ORIG.pop("__array__", None)
+
+    # jnp.asarray/jnp.array: the library-internal upload path (arena
+    # ingest, encoder re-upload).  Only a concrete host operand outside
+    # a trace is an upload — tracers are symbolic, device arrays free.
+    # Reentrancy-guarded: jnp.asarray delegates to jnp.array, and one
+    # upload must count once.
+    for name in ("asarray", "array"):
+        orig_jnp = getattr(jnp, name)
+
+        def _make(orig_fn):
+            def counting_jnp(a, *args, **kw):
+                # np.ndarray only: tracers (symbolic) and device arrays
+                # (already resident) fail the check and count nothing
+                if _is_host_operand(a) and not getattr(
+                        _tls, "in_jnp", False):
+                    _count("h2d", 1, _nbytes(a))
+                _tls.in_jnp = True
+                try:
+                    return orig_fn(a, *args, **kw)
+                finally:
+                    _tls.in_jnp = False
+
+            counting_jnp.__wrapped__ = orig_fn
+            return counting_jnp
+
+        _ORIG[f"jnp.{name}"] = orig_jnp
+        setattr(jnp, name, _make(orig_jnp))
+
+    # dispatch counting: the armed jit factory wraps its result
+    _ORIG["jit"] = jax.jit
+
+    def counting_jit(fun=None, **kw):
+        if fun is None:
+            def deco(f):
+                return _CountingJit(_ORIG["jit"](f, **kw))
+            return deco
+        return _CountingJit(_ORIG["jit"](fun, **kw))
+
+    jax.jit = counting_jit
+
+    _ORIG["log_compiles"] = jax.config.jax_log_compiles
+    jax.config.update("jax_log_compiles", True)
+    pxla = logging.getLogger(_PXLA_LOGGER)
+    pxla.addHandler(_handler)
+    # quiet the dispatch-phase timing spam jax_log_compiles flips on,
+    # and keep the pxla record from reaching the root last-resort
+    # printer (same hygiene as tracewatch.install) — only the counter
+    # consumes it
+    dispatch = logging.getLogger("jax._src.dispatch")
+    _ORIG["dispatch_level"] = dispatch.level
+    dispatch.setLevel(logging.ERROR)
+    _ORIG["pxla_propagate"] = pxla.propagate
+    pxla.propagate = False
+
+
+def _unpatch() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if "device_get" in _ORIG:
+        jax.device_get = _ORIG.pop("device_get")
+    if "device_put" in _ORIG:
+        jax.device_put = _ORIG.pop("device_put")
+    for name in _NP_SEAMS:
+        orig = _ORIG.pop(f"np.{name}", None)
+        if orig is not None:
+            setattr(np, name, orig)
+    for name in ("asarray", "array"):
+        orig = _ORIG.pop(f"jnp.{name}", None)
+        if orig is not None:
+            setattr(jnp, name, orig)
+    if "__array__" in _ORIG:
+        _ORIG["_array_cls"].__array__ = _ORIG.pop("__array__")
+    if "jit" in _ORIG:
+        jax.jit = _ORIG.pop("jit")
+    pxla = logging.getLogger(_PXLA_LOGGER)
+    pxla.removeHandler(_handler)
+    if "pxla_propagate" in _ORIG:
+        pxla.propagate = _ORIG.pop("pxla_propagate")
+    if "dispatch_level" in _ORIG:
+        logging.getLogger("jax._src.dispatch").setLevel(
+            _ORIG.pop("dispatch_level"))
+    if "log_compiles" in _ORIG:
+        jax.config.update("jax_log_compiles", _ORIG.pop("log_compiles"))
+    _ORIG.pop("_array_cls", None)
+
+
+# -- lifecycle ---------------------------------------------------------------
+
+
+def install() -> None:
+    """Arm the profiler (idempotent).  Counting starts immediately;
+    open ``hop()`` scopes to attribute."""
+    global _installed
+    if _installed:
+        return
+    _patch()
+    _installed = True
+
+
+def uninstall() -> None:
+    """Disarm and restore every seam (ledgers survive for inspection;
+    ``reset()`` clears them)."""
+    global _installed
+    if not _installed:
+        return
+    _unpatch()
+    _installed = False
+
+
+def installed() -> bool:
+    return _installed
+
+
+def reset() -> None:
+    global _totals
+    with _mu:
+        _hops.clear()
+        _totals = HopStats()
+
+
+def stats() -> Dict[str, dict]:
+    """Per-hop ledgers, as plain dicts (artifact-ready)."""
+    with _mu:
+        return {name: st.to_dict() for name, st in sorted(_hops.items())}
+
+
+def totals() -> dict:
+    with _mu:
+        return _totals.to_dict()
+
+
+def snapshot() -> dict:
+    """Opaque marker for :func:`since`: bench stages bracket their
+    steady-state loops with these, recording the per-stage transfer
+    delta next to ``compile_s``/``retraces``."""
+    return totals()
+
+
+def since(snap: dict) -> dict:
+    """Process-wide transfer/dispatch delta since ``snap`` (wall_s and
+    entries excluded — they are hop-scoped)."""
+    now = totals()
+    return {k: now[k] - snap[k]
+            for k in ("h2d_count", "h2d_bytes", "d2h_count", "d2h_bytes",
+                      "compiles", "dispatches")}
+
+
+# bench children / dtest node subprocesses inherit arming through their
+# environment, exactly like M3_TRACEWATCH (m3_tpu.x imports this module).
+if os.environ.get("M3_HOPWATCH"):
+    install()

@@ -1,6 +1,6 @@
 """m3lint: codebase-aware static analysis for the m3-tpu tree.
 
-Thirteen rule families, each encoding a contract this repo already
+Fourteen rule families, each encoding a contract this repo already
 pays for at runtime (race tier, fault tier, bit-exactness goldens,
 bench steady-state) as a static gate:
 
@@ -36,6 +36,12 @@ bench steady-state) as a static gate:
   constant-folded into jitted HLO.  Static twin of the runtime
   sanitizer ``m3_tpu/x/tracewatch.py``; see TESTING.md "Compile
   stability & transfer hygiene".
+* ``metric-hygiene``    — instrument interning inside loops/per-request
+  handlers in the request-serving trees (``server/``, ``query/``) —
+  registry interning makes it correct but per-call lock+intern is
+  hot-path waste — and unbounded tag cardinality (tag values from
+  f-strings/variables: every distinct value interns a series that
+  lives forever on /metrics).
 
 Run: ``python -m m3_tpu.tools.cli lint`` (gates against
 ``m3_tpu/tools/lint_baseline.json``; see TESTING.md "Static analysis &

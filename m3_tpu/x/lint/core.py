@@ -50,6 +50,9 @@ RULES = (
     "transfer-hygiene",
     "dtype-stability",
     "constant-bloat",
+    # round 10: instrument-callsite hygiene (metrics_rule.py) —
+    # per-call interning on hot paths, unbounded tag cardinality
+    "metric-hygiene",
 )
 
 _SUPPRESS_RE = re.compile(r"#\s*m3lint:\s*disable=([\w,-]+)")
@@ -122,6 +125,10 @@ class Context:
     jax_host_boundary: tuple = ("m3_tpu/tools/", "m3_tpu/encoding/m3tsz.py")
     # modules whose perf_counter-timed regions must block_until_ready
     timed_prefixes: tuple = ("m3_tpu/tools/",)
+    # request-serving trees where instrument interning must be hoisted
+    # out of loops/handlers and tag values must be literals
+    # (metric-hygiene rule); maintenance paths may intern lazily
+    metric_prefixes: tuple = ("m3_tpu/server/", "m3_tpu/query/")
     # known large host arrays (constant-bloat flags references to these
     # under the tracer even across modules, where size can't be folded)
     large_constants: tuple = ("_VALUE_CTRL_TBL",)
@@ -196,8 +203,8 @@ def apply_suppressions(unit: FileUnit, findings: Iterable[Finding]) -> List[Find
 
 def default_rules() -> List[Rule]:
     from m3_tpu.x.lint import (
-        corruption, deadline_aware, faultcov, jaxlint, locks, placement,
-        purity, resources, wirecheck,
+        corruption, deadline_aware, faultcov, jaxlint, locks,
+        metrics_rule, placement, purity, resources, wirecheck,
     )
 
     return [
@@ -214,6 +221,7 @@ def default_rules() -> List[Rule]:
         jaxlint.check_transfer,
         jaxlint.check_dtype_stability,
         jaxlint.check_constant_bloat,
+        metrics_rule.check,
     ]
 
 
@@ -221,12 +229,12 @@ def explain(rule: str) -> dict | None:
     """{why, bad, good} for a rule name, harvested from the rule
     modules' EXPLAIN tables (``cli lint --explain`` renders it)."""
     from m3_tpu.x.lint import (
-        corruption, deadline_aware, faultcov, jaxlint, locks, placement,
-        purity, resources, wirecheck,
+        corruption, deadline_aware, faultcov, jaxlint, locks,
+        metrics_rule, placement, purity, resources, wirecheck,
     )
 
     for mod in (jaxlint, locks, purity, wirecheck, faultcov, resources,
-                corruption, placement, deadline_aware):
+                corruption, placement, deadline_aware, metrics_rule):
         entry = getattr(mod, "EXPLAIN", {}).get(rule)
         if entry is not None:
             return entry

@@ -32,12 +32,13 @@ FAMILIES = {
     "bus": frozenset({"BUS_HELLO", "BUS_PUBLISH", "BUS_DELIVER", "BUS_ACK"}),
     "ingest": frozenset({"METRIC_BATCH", "TIMED_BATCH", "PASSTHROUGH_BATCH",
                          "FORWARDED_BATCH", "INGEST_HELLO", "INGEST_ACK",
-                         "INGEST_BACKOFF"}),
+                         "INGEST_BACKOFF", "INGEST_TRACE"}),
     "reply": frozenset({"OK", "ERROR"}),
     # frame families owned by other wire modules (server/rpc.py,
     # cluster/kv_remote.py, query/remote.py) — their dispatchers get the
     # same exhaustiveness treatment as protocol.py's
-    "rpc": frozenset({"RPC_REQ", "RPC_REQ_DL", "RPC_OK", "RPC_ERR"}),
+    "rpc": frozenset({"RPC_REQ", "RPC_REQ_DL", "RPC_REQ_TR", "RPC_OK",
+                      "RPC_ERR"}),
     "kv": frozenset({"KV_REQ", "KV_OK", "KV_ERR"}),
     "query": frozenset({"QUERY_FETCH", "QUERY_RESULT"}),
     "rpc-method": frozenset({"M_WRITE_BATCH", "M_WRITE_TAGGED", "M_READ",

@@ -1,0 +1,38 @@
+"""Seeded metric-hygiene violations (never imported).  The corpus run
+passes a Context whose metric prefixes match this directory."""
+
+scope = None  # placeholder; names resolve statically in the analyzer
+host, port = "h", 1
+
+
+def drain_loop(frames):
+    while frames:
+        scope.counter("frames").inc()       # VIOLATION: metric-hygiene (L10)
+        frames.pop()
+
+
+class Handler:
+    def do_GET(self):
+        scope.histogram("seconds").record(0.1)  # VIOLATION (L16)
+
+
+def tag_leaks(user_id):
+    scope.tagged({"peer": f"{host}:{port}"})    # VIOLATION: f-string (L20)
+    scope.tagged({"user": user_id})             # VIOLATION: variable (L21)
+
+
+class CleanServer:
+    def __init__(self):
+        # hoisted interning: created once, reused in the loop
+        self._frames = scope.counter("frames")
+        self._lat = scope.histogram("seconds")
+
+    def drain(self, frames):
+        while frames:
+            self._frames.inc()              # ok: pre-interned handle
+            frames.pop()
+
+
+def clean_tags():
+    scope.tagged({"path": "ingest"})        # ok: literal tag value
+    return scope.counter("requests")        # ok: module scope, no loop

@@ -173,6 +173,37 @@ class TestOverloadResilienceScenario:
             assert health["query"]["slow_query_total"] >= 3
             slow = health["query"]["slow"]
             assert slow and slow[-1]["query"].startswith("sum(ov)")
+
+            # -- merged latency SLOs from HISTOGRAM state -------------
+            # (round 10: the overload artifact's p50/p99 come from
+            # fleet-merged log-bucket histograms — exact vector adds
+            # across all three processes — not lifetime-reservoir
+            # Timers that would still report the warmup burst)
+            from m3_tpu.dtest.harness import merged_histogram
+            from m3_tpu.instrument.exposition import merged_quantile
+
+            ing = merged_histogram(ports, "m3tpu_ingest_seconds")
+            qry = merged_histogram(ports, "m3tpu_query_seconds")
+            slo = {
+                "ingest_p50_s": merged_quantile(ing, 0.50),
+                "ingest_p99_s": merged_quantile(ing, 0.99),
+                "query_p50_s": merged_quantile(qry, 0.50),
+                "query_p99_s": merged_quantile(qry, 0.99),
+                "ingest_samples": max(ing.values()),
+                "query_samples": max(qry.values()),
+            }
+            # every node ingested; the coordinator ran the queries
+            assert slo["ingest_samples"] >= 3
+            assert slo["query_samples"] >= 10
+            assert 0 < slo["ingest_p50_s"] <= slo["ingest_p99_s"]
+            # deadline-bounded queries: merged p99 must sit within the
+            # 30s warmup timeout; p50 within the 3s steady deadline + 2x
+            # bucket resolution
+            assert 0 < slo["query_p50_s"] < 8.0, slo
+            assert slo["query_p99_s"] < 64.0, slo
+            # /health mirrors the same histogram state per node
+            lat = health["latency"]
+            assert any(k.startswith("m3tpu.query.seconds") for k in lat)
         finally:
             for nd in nodes:
                 nd.kill()

@@ -1,0 +1,131 @@
+"""dtest: one sampled write through a 3-node cluster yields ONE
+stitched trace across process boundaries.
+
+The round-10 acceptance scenario: the driving process acts as the
+coordinator (root ``api.write`` span + per-replica ``session.write``
+fan-out spans), the replica fan-out rides RPC_REQ_TR frames into three
+real node processes, and each node's ``rpc.server``/``db.writeBatch``
+spans join the SAME trace — collected over HTTP from every process's
+``/api/v1/debug/traces`` ring and joined by the dtest harness.
+"""
+
+import json
+import socket
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from m3_tpu.dtest.harness import NodeProcess, collect_traces
+
+BLOCK = 2 * 3600 * 10**9
+START = (1_700_000_000 * 10**9) // BLOCK * BLOCK
+SEC = 10**9
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+@pytest.mark.slow
+class TestStitchedTraceAcrossCluster:
+    def test_sampled_write_stitches_coordinator_to_replicas(self, tmp_path):
+        from m3_tpu.client.session import ConsistencyLevel, ReplicatedSession
+        from m3_tpu.cluster.placement import Instance, initial_placement
+        from m3_tpu.instrument.tracing import Tracer
+        from m3_tpu.server.rpc import RemoteDatabase
+
+        rpc_ports = _free_ports(3)
+        nodes = []
+        for k in range(3):
+            root = tmp_path / f"n{k}" / "data"
+            cfg = tmp_path / f"n{k}" / "node.yaml"
+            cfg.parent.mkdir(parents=True, exist_ok=True)
+            cfg.write_text(f"""
+db:
+  root: {root}
+  rpc_listen_port: {rpc_ports[k]}
+  namespaces:
+    default: {{num_shards: 2}}
+coordinator: {{listen_port: 0, tracing: true}}
+mediator: {{enabled: false}}
+""")
+            root.mkdir(parents=True, exist_ok=True)
+            nodes.append(NodeProcess(str(cfg), str(root)))
+        try:
+            for nd in nodes:
+                nd.start()
+            http_ports = [
+                json.loads(Path(nd.root, "node.json").read_text())["port"]
+                for nd in nodes
+            ]
+            placement = initial_placement(
+                [Instance(f"i{k}") for k in range(3)], num_shards=2, rf=3)
+            tracer = Tracer()
+            session = ReplicatedSession(
+                placement,
+                {f"i{k}": RemoteDatabase(("127.0.0.1", rpc_ports[k]))
+                 for k in range(3)},
+                write_level=ConsistencyLevel.ALL,
+                tracer=tracer,
+            )
+
+            # -- the sampled write: coordinator root span around the
+            # replica fan-out; the context rides every RPC_REQ_TR
+            ids = [b"trace-%d" % i for i in range(4)]
+            ts = np.full(len(ids), START + SEC, np.int64)
+            with tracer.start_span("api.write", {"n": len(ids)}) as root:
+                session.write_batch("default", ids, ts,
+                                    np.arange(len(ids), dtype=np.float64),
+                                    now_nanos=START + SEC)
+            trace_id = root.span.trace_id
+
+            # -- collect from ALL processes and join
+            local = [s.to_dict() for s in tracer.finished()]
+            traces = collect_traces(http_ports, local_spans=local)
+            assert trace_id in traces, sorted(traces)
+            spans = traces[trace_id]
+            by_name: dict = {}
+            for s in spans:
+                by_name.setdefault(s["name"], []).append(s)
+
+            # one coordinator root; every span shares the trace id
+            assert len(by_name["api.write"]) == 1
+            assert all(s["trace_id"] == trace_id for s in spans)
+
+            # replica fan-out spans: one per (shard, replica) pair,
+            # all children of root, covering every replica
+            fan = by_name["session.writeReplica"]
+            assert len(fan) >= 3
+            root_id = by_name["api.write"][0]["span_id"]
+            assert all(s["parent_id"] == root_id for s in fan)
+            assert {s["tags"]["replica"] for s in fan} == {"i0", "i1", "i2"}
+
+            # node-side rpc spans: each parented on a fan-out span,
+            # each with a db.writeBatch child — 2 shards may split the
+            # batch, so >= one rpc span per replica
+            fan_ids = {s["span_id"] for s in fan}
+            rpc = by_name["rpc.server"]
+            assert len(rpc) >= 3
+            assert all(s["parent_id"] in fan_ids for s in rpc)
+            rpc_ids = {s["span_id"] for s in rpc}
+            writes = by_name["db.writeBatch"]
+            assert len(writes) >= 3
+            assert all(s["parent_id"] in rpc_ids for s in writes)
+
+            # parent-before-child ordering from the join
+            seen = set()
+            for s in spans:
+                assert s["parent_id"] is None or s["parent_id"] in seen
+                seen.add(s["span_id"])
+        finally:
+            for nd in nodes:
+                nd.kill()

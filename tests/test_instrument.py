@@ -1,0 +1,360 @@
+"""Instrument package unit tier: registry interning, collector
+isolation, strict exposition validity, Timer reservoir bounds + the
+lifetime-bias staleness regression, Histogram merge/window semantics,
+and cross-process trace context propagation.
+
+Previously the instrument substrate was only covered transitively
+(through server/dtest scenarios); round 10 makes it a first-class unit
+surface because /health SLOs and the dtest artifacts now read straight
+off Histogram state.
+"""
+
+import math
+
+import pytest
+
+from m3_tpu import instrument
+from m3_tpu.instrument import (
+    HISTOGRAM_BOUNDS, Histogram, Timer, exposition, new_registry,
+    quantile_from_buckets,
+)
+from m3_tpu.instrument import tracing as tracing_bind
+from m3_tpu.instrument.tracing import (
+    NOOP_SPAN, TraceContext, Tracepoint, Tracer, join_traces,
+)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class TestRegistryInterning:
+    def test_same_name_tags_same_instrument(self):
+        reg = new_registry()
+        a = reg.scope("db").counter("writes")
+        b = reg.scope("db").counter("writes")
+        assert a is b
+        a.inc(2)
+        assert b.value == 2
+
+    def test_tag_order_does_not_matter(self):
+        reg = new_registry()
+        a = reg.scope("s", {"x": "1", "y": "2"}).gauge("g")
+        b = reg.scope("s", {"y": "2", "x": "1"}).gauge("g")
+        assert a is b
+
+    def test_distinct_tags_distinct_instruments(self):
+        reg = new_registry()
+        a = reg.scope("s", {"x": "1"}).counter("c")
+        b = reg.scope("s", {"x": "2"}).counter("c")
+        assert a is not b
+
+    def test_subscope_and_tagged_compose(self):
+        reg = new_registry()
+        h1 = reg.scope("a").subscope("b").histogram("h")
+        h2 = reg.scope("a.b").histogram("h")
+        assert h1 is h2
+        t1 = reg.scope("a", {"k": "v"}).tagged({"k2": "v2"}).timer("t")
+        t2 = reg.scope("a", {"k2": "v2", "k": "v"}).timer("t")
+        assert t1 is t2
+
+
+class TestCollectorIsolation:
+    def test_raising_collector_never_poisons_the_scrape(self):
+        reg = new_registry()
+        reg.scope("x").counter("c").inc()
+        calls = []
+
+        def bad():
+            calls.append("bad")
+            raise RuntimeError("collector exploded")
+
+        def good():
+            calls.append("good")
+            reg.scope("x").gauge("g").update(7)
+
+        reg.register_collector(bad)
+        reg.register_collector(good)
+        snap = reg.snapshot()
+        assert snap["x.c"] == 1
+        assert snap["x.g"] == 7.0  # collector after the raiser still ran
+        assert calls == ["bad", "good"]
+        # and the raiser is retried on the next scrape, not dropped
+        reg.render_prometheus()
+        assert calls == ["bad", "good", "bad", "good"]
+
+    def test_unregister(self):
+        reg = new_registry()
+        fn = lambda: reg.scope("x").gauge("g").update(1)
+        reg.register_collector(fn)
+        reg.snapshot()
+        reg.unregister_collector(fn)
+        reg.scope("x").gauge("g").update(0)
+        assert reg.snapshot()["x.g"] == 0.0
+
+
+class TestTimer:
+    def test_reservoir_bounded(self):
+        t = Timer(reservoir=64)
+        for i in range(10_000):
+            t.record(i / 1000.0)
+        assert len(t._reservoir) == 64  # bounded memory
+        s = t.summary()
+        assert s["count"] == 10_000
+        assert s["max"] == pytest.approx(9.999)
+        assert 0 <= s["p50"] <= s["p95"] <= s["p99"] <= s["max"]
+
+    def test_lifetime_bias_is_the_documented_semantics(self):
+        """Timer's reservoir is uniform over the LIFETIME: after a
+        burst of slow samples followed by many fast ones, the summary
+        still reflects the burst (max never decays) — why hot paths
+        moved to Histogram."""
+        t = Timer(reservoir=128)
+        for _ in range(100):
+            t.record(5.0)  # the burst
+        for _ in range(100):
+            t.record(0.001)  # back to fast
+        s = t.summary()
+        assert s["max"] == 5.0  # never decays
+        assert s["p99"] == 5.0  # burst still dominates the tail
+
+
+class TestHistogram:
+    def test_bounds_are_log2_and_fixed(self):
+        assert len(HISTOGRAM_BOUNDS) == 31
+        for lo, hi in zip(HISTOGRAM_BOUNDS, HISTOGRAM_BOUNDS[1:]):
+            assert hi == 2 * lo
+
+    def test_merge_is_exact_bucket_sum(self):
+        """The acceptance property: two nodes' histograms merge to the
+        exact vector sum of their buckets (shared fixed bounds)."""
+        import random
+
+        rng = random.Random(7)
+        a, b, both = Histogram(), Histogram(), Histogram()
+        for _ in range(2000):
+            v = rng.lognormvariate(-4, 2)
+            a.record(v)
+            both.record(v)
+        for _ in range(3000):
+            v = rng.lognormvariate(-2, 1)
+            b.record(v)
+            both.record(v)
+        sa, sb, sboth = a.state(), b.state(), both.state()
+        merged = [x + y for x, y in zip(sa["buckets"], sb["buckets"])]
+        assert merged == sboth["buckets"]
+        assert sa["count"] + sb["count"] == sboth["count"]
+        assert sa["sum"] + sb["sum"] == pytest.approx(sboth["sum"])
+        # merged quantiles == quantiles of the union stream's histogram
+        for q in (0.5, 0.95, 0.99):
+            assert quantile_from_buckets(merged, q) == pytest.approx(
+                quantile_from_buckets(sboth["buckets"], q))
+
+    def test_quantile_within_bucket_resolution(self):
+        h = Histogram()
+        for _ in range(1000):
+            h.record(0.010)  # lands in the (2^-7, 2^-6] lane
+        s = h.summary()
+        # log-2 lanes: estimate within a factor of 2 of the true value
+        assert 0.005 <= s["p50"] <= 0.020
+        assert 0.005 <= s["p99"] <= 0.020
+
+    def test_windowed_summary_decays_timer_does_not(self):
+        """The staleness regression the ISSUE pins: after a burst ages
+        past two windows, Histogram p99 reflects CURRENT traffic while
+        Timer still reports the burst."""
+        clock = FakeClock()
+        h = Histogram(window_s=60.0, clock=clock)
+        t = Timer()
+        for _ in range(100):
+            h.record(5.0)
+            t.record(5.0)
+        assert h.summary()["p99"] > 2.0  # burst visible now
+        clock.advance(150.0)  # > 2 windows: the burst ages out entirely
+        for _ in range(100):
+            h.record(0.001)
+            t.record(0.001)
+        hs, ts = h.summary(), t.summary()
+        assert hs["p99"] < 0.01, hs     # histogram: current traffic
+        assert hs["max"] < 0.01, hs     # windowed max decayed too
+        assert ts["p99"] == 5.0         # timer: stale burst forever
+        assert ts["max"] == 5.0
+        # cumulative lanes still carry everything (Prometheus counters)
+        assert hs["count"] == 200
+
+    def test_idle_gap_between_one_and_two_windows(self):
+        clock = FakeClock()
+        h = Histogram(window_s=60.0, clock=clock)
+        h.record(1.0)
+        clock.advance(90.0)  # 1-2 windows: previous window still counts
+        assert h.summary()["window_count"] == 1
+        clock.advance(60.0)
+        assert h.summary()["window_count"] == 0
+
+
+class TestExposition:
+    def _render(self):
+        reg = new_registry()
+        s = reg.scope("m3tpu")
+        s.counter("writes").inc(3)
+        s.gauge("depth").update(2.5)
+        s.timer("tick_seconds").record(0.5)
+        s.tagged({"phase": "fetch"}).histogram("query_seconds").record(0.02)
+        s.histogram("ingest_seconds").record(0.001)
+        return reg.render_prometheus()
+
+    def test_registry_output_parses_strict(self):
+        samples = exposition.parse_text(self._render())
+        names = {s.name for s in samples}
+        assert "m3tpu_writes" in names
+        assert "m3tpu_ingest_seconds_bucket" in names
+        assert "m3tpu_ingest_seconds_count" in names
+
+    def test_histogram_lanes_cumulative_and_inf_terminated(self):
+        samples = exposition.parse_text(self._render())
+        lanes = exposition.histogram_series(samples, "m3tpu_ingest_seconds")
+        (lemap,) = lanes.values()
+        les = sorted(lemap)
+        assert math.isinf(les[-1])
+        cums = [lemap[le] for le in les]
+        assert cums == sorted(cums)
+
+    def test_label_escaping_round_trips(self):
+        reg = new_registry()
+        reg.scope("s", {"q": 'a"b\\c\nd'}).counter("c").inc()
+        samples = exposition.parse_text(reg.render_prometheus())
+        assert samples[0].label("q") == 'a"b\\c\nd'
+
+    def test_backslash_n_sequence_round_trips(self):
+        """Review regression: a literal backslash followed by 'n'
+        ('C:\\network') must survive escape→parse — sequential
+        str.replace unescaping cut a newline into the middle of it."""
+        reg = new_registry()
+        reg.scope("s", {"p": "C:\\network", "q": "\\\\host\\n"}).counter(
+            "c").inc()
+        samples = exposition.parse_text(reg.render_prometheus())
+        assert samples[0].label("p") == "C:\\network"
+        assert samples[0].label("q") == "\\\\host\\n"
+
+    @pytest.mark.parametrize("bad", [
+        "1metric 2\n",                       # name starts with digit
+        "metric  \n",                        # no value
+        'metric{l="v} 1\n',                  # unterminated label value
+        "metric 1\nmetric 1\n",              # duplicate series
+        'h_bucket{le="0.5"} 5\nh_bucket{le="1.0"} 3\n'
+        'h_bucket{le="+Inf"} 5\n',           # decreasing cumulative
+        'h_bucket{le="0.5"} 5\n',            # no +Inf lane
+        'h_bucket{le="+Inf"} 5\nh_count 4\n',  # +Inf != _count
+        "metric 1 \n",                       # trailing whitespace
+    ])
+    def test_strict_parser_rejects(self, bad):
+        with pytest.raises(exposition.ExpositionError):
+            exposition.parse_text(bad)
+
+    def test_merged_quantile_across_scrapes(self):
+        regs = [new_registry() for _ in range(2)]
+        for i, reg in enumerate(regs):
+            h = reg.scope("node").histogram("lat_seconds")
+            for _ in range(100):
+                h.record(0.001 if i == 0 else 1.0)
+        scrapes = [exposition.parse_text(r.render_prometheus())
+                   for r in regs]
+        merged = exposition.merge_histograms(scrapes, "node_lat_seconds")
+        p50 = exposition.merged_quantile(merged, 0.50)
+        p99 = exposition.merged_quantile(merged, 0.99)
+        assert 0.0005 <= p50 <= 1.5
+        assert 0.5 <= p99 <= 1.5  # the slow node's lane dominates p99
+
+
+class TestTraceContext:
+    def test_wire_roundtrip(self):
+        ctx = TraceContext(trace_id=2**63 + 5, span_id=42, sampled=True)
+        assert TraceContext.from_wire(ctx.to_wire()) == ctx
+        assert len(ctx.to_wire()) == TraceContext.WIRE_SIZE == 17
+        unsampled = TraceContext(1, 2, sampled=False)
+        assert not TraceContext.from_wire(unsampled.to_wire()).sampled
+
+    def test_active_span_binds_context(self):
+        tr = Tracer()
+        assert tracing_bind.current() is None
+        with tr.start_span("outer") as sp:
+            ctx = tracing_bind.current()
+            assert ctx.trace_id == sp.span.trace_id
+            assert ctx.span_id == sp.span.span_id
+            assert tracing_bind.current_wire() == ctx.to_wire()
+        assert tracing_bind.current() is None
+
+    def test_remote_context_parents_local_spans(self):
+        upstream = Tracer()
+        with upstream.start_span("api.write") as root:
+            wire = tracing_bind.current_wire()
+        downstream = Tracer()
+        with tracing_bind.bind(TraceContext.from_wire(wire)):
+            with downstream.start_span(Tracepoint.RPC_SERVER):
+                with downstream.start_span(Tracepoint.DB_WRITE_BATCH):
+                    pass
+        spans = {s.name: s for s in downstream.finished()}
+        assert spans["rpc.server"].trace_id == root.span.trace_id
+        assert spans["rpc.server"].parent_id == root.span.span_id
+        assert spans["db.writeBatch"].parent_id == spans["rpc.server"].span_id
+
+    def test_unsampled_context_produces_no_spans_and_no_wire(self):
+        tr = Tracer()
+        with tracing_bind.bind(TraceContext(1, 2, sampled=False)):
+            assert tracing_bind.current_wire() == b""
+            span = tr.start_span("x")
+            assert span is NOOP_SPAN
+        assert tr.finished() == []
+
+    def test_sample_rate_zero_records_nothing(self):
+        tr = Tracer(sample_rate=0.0)
+        with tr.start_span("root"):
+            pass
+        assert tr.finished() == []
+
+    def test_unsampled_root_suppresses_descendants(self):
+        """Review regression: a root that loses the sampling roll must
+        bind its NEGATIVE decision — otherwise every in-process child
+        re-rolls as a fresh root, littering the ring with unparented
+        fragment traces and inflating the effective sample rate."""
+        tr = Tracer(sample_rate=0.0)
+        with tr.start_span("api.write"):
+            # descendants on the same thread inherit "not sampled"
+            ctx = tracing_bind.current()
+            assert ctx is not None and not ctx.sampled
+            assert tracing_bind.current_wire() == b""
+            with tr.start_span("child"):
+                with tr.start_span("grandchild"):
+                    pass
+        assert tr.finished() == []
+        assert tracing_bind.current() is None  # binding restored
+
+    def test_join_traces_orders_parent_first(self):
+        tr = Tracer()
+        with tr.start_span("a"):
+            with tr.start_span("b"):
+                with tr.start_span("c"):
+                    pass
+        rows = [s.to_dict() for s in tr.finished()]
+        (trace,) = join_traces(rows).values()
+        assert [s["name"] for s in trace] == ["a", "b", "c"]
+
+    def test_inventory(self):
+        tr = Tracer()
+        with tr.start_span("a"):
+            with tr.start_span("b"):
+                pass
+        with tr.start_span("other"):
+            pass
+        inv = tr.inventory()
+        assert len(inv) == 2
+        by_spans = sorted(inv, key=lambda r: r["spans"])
+        assert by_spans[-1]["spans"] == 2
+        assert set(by_spans[-1]["names"]) == {"a", "b"}

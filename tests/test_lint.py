@@ -30,7 +30,7 @@ PERMISSIVE = Context(dtype_prefixes=("",), wire_prefixes=("",),
                      constant_files=(), persist_prefixes=("",),
                      deadline_files=(), deadline_prefixes=("",),
                      jax_prefixes=("",), jax_host_boundary=(),
-                     timed_prefixes=("",))
+                     timed_prefixes=("",), metric_prefixes=("",))
 
 EXPECTED = {
     ("lock_cases.py", "lock-discipline", 22),
@@ -81,6 +81,11 @@ EXPECTED = {
     ("deadline_cases.py", "deadline-aware", 8),
     ("deadline_cases.py", "deadline-aware", 9),
     ("deadline_cases.py", "deadline-aware", 13),
+    # round 10: instrument-callsite hygiene seeds
+    ("metric_cases.py", "metric-hygiene", 10),   # intern in loop
+    ("metric_cases.py", "metric-hygiene", 16),   # intern in do_GET
+    ("metric_cases.py", "metric-hygiene", 20),   # f-string tag value
+    ("metric_cases.py", "metric-hygiene", 21),   # variable tag value
 }
 
 
@@ -111,7 +116,7 @@ class TestCorpus:
                      "resource-hygiene", "corruption-typed",
                      "placement-cas", "deadline-aware", "retrace-risk",
                      "transfer-hygiene", "dtype-stability",
-                     "constant-bloat"):
+                     "constant-bloat", "metric-hygiene"):
             assert len(by_rule.get(rule, [])) >= 2, rule
 
 

@@ -43,15 +43,25 @@ class TestCodecs:
         matchers = (LabelMatcher(b"region", "=", b"us"),
                     LabelMatcher(b"host", "=~", b"h.*"))
         raw = encode_fetch(b"reqs", matchers, START, START + 100)
-        name, m2, s, e, dl_ms = decode_fetch(raw)
+        name, m2, s, e, dl_ms, tctx = decode_fetch(raw)
         assert name == b"reqs" and (s, e) == (START, START + 100)
         assert m2 == matchers
         assert dl_ms == -1  # no deadline attached
+        assert tctx is None  # unsampled: no trace trailer
         # nameless fetch, with a deadline budget riding the trailer
-        name, m2, _s, _e, dl_ms = decode_fetch(
+        name, m2, _s, _e, dl_ms, tctx = decode_fetch(
             encode_fetch(None, (), 0, 1, deadline_ms=1500))
         assert name is None and m2 == ()
         assert dl_ms == 1500
+        assert tctx is None
+        # sampled fetch: the TraceContext rides after the budget
+        from m3_tpu.instrument.tracing import TraceContext
+
+        ctx = TraceContext(trace_id=0xABCD, span_id=7, sampled=True)
+        _, _, _, _, dl_ms, tctx = decode_fetch(
+            encode_fetch(None, (), 0, 1, deadline_ms=1500,
+                         trace_ctx=ctx.to_wire()))
+        assert dl_ms == 1500 and tctx == ctx
 
     def test_result_roundtrip(self):
         block = RawBlock.from_lists(
