@@ -394,16 +394,25 @@ class ReplicatedSession:
         ts,
         vals,
         now_nanos: int | None = None,
-    ) -> None:
+    ) -> int:
+        """Returns a rejected-samples count (new-series rate limit /
+        slot capacity): per shard, the WORST replica's rejected count,
+        summed across the shards the batch touched — a conservative
+        upper bound on samples some replica refused, not an exact
+        per-sample tally.  A successful fan-out with rejections is an
+        ACK for the accepted samples only — callers holding a
+        durability ledger (the soak driver) must treat rejected > 0 as
+        a partially-unacked batch, not silent success."""
         ts = np.asarray(ts, np.int64)
         vals = np.asarray(vals, np.float64)
         by_shard: Dict[int, List[int]] = {}
         for i, sid in enumerate(ids):
             by_shard.setdefault(self._shard(sid), []).append(i)
+        rejected = 0
         for shard, idxs in by_shard.items():
             sel = np.asarray(idxs)
             sub_ids = [ids[i] for i in idxs]
-            self._fan_out(
+            results = self._fan_out(
                 "write",
                 shard,
                 self.write_level,
@@ -411,19 +420,24 @@ class ReplicatedSession:
                     namespace, sub_ids, ts[sel], vals[sel], now_nanos
                 ),
             )
+            rejected += max(
+                (getattr(r, "rejected", 0) for r in results), default=0)
+        return rejected
 
     def write_tagged_batch(
         self, namespace: str, docs, ts, vals, now_nanos: int | None = None
-    ) -> None:
+    ) -> int:
+        """Same rejected-count contract as :meth:`write_batch`."""
         ts = np.asarray(ts, np.int64)
         vals = np.asarray(vals, np.float64)
         by_shard: Dict[int, List[int]] = {}
         for i, d in enumerate(docs):
             by_shard.setdefault(self._shard(d.id), []).append(i)
+        rejected = 0
         for shard, idxs in by_shard.items():
             sel = np.asarray(idxs)
             sub = [docs[i] for i in idxs]
-            self._fan_out(
+            results = self._fan_out(
                 "write_tagged",
                 shard,
                 self.write_level,
@@ -431,6 +445,9 @@ class ReplicatedSession:
                     namespace, sub, ts[sel], vals[sel], now_nanos
                 ),
             )
+            rejected += max(
+                (getattr(r, "rejected", 0) for r in results), default=0)
+        return rejected
 
     # ---- read path (session.go fetch fan-out + merge) ----
 
@@ -449,6 +466,35 @@ class ReplicatedSession:
         # One merge seam for every read path (series_merge): replicas
         # should agree post-repair, so precedence is a tie-break only.
         return merge_point_sources(results)
+
+    def fetch_batch(
+        self, namespace: str, sids: Sequence[bytes], start: int, end: int
+    ) -> List[List[Tuple[int, float]]]:
+        """Batched :meth:`fetch`: group by shard, ONE fan-out per shard
+        (each replica answers the whole shard's id list through the
+        read_batch wire method), merge per id across replicas.  Returns
+        point lists aligned with ``sids``.  This is the soak harness's
+        ledger-verify read — a million acked samples check at Majority
+        in thousands of round trips instead of millions."""
+        by_shard: Dict[int, List[int]] = {}
+        for i, sid in enumerate(sids):
+            by_shard.setdefault(self._shard(sid), []).append(i)
+        out: List = [None] * len(sids)
+        for shard, idxs in by_shard.items():
+            sub = [sids[i] for i in idxs]
+            results = self._fan_out(
+                "fetch_batch",
+                shard,
+                self.read_level,
+                lambda db: (db.read_batch(namespace, sub, start, end)
+                            if hasattr(db, "read_batch")
+                            else [db.read(namespace, s, start, end)
+                                  for s in sub]),
+                for_read=True,
+            )
+            for k, i in enumerate(idxs):
+                out[i] = merge_point_sources([r[k] for r in results])
+        return out
 
     def query_ids(self, namespace: str, query, start: int, end: int) -> List[object]:
         """Index query fanned out to all instances, de-duplicated by

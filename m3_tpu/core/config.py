@@ -65,6 +65,12 @@ class NamespaceConfig:
     cold_writes_enabled: bool = True
     num_shards: int = 4
     resolution: str = "0s"  # 0 = raw/unaggregated namespace
+    # Per-shard series/sample sizing (0 = the storage defaults).  The
+    # slot capacity bounds ACTIVE series per shard — a node serving
+    # high-cardinality soak/production traffic must be sized for it
+    # (creations past the cap are rejected-and-counted, never stored).
+    slot_capacity: int = 0
+    sample_capacity: int = 0
 
     def validate(self, path: str, errs: list) -> None:
         for f in ("retention", "block_size", "buffer_past", "buffer_future",
@@ -75,6 +81,9 @@ class NamespaceConfig:
                 errs.append(f"{path}.{f}: {e}")
         if self.num_shards < 1:
             errs.append(f"{path}.num_shards: must be >= 1")
+        for f in ("slot_capacity", "sample_capacity"):
+            if getattr(self, f) < 0:
+                errs.append(f"{path}.{f}: must be >= 0 (0 = default)")
         try:
             if parse_duration(self.block_size) > parse_duration(self.retention):
                 errs.append(f"{path}: block_size exceeds retention")

@@ -58,10 +58,23 @@ class SlotAllocator:
         if not missing:
             return out
         # Budget counts CREATIONS, not occurrences: a batch repeating
-        # one new id many times must charge one token.
+        # one new id many times must charge one token.  Capacity is a
+        # budget too: a full allocator REJECTS the excess creations
+        # (slot -1 — counted, existing series still land) instead of
+        # raising out of the whole batch.  The round-12 soak found the
+        # old behavior the hard way: past ~131K series/shard every
+        # mixed batch DIED with an opaque RuntimeError, losing
+        # existing-series samples to a capacity problem that only
+        # concerns new ones (the same graceful-degradation contract as
+        # the new-series rate limiter).  Headroom caps the limiter
+        # ACQUISITION, not just the result: the token bucket is shared
+        # namespace-wide, and a full shard draining tokens it can never
+        # spend would starve shards that still have room.
         n_new = len({ids[i] for i in missing})
-        budget = (n_new if self.limiter is None
-                  else self.limiter.acquire_up_to(n_new))
+        headroom = self.capacity - len(self._ids) + len(self._free)
+        n_ask = min(n_new, max(0, headroom))
+        budget = (n_ask if self.limiter is None
+                  else self.limiter.acquire_up_to(n_ask))
         for i in missing:
             sid = ids[i]
             s = self._slots.get(sid)  # duplicate id earlier in batch

@@ -21,6 +21,7 @@ import signal
 import subprocess
 import sys
 import time
+import urllib.error
 import urllib.request
 from pathlib import Path
 
@@ -62,18 +63,28 @@ class NodeProcess:
             log_f.close()  # the child holds its own descriptor
         self.wait_healthy(timeout_s)
 
+    def _log_tail(self, nbytes: int = 2000) -> str:
+        if self.log_path.exists():
+            return self.log_path.read_bytes()[-nbytes:].decode(
+                errors="replace")
+        return "<no log file>"
+
     def wait_healthy(self, timeout_s: float) -> None:
-        """Heartbeat-until-ready (m3em agent heartbeats)."""
+        """Heartbeat-until-ready (m3em agent heartbeats).
+
+        On timeout, the raised error CARRIES the diagnosis: the tail of
+        the node's log file and the last /health payload (or the error
+        fetching it).  A wedged node used to fail with a bare
+        TimeoutError while the actual reason sat in an unprinted file
+        under tmp — a soak/CI run must surface it in the failure
+        itself."""
         deadline = time.monotonic() + timeout_s
+        last_health: object = "<never reached /health>"
         while time.monotonic() < deadline:
             if self.proc.poll() is not None:
-                err = ""
-                if self.log_path.exists():
-                    err = self.log_path.read_bytes()[-2000:].decode(
-                        errors="replace"
-                    )
                 raise RuntimeError(
-                    f"node died during startup (rc={self.proc.returncode}): {err}"
+                    f"node died during startup (rc={self.proc.returncode}): "
+                    f"{self._log_tail()}"
                 )
             if self.status_path.exists():
                 try:
@@ -88,10 +99,17 @@ class NodeProcess:
                     ) as r:
                         if r.status == 200:
                             return
-                except OSError:
-                    pass
+                except urllib.error.HTTPError as e:
+                    # non-200: the BODY is the diagnosis (urlopen raises
+                    # HTTPError rather than returning the response)
+                    body = (e.read() or b"")[:2000].decode(errors="replace")
+                    last_health = f"<health {e.code}: {body}>"
+                except OSError as e:
+                    last_health = f"<health fetch failed: {e}>"
             time.sleep(0.1)
-        raise TimeoutError("node did not become healthy")
+        raise TimeoutError(
+            f"node did not become healthy within {timeout_s:.0f}s; "
+            f"last /health: {last_health!r}; log tail:\n{self._log_tail()}")
 
     def alive(self) -> bool:
         return self.proc is not None and self.proc.poll() is None
@@ -160,6 +178,29 @@ def collect_traces(ports, local_spans=None, timeout_s: float = 30.0):
                 timeout=timeout_s) as r:
             spans.extend(json.load(r)["data"])
     return join_traces(spans)
+
+
+def scrape_fleet(ports, timeout_s: float = 10.0):
+    """Strict-parse every node's /metrics, TOLERATING dead nodes:
+    ``{port: [Sample] | None}`` — None marks an unreachable node (the
+    soak scrapes mid-SIGKILL, so this is a normal outcome, not an
+    error).  A scrape that ARRIVES but fails the strict parser still
+    raises: a live node emitting malformed exposition is a bug, not a
+    fault window."""
+    from m3_tpu.instrument import exposition
+
+    out = {}
+    for port in ports:
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics",
+                    timeout=timeout_s) as r:
+                text = r.read().decode()
+        except OSError:
+            out[port] = None
+            continue
+        out[port] = exposition.parse_text(text)
+    return out
 
 
 def merged_histogram(ports, base: str, timeout_s: float = 30.0):

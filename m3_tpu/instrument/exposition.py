@@ -28,7 +28,8 @@ from typing import Dict, Iterable, List, Tuple
 
 __all__ = [
     "ExpositionError", "Sample", "parse_text", "histogram_series",
-    "merge_histograms", "merged_quantile",
+    "merge_histograms", "merged_quantile", "delta_histogram",
+    "fleet_summary", "counter_value",
 ]
 
 _NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
@@ -225,3 +226,102 @@ def merged_quantile(merged: Dict[float, float], q: float) -> float:
         prev = merged[le]
     finite = [le for le in les if not math.isinf(le)]
     return quantile_from_buckets(noncum, q, bounds=tuple(finite))
+
+
+# -- fleet scrapes under failure + phase windows -----------------------------
+#
+# The soak harness scrapes the fleet at PHASE boundaries — including
+# mid-SIGKILL windows where a node is down, and post-restart windows
+# where its cumulative counters went back to zero.  These helpers make
+# both facts first-class instead of exceptions: a missing scrape yields
+# a merged summary honestly flagged ``partial`` over the reachable
+# majority, and a counter that went backwards is a detected restart
+# (delta from zero), never a negative rate.
+
+
+def delta_histogram(before: Dict[float, float] | None,
+                    after: Dict[float, float]) -> tuple:
+    """Phase delta of one node's cumulative lanes: ``after - before``
+    per ``le``.  Returns ``(lanes, reset)`` — when any lane decreased
+    the process restarted between scrapes, so the whole 'before' is
+    discarded (the new process started from zero) and ``reset=True``
+    tells the caller the window undercounts the pre-crash tail."""
+    if before is None:
+        return dict(after), False
+    if any(after.get(le, 0.0) < c for le, c in before.items()):
+        return dict(after), True
+    return {le: c - before.get(le, 0.0) for le, c in after.items()}, False
+
+
+def counter_value(samples: List[Sample] | None, name: str,
+                  labels: Dict[str, str] | None = None) -> float:
+    """Sum of one metric's samples across label sets (optionally
+    filtered by a label subset) — 0.0 for a missing metric or a failed
+    scrape, so counter-delta arithmetic stays total."""
+    if samples is None:
+        return 0.0
+    total = 0.0
+    for s in samples:
+        if s.name != name:
+            continue
+        if labels and any(s.label(k) != v for k, v in labels.items()):
+            continue
+        total += s.value
+    return total
+
+
+def fleet_summary(scrapes: Dict[object, List[Sample] | None], base: str,
+                  before: Dict[object, List[Sample] | None] | None = None,
+                  quantiles: Iterable[float] = (0.5, 0.99)) -> dict:
+    """Fleet-merged histogram summary that SURVIVES partial scrapes.
+
+    ``scrapes`` maps a node key to its parsed scrape, or ``None`` when
+    the node was unreachable (mid-SIGKILL / mid-restart — the soak
+    scrapes during fault windows, so this path is hot).  With
+    ``before`` (the previous phase boundary), per-node lanes are
+    DELTA'd first (restart-aware via :func:`delta_histogram`) so the
+    summary covers one phase, not the whole run.  Returns::
+
+        {"count", "quantiles": {"p50": s, ...}, "partial": bool,
+         "reachable": [keys], "unreachable": [keys], "resets": [keys]}
+
+    Never raises on a down node and never merges a guess: an
+    unreachable node simply contributes nothing, flagged."""
+    merged: Dict[float, float] = {}
+    reachable, unreachable, resets = [], [], []
+    for key in sorted(scrapes, key=str):
+        samples = scrapes[key]
+        if samples is None:
+            unreachable.append(key)
+            continue
+        reachable.append(key)
+        prev = (before or {}).get(key)
+        prev_lanes: Dict[float, float] = {}
+        if prev is not None:
+            for lanes in histogram_series(prev, base).values():
+                for le, c in lanes.items():
+                    prev_lanes[le] = prev_lanes.get(le, 0.0) + c
+        node_lanes: Dict[float, float] = {}
+        for lanes in histogram_series(samples, base).values():
+            for le, c in lanes.items():
+                node_lanes[le] = node_lanes.get(le, 0.0) + c
+        lanes, reset = delta_histogram(prev_lanes if prev is not None else None,
+                                       node_lanes)
+        if reset:
+            resets.append(key)
+        for le, c in lanes.items():
+            merged[le] = merged.get(le, 0.0) + c
+    count = max((c for le, c in merged.items() if math.isinf(le)),
+                default=0.0)
+    out = {
+        "count": count,
+        "quantiles": {},
+        "partial": bool(unreachable),
+        "reachable": reachable,
+        "unreachable": unreachable,
+        "resets": resets,
+    }
+    for q in quantiles:
+        out["quantiles"][f"p{int(q * 100)}"] = (
+            merged_quantile(merged, q) if count > 0 else None)
+    return out

@@ -29,6 +29,13 @@ over the KV control plane.  Routes:
                       corruption sweep + peer repair; body optionally
                       {"budget": N volumes (0 = whole disk, the default),
                        "repair": bool})
+    GET/POST          /api/v1/debug/faults                     (runtime
+                      faultpoint re-arm: GET = armed specs + counters;
+                      POST {"disarm": true|[points], "arm":
+                      "point=mode[:k=v]*;...", "reset_counters": bool}
+                      — the M3_FAULTPOINTS grammar, applied LIVE so a
+                      chaos scheduler flips fault windows without
+                      restarting the node; counters survive re-arm)
 
 Every placement mutation goes through ``PlacementService.update`` — a
 get→mutate→CAS loop with bounded retry on version conflict, so two
@@ -148,6 +155,13 @@ class _AdminHandler(BaseHTTPRequestHandler):
                 return self._json(200, traces_response(
                     tr, trace_id=q.get("trace_id", [None])[0],
                     name=q.get("name", [None])[0]))
+            if path == "/api/v1/debug/faults":
+                # same shared builder as the main API (the
+                # traces_response pattern): the chaos scheduler arms
+                # through whichever port it holds
+                from m3_tpu.x import fault
+
+                return self._json(200, fault.registry_response())
             if path == "/api/v1/services/m3db/namespace":
                 return self._json(200, {
                     "registry": {
@@ -319,6 +333,14 @@ class _AdminHandler(BaseHTTPRequestHandler):
                     "namespace": dataclasses.asdict(meta),
                     "placement": placement_out,
                 })
+            if path == "/api/v1/debug/faults":
+                # Runtime re-arm: validate-then-mutate through the ONE
+                # shared grammar/applier in x/fault (disarm first, then
+                # arm; counters preserved) — the soak's chaos scheduler
+                # opens/closes wire-fault windows on live nodes here.
+                from m3_tpu.x import fault
+
+                return self._json(200, fault.apply_request(body))
             if path == "/api/v1/database/scrub":
                 # On-demand integrity sweep (reference ops run
                 # verify_data_files out-of-band; here the scrubber is

@@ -137,6 +137,14 @@ class Assembly:
 
 
 def namespace_options(ns_cfg) -> NamespaceOptions:
+    kw = {}
+    # cardinality sizing: 0 keeps the storage defaults; a node serving
+    # million-series traffic raises slot_capacity per shard (the soak
+    # found the default wall at 2^17 series/shard)
+    if ns_cfg.slot_capacity:
+        kw["slot_capacity"] = ns_cfg.slot_capacity
+    if ns_cfg.sample_capacity:
+        kw["sample_capacity"] = ns_cfg.sample_capacity
     return NamespaceOptions(
         block_size_nanos=parse_duration(ns_cfg.block_size),
         retention_nanos=parse_duration(ns_cfg.retention),
@@ -144,6 +152,7 @@ def namespace_options(ns_cfg) -> NamespaceOptions:
         buffer_future_nanos=parse_duration(ns_cfg.buffer_future),
         cold_writes_enabled=ns_cfg.cold_writes_enabled,
         num_shards=ns_cfg.num_shards,
+        **kw,
     )
 
 

@@ -144,6 +144,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._metrics()
             if u.path in ("/debug/traces", "/api/v1/debug/traces"):
                 return self._traces(q)
+            if u.path == "/api/v1/debug/faults":
+                return self._faults()
             if u.path == "/debug/dump":
                 return self._debug_dump(q)
             if u.path in ("/api/v1/query_range", "/api/v1/query"):
@@ -180,6 +182,8 @@ class _Handler(BaseHTTPRequestHandler):
             if u.path in ("/api/v1/query_range", "/api/v1/query"):
                 q = parse_qs(self._body().decode())
                 return self._query(u.path.endswith("query_range"), q)
+            if u.path == "/api/v1/debug/faults":
+                return self._faults(json.loads(self._body() or b"{}"))
             return self._error(404, f"unknown path {u.path}")
         except (QueryLimitExceeded, QueryShedError, DeadlineExceeded,
                 PartialResultError) as e:
@@ -345,6 +349,19 @@ class _Handler(BaseHTTPRequestHandler):
         return self._json(200, traces_response(
             tr, trace_id=q.get("trace_id", [None])[0],
             name=q.get("name", [None])[0]))
+
+    def _faults(self, body: dict | None = None):
+        """Faultpoint debug surface, mirrored on the admin port like
+        /api/v1/debug/traces: GET = armed specs + counters, POST =
+        runtime re-arm in the M3_FAULTPOINTS grammar (x/fault owns the
+        shared parse/apply builders — two ports, one behavior).  This
+        is what lets the soak's chaos scheduler open and close wire-
+        fault windows on LIVE nodes instead of restarting them."""
+        from m3_tpu.x import fault
+
+        if body is None:
+            return self._json(200, fault.registry_response())
+        return self._json(200, fault.apply_request(body))
 
     @staticmethod
     def _series_id(tags: dict) -> bytes:

@@ -113,6 +113,7 @@ M_READ_BLOCK = 7
 M_WRITE_BLOCK = 8
 M_TICK = 9
 M_HEALTH = 10
+M_READ_BATCH = 11  # batched M_READ: one call, N ids, N point lists
 
 
 # ---------------------------------------------------------------------------
@@ -357,6 +358,23 @@ class _RpcHandler(socketserver.BaseRequestHandler):
             sid, pos = _unpack_bytes(raw, pos)
             start, end = struct.unpack_from("<qq", raw, pos)
             return _enc_points(db.read(ns, sid, start, end))
+        if method == M_READ_BATCH:
+            # Batched read: the ledger-verify / bulk-fetch wire shape.
+            # One storage read_batch amortizes the per-window sort
+            # across every id; the response is each id's point list in
+            # request order.
+            ns, pos = _dec_str(raw, 0)
+            start, end = struct.unpack_from("<qq", raw, pos)
+            pos += 16
+            (n,) = struct.unpack_from("<I", raw, pos)
+            pos += 4
+            sids = []
+            for _ in range(n):
+                sid, pos = _unpack_bytes(raw, pos)
+                sids.append(sid)
+            out = db.read_batch(ns, sids, start, end)
+            return (struct.pack("<I", len(out))
+                    + b"".join(_enc_points(p) for p in out))
         if method == M_QUERY_IDS:
             ns, pos = _dec_str(raw, 0)
             start, end = struct.unpack_from("<qq", raw, pos)
@@ -577,6 +595,23 @@ class RemoteDatabase:
                 + struct.pack("<qq", start, end))
         pts, _ = _dec_points(self._call(M_READ, body), 0)
         return pts
+
+    def read_batch(self, namespace, sids, start, end):
+        """Batched read: N ids in one round trip, point lists back in
+        request order (the soak ledger verify reads millions of acked
+        samples — per-id round trips would dominate the recovery
+        check)."""
+        body = (_enc_str(namespace) + struct.pack("<qq", start, end)
+                + struct.pack("<I", len(sids))
+                + b"".join(_pack_bytes(s) for s in sids))
+        raw = self._call(M_READ_BATCH, body)
+        (n,) = struct.unpack_from("<I", raw, 0)
+        pos = 4
+        out = []
+        for _ in range(n):
+            pts, pos = _dec_points(raw, pos)
+            out.append(pts)
+        return out
 
     def query_ids(self, namespace, q, start, end):
         body = (_enc_str(namespace) + struct.pack("<qq", start, end)

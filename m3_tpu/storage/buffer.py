@@ -165,6 +165,15 @@ class ShardBuffer:
         self.open_blocks: dict[int, int] = {}
         # block_start -> [(slot, ts, val)] host overflow (cold writes)
         self.cold: dict[int, list] = {}
+        # Sorted-window snapshot cache: every read of an open window
+        # (single-series, batched verify, snapshot peek) needs the SAME
+        # device sort+dedupe of the whole window, which is O(window) —
+        # at 1M buffered samples that is ~100ms of sort + a multi-MB
+        # device→host transfer PER READ.  One version counter (bumped
+        # on any mutation) makes the sorted snapshot reusable: K reads
+        # between two writes pay ONE drain + K binary searches.
+        self._version = 0
+        self._snap: dict[int, tuple] = {}  # block_start -> (version, s, t, v)
 
     def _row_for(self, block_start: int) -> int:
         return (block_start // self.block_size) % self.num_windows
@@ -184,6 +193,7 @@ class ShardBuffer:
                     (slots[sel].copy(), ts[sel].copy(), vals[sel].copy())
                 )
         if warm.any():
+            self._version += 1  # sorted snapshots are now stale
             wslots, wts, wvals = slots[warm], ts[warm], vals[warm]
             wstarts = block_starts[warm]
             rows = ((wstarts // self.block_size) % self.num_windows).astype(np.int32)
@@ -232,6 +242,7 @@ class ShardBuffer:
         return out
 
     def _reset_row(self, row: int) -> None:
+        self._version += 1
         imax = np.iinfo(np.int64).max
         self.state = BufferState(
             slot=self.state.slot.at[row].set(self.slot_capacity),
@@ -251,26 +262,68 @@ class ShardBuffer:
         vals = np.concatenate([p[2] for p in parts]).astype(np.float64)
         return dedupe_last_write_wins(slots, ts, vals)
 
+    def _sorted_window(self, block_start: int):
+        """(slots, ts, vals) of one open window, sorted by (slot, ts),
+        deduped last-write-wins, sentinel-stripped — served from the
+        version-stamped snapshot cache (invalidated by any write/drain)
+        so reads between mutations share ONE device sort instead of
+        paying O(window) each."""
+        row = self.open_blocks.get(block_start)
+        if row is None:
+            return None
+        hit = self._snap.get(block_start)
+        if hit is not None and hit[0] == self._version:
+            return hit[1:]
+        s_slot, s_ts, s_val, first = buffer_drain(self.state, jnp.int32(row))
+        s_slot = np.asarray(s_slot)
+        keep = np.asarray(first) & (s_slot < self.slot_capacity)
+        out = (s_slot[keep], np.asarray(s_ts)[keep], np.asarray(s_val)[keep])
+        # one snapshot per OPEN window (reads alternate between open
+        # blocks per series — a single-entry cache would thrash back to
+        # O(window) per read); closed windows' entries are pruned here
+        self._snap = {
+            bs: v for bs, v in self._snap.items() if bs in self.open_blocks
+        }
+        self._snap[block_start] = (self._version,) + out
+        return out
+
     def peek(self, block_start: int):
         """Non-destructive drain of one open window: (slots, ts, vals)
         sorted+deduped, state untouched — the snapshot read
         (reference buffer.go:537 Snapshot streams the open buckets
         without evicting them)."""
-        row = self.open_blocks.get(block_start)
-        if row is None:
+        snap = self._sorted_window(block_start)
+        if snap is None:
             return (np.empty(0, np.int32), np.empty(0, np.int64), np.empty(0))
-        s_slot, s_ts, s_val, first = buffer_drain(self.state, jnp.int32(row))
-        s_slot = np.asarray(s_slot)
-        keep = np.asarray(first) & (s_slot < self.slot_capacity)
-        return s_slot[keep], np.asarray(s_ts)[keep], np.asarray(s_val)[keep]
+        return snap
 
     def read_window(self, block_start: int, slot: int):
         """Read one series' points from an open (unsealed) block — the
-        read path's buffer component (buffer.go:705 ReadEncoded)."""
-        row = self.open_blocks.get(block_start)
-        if row is None:
+        read path's buffer component (buffer.go:705 ReadEncoded).  A
+        binary search over the sorted snapshot: O(log window) per call
+        once the snapshot is warm."""
+        snap = self._sorted_window(block_start)
+        if snap is None:
             return np.empty(0, np.int64), np.empty(0)
-        s_slot, s_ts, s_val, first = buffer_drain(self.state, jnp.int32(row))
-        s_slot = np.asarray(s_slot)
-        keep = np.asarray(first) & (s_slot == slot)
-        return np.asarray(s_ts)[keep], np.asarray(s_val)[keep]
+        s_slot, s_ts, s_val = snap
+        lo, hi = np.searchsorted(s_slot, [slot, slot + 1])
+        return s_ts[lo:hi], s_val[lo:hi]
+
+    def read_window_many(self, block_start: int, slots: np.ndarray):
+        """Batched :meth:`read_window`: one sorted snapshot serves every
+        requested slot (the bulk-verify / batched-fetch read path —
+        without this, reading S series out of a window costs S full
+        window sorts).  Returns ``[(ts, vals), ...]`` aligned with
+        ``slots``; a slot < 0 (unknown series) yields empty arrays."""
+        empty = (np.empty(0, np.int64), np.empty(0))
+        snap = self._sorted_window(block_start)
+        if snap is None:
+            return [empty for _ in slots]
+        s_slot, s_ts, s_val = snap
+        slots = np.asarray(slots, np.int64)
+        los = np.searchsorted(s_slot, slots)
+        his = np.searchsorted(s_slot, slots + 1)
+        return [
+            (s_ts[lo:hi], s_val[lo:hi]) if (hi > lo and sl >= 0) else empty
+            for sl, lo, hi in zip(slots.tolist(), los.tolist(), his.tolist())
+        ]

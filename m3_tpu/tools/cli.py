@@ -263,6 +263,65 @@ def hops(args) -> int:
     return 0
 
 
+def soak(args) -> int:
+    """Million-series soak (dtest/soak.py): real multi-node cluster,
+    sustained bulk ingest + PromQL/Graphite query traffic, a seeded
+    chaos timeline (wire faults, SIGKILL, fileset corruption, rolling
+    replace), and a zero-acked-sample-loss verdict — emitted as a
+    BENCH-style SOAK artifact.
+
+    ``--smoke`` is the tier-1 shape (2 nodes, ~20K series, one wire-
+    fault window).  ``--check BASELINE`` re-runs the baseline
+    artifact's own config and exits nonzero on SLO/loss regression —
+    the before/after gate for ROADMAP item 1's pipeline rebuild (run
+    ``cli soak --out SOAK_before.json`` before the refactor, ``cli
+    soak --check SOAK_before.json`` after)."""
+    from m3_tpu.dtest.soak import (
+        SoakConfig, check_artifact, config_from_artifact, run_soak,
+    )
+
+    def log(msg):
+        print(msg, file=sys.stderr)
+
+    baseline = None
+    if args.check is not None:
+        bpath = args.check or str(
+            Path(__file__).resolve().parents[2] / "SOAK_r10.json")
+        if not Path(bpath).exists():
+            print(f"soak --check: no baseline at {bpath}", file=sys.stderr)
+            return 2
+        baseline = json.loads(Path(bpath).read_text())
+
+    overrides = {}
+    for name in ("series", "nodes", "batch", "sweeps", "seed"):
+        v = getattr(args, name)
+        if v is not None:
+            overrides[name] = v
+    if baseline is not None:
+        cfg = config_from_artifact(baseline, **overrides)
+    elif args.smoke:
+        cfg = SoakConfig.smoke_config(**overrides)
+    else:
+        cfg = SoakConfig(**overrides)
+
+    artifact = run_soak(cfg, workdir=args.workdir,
+                        keep_workdir=args.keep_workdir, log=log)
+    text = json.dumps(artifact, indent=1)
+    if args.out:
+        # --out is honored in check mode too: a --check re-run is a
+        # full soak, and its artifact is the candidate next baseline
+        Path(args.out).write_text(text + "\n")
+        log(f"soak: artifact written to {args.out}")
+    if baseline is not None:
+        errs = check_artifact(artifact, baseline, tolerance=args.tolerance)
+        _out({"soak_check": {"ok": not errs, "violations": errs,
+                             "verdict": artifact["verdict"]}})
+        return 1 if errs else 0
+    if not args.out:
+        sys.stdout.write(text + "\n")
+    return 0 if artifact["verdict"]["zero_acked_loss"] else 1
+
+
 def lint(args) -> int:
     """Run m3lint over the package and gate against the committed
     baseline (tools/lint_baseline.json).  Exit 0 only when the findings
@@ -418,6 +477,39 @@ def main(argv=None) -> int:
                     help="allowed transfer-byte growth vs baseline "
                          "(default 0.25)")
     hp.set_defaults(fn=hops)
+
+    sk = sub.add_parser(
+        "soak",
+        help="million-series chaos soak: multi-node cluster under "
+             "sustained ingest + queries with a scripted fault "
+             "timeline; emits the SOAK SLO artifact with a zero-acked-"
+             "sample-loss verdict")
+    sk.add_argument("--smoke", action="store_true",
+                    help="tier-1 shape: 2 nodes, ~20K series, one "
+                         "wire-fault window, <2 min")
+    sk.add_argument("--check", nargs="?", const="", default=None,
+                    metavar="BASELINE",
+                    help="re-run BASELINE's config (default: repo "
+                         "SOAK_r10.json) and exit 1 on SLO p99 "
+                         "regression (> --tolerance x) or any acked-"
+                         "sample loss")
+    sk.add_argument("--series", type=int, help="bulk series space")
+    sk.add_argument("--nodes", type=int, help="initial cluster size")
+    sk.add_argument("--batch", type=int, help="samples per ingest batch")
+    sk.add_argument("--sweeps", type=int,
+                    help="minimum full passes over the series space")
+    sk.add_argument("--seed", type=int, help="chaos + workload seed")
+    sk.add_argument("--tolerance", type=float, default=2.0,
+                    help="allowed p99 growth ratio vs baseline "
+                         "(default 2.0 — phase windows on a shared box "
+                         "are noisy; loss is never tolerated)")
+    sk.add_argument("--out", help="write the artifact JSON here")
+    sk.add_argument("--workdir", help="cluster scratch dir (default: "
+                                      "a fresh tempdir)")
+    sk.add_argument("--keep-workdir", action="store_true",
+                    dest="keep_workdir",
+                    help="keep node roots/logs after the run")
+    sk.set_defaults(fn=soak)
 
     li = sub.add_parser(
         "lint", help="codebase-aware static analysis, baseline-gated")

@@ -1,0 +1,200 @@
+"""Deterministic chaos scheduler: a scripted fault timeline for soaks.
+
+The fault substrate built across PRs 1-5 (x/fault faultpoints, SIGKILL
+dtests, fileset corruption + quarantine, rolling replace) is armed
+point-by-point by individual scenarios.  A soak needs the opposite
+shape: ONE seeded script that drives *many* fault families against a
+live cluster on a fixed clock, so two runs of the same seed produce the
+same chaos and an SLO artifact is comparable run-over-run.
+
+Two pieces:
+
+* :class:`ChaosEvent` / :func:`parse_timeline` — the declarative
+  timeline.  Each event fires at a fixed offset from scheduler start:
+
+  =============  ==========================================================
+  action         meaning (ops method called)
+  =============  ==========================================================
+  ``phase``      marks an SLO phase boundary (no cluster mutation); the
+                 soak buckets latency between consecutive phase marks
+  ``kill``       SIGKILL a node (``ops.kill(node)``)
+  ``restart``    start a killed node (``ops.restart(node)``)
+  ``wire_fault`` arm faultpoints on a LIVE node through its
+                 ``POST /api/v1/debug/faults`` (``ops.arm_faults``);
+                 ``arg`` is the M3_FAULTPOINTS-grammar spec string
+  ``clear_faults``  disarm every faultpoint on a node (same endpoint)
+  ``corrupt``    byte-flip a flushed fileset volume on a node's disk
+                 (``ops.corrupt(node, seed)`` — quarantine/scrub must
+                 recover it)
+  ``replace``    rolling node replace: retire ``node``, bring in the
+                 spare (``ops.replace(node)`` drives the admin
+                 placement/replace verb + the migration path)
+  =============  ==========================================================
+
+* :class:`ChaosScheduler` — executes the timeline against an *ops*
+  adapter (the soak cluster; tests pass a fake) on an injectable
+  clock/sleep, recording every execution (offset asked, offset fired,
+  ok/error) into :attr:`log` — the artifact's chaos section is that log
+  verbatim, so a reader can line fault windows up with SLO phases.
+
+Determinism contract: the timeline is explicit (no random event
+choices); the run ``seed`` namespaces whatever randomness the events
+*use* — faultpoint specs without an explicit ``seed=`` get
+``seed=<run_seed + index>`` appended, corruption byte offsets derive
+from ``(seed, event index)``.  Same seed + same timeline = same chaos.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, List
+
+from m3_tpu.x import fault
+
+__all__ = ["ChaosEvent", "ChaosScheduler", "parse_timeline"]
+
+ACTIONS = ("phase", "kill", "restart", "wire_fault", "clear_faults",
+           "corrupt", "replace")
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosEvent:
+    at_s: float          # offset from scheduler start
+    action: str          # one of ACTIONS
+    node: int | None = None  # target node index (phase: None)
+    arg: str = ""        # wire_fault: spec string; phase: phase label
+
+    def __post_init__(self):
+        if self.action not in ACTIONS:
+            raise ValueError(
+                f"chaos action {self.action!r}: must be one of {ACTIONS}")
+        if self.action == "phase" and not self.arg:
+            raise ValueError("phase events need a label in 'arg'")
+        if self.action != "phase" and self.node is None:
+            raise ValueError(f"{self.action} event needs a 'node'")
+        if self.action == "wire_fault":
+            fault.parse_faults(self.arg)  # validate at BUILD time
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def parse_timeline(spec: dict) -> tuple[int, List[ChaosEvent]]:
+    """``{"seed": N, "events": [{"at_s": ..., "action": ..., ...}]}``
+    → ``(seed, events sorted by offset)``.  Validation is eager and
+    total: a typo'd action or a malformed faultpoint spec fails at
+    parse time, never mid-soak."""
+    unknown = set(spec) - {"seed", "events"}
+    if unknown:
+        raise ValueError(f"chaos timeline: unknown keys {sorted(unknown)}")
+    events = []
+    for i, e in enumerate(spec.get("events", ())):
+        bad = set(e) - {"at_s", "action", "node", "arg"}
+        if bad:
+            raise ValueError(f"chaos event #{i}: unknown keys {sorted(bad)}")
+        events.append(ChaosEvent(
+            at_s=float(e["at_s"]), action=e["action"],
+            node=e.get("node"), arg=e.get("arg", "")))
+    return int(spec.get("seed", 0)), sorted(events, key=lambda e: e.at_s)
+
+
+def _seeded_spec(spec: str, seed: int) -> str:
+    """Append ``seed=`` to every faultpoint entry that lacks one, so a
+    timeline's wire faults replay identically under the run seed."""
+    out = []
+    for entry in spec.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if not any(opt.startswith("seed=") for opt in entry.split(":")[1:]):
+            entry = f"{entry}:seed={seed}"
+        out.append(entry)
+    return ";".join(out)
+
+
+class ChaosScheduler:
+    """Run a timeline against an ops adapter on a background thread.
+
+    ``ops`` must provide ``kill(node)``, ``restart(node)``,
+    ``arm_faults(node, spec)``, ``clear_faults(node)``,
+    ``corrupt(node, seed)``, ``replace(node)``, and ``phase(label)``.
+    An event whose op RAISES is recorded in :attr:`log` with its error
+    and the run continues — one failed injection must not silently
+    cancel the rest of the chaos (the artifact shows exactly what
+    fired).  ``clock``/``sleep`` are injectable so unit tests replay a
+    timeline on a fake clock in microseconds.
+    """
+
+    def __init__(self, timeline: List[ChaosEvent], ops, seed: int = 0,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] | None = None):
+        self.timeline = sorted(timeline, key=lambda e: e.at_s)
+        self.ops = ops
+        self.seed = int(seed)
+        self._clock = clock
+        self._stop = threading.Event()
+        # default sleep is interruptible via stop() — a soak teardown
+        # must not wait out a multi-minute quiet window in the timeline
+        self._sleep = sleep if sleep is not None else (
+            lambda s: self._stop.wait(s))
+        self._thread: threading.Thread | None = None
+        self.log: List[dict] = []
+        self._log_lock = threading.Lock()
+
+    # -- execution ---------------------------------------------------------
+
+    def run(self) -> List[dict]:
+        """Execute synchronously (tests / in-thread callers)."""
+        t0 = self._clock()
+        for i, ev in enumerate(self.timeline):
+            delay = ev.at_s - (self._clock() - t0)
+            if delay > 0:
+                self._sleep(delay)
+            if self._stop.is_set():
+                break
+            self._fire(i, ev, t0)
+        return self.log
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+
+    def stop(self, timeout_s: float = 30.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout_s)
+
+    def join(self, timeout_s: float | None = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout_s)
+
+    @property
+    def done(self) -> bool:
+        return self._thread is not None and not self._thread.is_alive()
+
+    def _fire(self, index: int, ev: ChaosEvent, t0: float) -> None:
+        rec = dict(ev.to_dict(), fired_at_s=round(self._clock() - t0, 3),
+                   ok=True)
+        try:
+            if ev.action == "phase":
+                self.ops.phase(ev.arg)
+            elif ev.action == "kill":
+                self.ops.kill(ev.node)
+            elif ev.action == "restart":
+                self.ops.restart(ev.node)
+            elif ev.action == "wire_fault":
+                self.ops.arm_faults(
+                    ev.node, _seeded_spec(ev.arg, self.seed + index))
+            elif ev.action == "clear_faults":
+                self.ops.clear_faults(ev.node)
+            elif ev.action == "corrupt":
+                self.ops.corrupt(ev.node, self.seed + index)
+            elif ev.action == "replace":
+                self.ops.replace(ev.node)
+        except Exception as e:  # noqa: BLE001 — recorded, run continues
+            rec["ok"] = False
+            rec["error"] = f"{type(e).__name__}: {e}"
+        with self._log_lock:
+            self.log.append(rec)

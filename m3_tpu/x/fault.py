@@ -29,12 +29,22 @@ Arming:
   ``with armed(...):`` context manager (tests);
 * env — ``M3_FAULTPOINTS="kv_remote.call=drop:p=0.3;kv_remote.call=
   delay:ms=20"`` parsed at import, so dtest node subprocesses inherit
-  faults through their environment.
+  faults through their environment;
+* wire — ``POST /api/v1/debug/faults`` (admin + main API) carries the
+  SAME spec grammar in ``{"arm": "..."}`` so a chaos scheduler can
+  re-arm a LIVE node mid-run without a restart.  :func:`parse_faults`
+  is the one parser behind both; :func:`apply_request` /
+  :func:`registry_response` are the shared HTTP builders (the
+  ``tracing.traces_response`` pattern).
 
-Call sites pay one dict lookup when nothing is armed — the registry is
-free in production.  Per-point counters (passes/triggers per mode) are
-exported through ``m3_tpu.x.register_metrics`` and asserted by the
-dtest scenarios.
+The registry is thread-safe end to end (arm/disarm/snapshot/fire run
+concurrently with handler threads) and **counters survive re-arming**:
+per-point passes and per-mode trigger totals live OUTSIDE the specs, so
+``disarm(); arm(...)`` — the admin endpoint's re-arm shape — never
+zeroes what a scenario will assert on.  Call sites pay one dict lookup
+when nothing is armed — the registry is free in production.  Per-point
+counters (passes/triggers per mode) are exported through
+``m3_tpu.x.register_metrics`` and asserted by the dtest scenarios.
 """
 
 from __future__ import annotations
@@ -47,7 +57,9 @@ from typing import Callable, Dict, List
 
 __all__ = [
     "FaultInjected", "FaultSpec", "arm", "armed", "arm_from_env",
-    "disarm", "fire", "mangle", "counters", "reset_counters", "points",
+    "arm_many", "apply_request", "disarm", "fire", "mangle", "counters",
+    "parse_faults", "registry_response", "reset_counters", "points",
+    "snapshot",
 ]
 
 
@@ -63,8 +75,8 @@ MODES = ("drop", "delay", "error", "corrupt")
 class FaultSpec:
     """One armed behavior on one point; a point may hold several."""
 
-    __slots__ = ("point", "mode", "p", "n", "after", "delay_s", "_rng",
-                 "_passes", "triggers", "_lock")
+    __slots__ = ("point", "mode", "p", "n", "after", "delay_s", "seed",
+                 "_rng", "_passes", "triggers", "_lock")
 
     def __init__(self, point: str, mode: str, p: float = 1.0,
                  n: int | None = None, after: int = 0,
@@ -77,6 +89,7 @@ class FaultSpec:
         self.n = n
         self.after = int(after)
         self.delay_s = float(delay_ms) / 1000.0
+        self.seed = int(seed)
         # String seeding is deterministic across processes (sha512 of
         # the string, no PYTHONHASHSEED involvement).
         self._rng = random.Random(f"{seed}:{point}:{mode}")
@@ -100,6 +113,16 @@ class FaultSpec:
         with _lock:
             _trigger_totals[key] = _trigger_totals.get(key, 0) + 1
         return True
+
+    def to_dict(self) -> dict:
+        """Wire shape of one armed spec (GET /api/v1/debug/faults)."""
+        with self._lock:
+            return {
+                "point": self.point, "mode": self.mode, "p": self.p,
+                "n": self.n, "after": self.after,
+                "ms": self.delay_s * 1000.0, "seed": self.seed,
+                "passes": self._passes, "triggers": self.triggers,
+            }
 
 
 _lock = threading.Lock()
@@ -198,17 +221,22 @@ def mangle(point: str, data: bytes,
     return action, data
 
 
-def arm_from_env(env: str | None = None) -> int:
-    """Parse ``M3_FAULTPOINTS`` (or ``env``) and arm the result.
+def parse_faults(raw: str) -> List[tuple]:
+    """Parse the fault-spec grammar into ``[(point, mode, kwargs)]``
+    WITHOUT arming anything (validation happens before mutation — a
+    half-armed malformed request would leave the node in a state the
+    caller never asked for).
 
     Grammar: ``point=mode[:key=value]*`` joined by ``;``.  Keys:
     ``p`` (probability), ``n`` (max triggers), ``ms`` (delay),
-    ``after`` (skip first k passes), ``seed``.  Returns the number of
-    specs armed.  A malformed entry raises ValueError — a typo silently
-    arming nothing would invalidate the scenario the flag exists for.
+    ``after`` (skip first k passes), ``seed``.  One grammar everywhere:
+    the ``M3_FAULTPOINTS`` env var at process start and the
+    ``/api/v1/debug/faults`` admin body mid-run parse through this
+    exact function.  A malformed entry raises ValueError — a typo
+    silently arming nothing would invalidate the scenario the flag
+    exists for.
     """
-    raw = os.environ.get("M3_FAULTPOINTS", "") if env is None else env
-    count = 0
+    out: List[tuple] = []
     for entry in raw.split(";"):
         entry = entry.strip()
         if not entry:
@@ -216,13 +244,16 @@ def arm_from_env(env: str | None = None) -> int:
         head, *opts = entry.split(":")
         point, sep, mode = head.partition("=")
         if not sep or not point or not mode:
-            raise ValueError(f"M3_FAULTPOINTS entry {entry!r}: "
+            raise ValueError(f"faultpoints entry {entry!r}: "
                              "expected point=mode[:key=value]*")
+        if mode not in MODES:
+            raise ValueError(f"faultpoints entry {entry!r}: mode {mode!r} "
+                             f"must be one of {MODES}")
         kw: dict = {}
         for opt in opts:
             k, sep, v = opt.partition("=")
             if not sep:
-                raise ValueError(f"M3_FAULTPOINTS option {opt!r} in {entry!r}")
+                raise ValueError(f"faultpoints option {opt!r} in {entry!r}")
             if k == "p":
                 kw["p"] = float(v)
             elif k == "n":
@@ -234,10 +265,83 @@ def arm_from_env(env: str | None = None) -> int:
             elif k == "seed":
                 kw["seed"] = int(v)
             else:
-                raise ValueError(f"M3_FAULTPOINTS key {k!r} in {entry!r}")
+                raise ValueError(f"faultpoints key {k!r} in {entry!r}")
+        out.append((point, mode, kw))
+    return out
+
+
+def arm_many(raw: str) -> int:
+    """Parse-then-arm one spec string; returns the number of specs
+    armed.  All-or-nothing: a grammar error arms NOTHING."""
+    specs = parse_faults(raw)
+    for point, mode, kw in specs:
         arm(point, mode, **kw)
-        count += 1
-    return count
+    return len(specs)
+
+
+def arm_from_env(env: str | None = None) -> int:
+    """Arm from ``M3_FAULTPOINTS`` (or ``env``); see :func:`parse_faults`
+    for the grammar."""
+    raw = os.environ.get("M3_FAULTPOINTS", "") if env is None else env
+    return arm_many(raw)
+
+
+def snapshot() -> List[dict]:
+    """Every armed spec as a dict (point/mode/knobs + live pass/trigger
+    counts), sorted by point then mode — the readable half of the
+    runtime re-arm surface."""
+    with _lock:
+        specs = [s for lst in _points.values() for s in lst]
+    return sorted((s.to_dict() for s in specs),
+                  key=lambda d: (d["point"], d["mode"]))
+
+
+# -- HTTP builders (admin + main API /api/v1/debug/faults) -------------------
+#
+# Shared by server/admin_api.py and server/http_api.py exactly like
+# tracing.traces_response: two ports, one behavior, no drift.
+
+
+def registry_response() -> dict:
+    """GET body: armed specs + the process counters (passes survive
+    disarm, trigger totals survive re-arm)."""
+    return {"armed": snapshot(), "counters": counters()}
+
+
+def apply_request(body: dict) -> dict:
+    """POST body → mutate the registry, return the post-state.
+
+    ``{"disarm": true | ["point", ...], "reset_counters": bool,
+    "arm": "point=mode[:key=value]*;..."}`` — disarm applies FIRST so
+    one request is a complete re-arm (the chaos scheduler's
+    window-transition shape), and counters are PRESERVED unless
+    ``reset_counters`` asks otherwise.  Unknown keys raise (a typo'd
+    request must not silently no-op)."""
+    unknown = set(body) - {"arm", "disarm", "reset_counters"}
+    if unknown:
+        raise ValueError(f"debug/faults: unknown keys {sorted(unknown)}")
+    # validate BEFORE mutating: a bad arm spec must not leave the node
+    # disarmed when the caller asked for an atomic re-arm
+    specs = parse_faults(body.get("arm") or "")
+    dis = body.get("disarm")
+    # a bare string would iterate per CHARACTER and disarm nothing
+    # (disarm() pops unknown points silently) — the silent no-op this
+    # endpoint exists to prevent
+    if not (dis is None or isinstance(dis, (bool, list, tuple))):
+        raise ValueError(
+            "debug/faults: 'disarm' must be true or a list of points")
+    if dis is True:
+        disarm()
+    elif dis:
+        for point in dis:
+            disarm(str(point))
+    if body.get("reset_counters"):
+        reset_counters()
+    for point, mode, kw in specs:
+        arm(point, mode, **kw)
+    out = registry_response()
+    out["armed_count"] = len(specs)
+    return out
 
 
 def counters() -> Dict[str, int]:

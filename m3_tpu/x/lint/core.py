@@ -88,8 +88,13 @@ class Context:
     # bit-layout contracts exactly like the codec's
     dtype_prefixes: tuple = ("m3_tpu/encoding/", "m3_tpu/parallel/",
                              "m3_tpu/aggregator/")
+    # round 12: dtest/ joined the wire scope — the soak/chaos harness
+    # drives live clusters, and a raw socket op in IT would be a fault
+    # injection the faultpoint registry can't see or replay (chaos must
+    # stay scripted through named faultpoints, not ad-hoc socket pokes)
     wire_prefixes: tuple = ("m3_tpu/server/", "m3_tpu/client/",
-                            "m3_tpu/cluster/", "m3_tpu/msg/")
+                            "m3_tpu/cluster/", "m3_tpu/msg/",
+                            "m3_tpu/dtest/")
     wire_files: tuple = ("m3_tpu/persist/commitlog.py",)
     # The framing module IS the designated low-level seam: raw socket
     # ops are legal only here (everything else reaches them through

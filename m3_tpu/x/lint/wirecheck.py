@@ -44,7 +44,7 @@ FAMILIES = {
     "rpc-method": frozenset({"M_WRITE_BATCH", "M_WRITE_TAGGED", "M_READ",
                              "M_QUERY_IDS", "M_LIST_BLOCKS", "M_BLOCK_META",
                              "M_READ_BLOCK", "M_WRITE_BLOCK", "M_TICK",
-                             "M_HEALTH"}),
+                             "M_HEALTH", "M_READ_BATCH"}),
     "kv-method": frozenset({"M_GET", "M_SET", "M_SET_NX", "M_CAS",
                             "M_DELETE", "M_KEYS"}),
 }

@@ -1,0 +1,21 @@
+"""Seeded fault-coverage violations for the dtest scope (round 12,
+never imported).  The soak/chaos harness drives LIVE clusters — a raw
+socket op inside it is a fault injection the faultpoint registry cannot
+see, script, or replay, so dtest/ sits in the wire scope and chaos
+must reach sockets through named faultpoints or the protocol seam."""
+
+from m3_tpu.x import fault
+
+
+def adhoc_chaos_poke(sock, frame):
+    sock.sendall(frame)                # VIOLATION: fault-coverage (L11)
+
+
+def adhoc_drain(sock):
+    return sock.recv(65536)            # VIOLATION: fault-coverage (L15)
+
+
+def scripted_chaos_send(sock, frame):  # ok: a NAMED faultpoint guards it
+    if fault.fire("dtest.soak.send") == "drop":
+        raise ConnectionError("chaos drop")
+    sock.sendall(frame)

@@ -273,6 +273,73 @@ class TestExposition:
         assert 0.5 <= p99 <= 1.5  # the slow node's lane dominates p99
 
 
+class TestFleetSummaryPartial:
+    """Round-12 satellite: the fleet merge under partial scrape failure.
+    The soak scrapes at phase boundaries INCLUDING mid-SIGKILL windows,
+    so one-node-of-three-unreachable must yield a merged summary
+    honestly flagged ``partial`` over the reachable majority — never an
+    exception and never silently-wrong quantiles."""
+
+    def _scrape(self, value_s, n=100):
+        reg = new_registry()
+        h = reg.scope("node").histogram("lat_seconds")
+        for _ in range(n):
+            h.record(value_s)
+        return exposition.parse_text(reg.render_prometheus())
+
+    def test_one_of_three_unreachable_flags_partial(self):
+        scrapes = {0: self._scrape(0.001), 1: self._scrape(0.001), 2: None}
+        out = exposition.fleet_summary(scrapes, "node_lat_seconds")
+        assert out["partial"] is True
+        assert out["unreachable"] == [2]
+        assert out["reachable"] == [0, 1]
+        assert out["count"] == 200  # the reachable majority, fully merged
+        assert out["quantiles"]["p99"] is not None
+        assert out["quantiles"]["p99"] < 0.1  # not polluted by a guess
+
+    def test_all_unreachable_yields_empty_not_exception(self):
+        out = exposition.fleet_summary({0: None, 1: None}, "node_lat")
+        assert out["partial"] and out["count"] == 0
+        assert out["quantiles"]["p50"] is None
+
+    def test_phase_delta_subtracts_the_before_scrape(self):
+        reg = new_registry()
+        h = reg.scope("node").histogram("lat_seconds")
+        for _ in range(50):
+            h.record(0.001)
+        before = exposition.parse_text(reg.render_prometheus())
+        for _ in range(25):
+            h.record(0.001)
+        after = exposition.parse_text(reg.render_prometheus())
+        out = exposition.fleet_summary({0: after}, "node_lat_seconds",
+                                       before={0: before})
+        assert out["count"] == 25  # just the window, not the lifetime
+
+    def test_restart_between_scrapes_is_detected_not_negative(self):
+        before = self._scrape(0.001, n=100)
+        after = self._scrape(0.001, n=10)  # fresh process: counters reset
+        out = exposition.fleet_summary({0: after}, "node_lat_seconds",
+                                       before={0: before})
+        assert out["resets"] == [0]
+        assert out["count"] == 10  # the new process's absolute counts
+
+    def test_node_missing_from_before_is_a_full_delta(self):
+        # a node that JOINED mid-phase (the rolling-replace spare)
+        out = exposition.fleet_summary(
+            {0: self._scrape(0.001)}, "node_lat_seconds", before={})
+        assert out["count"] == 100 and not out["resets"]
+
+    def test_counter_value_sums_and_tolerates_none(self):
+        reg = new_registry()
+        reg.scope("s", {"k": "a"}).counter("c").inc(3)
+        reg.scope("s", {"k": "b"}).counter("c").inc(4)
+        samples = exposition.parse_text(reg.render_prometheus())
+        assert exposition.counter_value(samples, "s_c") == 7
+        assert exposition.counter_value(samples, "s_c", {"k": "a"}) == 3
+        assert exposition.counter_value(None, "s_c") == 0.0
+        assert exposition.counter_value(samples, "absent") == 0.0
+
+
 class TestTraceContext:
     def test_wire_roundtrip(self):
         ctx = TraceContext(trace_id=2**63 + 5, span_id=42, sampled=True)

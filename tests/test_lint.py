@@ -68,6 +68,10 @@ EXPECTED = {
     ("wire_cases.py", "wire-exhaustive", 17),
     ("fault_cases.py", "fault-coverage", 10),
     ("fault_cases.py", "fault-coverage", 14),
+    # round 12: chaos/soak fault injections go through named
+    # faultpoints — dtest/ joined the wire scope
+    ("dtest_cases.py", "fault-coverage", 11),
+    ("dtest_cases.py", "fault-coverage", 15),
     ("fault_cases.py", "fault-coverage", 24),
     ("resource_cases.py", "resource-hygiene", 7),
     ("resource_cases.py", "resource-hygiene", 13),
@@ -223,6 +227,31 @@ class TestDtypeScope:
     def test_out_of_scope_module_stays_clean(self, tmp_path):
         got = self._lint_at(tmp_path, "m3_tpu/query/engine.py")
         assert not any(f.rule == "explicit-dtype" for f in got)
+
+
+class TestWireScopeDtest:
+    """Round 12: the DEFAULT context's wire scope must cover dtest/ —
+    the soak/chaos harness drives live clusters, and a raw socket op in
+    it would be a fault injection the faultpoint registry can't script
+    or replay.  Permissive-context corpus tests can't catch this scope
+    regressing."""
+
+    RAW = ("def poke(sock, b):\n"
+           "    sock.sendall(b)\n")
+
+    def _lint_at(self, tmp_path, rel):
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(self.RAW)
+        return lint_file(p, tmp_path, Context())
+
+    def test_fires_in_dtest(self, tmp_path):
+        got = self._lint_at(tmp_path, "m3_tpu/dtest/soak2.py")
+        assert any(f.rule == "fault-coverage" for f in got)
+
+    def test_out_of_scope_stays_clean(self, tmp_path):
+        got = self._lint_at(tmp_path, "m3_tpu/query/engine.py")
+        assert not any(f.rule == "fault-coverage" for f in got)
 
 
 class TestJaxScope:

@@ -155,6 +155,69 @@ mediator: {{enabled: false}}
         assert child["parent_id"] == root["span_id"]
 
 
+class TestDebugFaultsEndpoint:
+    """Round-12: runtime faultpoint re-arm over HTTP — the chaos
+    scheduler's window-flip surface, mirrored on the main and admin
+    ports like debug/traces."""
+
+    def _post(self, port, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/v1/debug/faults",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.load(r)
+
+    def test_rearm_live_without_restart(self, tmp_path):
+        from m3_tpu.server.assembly import run_node
+        from m3_tpu.x import fault
+
+        cfg = f"""
+db:
+  root: {tmp_path}
+  namespaces:
+    default: {{num_shards: 1}}
+coordinator: {{listen_port: 0, admin_listen_port: 0}}
+mediator: {{enabled: false}}
+"""
+        fault.disarm()
+        fault.reset_counters()
+        asm = run_node(cfg)
+        try:
+            # arm through the MAIN port: same grammar as M3_FAULTPOINTS
+            out = self._post(asm.port, {
+                "arm": "rpc.server=delay:ms=1:p=0.5:seed=4"})
+            assert out["armed_count"] == 1
+            assert out["armed"][0]["point"] == "rpc.server"
+            # visible on the ADMIN port too (one process registry)
+            admin = json.loads(_get(
+                f"http://127.0.0.1:{asm.admin_port}/api/v1/debug/faults"))
+            assert [s["mode"] for s in admin["armed"]] == ["delay"]
+            # fire it, then RE-ARM: counters must survive the flip
+            fault.fire("rpc.server")
+            out = self._post(asm.admin_port, {
+                "disarm": True, "arm": "rpc.server=drop:p=1.0"})
+            assert [s["mode"] for s in out["armed"]] == ["drop"]
+            assert out["counters"]["rpc.server.passes"] == 1
+            # a malformed spec is a 400, and mutates NOTHING
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{asm.port}/api/v1/debug/faults",
+                data=b'{"arm": "broken-spec", "disarm": true}',
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(req, timeout=30)
+                raise AssertionError("malformed spec must 400")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+            still = json.loads(_get(
+                f"http://127.0.0.1:{asm.port}/api/v1/debug/faults"))
+            assert [s["mode"] for s in still["armed"]] == ["drop"]
+        finally:
+            asm.close()
+            fault.disarm()
+            fault.reset_counters()
+
+
 class TestIngestTracePreambleCompat:
     def test_legacy_server_degrades_to_untraced_delivery(self):
         """Review regression: a pre-round-10 ingest server kills the

@@ -1,0 +1,550 @@
+"""Round-12 soak tier: the million-series chaos harness's parts in
+isolation (deterministic workload generator, ledger regeneration,
+chaos scheduler on a fake clock, faultpoint runtime re-arm, the
+check-gate comparison, batched-read parity, harness diagnostics) plus
+the tier-1 ``cli soak --smoke`` end-to-end: generator → chaos
+scheduler → ledger verify → artifact schema against a REAL 2-node
+cluster with one wire-fault window."""
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from m3_tpu.dtest.soak import (
+    Ledger, SoakConfig, WorkloadGen, build_timeline, check_artifact,
+    config_from_artifact,
+)
+from m3_tpu.x import chaos, fault
+
+BLOCK = 2 * 3600 * 10**9
+T0 = (1_700_000_000 * 10**9) // BLOCK * BLOCK
+
+
+# ---------------------------------------------------------------------------
+# workload generator + ledger
+# ---------------------------------------------------------------------------
+
+
+class TestWorkloadGen:
+    def test_deterministic_across_instances(self):
+        a, b = WorkloadGen(1000, 0.1, 7), WorkloadGen(1000, 0.1, 7)
+        assert a.ids(3, 100, 300) == b.ids(3, 100, 300)
+        assert np.array_equal(a.values(3, 100, 300), b.values(3, 100, 300))
+
+    def test_seed_changes_values_and_churn(self):
+        a, b = WorkloadGen(1000, 0.1, 7), WorkloadGen(1000, 0.1, 8)
+        assert not np.array_equal(a.values(1, 0, 500), b.values(1, 0, 500))
+        assert a.ids(1, 0, 500) != b.ids(1, 0, 500)
+
+    def test_churn_rekeys_only_the_churn_subset(self):
+        g = WorkloadGen(10_000, 0.05, 3)
+        s0 = g.ids(0, 0, 10_000)
+        s1 = g.ids(1, 0, 10_000)
+        changed = sum(1 for x, y in zip(s0, s1) if x != y)
+        # ~5% re-key each sweep: new-series pressure, deterministic
+        assert 300 <= changed <= 700
+        # non-churned ids are stable across sweeps
+        assert all(y.endswith(b".g000") for x, y in zip(s0, s1) if x == y)
+
+    def test_zero_churn_is_stable(self):
+        g = WorkloadGen(500, 0.0, 1)
+        assert g.ids(0, 0, 500) == g.ids(5, 0, 500)
+
+    def test_value_families_striped(self):
+        g = WorkloadGen(300, 0.0, 1)
+        v1, v2 = g.values(1, 0, 300), g.values(2, 0, 300)
+        idx = np.arange(300)
+        counters = idx % 3 == 1
+        # counter family is monotonic in sweep; spiky family carries
+        # its 1e6 spikes
+        assert (v2[counters] > v1[counters]).all()
+        assert (v1[idx % 3 == 2] >= 1.0).all()
+        assert (g.values(0, 0, 300)[idx % 3 == 2] == 1e6).any()
+
+
+class TestLedger:
+    def test_expected_regenerates_bulk_and_explicit(self):
+        g = WorkloadGen(100, 0.0, 2)
+        led = Ledger(g)
+        led.ack_bulk(0, 10, 20, 111)
+        led.ack_bulk(1, 10, 15, 222)
+        led.ack_explicit([(b"x", 5, 1.5), (b"y", 6, 2.5)])
+        assert led.acked_samples == 10 + 5 + 2
+        exp = led.expected()
+        assert len(exp) == 12  # 10 bulk sids + x + y
+        sid10 = g.ids(0, 10, 11)[0]
+        assert exp[sid10][111] == g.values(0, 10, 11)[0]
+        assert exp[sid10][222] == g.values(1, 10, 11)[0]  # same id, 2 ts
+        assert exp[b"x"] == {5: 1.5}
+
+    def test_duplicate_ack_is_idempotent(self):
+        g = WorkloadGen(100, 0.0, 2)
+        led = Ledger(g)
+        led.ack_bulk(0, 0, 10, 111)
+        led.ack_bulk(0, 0, 10, 111)  # at-least-once resend
+        exp = led.expected()
+        assert all(len(pts) == 1 for pts in exp.values())
+
+
+# ---------------------------------------------------------------------------
+# chaos scheduler
+# ---------------------------------------------------------------------------
+
+
+class _FakeOps:
+    def __init__(self, fail_on=()):
+        self.calls = []
+        self.fail_on = set(fail_on)
+
+    def _rec(self, verb, *args):
+        self.calls.append((verb,) + args)
+        if verb in self.fail_on:
+            raise RuntimeError(f"injected {verb} failure")
+
+    def phase(self, label):
+        self._rec("phase", label)
+
+    def kill(self, node):
+        self._rec("kill", node)
+
+    def restart(self, node):
+        self._rec("restart", node)
+
+    def arm_faults(self, node, spec):
+        self._rec("arm_faults", node, spec)
+
+    def clear_faults(self, node):
+        self._rec("clear_faults", node)
+
+    def corrupt(self, node, seed):
+        self._rec("corrupt", node, seed)
+
+    def replace(self, node):
+        self._rec("replace", node)
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+
+
+class TestChaosScheduler:
+    def _run(self, events, ops, seed=0):
+        clk = _FakeClock()
+        sched = chaos.ChaosScheduler(events, ops, seed=seed,
+                                     clock=clk, sleep=clk.sleep)
+        return sched.run(), sched
+
+    def test_executes_in_order_on_the_fake_clock(self):
+        ops = _FakeOps()
+        log, _ = self._run([
+            chaos.ChaosEvent(5.0, "kill", node=2),
+            chaos.ChaosEvent(1.0, "phase", arg="healthy"),
+            chaos.ChaosEvent(9.0, "restart", node=2),
+        ], ops)
+        assert [c[0] for c in ops.calls] == ["phase", "kill", "restart"]
+        assert [e["fired_at_s"] for e in log] == [1.0, 5.0, 9.0]
+        assert all(e["ok"] for e in log)
+
+    def test_wire_fault_specs_get_run_seed(self):
+        ops = _FakeOps()
+        self._run([chaos.ChaosEvent(
+            0.0, "wire_fault", node=1,
+            arg="rpc.server=drop:p=0.5;rpc.server=delay:ms=5:seed=9")],
+            ops, seed=40)
+        _, _, spec = ops.calls[0]
+        # entry without a seed gets the (run seed + event index); an
+        # explicit seed is preserved
+        assert spec == "rpc.server=drop:p=0.5:seed=40;rpc.server=delay:ms=5:seed=9"
+
+    def test_failed_op_is_logged_and_run_continues(self):
+        ops = _FakeOps(fail_on={"corrupt"})
+        log, _ = self._run([
+            chaos.ChaosEvent(1.0, "corrupt", node=0),
+            chaos.ChaosEvent(2.0, "phase", arg="after"),
+        ], ops)
+        assert log[0]["ok"] is False and "injected" in log[0]["error"]
+        assert log[1]["ok"] is True  # the run went on
+
+    def test_parse_timeline_validates_eagerly(self):
+        seed, ev = chaos.parse_timeline({"seed": 3, "events": [
+            {"at_s": 2, "action": "kill", "node": 1},
+            {"at_s": 0, "action": "phase", "arg": "h"},
+        ]})
+        assert seed == 3 and [e.action for e in ev] == ["kill", "phase"] or \
+            [e.action for e in ev] == ["phase", "kill"]
+        assert ev[0].at_s <= ev[1].at_s
+        with pytest.raises(ValueError):
+            chaos.parse_timeline({"events": [{"at_s": 0, "action": "zap"}]})
+        with pytest.raises(ValueError):  # malformed faultpoint spec
+            chaos.parse_timeline({"events": [
+                {"at_s": 0, "action": "wire_fault", "node": 0,
+                 "arg": "not-a-spec"}]})
+        with pytest.raises(ValueError):  # phase without a label
+            chaos.ChaosEvent(0.0, "phase")
+        with pytest.raises(ValueError):  # kill without a target
+            chaos.ChaosEvent(0.0, "kill")
+
+    def test_build_timeline_shapes(self):
+        full = build_timeline(SoakConfig())
+        actions = [e.action for e in full]
+        for a in ("wire_fault", "kill", "restart", "corrupt", "replace"):
+            assert a in actions, a
+        labels = [e.arg for e in full if e.action == "phase"]
+        assert labels == ["healthy", "wire_faults", "sigkill", "corrupt",
+                          "replace", "recovered"]
+        smoke = build_timeline(SoakConfig.smoke_config())
+        sactions = [e.action for e in smoke]
+        assert "wire_fault" in sactions and "kill" not in sactions
+        assert [e.arg for e in smoke if e.action == "phase"] == \
+            ["healthy", "wire_faults", "recovered"]
+
+
+# ---------------------------------------------------------------------------
+# faultpoint runtime re-arm registry
+# ---------------------------------------------------------------------------
+
+
+class TestFaultRegistryRearm:
+    def setup_method(self):
+        fault.disarm()
+        fault.reset_counters()
+
+    def teardown_method(self):
+        fault.disarm()
+        fault.reset_counters()
+
+    def test_snapshot_reflects_armed_specs(self):
+        fault.arm_many("a.b=drop:p=0.25;a.b=delay:ms=7:seed=3")
+        snap = fault.snapshot()
+        assert [(s["mode"], s["p"], s["ms"], s["seed"]) for s in snap] == \
+            [("delay", 1.0, 7.0, 3), ("drop", 0.25, 0.0, 0)]
+
+    def test_arm_many_is_all_or_nothing(self):
+        with pytest.raises(ValueError):
+            fault.arm_many("a.b=drop;c.d=notamode")
+        assert fault.snapshot() == []  # the valid first entry did NOT arm
+
+    def test_counters_survive_rearm(self):
+        fault.arm("p.q", "error", n=1)
+        with pytest.raises(fault.FaultInjected):
+            fault.fire("p.q")
+        # the admin re-arm shape: disarm everything, arm fresh specs
+        out = fault.apply_request({"disarm": True, "arm": "p.q=drop:p=1.0"})
+        assert out["armed_count"] == 1
+        # the pre-re-arm trigger totals and passes are still visible
+        assert out["counters"]["p.q.error_triggers"] == 1
+        assert out["counters"]["p.q.passes"] == 1
+        assert fault.fire("p.q") == "drop"
+        c = fault.counters()
+        assert c["p.q.drop_triggers"] == 1 and c["p.q.error_triggers"] == 1
+
+    def test_apply_request_validates_before_mutating(self):
+        fault.arm("keep.me", "drop")
+        with pytest.raises(ValueError):
+            fault.apply_request({"disarm": True, "arm": "broken"})
+        # the bad request disarmed NOTHING
+        assert [s["point"] for s in fault.snapshot()] == ["keep.me"]
+        with pytest.raises(ValueError):
+            fault.apply_request({"zap": 1})
+
+    def test_reset_counters_via_request(self):
+        fault.arm("p.r", "drop")
+        fault.fire("p.r")
+        out = fault.apply_request({"reset_counters": True})
+        assert out["counters"] == {}
+
+
+# ---------------------------------------------------------------------------
+# the regression gate
+# ---------------------------------------------------------------------------
+
+
+def _artifact(p99_ms=100.0, fleet_p99_s=0.1, loss=False):
+    return {
+        "kind": "SOAK", "schema": 1,
+        "config": {"series": 1000, "nodes": 2, "smoke": True},
+        "phases": [{
+            "name": "healthy",
+            "ingest": {"driver_p99_ms": p99_ms, "acked_samples": 100},
+            "query": {"driver_p99_ms": p99_ms / 2},
+            "fleet_ingest": {"quantiles": {"p99": fleet_p99_s}},
+            "fleet_query": {"quantiles": {"p99": fleet_p99_s / 2}},
+        }],
+        "verdict": {"zero_acked_loss": not loss, "missing": 3 if loss else 0,
+                    "mismatched": 0, "acked_samples": 100},
+    }
+
+
+class TestCheckGate:
+    def test_clean_run_passes(self):
+        assert check_artifact(_artifact(), _artifact()) == []
+
+    def test_loss_always_fails(self):
+        errs = check_artifact(_artifact(loss=True), _artifact(),
+                              tolerance=1e9)
+        assert errs and "loss" in errs[0]
+
+    def test_driver_p99_regression_fails(self):
+        errs = check_artifact(_artifact(p99_ms=500.0), _artifact(),
+                              tolerance=2.0)
+        assert any("driver p99" in e for e in errs)
+
+    def test_fleet_p99_regression_fails(self):
+        errs = check_artifact(_artifact(fleet_p99_s=1.0), _artifact(),
+                              tolerance=2.0)
+        assert any("fleet" in e for e in errs)
+
+    def test_within_tolerance_passes(self):
+        assert check_artifact(_artifact(p99_ms=150.0, fleet_p99_s=0.15),
+                              _artifact(), tolerance=2.0) == []
+
+    def test_kind_mismatch_fails(self):
+        errs = check_artifact({"kind": "BENCH"}, _artifact())
+        assert errs and "kind" in errs[0]
+
+    def test_schema_mismatch_fails(self):
+        # a schema bump may rename the compared fields — every .get()
+        # would miss and the gate would pass vacuously; it must fail loud
+        new = _artifact()
+        new["schema"] = 2
+        errs = check_artifact(new, _artifact())
+        assert errs and "schema" in errs[0]
+
+    def test_setup_phase_excluded_from_p99_gate(self):
+        # setup quarantines one-time jit compiles; its p99 swings many x
+        # between identical runs and must never trip the gate
+        new, base = _artifact(), _artifact()
+        for art, p99 in ((new, 50_000.0), (base, 10.0)):
+            art["phases"].insert(0, {
+                "name": "setup",
+                "ingest": {"driver_p99_ms": p99},
+                "query": {"driver_p99_ms": p99},
+                "fleet_ingest": {"quantiles": {"p99": p99 / 1e3}},
+                "fleet_query": {"quantiles": {"p99": p99 / 1e3}},
+            })
+        assert check_artifact(new, base, tolerance=2.0) == []
+
+    def test_config_from_artifact_roundtrip(self):
+        cfg = SoakConfig.smoke_config()
+        art = {"config": __import__("dataclasses").asdict(cfg)}
+        cfg2 = config_from_artifact(art, series=999)
+        assert cfg2.nodes == cfg.nodes and cfg2.series == 999
+        assert cfg2.smoke
+
+
+# ---------------------------------------------------------------------------
+# harness diagnostics (satellite: wait_healthy carries the diagnosis)
+# ---------------------------------------------------------------------------
+
+
+class TestHarnessDiagnostics:
+    def _hung_node(self, tmp_path):
+        from m3_tpu.dtest.harness import NodeProcess
+
+        node = NodeProcess(str(tmp_path / "cfg.yaml"), str(tmp_path))
+        node.log_path.write_bytes(b"x" * 5000 + b"THE ACTUAL REASON\n")
+        node.proc = subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(60)"])
+        return node
+
+    def test_timeout_carries_log_tail_and_health(self, tmp_path):
+        node = self._hung_node(tmp_path)
+        try:
+            with pytest.raises(TimeoutError) as ei:
+                node.wait_healthy(0.4)
+            msg = str(ei.value)
+            assert "THE ACTUAL REASON" in msg          # log tail attached
+            assert "never reached /health" in msg      # health state attached
+        finally:
+            node.proc.kill()
+            node.proc.wait()
+
+    def test_dead_node_carries_rc_and_log(self, tmp_path):
+        node = self._hung_node(tmp_path)
+        node.proc.kill()
+        node.proc.wait()
+        with pytest.raises(RuntimeError) as ei:
+            node.wait_healthy(5)
+        assert "died during startup" in str(ei.value)
+        assert "THE ACTUAL REASON" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# batched read parity (storage + rpc + session)
+# ---------------------------------------------------------------------------
+
+
+class TestBatchedReadParity:
+    def test_read_batch_matches_single_reads(self, tmp_path):
+        from m3_tpu.storage.database import (
+            Database, DatabaseOptions, NamespaceOptions,
+        )
+
+        db = Database(
+            DatabaseOptions(root=str(tmp_path), commitlog_enabled=False),
+            namespaces={"default": NamespaceOptions(num_shards=2)})
+        db.bootstrap()
+        ids = [b"rbp-%03d" % i for i in range(64)]
+        db.write_batch("default", ids, np.full(64, T0 + 10**9, np.int64),
+                       np.arange(64, dtype=np.float64), now_nanos=T0 + 10**9)
+        # a cold write (out of window) rides the overflow path
+        db.write_batch("default", ids[:8],
+                       np.full(8, T0 - 6 * BLOCK, np.int64),
+                       np.arange(8, dtype=np.float64) + 500.0,
+                       now_nanos=T0 + 10**9)
+        lo, hi = T0 - 8 * BLOCK, T0 + BLOCK
+        got = db.read_batch("default", ids + [b"missing"], lo, hi)
+        for sid, pts in zip(ids, got):
+            assert pts == db.read("default", sid, lo, hi), sid
+        assert got[-1] == []
+        assert len(got[0]) == 2  # warm + cold both served
+
+    def test_rpc_read_batch_round_trip(self, tmp_path):
+        from m3_tpu.server.rpc import RemoteDatabase, serve_rpc_background
+        from m3_tpu.storage.database import (
+            Database, DatabaseOptions, NamespaceOptions,
+        )
+
+        db = Database(
+            DatabaseOptions(root=str(tmp_path), commitlog_enabled=False),
+            namespaces={"default": NamespaceOptions(num_shards=2)})
+        db.bootstrap()
+        ids = [b"rpc-%03d" % i for i in range(10)]
+        db.write_batch("default", ids, np.full(10, T0 + 10**9, np.int64),
+                       np.arange(10, dtype=np.float64), now_nanos=T0 + 10**9)
+        srv = serve_rpc_background(db)
+        remote = RemoteDatabase(("127.0.0.1", srv.port))
+        try:
+            got = remote.read_batch("default", ids + [b"nope"], T0,
+                                    T0 + BLOCK)
+            assert got[:10] == [db.read("default", s, T0, T0 + BLOCK)
+                                for s in ids]
+            assert got[10] == []
+        finally:
+            remote.close()
+            srv.shutdown()
+            srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# slot-capacity degradation (found by the first 1M run: past the cap,
+# every mixed batch DIED with an opaque RuntimeError)
+# ---------------------------------------------------------------------------
+
+
+class TestSlotCapacityDegradation:
+    def test_full_allocator_rejects_creations_not_batches(self, tmp_path):
+        from m3_tpu.storage.database import (
+            Database, DatabaseOptions, NamespaceOptions,
+        )
+
+        db = Database(
+            DatabaseOptions(root=str(tmp_path), commitlog_enabled=False),
+            namespaces={"default": NamespaceOptions(
+                num_shards=1, slot_capacity=4, sample_capacity=64)})
+        db.bootstrap()
+        old = [b"cap-%d" % i for i in range(4)]
+        res = db.write_batch("default", old, np.full(4, T0 + 10**9, np.int64),
+                             np.arange(4, dtype=np.float64),
+                             now_nanos=T0 + 10**9)
+        assert res.rejected == 0
+        # a MIXED batch at capacity: existing series land, the new one
+        # is rejected-and-counted (never an exception, never data loss
+        # for the series that fit)
+        mixed = old + [b"cap-overflow"]
+        res = db.write_batch("default", mixed,
+                             np.full(5, T0 + 2 * 10**9, np.int64),
+                             np.arange(5, dtype=np.float64) + 100,
+                             now_nanos=T0 + 2 * 10**9)
+        assert res.rejected == 1
+        assert db.read("default", old[0], T0, T0 + BLOCK) == [
+            (T0 + 10**9, 0.0), (T0 + 2 * 10**9, 100.0)]
+        assert db.read("default", b"cap-overflow", T0, T0 + BLOCK) == []
+
+    def test_session_surfaces_the_rejected_count(self, tmp_path):
+        from m3_tpu.client.session import ConsistencyLevel, ReplicatedSession
+        from m3_tpu.cluster.placement import Instance, initial_placement
+        from m3_tpu.storage.database import (
+            Database, DatabaseOptions, NamespaceOptions,
+        )
+
+        dbs = {}
+        for iid in ("i0", "i1"):
+            db = Database(
+                DatabaseOptions(root=str(tmp_path / iid),
+                                commitlog_enabled=False),
+                namespaces={"default": NamespaceOptions(
+                    num_shards=1, slot_capacity=4, sample_capacity=64)})
+            db.bootstrap()
+            dbs[iid] = db
+        sess = ReplicatedSession(
+            initial_placement([Instance("i0"), Instance("i1")],
+                              num_shards=1, rf=2),
+            dbs, write_level=ConsistencyLevel.MAJORITY,
+            read_level=ConsistencyLevel.MAJORITY)
+        ids = [b"sr-%d" % i for i in range(6)]
+        rejected = sess.write_batch(
+            "default", ids, np.full(6, T0 + 10**9, np.int64),
+            np.arange(6, dtype=np.float64), now_nanos=T0 + 10**9)
+        # 6 new series into capacity-4 replicas: the fan-out SUCCEEDS
+        # (both replicas answered) but the caller is told 2 samples
+        # were rejected — a durability ledger must not ack this batch
+        assert rejected == 2
+
+
+# ---------------------------------------------------------------------------
+# tier-1 smoke: the whole pipeline against a real 2-node cluster
+# ---------------------------------------------------------------------------
+
+
+class TestSoakSmoke:
+    def test_cli_soak_smoke_end_to_end(self, tmp_path):
+        """``cli soak --smoke``: 2 real node processes, ~20K series,
+        one wire-fault window — generator, chaos scheduler, runtime
+        fault re-arm, ledger verify and artifact schema all exercised
+        end to end.  The slowest tier-1 test by design; the full
+        chaos timeline (SIGKILL/corrupt/replace at >=1M series) runs
+        via ``cli soak`` and is committed as SOAK_r10.json."""
+        from m3_tpu.tools import cli
+
+        out = tmp_path / "SOAK_smoke.json"
+        rc = cli.main(["soak", "--smoke", "--series", "6000",
+                       "--sweeps", "1", "--out", str(out)])
+        assert rc == 0
+        art = json.loads(out.read_text())
+        assert art["kind"] == "SOAK" and art["schema"] == 1
+        v = art["verdict"]
+        assert v["zero_acked_loss"] is True
+        assert v["missing"] == 0 and v["mismatched"] == 0
+        assert v["ledger_sha256"] == v["recovered_sha256"]
+        assert v["active_series"] >= 6000
+        names = [p["name"] for p in art["phases"]]
+        assert names[0] == "setup"
+        assert {"healthy", "wire_faults", "recovered"} <= set(names)
+        # the wire-fault window really armed through the live endpoint
+        assert any(e["action"] == "wire_fault" and e["ok"]
+                   for e in art["chaos"])
+        # fleet-merged summaries rode the strict parser at every
+        # boundary; ingest latency histograms had traffic
+        for p in art["phases"]:
+            if p["name"] == "recovered":
+                assert p["fleet_ingest"]["count"] > 0
+                assert p["fleet_ingest"]["quantiles"]["p99"] is not None
+                assert p["ingest"]["acked_samples"] > 0
+        # driver + verdict agree on scale: every bulk sample the phases
+        # acked is in the verified total (which also counts the
+        # historical + query corpora)
+        total = sum(p["ingest"]["acked_samples"] for p in art["phases"])
+        assert 0 < total <= v["acked_samples"]
