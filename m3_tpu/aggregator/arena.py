@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from m3_tpu.metrics.aggregation import AggregationType
+from m3_tpu.x import devguard, membudget
 
 I64_MIN = np.iinfo(np.int64).min
 I64_MAX = np.iinfo(np.int64).max
@@ -217,13 +218,17 @@ def make_arenas(num_windows: int, capacity: int, sample_capacity: int,
                        quantiles, packed32=timer_packed32))
 
 
-def _seg3(sum_col, sq_col, cnt_col, idx, values):
+def _seg3(sum_col, sq_col, cnt_col, idx, values, impl: str | None = None):
     """The sum / sum² / count accumulation every arena shares, routed
     through the configured implementation.  ``idx`` >= len(sum_col)
     drops (the sentinel contract) on both paths.  The pallas path
     computes all three lanes in ONE batch sweep
-    (pallas_segment_moments: the hit mask is shared)."""
-    if resolved_ingest_impl() == "pallas":
+    (pallas_segment_moments: the hit mask is shared).  ``impl`` pins
+    the choice explicitly (the arena wrappers thread it as a STATIC
+    jit argument so the device guard's fallback — pallas → scatter —
+    needs no cache clearing and never retraces); None keeps the
+    trace-time resolved seam for raw() composition (sharded_agg)."""
+    if (impl or resolved_ingest_impl()) == "pallas":
         from m3_tpu.parallel import pallas_ingest as pi
 
         n_out = sum_col.shape[0]
@@ -335,16 +340,18 @@ def counter_init(num_windows: int, capacity: int) -> CounterState:
     )
 
 
-@functools.partial(jax.jit, donate_argnums=0)
+@functools.partial(jax.jit, donate_argnums=0, static_argnames=("impl",))
 def counter_ingest(
     state: CounterState,
     idx: jnp.ndarray,  # i32 (N,) flattened window*C + slot; >= W*C to drop
     slots: jnp.ndarray,  # i32 (N,)
     values: jnp.ndarray,  # i64 (N,)
     times: jnp.ndarray,  # i64 (N,)
+    impl: str | None = None,  # static ingest impl (None = resolved seam)
 ) -> CounterState:
     """Counter.Update for a batch (reference counter.go:53-76)."""
-    s, sq, c = _seg3(state.sum, state.sum_sq, state.count, idx, values)
+    s, sq, c = _seg3(state.sum, state.sum_sq, state.count, idx, values,
+                     impl)
     slot_safe = _sanitize_slots(slots, state.last_at.shape[0])
     return CounterState(
         sum=s,
@@ -457,13 +464,14 @@ def gauge_init(num_windows: int, capacity: int) -> GaugeState:
     )
 
 
-@functools.partial(jax.jit, donate_argnums=0)
+@functools.partial(jax.jit, donate_argnums=0, static_argnames=("impl",))
 def gauge_ingest(
     state: GaugeState,
     idx: jnp.ndarray,  # i32 (N,) flattened; >= W*C to drop
     slots: jnp.ndarray,  # i32 (N,)
     values: jnp.ndarray,  # f64 (N,)
     times: jnp.ndarray,  # i64 (N,)
+    impl: str | None = None,  # static ingest impl (None = resolved seam)
 ) -> GaugeState:
     """Gauge.Update for a batch (reference gauge.go:53-104).
 
@@ -489,7 +497,8 @@ def gauge_ingest(
     take = is_winner & (s_times > old_time)
     widx = jnp.where(take, s_idx, state.last.shape[0])  # OOB -> dropped
 
-    g_s, g_sq, g_c = _seg3(state.sum, state.sum_sq, state.count, idx, safe)
+    g_s, g_sq, g_c = _seg3(state.sum, state.sum_sq, state.count, idx, safe,
+                           impl)
     slot_safe = _sanitize_slots(slots, state.last_at.shape[0])
     return GaugeState(
         last=state.last.at[widx].set(s_val, mode="drop"),
@@ -628,7 +637,8 @@ def timer_init(num_windows: int, capacity: int, sample_capacity: int) -> TimerSt
     )
 
 
-@functools.partial(jax.jit, donate_argnums=0, static_argnames=("capacity",))
+@functools.partial(jax.jit, donate_argnums=0,
+                   static_argnames=("capacity", "impl"))
 def timer_ingest(
     state: TimerState,
     windows: jnp.ndarray,  # i32 (N,) window ring index per sample; >= W drops
@@ -636,6 +646,7 @@ def timer_ingest(
     values: jnp.ndarray,  # f64 (N,)
     times: jnp.ndarray,  # i64 (N,)
     capacity: int,
+    impl: str | None = None,  # static ingest impl (None = resolved seam)
 ) -> TimerState:
     """Timer.AddBatch for a batch of (slot, value) samples
     (reference timer.go:55-76): moments scatter-add plus sample append.
@@ -656,7 +667,8 @@ def timer_ingest(
     idx = jnp.where(drop, num_w * capacity,
                     windows * capacity + slots)
 
-    t_s, t_sq, t_c = _seg3(state.sum, state.sum_sq, state.count, idx, values)
+    t_s, t_sq, t_c = _seg3(state.sum, state.sum_sq, state.count, idx, values,
+                           impl)
     slot_safe = _sanitize_slots(slots, capacity)
     return TimerState(
         sum=t_s,
@@ -860,56 +872,103 @@ class _TimerLanesMixin:
         return None
 
 
+def _guarded_ingest(call):
+    """Run one arena ingest behind the device guard.  The fallback
+    re-issues the call with the scatter (jnp) ingest impl as a STATIC
+    argument — on TPU that steps down from the Pallas kernel with no
+    cache clearing and no retrace of the primary; on CPU primary and
+    fallback coincide and the re-run simply skips the device
+    faultpoints (the injected-fault contract).  A failure that
+    persists through the fallback raises typed to the engine."""
+    return devguard.run_guarded(
+        "arena.ingest", lambda: call(resolved_ingest_impl()),
+        lambda: call("scatter"))
+
+
+def _guarded_consume(call):
+    """Arena window drains re-probe/fall back like ingests; the
+    fallback is the same jnp program with the faultpoints skipped (the
+    consume path has no lower impl to step down to — its lanes are
+    already the jnp formulation)."""
+    def primary():
+        out = call()
+        devguard.transfer_point("arena.consume")
+        return out
+
+    return devguard.run_guarded("arena.consume", primary, call)
+
+
+def _guarded_state_op(call):
+    """Window resets and slot clears ride the consume cycle's stage
+    breaker (they follow a drain / an expiry sweep); like consume, the
+    fallback is the same program with the faultpoints skipped."""
+    return devguard.run_guarded("arena.consume", call, call)
+
+
 class CounterArena(_ScalarLanesMixin):
     """Counter slots over a W-window ring (reference counter.go semantics)."""
 
     def __init__(self, num_windows: int, capacity: int):
         self.num_windows = num_windows
         self.capacity = capacity
+        self._mem = membudget.reserve(
+            "aggregator.counter",
+            membudget.counter_arena_bytes("f64", num_windows, capacity),
+            owner=self)
         self.state = counter_init(num_windows, capacity)
 
     def ingest(self, windows, slots, values, times):
         idx = flat_window_index(windows, slots, self.num_windows, self.capacity)
-        self.state = counter_ingest(self.state, idx, slots, values.astype(jnp.int64), times)
+        self.state = _guarded_ingest(lambda impl: counter_ingest(
+            self.state, idx, slots, values.astype(jnp.int64), times,
+            impl=impl))
 
     def consume(self, window: int):
-        return counter_consume(self.state, jnp.int32(window), self.capacity)
+        return _guarded_consume(lambda: counter_consume(
+            self.state, jnp.int32(window), self.capacity))
 
     def reset_window(self, window: int):
-        self.state = counter_reset_window(self.state, jnp.int32(window), self.capacity)
+        self.state = _guarded_state_op(lambda: counter_reset_window(self.state, jnp.int32(window), self.capacity))
 
     def clear_slots(self, slots):
-        self.state = counter_clear_slots(
+        self.state = _guarded_state_op(lambda: counter_clear_slots(
             self.state,
             jnp.asarray(pad_slots(np.asarray(slots), self.capacity)),
             self.num_windows,
             self.capacity,
-        )
+        ))
 
 
 class GaugeArena(_ScalarLanesMixin):
     def __init__(self, num_windows: int, capacity: int):
         self.num_windows = num_windows
         self.capacity = capacity
+        self._mem = membudget.reserve(
+            "aggregator.gauge",
+            membudget.gauge_arena_bytes("f64", num_windows, capacity),
+            owner=self)
         self.state = gauge_init(num_windows, capacity)
 
     def ingest(self, windows, slots, values, times):
         idx = flat_window_index(windows, slots, self.num_windows, self.capacity)
-        self.state = gauge_ingest(self.state, idx, slots, values.astype(jnp.float64), times)
+        self.state = _guarded_ingest(lambda impl: gauge_ingest(
+            self.state, idx, slots, values.astype(jnp.float64), times,
+            impl=impl))
 
     def consume(self, window: int):
-        return gauge_consume(self.state, jnp.int32(window), self.capacity)
+        return _guarded_consume(lambda: gauge_consume(
+            self.state, jnp.int32(window), self.capacity))
 
     def reset_window(self, window: int):
-        self.state = gauge_reset_window(self.state, jnp.int32(window), self.capacity)
+        self.state = _guarded_state_op(lambda: gauge_reset_window(self.state, jnp.int32(window), self.capacity))
 
     def clear_slots(self, slots):
-        self.state = gauge_clear_slots(
+        self.state = _guarded_state_op(lambda: gauge_clear_slots(
             self.state,
             jnp.asarray(pad_slots(np.asarray(slots), self.capacity)),
             self.num_windows,
             self.capacity,
-        )
+        ))
 
 
 class TimerArena(_TimerLanesMixin):
@@ -928,6 +987,11 @@ class TimerArena(_TimerLanesMixin):
         self.sample_capacity = sample_capacity
         self.quantiles = tuple(quantiles)
         self.packed32 = packed32
+        self._mem = membudget.reserve(
+            "aggregator.timer",
+            membudget.timer_arena_bytes("f64", num_windows, capacity,
+                                        sample_capacity),
+            owner=self)
         self.state = timer_init(num_windows, capacity, sample_capacity)
         # Host shadow of state.sample_n: avoids a device sync per ingest
         # batch just to run the overflow check.
@@ -948,23 +1012,34 @@ class TimerArena(_TimerLanesMixin):
         per_w = np.bincount(
             windows_np[in_range], minlength=self.num_windows
         )
-        self._sample_n_host += per_w
-        needed = int(self._sample_n_host.max())
+        # Commit-after-success (the ShardBuffer.write pattern): a
+        # _grow budget reject or device failure must leave the shadow
+        # mirroring state.sample_n, or every later batch re-rejects.
+        new_n = self._sample_n_host + per_w
+        needed = int(new_n.max())
         if needed > self.sample_capacity:
             self._grow(needed)
-        self.state = timer_ingest(
+        self.state = _guarded_ingest(lambda impl: timer_ingest(
             self.state,
             jnp.asarray(windows_np.astype(np.int32)),
             slots,
             values.astype(jnp.float64),
             times,
             self.capacity,
-        )
+            impl=impl,
+        ))
+        self._sample_n_host = new_n
 
     def _grow(self, needed: int) -> None:
         new_cap = self.sample_capacity
         while new_cap < needed:
             new_cap *= 2
+        # Admission before the pad allocates: an over-budget grow
+        # raises typed (the reference CM stream's never-drop contract
+        # yields to the budget — the caller sees the reject, the
+        # existing samples stay intact).
+        self._mem.resize(membudget.timer_arena_bytes(
+            "f64", self.num_windows, self.capacity, new_cap))
         pad = new_cap - self.sample_capacity
         self.state = TimerState(
             sum=self.state.sum,
@@ -982,19 +1057,19 @@ class TimerArena(_TimerLanesMixin):
         self.sample_capacity = new_cap
 
     def consume(self, window: int):
-        return timer_consume(
+        return _guarded_consume(lambda: timer_consume(
             self.state, jnp.int32(window), self.capacity, self.quantiles,
             self.packed32,
-        )
+        ))
 
     def reset_window(self, window: int):
-        self.state = timer_reset_window(self.state, jnp.int32(window), self.capacity)
+        self.state = _guarded_state_op(lambda: timer_reset_window(self.state, jnp.int32(window), self.capacity))
         self._sample_n_host[window] = 0
 
     def clear_slots(self, slots):
-        self.state = timer_clear_slots(
+        self.state = _guarded_state_op(lambda: timer_clear_slots(
             self.state,
             jnp.asarray(pad_slots(np.asarray(slots), self.capacity)),
             self.num_windows,
             self.capacity,
-        )
+        ))

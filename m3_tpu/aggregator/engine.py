@@ -288,6 +288,77 @@ class MetricMap:
         self._slots[(mid, mask)] = s
         return s
 
+    def to_entries(self) -> dict:
+        """Checkpoint form: exact (slot, id, mask, tail_sig) rows plus
+        the python path's free list (aggregator/checkpoint.py)."""
+        entries = []
+        n = (len(self._native_ids) if self._native is not None
+             else len(self._ids))
+        for s in range(n):
+            mid = self.id_of(s)
+            if mid is not None:
+                entries.append((s, mid, int(self.agg_mask[s]),
+                                int(self.tail_sig[s])))
+        free = [] if self._native is not None else list(self._free)
+        return {"entries": entries, "free": free, "size": n}
+
+    def load_entries(self, saved: dict) -> None:
+        """Rebuild EXACT slot→id assignment from a checkpoint into this
+        (fresh) map.  Python path: direct structure install, free list
+        preserved — post-restore allocation order matches the
+        uninterrupted process bit-for-bit.  Native path: ids insert in
+        slot order with hole placeholders released afterwards; a
+        resolver that does not assign sequentially fails loudly (the
+        restore aborts typed rather than silently remapping slots)."""
+        entries = sorted(saved["entries"])
+        if self._native is not None:
+            nxt = 0
+            holes = []
+            for slot, mid, mask, tail_sig in entries:
+                while nxt < slot:
+                    dummy = b"\x00ckpt-hole-%d" % nxt
+                    s, _ = self._native.resolve([dummy], 0)
+                    if int(s[0]) != nxt:
+                        raise ValueError(
+                            "native idmap did not allocate sequentially "
+                            "during checkpoint restore")
+                    holes.append(dummy)
+                    nxt += 1
+                s, _ = self._native.resolve([mid], mask)
+                if int(s[0]) != slot:
+                    raise ValueError(
+                        f"native idmap restored {mid!r} at slot "
+                        f"{int(s[0])}, checkpoint says {slot}")
+                self._native_ids[slot] = mid
+                self.agg_mask[slot] = np.uint64(mask)
+                self.tail_sig[slot] = tail_sig
+                nxt = slot + 1
+            for dummy in holes:
+                self._native.release(dummy, 0)
+            return
+        size = saved.get("size", (entries[-1][0] + 1 if entries else 0))
+        self._ids = [None] * size
+        self._slots = {}
+        self.agg_mask[:] = 0
+        self.tail_sig[:] = 0
+        for slot, mid, mask, tail_sig in entries:
+            self._ids[slot] = mid
+            self._slots[(mid, mask)] = slot
+            self.agg_mask[slot] = np.uint64(mask)
+            self.tail_sig[slot] = tail_sig
+        self._free = list(saved.get("free", ()))
+        # A native-path checkpoint reports size == len(_native_ids)
+        # (the preallocated capacity) with an EMPTY free list — the
+        # native resolver keeps its own.  Restoring it here must
+        # rediscover the holes or _allocate is permanently exhausted
+        # for new series.  Python-path checkpoints carry free == holes
+        # exactly, so this adds nothing and allocation order stays
+        # bit-for-bit.
+        known = set(self._free)
+        known.update(slot for slot, _, _, _ in entries)
+        self._free.extend(
+            s for s in range(size - 1, -1, -1) if s not in known)
+
     def release(self, slot: int) -> None:
         if self._native is not None:
             mid = self._native_ids[slot] if slot < len(self._native_ids) else None

@@ -77,6 +77,9 @@ from m3_tpu.aggregator.arena import (
     I64_MAX,
     I64_MIN,
     SCALAR_LANES,
+    _guarded_consume,
+    _guarded_ingest,
+    _guarded_state_op,
     _ScalarLanesMixin,
     _TimerLanesMixin,
     _sanitize_slots,
@@ -86,6 +89,7 @@ from m3_tpu.aggregator.arena import (
     pad_slots,
     timer_append_plan,
 )
+from m3_tpu.x import devguard, membudget
 
 # Default adaptive-width lane split for the counter base word
 # (count:16 | sum:24 | sq:24) and the int16 min/max word.  Tests pass
@@ -1015,6 +1019,11 @@ class PackedCounterArena(_ScalarLanesMixin):
         self.capacity = capacity
         self.widths = tuple(widths)
         self.promote_k = promote_k
+        self._mem = membudget.reserve(
+            "aggregator.counter",
+            membudget.counter_arena_bytes("packed", num_windows, capacity,
+                                          pool_capacity),
+            owner=self)
         self.state = counter_init(num_windows, capacity, pool_capacity,
                                   self.widths)
 
@@ -1033,7 +1042,12 @@ class PackedCounterArena(_ScalarLanesMixin):
             # transient burst must not wedge every later flush forever.
             # A recurring condition re-sets the flag and raises again.
             self.state = self.state._replace(err=jnp.int32(0))
-            raise RuntimeError(
+            # DeviceStateError (a RuntimeError): resident arena state
+            # is unreliable — typed so the device guard's classifier
+            # and the engine's degrade paths see it as the state
+            # poisoning it is, not a generic crash.
+            raise devguard.DeviceStateError(
+                "arena.consume",
                 "packed counter arena overflow-pool error: "
                 + "; ".join(what)
                 + " — grow pool_capacity/promote_k or use the f64 layout"
@@ -1044,53 +1058,60 @@ class PackedCounterArena(_ScalarLanesMixin):
     def ingest(self, windows, slots, values, times):
         idx = packed_flat_index(jnp.asarray(windows), jnp.asarray(slots),
                                 self.num_windows, self.capacity)
-        self.state = counter_ingest(
+        # the packed formulation is already the jnp path — the guard's
+        # fallback re-runs it with the faultpoints skipped (impl unused)
+        self.state = _guarded_ingest(lambda impl: counter_ingest(
             self.state, idx, jnp.asarray(values).astype(jnp.int64),
             jnp.asarray(times), self.num_windows, self.capacity,
-            self.widths, self.promote_k)
+            self.widths, self.promote_k))
 
     def consume(self, window: int):
         self._check_err()
-        return counter_consume(self.state, jnp.int32(window),
-                               self.capacity, self.widths)
+        return _guarded_consume(lambda: counter_consume(
+            self.state, jnp.int32(window), self.capacity, self.widths))
 
     def reset_window(self, window: int):
-        self.state = counter_reset_window(
+        self.state = _guarded_state_op(lambda: counter_reset_window(
             self.state, jnp.int32(window), self.num_windows,
-            self.capacity, self.widths)
+            self.capacity, self.widths))
 
     def clear_slots(self, slots):
-        self.state = counter_clear_slots(
+        self.state = _guarded_state_op(lambda: counter_clear_slots(
             self.state,
             jnp.asarray(pad_slots(np.asarray(slots), self.capacity)),
-            self.num_windows, self.capacity, self.widths)
+            self.num_windows, self.capacity, self.widths))
 
 
 class PackedGaugeArena(_ScalarLanesMixin):
     def __init__(self, num_windows: int, capacity: int):
         self.num_windows = num_windows
         self.capacity = capacity
+        self._mem = membudget.reserve(
+            "aggregator.gauge",
+            membudget.gauge_arena_bytes("packed", num_windows, capacity),
+            owner=self)
         self.state = gauge_init(num_windows, capacity)
 
     def ingest(self, windows, slots, values, times):
         idx = packed_flat_index(jnp.asarray(windows), jnp.asarray(slots),
                                 self.num_windows, self.capacity)
-        self.state = gauge_ingest(
+        self.state = _guarded_ingest(lambda impl: gauge_ingest(
             self.state, idx, jnp.asarray(values).astype(jnp.float64),
-            jnp.asarray(times), self.num_windows, self.capacity)
+            jnp.asarray(times), self.num_windows, self.capacity))
 
     def consume(self, window: int):
-        return gauge_consume(self.state, jnp.int32(window), self.capacity)
+        return _guarded_consume(lambda: gauge_consume(
+            self.state, jnp.int32(window), self.capacity))
 
     def reset_window(self, window: int):
-        self.state = gauge_reset_window(self.state, jnp.int32(window),
-                                        self.capacity)
+        self.state = _guarded_state_op(lambda: gauge_reset_window(self.state, jnp.int32(window),
+                                        self.capacity))
 
     def clear_slots(self, slots):
-        self.state = gauge_clear_slots(
+        self.state = _guarded_state_op(lambda: gauge_clear_slots(
             self.state,
             jnp.asarray(pad_slots(np.asarray(slots), self.capacity)),
-            self.num_windows, self.capacity)
+            self.num_windows, self.capacity))
 
 
 class PackedTimerArena(_TimerLanesMixin):
@@ -1103,6 +1124,11 @@ class PackedTimerArena(_TimerLanesMixin):
         self.capacity = capacity
         self.sample_capacity = sample_capacity
         self.quantiles = tuple(quantiles)
+        self._mem = membudget.reserve(
+            "aggregator.timer",
+            membudget.timer_arena_bytes("packed", num_windows, capacity,
+                                        sample_capacity),
+            owner=self)
         self.state = timer_init(num_windows, capacity, sample_capacity)
         self._sample_n_host = np.zeros(num_windows, np.int64)
 
@@ -1113,20 +1139,26 @@ class PackedTimerArena(_TimerLanesMixin):
                     & (slots_np >= 0) & (slots_np < self.capacity))
         per_w = np.bincount(windows_np[in_range],
                             minlength=self.num_windows)
-        self._sample_n_host += per_w
-        needed = int(self._sample_n_host.max())
+        # Commit-after-success (the ShardBuffer.write pattern): a
+        # _grow budget reject or device failure must leave the shadow
+        # mirroring state.sample_n, or every later batch re-rejects.
+        new_n = self._sample_n_host + per_w
+        needed = int(new_n.max())
         if needed > self.sample_capacity:
             self._grow(needed)
-        self.state = timer_ingest(
+        self.state = _guarded_ingest(lambda impl: timer_ingest(
             self.state, jnp.asarray(windows_np.astype(np.int32)),
             jnp.asarray(slots_np.astype(np.int32)),
             jnp.asarray(values).astype(jnp.float64),
-            jnp.asarray(times), self.capacity)
+            jnp.asarray(times), self.capacity))
+        self._sample_n_host = new_n
 
     def _grow(self, needed: int) -> None:
         new_cap = self.sample_capacity
         while new_cap < needed:
             new_cap *= 2
+        self._mem.resize(membudget.timer_arena_bytes(
+            "packed", self.num_windows, self.capacity, new_cap))
         pad = new_cap - self.sample_capacity
         empty = np.uint64(_timer_empty_word(self.capacity))
         self.state = PackedTimerState(
@@ -1138,16 +1170,16 @@ class PackedTimerArena(_TimerLanesMixin):
         self.sample_capacity = new_cap
 
     def consume(self, window: int):
-        return timer_consume(self.state, jnp.int32(window),
-                             self.capacity, self.quantiles)
+        return _guarded_consume(lambda: timer_consume(
+            self.state, jnp.int32(window), self.capacity, self.quantiles))
 
     def reset_window(self, window: int):
-        self.state = timer_reset_window(self.state, jnp.int32(window),
-                                        self.capacity)
+        self.state = _guarded_state_op(lambda: timer_reset_window(self.state, jnp.int32(window),
+                                        self.capacity))
         self._sample_n_host[window] = 0
 
     def clear_slots(self, slots):
-        self.state = timer_clear_slots(
+        self.state = _guarded_state_op(lambda: timer_clear_slots(
             self.state,
             jnp.asarray(pad_slots(np.asarray(slots), self.capacity)),
-            self.num_windows, self.capacity)
+            self.num_windows, self.capacity))

@@ -14,6 +14,7 @@ everything after ID resolution is the device arena path.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from typing import Dict, List, Sequence
 
@@ -53,6 +54,12 @@ class Downsampler:
         # output id -> tags for index writeback (rollup outputs carry
         # their kept tags; mapping outputs keep the source's tags)
         self._series_tags: Dict[bytes, dict] = {}
+        # One coarse lock over the MetricLists: write_batch runs on
+        # HTTP/carbon handler threads while the mediator drives flush
+        # and checkpointing — an unsynchronized flush racing an ingest
+        # would tear the arena state mid-snapshot (and a checkpoint of
+        # it would not be bit-exact).
+        self._lock = threading.Lock()
 
     def output_namespace(self, sp: StoragePolicy) -> str:
         """Aggregates write to the policy's own namespace (the reference
@@ -87,6 +94,11 @@ class Downsampler:
         (reference downsampler drop policies)."""
         ts = np.asarray(ts, np.int64)
         vals = np.asarray(vals, np.float64)
+        with self._lock:
+            return self._write_batch_locked(docs, ts, vals, metric_type)
+
+    def _write_batch_locked(self, docs, ts, vals,
+                            metric_type: MetricType) -> np.ndarray:
         keep = np.ones(len(docs), bool)
         # (policy, agg_id, output id, pipeline tail) -> idx list.  The
         # tail rides the batch key so rollup outputs register their
@@ -134,6 +146,10 @@ class Downsampler:
         (reference flush_handler.go → ingest write path).  Aggregated
         series IDs carry the aggregation-type suffix (reference id
         suffixing, e.g. `.p99` for timer quantiles)."""
+        with self._lock:
+            return self._flush_locked(now_nanos)
+
+    def _flush_locked(self, now_nanos: int) -> int:
         written = 0
         for sp, ml in self._lists.items():
             # Multi-stage rollups: consume self-delivers forwarded stage
@@ -180,3 +196,44 @@ class Downsampler:
                     )
                     written += len(ids)
         return written
+
+    # -- checkpoint/restore (aggregator/checkpoint.py; the mediator's
+    # checkpoint task + Assembly.drain drive save, run_node restore) ---
+
+    def checkpoint_to(self, path) -> int:
+        """Snapshot every (policy, MetricList) + the series-tag
+        registry, atomically, under the ingest lock (a torn snapshot
+        racing write_batch would not be bit-exact).  Returns bytes."""
+        from m3_tpu.aggregator import checkpoint
+
+        with self._lock:
+            return checkpoint.save_lists(
+                self._lists, path,
+                extra_meta={"series_tags": dict(self._series_tags)})
+
+    def restore_from(self, path) -> None:
+        """Rebuild the MetricLists from a checkpoint: open windows
+        resume exactly where the killed process left them (same slot
+        assignments, same lane bits, same consumed_until watermark).
+        Geometry comes from the checkpoint itself, not DownsamplerOpts
+        — a config resize applies to lists created AFTER restore."""
+        from m3_tpu.aggregator import checkpoint
+
+        def make_list(policy_str: str, opts: dict) -> MetricList:
+            sp = StoragePolicy.parse(policy_str)
+            return MetricList(sp, AggregatorOptions(
+                capacity=opts["capacity"],
+                num_windows=opts["num_windows"],
+                timer_sample_capacity=opts["timer_sample_capacity"],
+                quantiles=tuple(opts["quantiles"]),
+                timer_packed32=opts["timer_packed32"],
+                layout=opts["layout"],
+                storage_policies=(sp,),
+            ))
+
+        with self._lock:
+            lists, extra = checkpoint.restore_lists(path, make_list)
+            for policy_str, ml in lists.items():
+                self._lists[StoragePolicy.parse(policy_str)] = ml
+            for sid, tags in (extra.get("series_tags") or {}).items():
+                self._series_tags.setdefault(sid, tags)

@@ -258,6 +258,38 @@ class QueryConfig:
 
 
 @dataclasses.dataclass
+class DeviceConfig:
+    """Device-boundary resilience knobs (x/devguard + x/membudget).
+
+    ``mem_budget`` caps the bytes the process's device-resident
+    structures (arenas, series buffers, control tables, big transient
+    stage buffers) may reserve — 0 disables admission; accepts plain
+    bytes or K/M/G/T-suffixed strings (binary units).  Over-budget
+    construction rejects typed (DeviceBudgetExceeded) instead of
+    OOM-crashing inside XLA.  ``breaker_failures``/``breaker_reset``
+    are the per-stage fallback breakers' trip threshold and open →
+    half-open cool-down (the query breaker knobs' shape)."""
+
+    mem_budget: str = "0"
+    breaker_failures: int = 5
+    breaker_reset: str = "10s"
+
+    def validate(self, errs: list) -> None:
+        from m3_tpu.x.membudget import parse_bytes
+
+        try:
+            parse_bytes(self.mem_budget)
+        except ValueError as e:
+            errs.append(f"device.mem_budget: {e}")
+        if self.breaker_failures < 1:
+            errs.append("device.breaker_failures: must be >= 1")
+        try:
+            parse_duration(self.breaker_reset)
+        except ConfigError as e:
+            errs.append(f"device.breaker_reset: {e}")
+
+
+@dataclasses.dataclass
 class CoordinatorConfig:
     listen_host: str = "127.0.0.1"
     listen_port: int = 0  # 0 = ephemeral
@@ -277,10 +309,19 @@ class CoordinatorConfig:
     # round-8 sort/segment formulation; f64 = the scatter-arena parity
     # oracle — see aggregator/arena.py + aggregator/packed.py).
     arena_layout: str = ""
+    # Aggregation-arena checkpointing (aggregator/checkpoint.py): the
+    # downsampler's open windows are snapshotted bit-exactly to
+    # <db.root>/checkpoint/aggregator.ckpt every N mediator ticks (and
+    # on SIGTERM drain) and restored on boot — a SIGKILL mid-window
+    # resumes instead of losing up to a resolution window of acked
+    # samples.  0 disables (requires downsample: true to matter).
+    checkpoint_every: int = 0
 
     def validate(self, errs: list) -> None:
         if not (0 <= self.listen_port < 65536):
             errs.append("coordinator.listen_port: out of range")
+        if self.checkpoint_every < 0:
+            errs.append("coordinator.checkpoint_every: must be >= 0")
         for f in ("carbon_listen_port", "admin_listen_port"):
             v = getattr(self, f)
             if v is not None and not (0 <= v < 65536):
@@ -312,6 +353,7 @@ class NodeConfig:
     )
     mediator: MediatorConfig = dataclasses.field(default_factory=MediatorConfig)
     query: QueryConfig = dataclasses.field(default_factory=QueryConfig)
+    device: DeviceConfig = dataclasses.field(default_factory=DeviceConfig)
     metrics_prefix: str = "m3tpu"
 
     def validate(self) -> None:
@@ -321,6 +363,7 @@ class NodeConfig:
             self.coordinator.validate(errs)
         self.mediator.validate(errs)
         self.query.validate(errs)
+        self.device.validate(errs)
         if errs:
             raise ConfigError("; ".join(errs))
 
@@ -331,6 +374,7 @@ _NESTED = {
     "coordinator": CoordinatorConfig,
     "mediator": MediatorConfig,
     "query": QueryConfig,
+    "device": DeviceConfig,
 }
 # Optional nested sections: an explicit `field: null` disables the
 # subsystem (yields None) instead of instantiating defaults.

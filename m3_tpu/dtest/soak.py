@@ -99,6 +99,14 @@ class SoakConfig:
     t_kill: float = 60.0
     t_corrupt: float = 45.0
     wire_spec: str = "rpc.server=delay:ms=25:p=0.5;rpc.server=drop:p=0.1"
+    # device-fault window (x/devguard seam): every guarded device
+    # dispatch on the target node fails typed for t_device seconds —
+    # the ingest buffer degrades to its host staging path, the stage
+    # breaker trips, and the zero-acked-loss verdict must still hold
+    # (ISSUE 13's acceptance dtest, riding the soak's own ledger).
+    # 0 disables the window.
+    t_device: float = 30.0
+    device_spec: str = "device.dispatch=error"
     replace: bool = True
 
     @classmethod
@@ -113,6 +121,7 @@ class SoakConfig:
             query_interval_s=1.0,
             hist_series=200, hist_points=2, verify_batch=5_000, smoke=True,
             t_healthy=6.0, t_wire=10.0, t_kill=0.0, t_corrupt=0.0,
+            t_device=8.0,
             wire_spec="rpc.server=delay:ms=10:p=0.4;rpc.server=drop:p=0.05",
             replace=False,
         )
@@ -144,6 +153,16 @@ def build_timeline(cfg: SoakConfig) -> List[ChaosEvent]:
                          arg=cfg.wire_spec))
     t += cfg.t_wire
     ev.append(ChaosEvent(t - 1, "clear_faults", node=1 % cfg.nodes))
+    if cfg.t_device > 0:
+        # Device-boundary faults on node 0 (always a write target of
+        # the replicated session): guarded stages fail typed, the
+        # buffer append degrades to host staging, breakers trip —
+        # acked samples must all verify after the window clears.
+        ev.append(ChaosEvent(t, "phase", arg="device_faults"))
+        ev.append(ChaosEvent(t + 1, "device_fault", node=0,
+                             arg=cfg.device_spec))
+        t += cfg.t_device
+        ev.append(ChaosEvent(t - 1, "clear_faults", node=0))
     victim = cfg.nodes - 1
     if cfg.t_kill > 0:
         ev.append(ChaosEvent(t, "phase", arg="sigkill"))

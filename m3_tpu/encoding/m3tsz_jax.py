@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
 
 import numpy as np
 
@@ -52,6 +53,7 @@ from jax import lax
 from m3_tpu.core.xtime import Unit
 from m3_tpu.encoding import f64_emul as fe
 from m3_tpu.encoding.scheme import tail_bytes
+from m3_tpu.x import devguard, membudget
 
 U64 = jnp.uint64
 I64 = jnp.int64
@@ -585,6 +587,15 @@ def resolved_place() -> str:
     return "pallas" if jax.default_backend() == "tpu" else "gather"
 
 
+def fallback_place(place: str) -> str:
+    """The devguard stepping-down rule for the encode placement seam,
+    owned ONCE (encode_batch_device + parallel/sharded_encode): a
+    classified device failure re-runs through the cheap-compile jnp
+    scatter tail, or gather when scatter IS the primary — every tail
+    is byte-identical, so the choice is purely about compile cost."""
+    return "scatter" if place != "scatter" else "gather"
+
+
 def _lane_frags(valq, pos, n):
     """One (value, bit offset, width) lane class -> its two word
     fragments.  ``valq`` holds the field right-aligned (low ``n``
@@ -629,9 +640,30 @@ def encode_batch_device(timestamps, value_bits, start, valid, unit: int = 1,
     if place not in _PLACE_IMPLS:
         raise ValueError(f"place={place!r}: expected one of "
                          f"{_PLACE_IMPLS + ('auto',)}")
-    return _encode_batch_device(
-        timestamps, value_bits, start, valid, unit=unit,
-        out_words=out_words, prefix_bits=prefix_bits, place=place)
+    S, T = timestamps.shape
+    ow = out_words if out_words else (T * 16) // 64 + 4
+
+    def _run(p: str):
+        # the jitted program with the placement as a STATIC argument —
+        # the guard's fallback is just a different static value, so
+        # nothing retraces and the happy path stays transfer-free
+        # (hops --check)
+        return _encode_batch_device(
+            timestamps, value_bits, start, valid, unit=unit,
+            out_words=out_words, prefix_bits=prefix_bits, place=p)
+
+    # device-guard seam: a classified device failure re-runs the SAME
+    # batch through the cheap-compile jnp scatter tail (or gather when
+    # scatter IS the primary) — all placements are byte-identical
+    # (PINNED_ENCODE_DIGEST + the fuzz suite pin every tail).  Budget
+    # admission for the transient lane tables happens ONCE, outside
+    # the guard: the fallback reserves the same bytes, so an admission
+    # reject is not a device fault the fallback can relieve — it
+    # raises typed here without touching the stage breaker.
+    with membudget.transient("encode.lanes",
+                             membudget.encode_lane_bytes(S, T, ow)):
+        return devguard.run_guarded("encode", lambda: _run(place),
+                                    lambda: _run(fallback_place(place)))
 
 
 def _encode_carry0(S: int, start, unit: int):
@@ -1078,7 +1110,21 @@ def value_ctrl_table():
     (constant-bloat; the finding that motivated the rule).  Uncommitted
     (plain jnp.asarray, no device pin) so the sharded paths can
     replicate it across the mesh without a resharding error."""
+    global _CTRL_TBL_RESERVED
+    # lru_cache does not serialize concurrent first calls — the lock
+    # keeps two first decoders from double-reserving the ledger entry
+    with _CTRL_TBL_LOCK:
+        if not _CTRL_TBL_RESERVED:
+            # one permanent ~1MiB ledger entry for the resident control
+            # table (x/membudget admission; never released — the table
+            # lives for the process)
+            membudget.reserve("decode.ctrl_table", _VALUE_CTRL_TBL.nbytes)
+            _CTRL_TBL_RESERVED = True
     return jnp.asarray(_VALUE_CTRL_TBL, dtype=jnp.uint32)
+
+
+_CTRL_TBL_RESERVED = False
+_CTRL_TBL_LOCK = threading.Lock()
 
 
 def _decode_step(carry, _, words, nbits, unit0, ctrl_tbl,
@@ -1596,6 +1642,14 @@ def resolved_chains() -> str:
     return "gather" if jax.default_backend() == "tpu" else "fused"
 
 
+def fallback_chains(chains: str) -> str:
+    """The devguard stepping-down rule for the decode chains seam,
+    owned ONCE (decode_batch_device + parallel/sharded_decode): step
+    down to the OTHER tail (the fused tail also pins extract="jnp",
+    so a failing Pallas extraction kernel steps down with it)."""
+    return "fused" if chains != "fused" else "gather"
+
+
 def _resolved_extract(chains: str) -> str:
     """The phase-2 field-extraction impl for a chains tail, resolved on
     the host: only the gather tail runs the extraction pass, so the
@@ -1663,10 +1717,26 @@ def decode_batch_device(words, nbits, max_points: int, default_unit: int = 1,
     if chains not in _CHAIN_IMPLS:
         raise ValueError(f"chains={chains!r}: expected one of "
                          f"{_CHAIN_IMPLS + ('auto',)}")
-    return _decode_batch_device(
-        words, nbits, value_ctrl_table(), max_points=max_points,
-        default_unit=default_unit, chains=chains, scan_major=scan_major,
-        extract=_resolved_extract(chains))
+    S, Wp = words.shape
+
+    def _run(ch: str):
+        return _decode_batch_device(
+            words, nbits, value_ctrl_table(), max_points=max_points,
+            default_unit=default_unit, chains=ch,
+            scan_major=scan_major, extract=_resolved_extract(ch))
+
+    # device-guard seam: the fallback rides the OTHER chains tail as a
+    # static argument (the fused tail also pins extract="jnp", so a
+    # failing Pallas extraction kernel steps down with it) — both tails
+    # are bit-identical, corpus sha256 + fuzz pinned.  Lane-table
+    # admission is ONCE, outside the guard (encode_batch_device's
+    # rationale: an admission reject is not a fault the fallback can
+    # relieve — typed raise, no breaker).
+    with membudget.transient(
+            "decode.lanes",
+            membudget.decode_lane_bytes(S, Wp, max_points)):
+        return devguard.run_guarded("decode", lambda: _run(chains),
+                                    lambda: _run(fallback_chains(chains)))
 
 
 @functools.partial(jax.jit,

@@ -81,9 +81,18 @@ def decode_batch_device_sharded(words, nbits, max_points: int,
     if pad:
         words = jnp.pad(words, ((0, pad), (0, 0)))
         nbits = jnp.pad(nbits, (0, pad))
-    out = _sharded_fn(n_dev, max_points, default_unit, chains, scan_major,
-                      codec._resolved_extract(chains))(
-        words, nbits, codec.value_ctrl_table())
+    def _run(ch: str):
+        return _sharded_fn(n_dev, max_points, default_unit, ch, scan_major,
+                           codec._resolved_extract(ch))(
+            words, nbits, codec.value_ctrl_table())
+
+    # same guard + static-seam fallback as the codec's own wrapper
+    # (m3tsz_jax.decode_batch_device)
+    from m3_tpu.x import devguard
+
+    out = devguard.run_guarded(
+        "decode", lambda: _run(chains),
+        lambda: _run(codec.fallback_chains(chains)))
     if pad:
         sl = ((slice(None), slice(None, S)) if scan_major
               else (slice(None, S), slice(None)))

@@ -89,11 +89,22 @@ def encode_batch_device_sharded(timestamps, value_bits, start, valid,
         valid = jnp.pad(valid, ((0, pad), (0, 0)))
         if prefix_bits is not None:
             prefix_bits = jnp.pad(prefix_bits, (0, pad))
-    fn = _sharded_fn(n_dev, unit, out_words, place, prefix_bits is not None)
     args = (timestamps, value_bits, start, valid)
     if prefix_bits is not None:
         args = args + (prefix_bits,)
-    out = fn(*args)
+
+    def _run(p: str):
+        return _sharded_fn(n_dev, unit, out_words, p,
+                           prefix_bits is not None)(*args)
+
+    # same guard + static-seam fallback as the codec's own wrapper
+    # (m3tsz_jax.encode_batch_device) — the sharded dispatch is a
+    # distinct stage entry point, so it gets its own guarded call
+    from m3_tpu.x import devguard
+
+    out = devguard.run_guarded(
+        "encode", lambda: _run(place),
+        lambda: _run(codec.fallback_place(place)))
     if pad:
         out = {k: v[:S] for k, v in out.items()}
     return out

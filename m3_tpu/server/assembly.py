@@ -39,6 +39,8 @@ class Assembly:
     migrator: object | None = None   # storage.migration.ShardMigrator
     query_server: object | None = None  # query.remote.QueryServer
     remote_stores: list = dataclasses.field(default_factory=list)
+    downsampler: object | None = None   # coordinator.downsample.Downsampler
+    checkpointer: object | None = None  # aggregator.checkpoint driver
 
     @property
     def port(self) -> int | None:
@@ -126,6 +128,13 @@ class Assembly:
             self.db.snapshot()
         except Exception:  # noqa: BLE001 — drain must reach close()
             log.exception("drain: final flush/snapshot failed")
+        if self.checkpointer is not None:
+            # Final arena checkpoint: a SIGTERM'd aggregator resumes
+            # its open windows on restart (aggregator/checkpoint.py)
+            try:
+                self.checkpointer.save()
+            except Exception:  # noqa: BLE001 — drain must reach close()
+                log.exception("drain: aggregator checkpoint failed")
         if self.migrator is not None:
             if not self.migrator.wait_handed_off(handoff_timeout_s):
                 log.warning(
@@ -185,6 +194,15 @@ def run_node(source, start_mediator: bool | None = None,
         # Must land BEFORE any MetricList is built: arenas bind their
         # layout at construction (aggregator/arena.py layout seam).
         arena.set_arena_layout(cfg.coordinator.arena_layout)
+    # Device-boundary knobs FIRST: the memory budget must be installed
+    # before any arena/buffer reserves against it, and the stage
+    # breakers bind their thresholds at first guarded call.
+    from m3_tpu.x import devguard as _devguard, membudget as _membudget
+
+    _membudget.set_budget(cfg.device.mem_budget)
+    _devguard.configure(
+        failures=cfg.device.breaker_failures,
+        reset_s=parse_duration(cfg.device.breaker_reset) / 1e9)
     registry = instrument.new_registry()
     scope = registry.scope(cfg.metrics_prefix)
     # Mirror the process-global fault/retry counters onto this node's
@@ -338,6 +356,35 @@ def run_node(source, start_mediator: bool | None = None,
             budget_volumes=cfg.mediator.scrub_volumes, instrument=scope,
         )
 
+        # Downsampler BEFORE the mediator: its window drain and arena
+        # checkpoint ride the mediator tick, and a checkpoint restore
+        # must land before any traffic re-opens the windows.
+        downsampler = None
+        if (serve_http and cfg.coordinator is not None
+                and cfg.coordinator.downsample):
+            from m3_tpu.coordinator.downsample import Downsampler
+
+            downsampler = Downsampler(
+                db, ruleset, namespace=cfg.coordinator.namespace
+            )
+            asm.downsampler = downsampler
+            if cfg.coordinator.checkpoint_every > 0:
+                from pathlib import Path as _Path
+
+                from m3_tpu.aggregator.checkpoint import (
+                    AggregatorCheckpointer,
+                )
+
+                asm.checkpointer = AggregatorCheckpointer(
+                    downsampler,
+                    _Path(cfg.db.root) / "checkpoint" / "aggregator.ckpt",
+                    instrument=scope,
+                )
+                # Resume open aggregation windows from the last
+                # checkpoint (SIGKILL/SIGTERM recovery); a corrupt file
+                # is moved aside and the node boots fresh.
+                asm.checkpointer.restore()
+
         if cfg.mediator.enabled if start_mediator is None else start_mediator:
             asm.mediator = Mediator(
                 db,
@@ -349,18 +396,15 @@ def run_node(source, start_mediator: bool | None = None,
                 scrub_every=cfg.mediator.scrub_every,
                 migrator=asm.migrator,
                 migrate_every=cfg.mediator.migrate_every,
+                downsampler=downsampler,
+                checkpointer=asm.checkpointer,
+                checkpoint_every=(cfg.coordinator.checkpoint_every
+                                  if cfg.coordinator is not None else 0),
                 instrument=scope,
             )
             asm.mediator.open()
 
-        downsampler = None
         if serve_http and cfg.coordinator is not None:
-            if cfg.coordinator.downsample:
-                from m3_tpu.coordinator.downsample import Downsampler
-
-                downsampler = Downsampler(
-                    db, ruleset, namespace=cfg.coordinator.namespace
-                )
             from m3_tpu.x.admission import AdmissionController
 
             admission = AdmissionController(
@@ -378,6 +422,7 @@ def run_node(source, start_mediator: bool | None = None,
                 slow_query_fraction=cfg.query.slow_query_fraction,
                 remotes=asm.remote_stores,
                 remotes_required=cfg.query.remotes_required,
+                checkpointer=asm.checkpointer,
             )
 
             # Admission/slow-query observability: query_active,

@@ -248,12 +248,49 @@ class _Handler(BaseHTTPRequestHandler):
             q = self.ctx.query_status()
             from m3_tpu.x.breaker import all_breakers
 
-            breakers = {name: br.state for name, br in all_breakers().items()}
+            # peer breakers only: stage:* breakers (x/devguard) report
+            # through the `device` section below, not the query view
+            breakers = {name: br.state
+                        for name, br in all_breakers().items()
+                        if br.kind == "peer"}
             if breakers:
                 q["breakers"] = breakers
             if (breakers or q["max_concurrent"] > 0
                     or q["slow_query_total"] or q["shed_total"]):
                 out["query"] = q
+        except Exception:  # noqa: BLE001 — health must never 500
+            pass
+        # Device-boundary visibility: per-stage guard counters +
+        # breaker states (x/devguard), the HBM budget ledger
+        # (x/membudget), and the arena checkpoint driver — the
+        # operator's window into a degraded device path that is still
+        # serving.  Health reports DEGRADATION, not activity: a stage
+        # appears once it has errors/fallbacks or a non-closed breaker
+        # (full happy-path counters live on /metrics), so a clean
+        # node's health stays noise-free.
+        try:
+            from m3_tpu.x import devguard, membudget
+
+            dev = devguard.status()
+            mb = membudget.snapshot()
+            section = {}
+            degraded = {
+                st: doc for st, doc in dev["stages"].items()
+                if doc.get("errors") or doc.get("fallback_calls")
+                or doc.get("breaker", "closed") != "closed"
+            }
+            if degraded:
+                section["stages"] = degraded
+            # used_bytes alone is NOT a signal — every node's buffers
+            # reserve bytes; the ledger is health-worthy only once a
+            # budget is configured (or something was rejected before
+            # one was)
+            if mb["budget_bytes"] or mb["rejected_total"]:
+                section["membudget"] = mb
+            if self.ctx.checkpointer is not None:
+                section["checkpoint"] = self.ctx.checkpointer.status()
+            if section:
+                out["device"] = section
         except Exception:  # noqa: BLE001 — health must never 500
             pass
         return self._json(200, out)
@@ -624,13 +661,14 @@ class ApiContext:
                  query_timeout_s: float = 30.0,
                  slow_query_fraction: float = 0.75,
                  remotes=None, remotes_required: bool = False,
-                 metrics_scope=None):
+                 metrics_scope=None, checkpointer=None):
         self.db = db
         self.namespace = namespace
         self.downsampler = downsampler
         self.registry = registry
         self.tracer = tracer
         self.migrator = migrator  # storage.migration.ShardMigrator | None
+        self.checkpointer = checkpointer  # aggregator checkpoint driver
         # read-path overload controls (see module docstring); the
         # default AdmissionController(0) gates nothing
         self.admission = admission or AdmissionController()

@@ -20,6 +20,21 @@ Out-of-window writes (cold writes / too-late / too-future) never touch the
 device: the host routes them to a per-block overflow list, flushed as a
 higher fileset volume (the reference's cold flush,
 `storage/coldflush.go` + `fs_merge_with_mem.go`).
+
+Device-fault contract (round 12): the two device entry points —
+``buffer_append`` on the write path, ``buffer_drain`` on the
+seal/snapshot/read path — run behind the ``x.devguard`` seam.  A
+classified device failure (XLA OOM, lost device, an over-budget grow
+rejected by ``x.membudget``) degrades instead of dropping acked
+samples: the append falls back to staging the batch on the SAME host
+overflow lists the cold path uses (readable immediately via
+``read_sources``, snapshot-covered, merged in by the next cold flush
+AFTER the block seals), and the drain falls back to a bit-identical
+numpy sort+dedupe of the transferred columns.  The stage breakers
+(``storage.buffer_append`` / ``storage.buffer_drain``) trip after
+consecutive failures, skip the device entirely while open, and
+half-open re-probe it — visible on /metrics and /health like every
+other edge.
 """
 
 from __future__ import annotations
@@ -30,6 +45,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from m3_tpu.x import devguard, membudget
 
 
 class BufferState(NamedTuple):
@@ -159,7 +176,19 @@ class ShardBuffer:
         self.num_windows = num_windows
         self.sample_capacity = sample_capacity
         self.slot_capacity = slot_capacity
+        # Admission before allocation: an over-budget ring rejects
+        # typed (DeviceBudgetExceeded) here instead of OOM-ing inside
+        # XLA; released automatically when this buffer is collected.
+        self._mem = membudget.reserve(
+            "storage.buffer",
+            membudget.buffer_bytes(num_windows, sample_capacity),
+            owner=self)
         self.state = buffer_init(num_windows, sample_capacity, slot_capacity)
+        # Warm samples routed to the host overflow lists while the
+        # device path is degraded (the buffer_append fallback); counted
+        # for /metrics-style visibility through devguard's counters and
+        # surfaced per-buffer for tests.
+        self.degraded_staged = 0
         self._n_host = np.zeros(num_windows, np.int64)
         # block_start -> ring row for open windows
         self.open_blocks: dict[int, int] = {}
@@ -193,29 +222,55 @@ class ShardBuffer:
                     (slots[sel].copy(), ts[sel].copy(), vals[sel].copy())
                 )
         if warm.any():
-            self._version += 1  # sorted snapshots are now stale
             wslots, wts, wvals = slots[warm], ts[warm], vals[warm]
             wstarts = block_starts[warm]
-            rows = ((wstarts // self.block_size) % self.num_windows).astype(np.int32)
-            for bs in np.unique(wstarts):
-                self.open_blocks[int(bs)] = self._row_for(int(bs))
-            per_row = np.bincount(rows, minlength=self.num_windows)
-            self._n_host += per_row
-            if self._n_host.max() > self.sample_capacity:
-                self._grow(int(self._n_host.max()))
-            self.state = buffer_append(
-                self.state,
-                jnp.asarray(rows),
-                jnp.asarray(wslots.astype(np.int32)),
-                jnp.asarray(wts.astype(np.int64)),
-                jnp.asarray(wvals.astype(np.float64)),
-            )
+
+            def _device_append():
+                self._version += 1  # sorted snapshots are now stale
+                rows = ((wstarts // self.block_size)
+                        % self.num_windows).astype(np.int32)
+                for bs in np.unique(wstarts):
+                    self.open_blocks[int(bs)] = self._row_for(int(bs))
+                per_row = np.bincount(rows, minlength=self.num_windows)
+                if (self._n_host + per_row).max() > self.sample_capacity:
+                    self._grow(int((self._n_host + per_row).max()))
+                state = buffer_append(
+                    self.state,
+                    jnp.asarray(rows),
+                    jnp.asarray(wslots.astype(np.int32)),
+                    jnp.asarray(wts.astype(np.int64)),
+                    jnp.asarray(wvals.astype(np.float64)),
+                )
+                self._n_host += per_row
+                self.state = state
+
+            def _host_stage():
+                # Degraded path: warm samples land on the SAME host
+                # overflow lists the cold path owns — acked samples
+                # stay readable (read_sources serves the cold lists)
+                # and snapshot-covered; cold_flush merges them in only
+                # AFTER the block seals (Namespace.tick passes the
+                # open-window skip set), so the sealed warm volume is
+                # never overwritten by an early degraded flush.
+                for bs in np.unique(wstarts):
+                    sel = wstarts == bs
+                    self.cold.setdefault(int(bs), []).append(
+                        (wslots[sel].copy(), wts[sel].copy(),
+                         wvals[sel].copy()))
+                self.degraded_staged += len(wslots)
+
+            devguard.run_guarded("storage.buffer_append",
+                                 _device_append, _host_stage)
         return ncold
 
     def _grow(self, needed: int) -> None:
         new_cap = self.sample_capacity
         while new_cap < needed:
             new_cap *= 2
+        # Admit the growth BEFORE padding: an over-budget grow raises
+        # typed inside the guarded append, which degrades this batch to
+        # the host staging path instead of OOM-ing in XLA.
+        self._mem.resize(membudget.buffer_bytes(self.num_windows, new_cap))
         pad = new_cap - self.sample_capacity
         imax = np.iinfo(np.int64).max
         self.state = BufferState(
@@ -227,6 +282,35 @@ class ShardBuffer:
         )
         self.sample_capacity = new_cap
 
+    def _drain_row(self, row: int):
+        """One window's (slot, ts, val, first) as host arrays, behind
+        the ``storage.buffer_drain`` guard: the device sort falls back
+        to a bit-identical numpy lexsort of the transferred columns
+        when the device path is degraded."""
+
+        def _device():
+            s_slot, s_ts, s_val, first = buffer_drain(
+                self.state, jnp.int32(row))
+            devguard.transfer_point("storage.buffer_drain")
+            return (np.asarray(s_slot), np.asarray(s_ts),
+                    np.asarray(s_val), np.asarray(first))
+
+        return devguard.run_guarded("storage.buffer_drain", _device,
+                                    lambda: self._host_drain(row))
+
+    def _host_drain(self, row: int):
+        """Numpy mirror of :func:`buffer_drain` — same (slot, ts,
+        arrival-desc) order, same first mask; the degraded-mode tail."""
+        slot_w = np.asarray(self.state.slot)[row]
+        ts_w = np.asarray(self.state.ts)[row]
+        val_w = np.asarray(self.state.val)[row]
+        arrival = np.arange(len(slot_w))
+        order = np.lexsort((-arrival, ts_w, slot_w))
+        s_slot, s_ts, s_val = slot_w[order], ts_w[order], val_w[order]
+        first = np.ones(len(s_slot), bool)
+        first[1:] = (s_slot[1:] != s_slot[:-1]) | (s_ts[1:] != s_ts[:-1])
+        return s_slot, s_ts, s_val, first
+
     def drain(self, block_start: int):
         """Seal one open block: device sort+dedupe, then host-side
         ragged split.  Returns (slots, ts, vals) sorted by (slot, ts)
@@ -234,10 +318,9 @@ class ShardBuffer:
         row = self.open_blocks.pop(block_start, None)
         if row is None:
             return (np.empty(0, np.int32), np.empty(0, np.int64), np.empty(0))
-        s_slot, s_ts, s_val, first = buffer_drain(self.state, jnp.int32(row))
-        s_slot = np.asarray(s_slot)
-        keep = np.asarray(first) & (s_slot < self.slot_capacity)
-        out = (s_slot[keep], np.asarray(s_ts)[keep], np.asarray(s_val)[keep])
+        s_slot, s_ts, s_val, first = self._drain_row(row)
+        keep = first & (s_slot < self.slot_capacity)
+        out = (s_slot[keep], s_ts[keep], s_val[keep])
         self._reset_row(row)
         return out
 
@@ -274,10 +357,9 @@ class ShardBuffer:
         hit = self._snap.get(block_start)
         if hit is not None and hit[0] == self._version:
             return hit[1:]
-        s_slot, s_ts, s_val, first = buffer_drain(self.state, jnp.int32(row))
-        s_slot = np.asarray(s_slot)
-        keep = np.asarray(first) & (s_slot < self.slot_capacity)
-        out = (s_slot[keep], np.asarray(s_ts)[keep], np.asarray(s_val)[keep])
+        s_slot, s_ts, s_val, first = self._drain_row(row)
+        keep = first & (s_slot < self.slot_capacity)
+        out = (s_slot[keep], s_ts[keep], s_val[keep])
         # one snapshot per OPEN window (reads alternate between open
         # blocks per series — a single-entry cache would thrash back to
         # O(window) per read); closed windows' entries are pruned here

@@ -233,11 +233,21 @@ class Shard:
         self.flushed_blocks.add(block_start)
         return len(series)
 
-    def cold_flush(self) -> int:
+    def cold_flush(self, skip_open: frozenset = frozenset()) -> int:
         """Merge cold overflow writes with the existing volume and write
-        volume+1 (reference coldflush.go + fs/merger.go)."""
+        volume+1 (reference coldflush.go + fs/merger.go).
+
+        ``skip_open`` holds block starts still inside the warm window:
+        their overflow entries are DEGRADED-MODE staging from the
+        guarded buffer append (warm samples host-routed while the
+        device path is down), and flushing them before the block seals
+        would race the later warm flush for volume numbering.  They
+        stay readable from the overflow lists and are merged by the
+        cold flush that follows the seal."""
         flushed = 0
         for block_start in sorted(self.buffer.cold.keys()):
+            if block_start in skip_open:
+                continue
             slots, ts, vals = self.buffer.drain_cold(block_start)
             if len(slots) == 0:
                 continue
@@ -619,7 +629,8 @@ class Namespace:
                 stats["warm_flushed"] += shard.warm_flush(bs)
                 sealed_blocks.add(bs)
             if self.opts.cold_writes_enabled:
-                stats["cold_flushed"] += shard.cold_flush()
+                stats["cold_flushed"] += shard.cold_flush(
+                    skip_open=frozenset(open_now))
         # Index blocks seal alongside their data blocks (reference index
         # flush rides the same mediator file-system pass, mediator.go:318).
         for bs in sorted(sealed_blocks):

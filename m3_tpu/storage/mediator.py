@@ -44,6 +44,9 @@ class Mediator:
         scrub_every: int = 1,
         migrator=None,
         migrate_every: int = 1,
+        downsampler=None,
+        checkpointer=None,
+        checkpoint_every: int = 0,
         instrument=None,
     ):
         self.db = db
@@ -61,6 +64,17 @@ class Mediator:
         # runs off this same thread, budgeted per tick like the scrub.
         self.migrator = migrator
         self.migrate_every = max(1, migrate_every)
+        # Optional coordinator Downsampler: its window drain rides the
+        # maintenance loop (the reference coordinator's flush manager
+        # role) — without this, a live node's downsampled aggregates
+        # would only ever flush on drain.
+        self.downsampler = downsampler
+        # Optional aggregator.checkpoint.AggregatorCheckpointer: the
+        # arena checkpoint rides the tick cadence (plus SIGTERM drain),
+        # so a SIGKILL loses at most checkpoint_every ticks of window
+        # state; 0 disables the periodic save.
+        self.checkpointer = checkpointer
+        self.checkpoint_every = checkpoint_every
         self._ticks = 0
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -101,6 +115,21 @@ class Mediator:
                 # and a due drop frees its volumes before the sweep
                 # re-lists them.
                 stats["topology"] = self.migrator.tick()
+            if self.downsampler is not None:
+                try:
+                    stats["downsample_flushed"] = self.downsampler.flush(now)
+                except Exception:  # noqa: BLE001 — one bad drain must
+                    # not disable flush/snapshot/cleanup for the pass
+                    _LOG.exception("mediator: downsampler flush failed")
+                    if self._scope is not None:
+                        self._scope.counter("downsample_flush_errors").inc()
+            if (self.checkpointer is not None and self.checkpoint_every > 0
+                    and self._ticks % self.checkpoint_every == 0):
+                try:
+                    stats["checkpoint"] = self.checkpointer.save()
+                except Exception:  # noqa: BLE001 — counted by the
+                    # checkpointer; the tick's remaining stages still run
+                    _LOG.exception("mediator: aggregator checkpoint failed")
             if (self.scrubber is not None
                     and self._ticks % self.scrub_every == 0):
                 # Non-blocking: an admin-triggered whole-disk scrub in

@@ -35,6 +35,16 @@
   ``cli hops`` drives the wire→arena→drain→encode→fileset path under
   it and commits the PIPELINE artifact ROADMAP item 1 rebuilds
   against.
+* ``m3_tpu.x.devguard`` — the device-boundary resilience seam: typed
+  ``DeviceError`` classification over jax/XLA exception shapes,
+  per-stage fallback breakers (``run_guarded``), and the
+  ``device.compile``/``device.dispatch``/``device.transfer``
+  faultpoints so synthetic device failures are injectable on live
+  nodes through ``/api/v1/debug/faults``.
+* ``m3_tpu.x.membudget`` — process-level device-memory ledger: arenas,
+  series buffers and big transient stage buffers reserve bytes BEFORE
+  XLA allocates; over ``M3_DEVICE_MEM_BUDGET`` rejects typed
+  (``DeviceBudgetExceeded``) instead of dying inside the runtime.
 * ``m3_tpu.x.lint`` — m3lint, the codebase-aware static analyzer
   (``python -m m3_tpu.tools.cli lint``); its rule families are the
   static mirror of what fault/retry/lockcheck/tracewatch enforce at
@@ -60,6 +70,7 @@ from m3_tpu.x import lockcheck  # noqa: F401  (env-armed seam)
 from m3_tpu.x import tracewatch  # noqa: F401  (env-armed seam)
 from m3_tpu.x import hopwatch  # noqa: F401  (env-armed seam)
 from m3_tpu.x import breaker, deadline, fault, retry
+from m3_tpu.x import devguard, membudget  # noqa: F401  (device guard)
 
 
 def register_metrics(registry, prefix: str = "") -> object:
@@ -82,11 +93,35 @@ def register_metrics(registry, prefix: str = "") -> object:
         scope.gauge("query_cancelled_total").update(
             dl.get("deadline.cancelled", 0))
         for peer, br in breaker.all_breakers().items():
-            scope.tagged({"peer": peer}).gauge("breaker_state").update(
-                br.state_code)
+            scope.tagged({"peer": peer, "kind": br.kind}).gauge(
+                "breaker_state").update(br.state_code)
         for name, value in breaker.counters().items():
             peer, _, key = name.rpartition(".")
             scope.tagged({"peer": peer}).gauge(f"breaker.{key}").update(value)
+        # device-guard stage counters: device.<stage>.calls /
+        # .fallback_calls / .errors.<kind> (stage names contain dots —
+        # split on the known suffixes, the devguard.status() rule)
+        for name, value in devguard.counters().items():
+            rest = name[len("device."):]
+            if rest.endswith(".calls") and not rest.endswith(
+                    ".fallback_calls"):
+                scope.tagged({"stage": rest[:-len(".calls")]}).gauge(
+                    "device_guard_calls").update(value)
+            elif rest.endswith(".fallback_calls"):
+                scope.tagged(
+                    {"stage": rest[:-len(".fallback_calls")]}).gauge(
+                    "device_fallback_total").update(value)
+            else:
+                st, _, kind = rest.rpartition(".errors.")
+                if st:
+                    scope.tagged({"stage": st, "kind": kind}).gauge(
+                        "device_error_total").update(value)
+        mb = membudget.snapshot()
+        scope.gauge("device_mem_budget_bytes").update(mb["budget_bytes"])
+        scope.gauge("device_mem_used_bytes").update(mb["used_bytes"])
+        scope.gauge("device_mem_peak_bytes").update(mb["peak_bytes"])
+        scope.gauge("device_mem_rejected_total").update(
+            mb["rejected_total"])
 
     registry.register_collector(collect)
     return collect

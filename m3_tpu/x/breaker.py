@@ -24,6 +24,14 @@ endpoint.  States are mirrored onto /metrics by
 (0=closed, 1=half-open, 2=open) plus open/trip counters — the overload
 dtest asserts the slow replica's breaker opening from outside the
 process.
+
+Round 12 generalized the registry to NAMESPACED keys: the name is
+still the registry key, but breakers carry a ``kind`` — ``"peer"``
+(every pre-existing caller, unchanged) or ``"stage"`` (the device
+guard's per-hot-path-stage breakers, keyed ``stage:<name>`` by
+``x.devguard``) — and ``breaker_state`` gains a matching ``kind``
+label so a dashboard can split peer health from device-stage health
+without parsing key prefixes.
 """
 
 from __future__ import annotations
@@ -79,8 +87,10 @@ class CircuitBreaker:
     def __init__(self, name: str, failure_threshold: int = 5,
                  reset_timeout_s: float = 10.0,
                  clock: Callable[[], float] = time.monotonic,
-                 is_failure: Callable[[BaseException], bool] | None = None):
+                 is_failure: Callable[[BaseException], bool] | None = None,
+                 kind: str = "peer"):
         self.name = name
+        self.kind = kind
         self.failure_threshold = int(failure_threshold)
         self.reset_timeout_s = float(reset_timeout_s)
         self._clock = clock
@@ -178,15 +188,17 @@ def default_breaker_failure(e: BaseException) -> bool:
 
 def breaker_for(peer: str, failure_threshold: int = 5,
                 reset_timeout_s: float = 10.0,
-                clock: Callable[[], float] = time.monotonic) -> CircuitBreaker:
+                clock: Callable[[], float] = time.monotonic,
+                kind: str = "peer") -> CircuitBreaker:
     """The process-wide breaker for ``peer``, created on first use.
-    Threshold/timeout apply on creation only — all sharers see one
-    state."""
+    Threshold/timeout/kind apply on creation only — all sharers see
+    one state.  ``kind`` labels the breaker_state metric ("peer" for
+    every wire caller; "stage" for x.devguard's per-stage breakers)."""
     with _lock:
         br = _registry.get(peer)
         if br is None:
             br = CircuitBreaker(peer, failure_threshold, reset_timeout_s,
-                                clock)
+                                clock, kind=kind)
             _registry[peer] = br
         return br
 

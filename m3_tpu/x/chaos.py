@@ -22,6 +22,15 @@ Two pieces:
   ``wire_fault`` arm faultpoints on a LIVE node through its
                  ``POST /api/v1/debug/faults`` (``ops.arm_faults``);
                  ``arg`` is the M3_FAULTPOINTS-grammar spec string
+  ``device_fault``  arm DEVICE-boundary faultpoints (``device.compile``
+                 / ``device.dispatch`` / ``device.transfer`` — the
+                 x/devguard seam) on a live node, same endpoint and
+                 grammar as ``wire_fault``; every point must be in the
+                 ``device.`` namespace (eager-validated) so a timeline
+                 cannot silently arm a wire point under the device
+                 phase label.  Error-mode triggers surface as typed
+                 DeviceOOM/CompileFailure/DeviceLost and trip the
+                 per-stage fallback breakers — no real TPU needed.
   ``clear_faults``  disarm every faultpoint on a node (same endpoint)
   ``corrupt``    byte-flip a flushed fileset volume on a node's disk
                  (``ops.corrupt(node, seed)`` — quarantine/scrub must
@@ -55,8 +64,8 @@ from m3_tpu.x import fault
 
 __all__ = ["ChaosEvent", "ChaosScheduler", "parse_timeline"]
 
-ACTIONS = ("phase", "kill", "restart", "wire_fault", "clear_faults",
-           "corrupt", "replace")
+ACTIONS = ("phase", "kill", "restart", "wire_fault", "device_fault",
+           "clear_faults", "corrupt", "replace")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -76,6 +85,15 @@ class ChaosEvent:
             raise ValueError(f"{self.action} event needs a 'node'")
         if self.action == "wire_fault":
             fault.parse_faults(self.arg)  # validate at BUILD time
+        if self.action == "device_fault":
+            specs = fault.parse_faults(self.arg)  # eager, like wire_fault
+            bad = [p for p, _, _ in specs if not p.startswith("device.")]
+            if bad:
+                raise ValueError(
+                    f"device_fault event arms non-device points {bad}: "
+                    "use wire_fault for wire-boundary points")
+            if not specs:
+                raise ValueError("device_fault events need a spec in 'arg'")
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -184,7 +202,7 @@ class ChaosScheduler:
                 self.ops.kill(ev.node)
             elif ev.action == "restart":
                 self.ops.restart(ev.node)
-            elif ev.action == "wire_fault":
+            elif ev.action in ("wire_fault", "device_fault"):
                 self.ops.arm_faults(
                     ev.node, _seeded_spec(ev.arg, self.seed + index))
             elif ev.action == "clear_faults":

@@ -53,6 +53,9 @@ RULES = (
     # round 10: instrument-callsite hygiene (metrics_rule.py) —
     # per-call interning on hot paths, unbounded tag cardinality
     "metric-hygiene",
+    # round 12: device-boundary guard coverage (devguard_rule.py) —
+    # hot-path jit dispatches must run behind x.devguard
+    "device-guard",
 )
 
 _SUPPRESS_RE = re.compile(r"#\s*m3lint:\s*disable=([\w,-]+)")
@@ -137,6 +140,16 @@ class Context:
     # known large host arrays (constant-bloat flags references to these
     # under the tracer even across modules, where size can't be folded)
     large_constants: tuple = ("_VALUE_CTRL_TBL",)
+    # round 12: serving-hot-path trees whose raw device dispatches
+    # (module-jitted names, device_put, block_until_ready) must flow
+    # through the x.devguard seam (device-guard rule).  parallel/ is
+    # out of scope by design: its shard_map bodies compose raw() ops
+    # in-trace, and its host wrappers are themselves the guarded seam.
+    device_prefixes: tuple = ("m3_tpu/server/", "m3_tpu/storage/",
+                              "m3_tpu/aggregator/")
+    # files that ARE the guard plumbing (nothing today; the seam lives
+    # in x/devguard.py, outside the scoped prefixes)
+    device_helper_files: tuple = ()
 
     def is_wire_module(self, path: str) -> bool:
         return (path in self.wire_files
@@ -208,8 +221,8 @@ def apply_suppressions(unit: FileUnit, findings: Iterable[Finding]) -> List[Find
 
 def default_rules() -> List[Rule]:
     from m3_tpu.x.lint import (
-        corruption, deadline_aware, faultcov, jaxlint, locks,
-        metrics_rule, placement, purity, resources, wirecheck,
+        corruption, deadline_aware, devguard_rule, faultcov, jaxlint,
+        locks, metrics_rule, placement, purity, resources, wirecheck,
     )
 
     return [
@@ -227,6 +240,7 @@ def default_rules() -> List[Rule]:
         jaxlint.check_dtype_stability,
         jaxlint.check_constant_bloat,
         metrics_rule.check,
+        devguard_rule.check,
     ]
 
 
@@ -234,12 +248,13 @@ def explain(rule: str) -> dict | None:
     """{why, bad, good} for a rule name, harvested from the rule
     modules' EXPLAIN tables (``cli lint --explain`` renders it)."""
     from m3_tpu.x.lint import (
-        corruption, deadline_aware, faultcov, jaxlint, locks,
-        metrics_rule, placement, purity, resources, wirecheck,
+        corruption, deadline_aware, devguard_rule, faultcov, jaxlint,
+        locks, metrics_rule, placement, purity, resources, wirecheck,
     )
 
     for mod in (jaxlint, locks, purity, wirecheck, faultcov, resources,
-                corruption, placement, deadline_aware, metrics_rule):
+                corruption, placement, deadline_aware, metrics_rule,
+                devguard_rule):
         entry = getattr(mod, "EXPLAIN", {}).get(rule)
         if entry is not None:
             return entry

@@ -1,0 +1,160 @@
+"""Rule family 15 — device-boundary guard coverage (``device-guard``).
+
+Round 12's invariant, made permanent: every device dispatch on the
+serving hot path — calling a module-level jitted function, a raw
+``jax.device_put``, a ``.block_until_ready()`` — in ``server/``,
+``storage/`` and ``aggregator/`` must flow through the ``x.devguard``
+seam (``run_guarded``/``transfer_point``, or the arena wrappers'
+``_guarded_ingest``/``_guarded_consume`` helpers built on it).  A bare
+dispatch added next quarter is a device boundary the fault tier cannot
+reach (``device.compile``/``device.dispatch``/``device.transfer``
+faultpoints fire inside the seam) and a failure the per-stage breakers
+cannot degrade — an XlaRuntimeError there is a node crash, exactly the
+class of loss ISSUE 13 exists to remove.
+
+Mechanics (the fault-coverage rule's shape, with ancestor coverage):
+
+* a module's *jitted names* are defs decorated ``@jax.jit`` /
+  ``@functools.partial(jax.jit, ...)`` (assignments of ``jax.jit(f)``
+  count too);
+* a call to a jitted name, ``jax.device_put``, or
+  ``.block_until_ready`` is COVERED when any enclosing function (the
+  innermost def or an ancestor — guarded primaries are closures passed
+  INTO the seam) calls a seam name;
+* calls *inside* a jit-decorated def are tracing, not dispatching —
+  the dispatch happens at that def's callers, so they are exempt;
+* ``x/`` itself (the seam's home) and ``parallel/`` (in-jit
+  composition via ``raw()``) are out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from m3_tpu.x.lint.core import Context, FileUnit, Finding, dotted
+
+_SEAM_CALLS = {
+    "devguard.run_guarded", "run_guarded",
+    "devguard.transfer_point", "transfer_point",
+    "_guarded_ingest", "_guarded_consume", "_guarded_state_op",
+}
+_RAW_DOTTED = {"jax.device_put": "device_put"}
+_RAW_METHODS = {"block_until_ready": "block_until_ready"}
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    d = dotted(dec)
+    if d in ("jax.jit", "jit"):
+        return True
+    if isinstance(dec, ast.Call):
+        callee = dotted(dec.func)
+        if callee in ("jax.jit", "jit"):
+            return True
+        if callee in ("functools.partial", "partial") and dec.args:
+            return dotted(dec.args[0]) in ("jax.jit", "jit")
+    return False
+
+
+def _jitted_names(tree: ast.AST) -> Set[str]:
+    """Module-level names bound to jitted callables: decorated defs
+    plus ``name = jax.jit(f)`` assignments."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_decorator(d) for d in node.decorator_list):
+                names.add(node.name)
+        elif isinstance(node, ast.Assign):
+            v = node.value
+            if (isinstance(v, ast.Call)
+                    and dotted(v.func) in ("jax.jit", "jit")):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+    return names
+
+
+def _calls_seam(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and dotted(node.func) in _SEAM_CALLS:
+            return True
+    return False
+
+
+def check(unit: FileUnit, ctx: Context) -> List[Finding]:
+    if not any(unit.path.startswith(p) for p in ctx.device_prefixes):
+        return []
+    if unit.path in getattr(ctx, "device_helper_files", ()):
+        return []
+    jitted = _jitted_names(unit.tree)
+    funcs = [n for n in ast.walk(unit.tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    # parent chain: innermost enclosing def per node, and def -> parent
+    # def, so coverage flows OUTWARD (a guarded primary is a nested
+    # closure whose seam call sits in the parent)
+    parent: Dict[int, ast.AST] = {}
+    enclosing: Dict[int, ast.AST] = {}
+    for fn in funcs:
+        for node in ast.walk(fn):
+            if node is fn:
+                continue
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                parent[id(node)] = fn  # innermost wins on later visits
+            if isinstance(node, ast.Call):
+                enclosing[id(node)] = fn
+
+    fires = {id(fn) for fn in funcs if _calls_seam(fn)}
+    is_jit_def = {id(fn) for fn in funcs
+                  if any(_is_jit_decorator(d) for d in fn.decorator_list)}
+
+    def covered(fn: ast.AST | None) -> bool:
+        seen = 0
+        while fn is not None and seen < 64:
+            if id(fn) in fires or id(fn) in is_jit_def:
+                return True
+            fn = parent.get(id(fn))
+            seen += 1
+        return False
+
+    findings: List[Finding] = []
+    for node in ast.walk(unit.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        what = None
+        callee = dotted(node.func)
+        if callee in _RAW_DOTTED:
+            what = _RAW_DOTTED[callee]
+        elif (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _RAW_METHODS):
+            what = _RAW_METHODS[node.func.attr]
+        elif (isinstance(node.func, ast.Name) and node.func.id in jitted):
+            what = f"jit dispatch of {node.func.id}()"
+        if what is None:
+            continue
+        fn = enclosing.get(id(node))
+        if covered(fn):
+            continue
+        where = f"{fn.name}()" if fn is not None else "module level"
+        findings.append(Finding(
+            "device-guard", unit.path, node.lineno,
+            f"raw {what} in {where} outside the devguard seam — hot-path "
+            "device dispatches must run behind x.devguard.run_guarded so "
+            "device faults classify, degrade and stay injectable"))
+    return findings
+
+
+EXPLAIN = {
+    "device-guard": {
+        "why": (
+            "A bare jit dispatch / device_put / block_until_ready on the "
+            "serving hot path is a device boundary the fault tier cannot "
+            "reach and the per-stage breakers cannot degrade: a real XLA "
+            "OOM there is a node crash and acked-sample loss instead of "
+            "a typed, counted fallback (x/devguard.py — ISSUE 13's "
+            "detect -> degrade -> keep-serving -> recover contract)."),
+        "bad": "self.state = buffer_append(self.state, rows, ...)\n",
+        "good": ("devguard.run_guarded(\"storage.buffer_append\",\n"
+                 "    lambda: buffer_append(self.state, rows, ...),\n"
+                 "    self._host_stage)\n"),
+    },
+}

@@ -1,0 +1,56 @@
+"""Seeded device-guard violations (never imported).  The corpus run
+scopes the rule to this file (``device_prefixes=("devguard_cases",)``);
+the real Context pins server/ + storage/ + aggregator/ — see
+TestDevguardScope."""
+
+import jax
+import jax.numpy as jnp
+
+from m3_tpu.x import devguard
+
+state, rows, table = None, None, None
+
+
+@jax.jit
+def buffer_append(s, r):
+    return s
+
+
+sorted_drain = jax.jit(lambda s: s)
+
+
+class HotBuffer:
+    def append(self, r):
+        self.state = buffer_append(self.state, r)   # VIOLATION: device-guard (L24)
+
+    def drain(self, row):
+        out = sorted_drain(self.state)              # VIOLATION: jitted assign (L27)
+        return out.block_until_ready()              # VIOLATION: raw sync (L28)
+
+
+def upload():
+    return jax.device_put(table)                    # VIOLATION: raw upload (L32)
+
+
+class GuardedBuffer:
+    """Clean counterparts: the dispatch rides the devguard seam."""
+
+    def append(self, r):
+        self.state = devguard.run_guarded(
+            "storage.buffer_append",
+            lambda: buffer_append(self.state, r),   # ok: guarded closure
+            lambda: self._host(r))
+
+    def _host(self, r):
+        return self.state
+
+
+@jax.jit
+def fused(s, r):
+    return buffer_append(s, r)                      # ok: tracing, not dispatch
+
+
+def nested_primary(r):
+    def primary():
+        return buffer_append(state, r)              # ok: ancestor calls the seam
+    return devguard.run_guarded("arena.ingest", primary, primary)

@@ -182,6 +182,9 @@ class TestReporter:
             r.timer(b"t", i / 10)
         assert r.dropped_timers == 6
 
+    @pytest.mark.slow  # round-12 tier-1 budget: ~10s of default-
+    # geometry arena compiles; the reporter's unit tests above keep
+    # the contract tier-1
     def test_end_to_end_with_aggregator(self):
         from m3_tpu.aggregator.engine import Aggregator
 

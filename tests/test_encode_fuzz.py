@@ -178,6 +178,9 @@ class TestEncodeProperties:
             _assert_bytes_match_oracle(streams, ts, vals, starts,
                                        anns=anns)
 
+    @pytest.mark.slow  # round-12 tier-1 budget: one bespoke jit
+    # compile each (~9s); byte-identity stays tier-1 via the pinned
+    # corpus + placement-tails + sharded-parity tests
     def test_every_dod_bucket_width(self):
         """Deltas hitting each timestamp opcode bucket (0/7/9/12-bit
         and the 32-bit default escape) in one stream."""
@@ -194,18 +197,27 @@ class TestEncodeProperties:
         self._roundtrip(ts, np.array(vs)[None, :],
                         np.full(1, START, np.int64))
 
+    @pytest.mark.slow  # round-12 tier-1 budget: one bespoke jit
+    # compile each (~9s); byte-identity stays tier-1 via the pinned
+    # corpus + placement-tails + sharded-parity tests
     def test_int_float_mode_churn(self):
         vs = [3.0, 4.0, 4.5, 4.75, 5.0, 6.0, 0.125, 7.0, 7.25, 8.0]
         ts = (START + np.arange(1, len(vs) + 1) * SEC)[None, :].astype(np.int64)
         self._roundtrip(ts, np.array(vs)[None, :],
                         np.full(1, START, np.int64))
 
+    @pytest.mark.slow  # round-12 tier-1 budget: one bespoke jit
+    # compile each (~9s); byte-identity stays tier-1 via the pinned
+    # corpus + placement-tails + sharded-parity tests
     def test_nan_inf_specials(self):
         vs = [1.0, np.nan, np.inf, -np.inf, np.nan, 2.5, np.nan]
         ts = (START + np.arange(1, len(vs) + 1) * SEC)[None, :].astype(np.int64)
         self._roundtrip(ts, np.array(vs)[None, :],
                         np.full(1, START, np.int64))
 
+    @pytest.mark.slow  # round-12 tier-1 budget: one bespoke jit
+    # compile each (~9s); byte-identity stays tier-1 via the pinned
+    # corpus + placement-tails + sharded-parity tests
     def test_unaligned_start_tu_marker(self):
         """An unaligned encoder start writes the TU-marker prefix +
         full 64-bit nanosecond dod on the first datapoint (the t1
@@ -216,6 +228,9 @@ class TestEncodeProperties:
         vals = np.arange(T, dtype=np.float64)[None, :]
         self._roundtrip(ts, vals, np.full(1, start, np.int64))
 
+    @pytest.mark.slow  # round-12 tier-1 budget: one bespoke jit
+    # compile each (~9s); byte-identity stays tier-1 via the pinned
+    # corpus + placement-tails + sharded-parity tests
     def test_first_datapoint_annotation_prefix(self):
         T = 24
         ts = np.tile(START + np.arange(1, T + 1) * SEC, (3, 1)).astype(np.int64)
@@ -223,6 +238,9 @@ class TestEncodeProperties:
         anns = [b"proto-schema-A", None, b"x" * 100]
         self._roundtrip(ts, vals, np.full(3, START, np.int64), anns=anns)
 
+    @pytest.mark.slow  # round-12 tier-1 budget: one bespoke jit
+    # compile each (~9s); byte-identity stays tier-1 via the pinned
+    # corpus + placement-tails + sharded-parity tests
     def test_mid_stream_unit_change_flags_fallback(self):
         """Timestamps whose deltas stop dividing the unit force the
         scalar encoder into a mid-stream TU switch; the device encoder

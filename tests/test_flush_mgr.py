@@ -166,6 +166,9 @@ class TestFlushManager:
         fm1b.tick(t0 + 20 * SEC)
         assert len(sink) > n_before
 
+    @pytest.mark.slow  # round-12 tier-1 budget: ~10s default-geometry
+    # Aggregator construction; murmur3 routing parity stays tier-1 in
+    # test_wire.py::test_shard_routing_matches_murmur3
     def test_shard_routing_is_murmur3(self):
         agg = Aggregator(num_shards=4)
         for mid in (b"a", b"foo", b"metric.name.with.dots"):

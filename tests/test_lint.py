@@ -30,7 +30,11 @@ PERMISSIVE = Context(dtype_prefixes=("",), wire_prefixes=("",),
                      constant_files=(), persist_prefixes=("",),
                      deadline_files=(), deadline_prefixes=("",),
                      jax_prefixes=("",), jax_host_boundary=(),
-                     timed_prefixes=("",), metric_prefixes=("",))
+                     timed_prefixes=("",), metric_prefixes=("",),
+                     # device-guard is pinned to its own corpus file:
+                     # jax_cases.py's clean `jax.block_until_ready`
+                     # timing idiom is a legitimate raw sync there
+                     device_prefixes=("devguard_cases",))
 
 EXPECTED = {
     ("lock_cases.py", "lock-discipline", 22),
@@ -90,6 +94,11 @@ EXPECTED = {
     ("metric_cases.py", "metric-hygiene", 16),   # intern in do_GET
     ("metric_cases.py", "metric-hygiene", 20),   # f-string tag value
     ("metric_cases.py", "metric-hygiene", 21),   # variable tag value
+    # round 12: device-boundary guard coverage seeds
+    ("devguard_cases.py", "device-guard", 24),   # raw jit dispatch
+    ("devguard_cases.py", "device-guard", 27),   # jax.jit(f) assignment
+    ("devguard_cases.py", "device-guard", 28),   # raw block_until_ready
+    ("devguard_cases.py", "device-guard", 32),   # raw device_put
 }
 
 
@@ -120,7 +129,7 @@ class TestCorpus:
                      "resource-hygiene", "corruption-typed",
                      "placement-cas", "deadline-aware", "retrace-risk",
                      "transfer-hygiene", "dtype-stability",
-                     "constant-bloat", "metric-hygiene"):
+                     "constant-bloat", "metric-hygiene", "device-guard"):
             assert len(by_rule.get(rule, [])) >= 2, rule
 
 
@@ -308,6 +317,56 @@ class TestJaxScope:
                "    return jnp.asarray(mj._VALUE_CTRL_TBL)[i]\n")
         got = self._lint_at(tmp_path, "m3_tpu/query/engine.py", src)
         assert any(f.rule == "constant-bloat" for f in got)
+
+
+class TestDevguardScope:
+    """The DEFAULT context aims device-guard at the serving hot path
+    (server/ + storage/ + aggregator/): a raw dispatch there is a
+    device boundary the fault tier cannot reach, while parallel/ (the
+    in-jit composition layer) and x/ (the seam's home) stay exempt."""
+
+    RAW = ("import jax\n"
+           "@jax.jit\n"
+           "def append(s, r):\n"
+           "    return s\n"
+           "class Buf:\n"
+           "    def add(self, r):\n"
+           "        self.state = append(self.state, r)\n")
+
+    GUARDED = ("import jax\n"
+               "from m3_tpu.x import devguard\n"
+               "@jax.jit\n"
+               "def append(s, r):\n"
+               "    return s\n"
+               "class Buf:\n"
+               "    def add(self, r):\n"
+               "        self.state = devguard.run_guarded(\n"
+               "            's', lambda: append(self.state, r),\n"
+               "            lambda: self.state)\n")
+
+    def _lint_at(self, tmp_path, rel, src):
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+        return lint_file(p, tmp_path, Context())
+
+    def test_fires_in_hot_modules(self, tmp_path):
+        for rel in ("m3_tpu/storage/buffer2.py",
+                    "m3_tpu/aggregator/arena2.py",
+                    "m3_tpu/server/assembly2.py"):
+            got = self._lint_at(tmp_path, rel, self.RAW)
+            assert any(f.rule == "device-guard" for f in got), rel
+
+    def test_guarded_dispatch_is_clean(self, tmp_path):
+        got = self._lint_at(tmp_path, "m3_tpu/storage/buffer2.py",
+                            self.GUARDED)
+        assert not any(f.rule == "device-guard" for f in got)
+
+    def test_out_of_scope_layers_exempt(self, tmp_path):
+        for rel in ("m3_tpu/parallel/sharded2.py", "m3_tpu/x/devguard2.py",
+                    "m3_tpu/encoding/m3tsz_jax2.py"):
+            got = self._lint_at(tmp_path, rel, self.RAW)
+            assert not any(f.rule == "device-guard" for f in got), rel
 
 
 class TestExplain:

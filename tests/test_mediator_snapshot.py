@@ -178,6 +178,9 @@ class TestColdWriteRecovery:
 
 
 class TestConcurrency:
+    @pytest.mark.slow  # round-12 tier-1 budget: ~60s threaded stress
+    # loop; the sample-conservation invariant it shares with the race
+    # tier stays tier-1 in test_race.py::TestFlushTickVsWriters
     def test_ingest_races_mediator(self, tmp_path):
         """HTTP-thread ingest concurrent with mediator snapshot/tick must
         not drop batches or hit closed commitlog files (the engine

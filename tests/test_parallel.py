@@ -90,6 +90,9 @@ def test_graft_entry_single_chip():
     assert np.asarray(c_lanes).shape[1] == 8
 
 
+@pytest.mark.slow  # round-12 tier-1 budget: ~17s duplicate of the driver's
+# separate `__graft_entry__.dryrun_multichip` run (TESTING.md tier 6);
+# test_graft_entry_single_chip keeps the entry-point contract in tier-1.
 def test_graft_entry_dryrun_multichip():
     import __graft_entry__ as ge
 

@@ -199,16 +199,21 @@ class TestChaosScheduler:
     def test_build_timeline_shapes(self):
         full = build_timeline(SoakConfig())
         actions = [e.action for e in full]
-        for a in ("wire_fault", "kill", "restart", "corrupt", "replace"):
+        for a in ("wire_fault", "device_fault", "kill", "restart",
+                  "corrupt", "replace"):
             assert a in actions, a
         labels = [e.arg for e in full if e.action == "phase"]
-        assert labels == ["healthy", "wire_faults", "sigkill", "corrupt",
-                          "replace", "recovered"]
+        assert labels == ["healthy", "wire_faults", "device_faults",
+                          "sigkill", "corrupt", "replace", "recovered"]
         smoke = build_timeline(SoakConfig.smoke_config())
         sactions = [e.action for e in smoke]
         assert "wire_fault" in sactions and "kill" not in sactions
+        assert "device_fault" in sactions
         assert [e.arg for e in smoke if e.action == "phase"] == \
-            ["healthy", "wire_faults", "recovered"]
+            ["healthy", "wire_faults", "device_faults", "recovered"]
+        # t_device=0 removes the window entirely
+        nodev = build_timeline(SoakConfig.smoke_config(t_device=0.0))
+        assert "device_fault" not in [e.action for e in nodev]
 
 
 # ---------------------------------------------------------------------------

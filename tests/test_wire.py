@@ -99,6 +99,10 @@ class TestIngestPath:
         resolve = lambda iid: ("127.0.0.1", servers[iid].port)
         return placement, aggs, servers, resolve, regs
 
+    @pytest.mark.slow  # round-12 tier-1 budget: ~70s of server-side
+    # arena compiles at the DEFAULT (1<<20-slot) geometry; the routing
+    # half stays tier-1 in test_shard_routing_matches_murmur3 and the
+    # replica fan-out contract in test_replication/test_dtest
     def test_client_routes_and_replicates(self):
         placement, aggs, servers, resolve, regs = self._cluster(rf=2)
         client = AggregatorClient(placement, resolve)
