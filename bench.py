@@ -285,6 +285,34 @@ def _retrace_verdict(verdict: str, retraces: int) -> str:
     return verdict
 
 
+def _cost_block(*stage_names: str, need_s: int = 30) -> dict | None:
+    """Machine-independent cost fingerprints for a bench stage — the
+    x/costwatch registry stages this wall-clock stage corresponds to,
+    at the registry's CANONICAL shapes (so every BENCH artifact carries
+    numbers directly comparable to the committed COSTS baseline and to
+    every other box's BENCH, relay up or down).  Compile-only and
+    budget-guarded; a failure degrades to an error record, never kills
+    the stage."""
+    if _left() < need_s:
+        return None
+    try:
+        from m3_tpu.x import costwatch
+
+        fps = costwatch.run_stages(stage_names)
+        slim = {}
+        for name, fp in fps.items():
+            slim[name] = {
+                "flops_per_dp": fp["flops_per_dp"],
+                "bytes_per_dp": fp["bytes_per_dp"],
+                "peak_bytes_per_dp": fp["peak_bytes_per_dp"],
+                "temp_bytes": fp["memory"]["temp_bytes"],
+                "hlo_op_total": fp["hlo_op_total"],
+            }
+        return slim
+    except Exception as e:  # noqa: BLE001 — fingerprints are best-effort
+        return {"error": f"{type(e).__name__}: {e}"[:160]}
+
+
 # The pre-rewrite single-scan decoder's round-5 numbers — deleted in
 # round 6 (the two-phase rewrite replaced it wholesale), so the bench's
 # old-vs-new head-to-head reports against these RECORDED baselines.
@@ -410,6 +438,13 @@ def _run_decode_stage(S: int, T: int, platform: str) -> dict:
            "transfers": _hop_delta(hsnap),
            "chains": primary, "layout": "scan_major",
            "devices": jax.device_count()}
+    # Machine-independent fingerprint next to the wall clock: the
+    # costwatch registry stage for the primary chains tail, at the
+    # registry's canonical shapes (comparable to COSTS_r13 and across
+    # boxes/backends — the number that keeps moving relay-down).
+    cost = _cost_block(f"decode/{primary}")
+    if cost is not None:
+        res["cost"] = cost
     # Old-vs-new: the recorded r05 single-scan number for this backend,
     # plus the non-default chains tail so the seam's flip decision stays
     # re-measurable every round (both tails are parity-pinned by
@@ -438,6 +473,27 @@ def _run_decode_stage(S: int, T: int, platform: str) -> dict:
         except Exception as e:  # record, keep the primary result
             res[f"dps_{other}"] = f"{type(e).__name__}: {e}"[:120]
     return res
+
+
+def _run_costs_stage(platform: str) -> dict:
+    """Compile-only cost/memory fingerprints of the FULL costwatch
+    registry on this child's backend (cli tpu_backlog's `costs` stage):
+    the first relay window captures the TPU-backend fingerprints —
+    Mosaic pallas kernels included — head-to-head against the committed
+    CPU baseline (COSTS_r13.json), for the price of compiles alone.
+    Cheap even over the relay: no steady-state loops, no transfers
+    beyond program upload."""
+    from m3_tpu.tools.costs import build_artifact
+
+    artifact = build_artifact(log=_log)
+    return {
+        "platform": platform,
+        "config": artifact["config"],
+        "stages": artifact["stages"],
+        "opsdp_crosscheck": artifact["opsdp_crosscheck"],
+        "membudget_crosscheck": artifact.get("membudget_crosscheck"),
+        "validation": "ok",
+    }
 
 
 # The pre-rewrite wide-carry encode scan's round-7 number — deleted in
@@ -536,6 +592,11 @@ def _run_device_encode_stage(S: int, T: int, platform: str) -> dict:
              "transfers": _hop_delta(hsnap),
              "place": place, "devices": jax.device_count(),
              "platform": platform, "validation": verdict}
+    # Machine-independent fingerprint for the primary placement tail
+    # (costwatch canonical shapes — comparable to COSTS_r13).
+    cost = _cost_block(f"encode/{place}")
+    if cost is not None:
+        stage["cost"] = cost
     # Single-device number: methodology-comparable to r07 and to the
     # decode stage's full_1device convention.  On a budget-cut
     # multi-device child the key is OMITTED — reporting the sharded
@@ -806,6 +867,13 @@ def _run_agg_bench(kind: str, C: int, N: int, NT: int, platform: str) -> dict:
             out.update(go_proxy_samples_per_sec=round(proxy_rate),
                        vs_go_proxy=round(p_rate / proxy_rate, 3),
                        vs_go_proxy_f64=round(dev_rate / proxy_rate, 3))
+        # Machine-independent fingerprints next to the wall clock
+        # (x/costwatch canonical shapes — comparable to COSTS_r13).
+        cost = _cost_block("arena/rollup_ingest_packed",
+                           "arena/counter_ingest_f64",
+                           "arena/gauge_ingest_f64")
+        if cost is not None:
+            out["cost"] = cost
         return out
 
     # kind == "timer": NT samples over C timer IDs, p50/95/99.
@@ -950,6 +1018,10 @@ def _run_agg_bench(kind: str, C: int, N: int, NT: int, platform: str) -> dict:
     # deleted with the impl in round 6 — BENCH_r05 measured it at
     # 0.063-0.102x of scatter end-to-end here, a regression the bench
     # kept reporting as a feature.)
+    cost = _cost_block("timer/ingest_packed", "timer/consume_packed",
+                       "timer/ingest_f64", "timer/consume_f64")
+    if cost is not None:
+        out["cost"] = cost
     return out
 
 
@@ -1445,6 +1517,10 @@ def child_main(platform: str) -> None:
         guarded("encode_device", 90, _run_device_encode_stage, 8_192,
                 T_POINTS, "tpu")
         guarded("pallas", 90, _run_pallas_compare, "tpu")
+        # TPU-backend cost/memory fingerprints (compile-only — cheap
+        # even over the relay) for head-to-head vs the committed CPU
+        # baseline COSTS_r13.json.
+        guarded("costs", 60, _run_costs_stage, "tpu")
         if jax.device_count() > 1:
             guarded("agg_scaling", 120, _run_agg_scaling, "tpu")
         return
@@ -1569,6 +1645,7 @@ def main() -> None:
     encode_block: dict = {}
     promql_block: dict = {}
     pallas_block: dict = {}
+    costs_block: dict = {}
 
     def compose_and_log(tag: str) -> None:
         """Fold current state into `result` and mirror to stderr (the
@@ -1617,6 +1694,8 @@ def main() -> None:
             result["promql"] = promql_block
         if pallas_block:
             result["pallas_ingest"] = pallas_block
+        if costs_block:
+            result["costs"] = costs_block
         result["probe_timeline"] = PROBE_TIMELINE
         # Structured probe outcome (round-6 satellite): a dead relay
         # used to be one clause in the free-text `note`, which is how
@@ -1688,6 +1767,15 @@ def main() -> None:
         st = res.get("pallas")
         if st is not None:
             pallas_block.update(st)
+        st = res.get("costs")
+        if st is not None:
+            # accelerator fingerprints win (that's what the stage is
+            # FOR: the TPU head-to-head vs the committed CPU baseline)
+            if (costs_block.get("platform") != "tpu"
+                    or st.get("platform") == "tpu"):
+                costs_block.update(st)
+            detail[f"costs_{st.get('platform', '?')}"] = st.get(
+                "validation", "?")
         st = res.get("agg_scaling")
         if st is not None:
             old = agg_block.get("agg_scaling")

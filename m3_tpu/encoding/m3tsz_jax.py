@@ -657,11 +657,15 @@ def encode_batch_device(timestamps, value_bits, start, valid, unit: int = 1,
     # scatter IS the primary) — all placements are byte-identical
     # (PINNED_ENCODE_DIGEST + the fuzz suite pin every tail).  Budget
     # admission for the transient lane tables happens ONCE, outside
-    # the guard: the fallback reserves the same bytes, so an admission
-    # reject is not a device fault the fallback can relieve — it
-    # raises typed here without touching the stage breaker.
-    with membudget.transient("encode.lanes",
-                             membudget.encode_lane_bytes(S, T, ow)):
+    # the guard, at the WORSE of the primary/fallback tails' footprints
+    # (the formulas are per-tail since round 13, XLA-verified by the
+    # costs artifact): an admission reject is not a device fault the
+    # fallback can relieve — it raises typed here without touching the
+    # stage breaker.
+    lane_bytes = max(
+        membudget.encode_lane_bytes(S, T, ow, place=place),
+        membudget.encode_lane_bytes(S, T, ow, place=fallback_place(place)))
+    with membudget.transient("encode.lanes", lane_bytes):
         return devguard.run_guarded("encode", lambda: _run(place),
                                     lambda: _run(fallback_place(place)))
 
@@ -1729,12 +1733,17 @@ def decode_batch_device(words, nbits, max_points: int, default_unit: int = 1,
     # static argument (the fused tail also pins extract="jnp", so a
     # failing Pallas extraction kernel steps down with it) — both tails
     # are bit-identical, corpus sha256 + fuzz pinned.  Lane-table
-    # admission is ONCE, outside the guard (encode_batch_device's
-    # rationale: an admission reject is not a fault the fallback can
-    # relieve — typed raise, no breaker).
-    with membudget.transient(
-            "decode.lanes",
-            membudget.decode_lane_bytes(S, Wp, max_points)):
+    # admission is ONCE, outside the guard, at the worse of the
+    # primary/fallback tails (encode_batch_device's rationale: an
+    # admission reject is not a fault the fallback can relieve — typed
+    # raise, no breaker).
+    fb = fallback_chains(chains)
+    lane_bytes = max(
+        membudget.decode_lane_bytes(S, Wp, max_points, chains=chains,
+                                    extract=_resolved_extract(chains)),
+        membudget.decode_lane_bytes(S, Wp, max_points, chains=fb,
+                                    extract=_resolved_extract(fb)))
+    with membudget.transient("decode.lanes", lane_bytes):
         return devguard.run_guarded("decode", lambda: _run(chains),
                                     lambda: _run(fallback_chains(chains)))
 

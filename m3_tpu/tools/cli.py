@@ -171,7 +171,9 @@ def scrub(args) -> int:
 def tpu_backlog(args) -> int:
     """Probe the axon TPU relay and, when it answers, run the
     accumulated on-chip benchmark backlog (decode, rollup_full,
-    timer_full, agg_scaling, the round-9 encode) in one shot via
+    timer_full, agg_scaling, the round-9 encode, and the round-13
+    compile-only ``costs`` fingerprint stage — the TPU head-to-head
+    vs the committed COSTS_r13.json CPU baseline) in one shot via
     bench.py's ``tpu_backlog`` child.
 
     The probe is a plain TCP connect and the child runs with any
@@ -231,9 +233,11 @@ def hops(args) -> int:
     before-state ROADMAP item 1's device-resident rebuild is judged
     against); ``--check [BASELINE]`` re-runs the profile and exits
     nonzero if the steady pipeline moves more transfer bytes than the
-    committed baseline allows (±tolerance) or picks up steady-state
-    compiles — the hot path must not quietly regress to MORE host
-    hops."""
+    committed baseline allows (±tolerance), picks up steady-state
+    compiles, or grows any hop's steady dispatch count past
+    ``--dispatch-tolerance`` (dispatch growth is the leading indicator
+    the transfer gate misses) — the hot path must not quietly regress
+    to MORE host hops."""
     from m3_tpu.tools.hops import check_against_baseline, run_pipeline
 
     baseline = None
@@ -241,15 +245,16 @@ def hops(args) -> int:
         # resolve + validate the baseline BEFORE the multi-minute
         # profile run: a typo'd path must fail in milliseconds
         baseline = args.check or str(
-            Path(__file__).resolve().parents[2] / "PIPELINE_r09.json")
+            Path(__file__).resolve().parents[2] / "PIPELINE_r13.json")
         if not Path(baseline).exists():
             print(f"hops --check: no baseline at {baseline}",
                   file=sys.stderr)
             return 2
     artifact = run_pipeline(S=args.series, T=args.samples)
     if baseline is not None:
-        errs = check_against_baseline(artifact, baseline,
-                                      tolerance=args.tolerance)
+        errs = check_against_baseline(
+            artifact, baseline, tolerance=args.tolerance,
+            dispatch_tolerance=args.dispatch_tolerance)
         _out({"hops_check": {"ok": not errs, "baseline": baseline,
                              "violations": errs,
                              "pipeline": artifact["pipeline"]}})
@@ -259,6 +264,84 @@ def hops(args) -> int:
         Path(args.out).write_text(text + "\n")
         print(f"hops: artifact written to {args.out}", file=sys.stderr)
     else:
+        sys.stdout.write(text + "\n")
+    return 0
+
+
+def costs(args) -> int:
+    """Machine-independent per-stage cost fingerprints from XLA
+    cost/memory analysis (x/costwatch.py): lower + compile every
+    registered hot-path device program at pinned canonical shapes and
+    extract flops / transcendentals / bytes-accessed / HLO op histogram
+    / memory_analysis temp+peak bytes with per-datapoint
+    normalizations.  Compile-only — no timed loops, immune to box
+    noise, identical with the TPU relay up or down.
+
+    ``--out COSTS_rNN.json`` writes the artifact (the committed
+    baseline the formulation work is ratcheted against); ``--check
+    [BASELINE]`` re-runs the registry and exits nonzero when any
+    per-stage gated metric moves past tolerance in either direction, a
+    stage vanishes/appears, or a pinned config changes — improvements
+    re-baseline (the lint/hops multiset-ratchet tradition).  ``--json``
+    emits the structured CI report (`cli lint --json` shape)."""
+    import os
+
+    # The sharded-wrapper stages pin a 2-device mesh: give a virgin
+    # process the virtual CPU devices BEFORE the backend initializes.
+    # Unconditional on purpose: both knobs only multiply the HOST
+    # platform's devices (inert on a real TPU backend, inert after
+    # init), and keying this on a JAX_PLATFORMS env pin made an
+    # unpinned CPU run fail the sharded stages' config check with a
+    # misleading devices=1-vs-2 violation.
+    from m3_tpu.parallel.mesh import enable_cpu_core_devices
+
+    enable_cpu_core_devices(max(2, os.cpu_count() or 1))
+    from m3_tpu.tools.costs import (
+        DEFAULT_TOLERANCE, build_artifact, check_against_baseline,
+        default_baseline_path,
+    )
+
+    baseline = None
+    if args.check is not None:
+        # resolve + validate the baseline BEFORE the compile run: a
+        # typo'd path must fail in milliseconds (the hops precedent)
+        baseline = args.check or str(default_baseline_path())
+        if not Path(baseline).exists():
+            print(f"costs --check: no baseline at {baseline}",
+                  file=sys.stderr)
+            return 2
+
+    def log(msg):
+        print(msg, file=sys.stderr)
+
+    artifact = build_artifact(stage_names=args.stage or None, log=log)
+    text = json.dumps(artifact, indent=1)
+    if args.out:
+        Path(args.out).write_text(text + "\n")
+        log(f"costs: artifact written to {args.out}")
+    if baseline is not None:
+        errs = check_against_baseline(
+            artifact, baseline,
+            tolerance=(args.tolerance if args.tolerance is not None
+                       else DEFAULT_TOLERANCE))
+        if args.json:
+            _out({"ok": not errs, "artifact": "COSTS",
+                  "baseline": baseline,
+                  "stages": len(artifact["stages"]),
+                  "violations": errs})
+        else:
+            for e in errs:
+                print(f"{e['kind'].upper():<14} {e['message']}",
+                      file=sys.stderr)
+            _out({"costs_check": {"ok": not errs, "baseline": baseline,
+                                  "stages": len(artifact["stages"]),
+                                  "violations": len(errs)}})
+        return 1 if errs else 0
+    if args.json:
+        _out({"ok": True, "artifact": "COSTS",
+              "stages": len(artifact["stages"]),
+              "violations": []})
+    elif not args.out:
         sys.stdout.write(text + "\n")
     return 0
 
@@ -449,8 +532,9 @@ def main(argv=None) -> int:
     tb = sub.add_parser(
         "tpu_backlog",
         help="probe the TPU relay and run the accumulated on-chip "
-             "bench backlog (decode/rollup/timer/agg_scaling/encode) "
-             "in one shot when it answers")
+             "bench backlog (decode/rollup/timer/agg_scaling/encode + "
+             "compile-only cost fingerprints) in one shot when it "
+             "answers")
     tb.add_argument("--budget", type=int, default=780,
                     help="child deadline in seconds (default 780)")
     tb.add_argument("--probe-timeout", type=float, default=3.0,
@@ -471,12 +555,43 @@ def main(argv=None) -> int:
     hp.add_argument("--check", nargs="?", const="", default=None,
                     metavar="BASELINE",
                     help="gate against a committed PIPELINE artifact "
-                         "(default: repo PIPELINE_r09.json); exit 1 on "
-                         "transfer-byte/compile regression")
+                         "(default: repo PIPELINE_r13.json); exit 1 on "
+                         "transfer-byte/compile/dispatch regression")
     hp.add_argument("--tolerance", type=float, default=0.25,
                     help="allowed transfer-byte growth vs baseline "
                          "(default 0.25)")
+    hp.add_argument("--dispatch-tolerance", type=float, default=0.10,
+                    dest="dispatch_tolerance",
+                    help="allowed per-hop steady dispatch-count growth "
+                         "vs baseline (default 0.10 — dispatch counts "
+                         "are deterministic at the pinned corpus shape)")
     hp.set_defaults(fn=hops)
+
+    co = sub.add_parser(
+        "costs",
+        help="compile-only per-stage cost fingerprints from XLA "
+             "cost/memory analysis (flops/bytes/op-histogram/peak per "
+             "datapoint at pinned canonical shapes); emit/check the "
+             "COSTS artifact")
+    co.add_argument("--out", help="write the artifact JSON here")
+    co.add_argument("--check", nargs="?", const="", default=None,
+                    metavar="BASELINE",
+                    help="gate against a committed COSTS artifact "
+                         "(default: repo COSTS_r13.json); exit 1 when "
+                         "any gated per-stage metric moves past "
+                         "tolerance, a stage vanishes/appears, or a "
+                         "pinned config changes")
+    co.add_argument("--tolerance", type=float, default=None,
+                    help="allowed per-metric ratio drift vs baseline "
+                         "(default 0.05; both directions — "
+                         "improvements re-baseline)")
+    co.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout (ok flag + "
+                         "structured violations) for CI")
+    co.add_argument("--stage", action="append", metavar="NAME",
+                    help="restrict to named stages (repeatable; "
+                         "default: full registry)")
+    co.set_defaults(fn=costs)
 
     sk = sub.add_parser(
         "soak",

@@ -348,15 +348,6 @@ def profile(S: int, T: int) -> dict:
     # EVERY branch (branchless SIMD), so ops-per-datapoint × lanes is
     # the compute the backend must sustain — the C++ decoder takes only
     # the ~100 ops of the branch each point actually needs.
-    def _count(j):
-        n = 0
-        for e in j.eqns:
-            n += 1
-            for v in e.params.values():
-                if hasattr(v, "jaxpr"):
-                    n += _count(v.jaxpr)
-        return n
-
     try:
         S_ = words.shape[0]
         wz = jnp.zeros_like(wpad)
@@ -367,7 +358,7 @@ def profile(S: int, T: int) -> dict:
         carry0 = mj._decode_carry0(
             S_, base_time if chains == "fused" else None)
         jx = jax.make_jaxpr(dstep)(carry0, None)
-        ops = _count(jx.jaxpr)
+        ops = _count_ops(jx.jaxpr)
         out["step_ops"] = ops
         out["element_ops_per_datapoint"] = ops
         out["element_ops_r05"] = 1972
@@ -379,13 +370,13 @@ def profile(S: int, T: int) -> dict:
 
 
 def _count_ops(j):
-    n = 0
-    for e in j.eqns:
-        n += 1
-        for v in e.params.values():
-            if hasattr(v, "jaxpr"):
-                n += _count_ops(v.jaxpr)
-    return n
+    """One home: x/costwatch owns the jaxpr equation counter — the
+    costs artifact cross-checks THESE hand counts against the
+    HLO-derived numbers every run (opsdp_crosscheck), which only means
+    something if both sides count the same way."""
+    from m3_tpu.x.costwatch import count_jaxpr_ops
+
+    return count_jaxpr_ops(j)
 
 
 def profile_encode(S: int, T: int) -> dict:

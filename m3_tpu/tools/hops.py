@@ -193,6 +193,12 @@ def run_pipeline(S: int = S_DEFAULT, T: int = T_DEFAULT,
             else 0.0,
             "transfers": (st.get("h2d_count", 0) + st.get("d2h_count", 0)),
             "bytes_moved": (st.get("h2d_bytes", 0) + st.get("d2h_bytes", 0)),
+            # steady per-hop dispatch count, promoted to a first-class
+            # artifact field (round 13): dispatch growth is the leading
+            # indicator of a hop splitting into more device programs —
+            # it shows up before the transfer-byte gate moves, because
+            # the extra dispatches initially shuttle the same bytes.
+            "dispatches": st.get("dispatches", 0),
         }
     transfer_bytes = sum(h["bytes_moved"] for h in hops.values())
     artifact = {
@@ -212,6 +218,8 @@ def run_pipeline(S: int = S_DEFAULT, T: int = T_DEFAULT,
             if steady_wall else 0,
             "transfer_bytes_steady": transfer_bytes,
             "transfers_steady": sum(h["transfers"] for h in hops.values()),
+            "dispatches_steady": sum(
+                h["dispatches"] for h in hops.values()),
             "compiles_cold": sum(
                 h["cold"].get("compiles", 0) for h in hops.values()),
             "compiles_steady": sum(
@@ -262,12 +270,27 @@ def derive_findings(artifact: dict) -> list[str]:
     return findings
 
 
+def _hop_dispatches(hop: dict) -> int:
+    """Baseline compat: the r13 artifacts carry a top-level per-hop
+    ``dispatches``; older artifacts (r09) only have the steady ledger's
+    count — same number, different nesting."""
+    if "dispatches" in hop:
+        return hop["dispatches"]
+    return hop.get("steady", {}).get("dispatches", 0)
+
+
 def check_against_baseline(artifact: dict, baseline_path: str,
-                           tolerance: float = 0.25) -> list[str]:
+                           tolerance: float = 0.25,
+                           dispatch_tolerance: float = 0.10) -> list[str]:
     """Regression gate for ``cli hops --check``: the steady pipeline
-    must not move MORE transfer bytes (or add steady-state compiles)
-    than the committed baseline allows.  Returns violation strings
-    (empty = pass)."""
+    must not move MORE transfer bytes, add steady-state compiles, or
+    grow any hop's steady DISPATCH count past tolerance vs the
+    committed baseline.  Dispatch growth is the leading indicator the
+    transfer gate misses: a hop splitting into more device programs
+    pays per-dispatch overhead first and often moves the same bytes —
+    by the time transfer bytes regress, the dispatch count has usually
+    been climbing for rounds.  Returns violation strings (empty =
+    pass)."""
     base = json.loads(Path(baseline_path).read_text())
     errs = []
     b = base["pipeline"]["transfer_bytes_steady"]
@@ -282,4 +305,20 @@ def check_against_baseline(artifact: dict, baseline_path: str,
         errs.append(
             f"steady-state compiles regressed: {cur} > baseline {b} "
             f"(a hop is retracing)")
+    # per-hop dispatch gate (dispatch counts are deterministic for a
+    # pinned corpus shape; the tolerance only absorbs baseline-era
+    # jitter like conditional warm-up dispatches)
+    for h, bh in base.get("hops", {}).items():
+        bd = _hop_dispatches(bh)
+        ch = artifact.get("hops", {}).get(h)
+        if ch is None:
+            errs.append(f"hop {h} present in baseline but missing from "
+                        "this run — the pipeline lost a named stage")
+            continue
+        cd = _hop_dispatches(ch)
+        if cd > bd * (1.0 + dispatch_tolerance) and cd > bd:
+            errs.append(
+                f"hop {h}: steady dispatches regressed {bd} -> {cd} "
+                f"(+{dispatch_tolerance:.0%} tolerance) — the hop is "
+                "splitting into more device programs")
     return errs

@@ -45,6 +45,13 @@
   series buffers and big transient stage buffers reserve bytes BEFORE
   XLA allocates; over ``M3_DEVICE_MEM_BUDGET`` rejects typed
   (``DeviceBudgetExceeded``) instead of dying inside the runtime.
+* ``m3_tpu.x.costwatch`` — machine-independent cost fingerprints: a
+  registry of every hot-path device program at pinned canonical
+  shapes, fingerprinted compile-only from XLA's cost/memory analysis
+  (flops/bytes/op-histogram/peak per datapoint); ``cli costs --check``
+  ratchets the committed COSTS artifact, box-noise-immune and
+  relay-independent.  (Imported lazily — it pulls the codec/arena
+  modules in, so it is not part of the m3_tpu.x import set.)
 * ``m3_tpu.x.lint`` — m3lint, the codebase-aware static analyzer
   (``python -m m3_tpu.tools.cli lint``); its rule families are the
   static mirror of what fault/retry/lockcheck/tracewatch enforce at

@@ -1,0 +1,131 @@
+"""membudget footprint formulas vs XLA ``memory_analysis()`` actuals.
+
+PR 12 pinned the admission formulas against hand-derived lane nbytes;
+round 13 verifies them against XLA's OWN accounting at the costwatch
+canonical shapes: every registered arena formula must admit at least
+what XLA lays out for the state (init program output bytes) and no more
+than 2x it, on BOTH layouts — the regression-style bound the ISSUE
+names.  (The codec lane formulas get the same [1x, 2x] bound against
+argument+output+temp of the already-compiled registry programs in
+tests/test_costwatch.py::TestMembudgetCrosscheckInArtifact — one set of
+compiles serves both pins.)"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+from m3_tpu.aggregator import arena, packed
+from m3_tpu.x import costwatch, membudget
+
+W = costwatch.CANONICAL["W"]
+C = costwatch.CANONICAL["C"]
+SCAP = costwatch.CANONICAL["SCAP"]
+
+
+def _xla_state_bytes(initfn) -> int:
+    """XLA's layout of the state: the init program's output bytes
+    (compile-only — nothing allocates)."""
+    ma = jax.jit(initfn).lower().compile().memory_analysis()
+    return int(ma.output_size_in_bytes)
+
+
+# ONE home for the case table: tools/costs.py exports it, the
+# artifact's membudget_crosscheck walks the same list — a case added
+# to one consumer but not the other cannot happen.
+from m3_tpu.tools.costs import membudget_arena_cases
+
+ARENA_CASES = membudget_arena_cases()
+
+
+class TestArenaFormulasVsXla:
+    @pytest.mark.parametrize(
+        "name,initfn,formula",
+        ARENA_CASES, ids=[name.replace("/", "-")
+                          for name, _, _ in ARENA_CASES])
+    def test_formula_within_1x_2x_of_xla_actual(self, name, initfn,
+                                                formula):
+        actual = _xla_state_bytes(initfn)
+        est = formula()
+        assert est >= actual, (
+            f"{name}: formula {est} admits LESS than XLA "
+            f"allocates ({actual}) — an admitted arena could still OOM")
+        assert est <= 2 * actual, (
+            f"{name}: formula {est} over-admits more than 2x "
+            f"XLA's {actual} — budget headroom fiction")
+
+    def test_case_table_covers_both_layouts_every_kind(self):
+        names = {n for n, _, _ in ARENA_CASES}
+        assert names == {f"{k}/{lo}" for k in ("counter", "gauge", "timer")
+                         for lo in ("f64", "packed")}
+
+    def test_formula_tracks_live_lane_nbytes_too(self):
+        """The PR 12 pin stays: formula >= the live lanes' raw nbytes
+        (XLA actual >= lane nbytes, so this is implied — asserted
+        directly so a future layout change failing BOTH bounds reports
+        the simpler one first)."""
+        st = packed.counter_init(W, C)
+        raw = sum(np.asarray(getattr(st, f)).nbytes for f in st._fields)
+        assert membudget.counter_arena_bytes("packed", W, C) >= raw
+
+    def test_nondefault_pool_capacity_scales(self):
+        base = membudget.counter_arena_bytes("packed", W, C)
+        bigger = membudget.counter_arena_bytes("packed", W, C,
+                                               pool_capacity=4 * (W * C // 16))
+        assert bigger > base
+
+
+class TestCodecFormulaShapes:
+    """Unit pins on the per-tail codec formulas (the [1x, 2x] XLA bound
+    itself rides the registry compiles in test_costwatch)."""
+
+    def test_decode_tails_ordered_by_materialization(self):
+        S, Wp, P = 256, 53, 129
+        fused = membudget.decode_lane_bytes(S, Wp, P, chains="fused")
+        gather = membudget.decode_lane_bytes(S, Wp, P, chains="gather")
+        pallas = membudget.decode_lane_bytes(S, Wp, P, chains="gather",
+                                             extract="pallas")
+        # the fused tail carries chains in the scan — no lane tables;
+        # the pallas extraction materializes the most
+        assert fused < gather < pallas
+
+    def test_encode_tails_cover_scatter_cheapest(self):
+        S, T, ow = 256, 128, 36
+        g = membudget.encode_lane_bytes(S, T, ow, place="gather")
+        s = membudget.encode_lane_bytes(S, T, ow, place="scatter")
+        p = membudget.encode_lane_bytes(S, T, ow, place="pallas")
+        assert s < g < p
+
+    def test_default_tail_matches_explicit(self):
+        # the wrappers pass the resolved tail; a caller that does not
+        # gets the CPU-primary gather coefficient, not a silent zero
+        assert membudget.encode_lane_bytes(4, 8, 6) == \
+            membudget.encode_lane_bytes(4, 8, 6, place="gather")
+        assert membudget.decode_lane_bytes(4, 8, 9) == \
+            membudget.decode_lane_bytes(4, 8, 9, chains="fused")
+
+    def test_wrapper_reserves_worse_of_primary_and_fallback(self):
+        """encode_batch_device admits max(primary, fallback) so the
+        devguard fallback can never need MORE than what was admitted
+        (the round-13 contract the wrapper comments state)."""
+        import jax.numpy as jnp
+
+        from m3_tpu.encoding.m3tsz_jax import encode_batch_device
+        from m3_tpu.x.membudget import DeviceBudgetExceeded
+
+        S, T = 2, 8
+        ts = jnp.asarray(
+            1_600_000_000_000_000_000
+            + np.arange(S * T, dtype=np.int64).reshape(S, T)
+            * 10_000_000_000)
+        vb = jnp.asarray(np.full((S, T), np.float64(1.5)).view(np.uint64))
+        st = jnp.asarray(ts[:, 0] - 10_000_000_000)
+        va = jnp.asarray(np.ones((S, T), bool))
+        membudget.set_budget(1)  # everything rejects
+        try:
+            with pytest.raises(DeviceBudgetExceeded):
+                encode_batch_device(ts, vb, st, va, place="gather")
+        finally:
+            membudget.set_budget(0)

@@ -345,10 +345,12 @@ class TestHopwatch:
 
 
 class TestHopsCheckGate:
-    def _artifact(self, bytes_steady, compiles_steady=0):
+    def _artifact(self, bytes_steady, compiles_steady=0, dispatches=None):
         return {
             "pipeline": {"transfer_bytes_steady": bytes_steady,
                          "compiles_steady": compiles_steady},
+            "hops": ({h: {"dispatches": d} for h, d in dispatches.items()}
+                     if dispatches else {}),
         }
 
     def test_within_tolerance_passes(self, tmp_path):
@@ -377,12 +379,62 @@ class TestHopsCheckGate:
             self._artifact(1000, 2), str(base))
         assert errs and "compiles regressed" in errs[0]
 
-    def test_committed_artifact_is_wellformed(self):
+    def test_dispatch_regression_fails(self, tmp_path):
+        """The round-13 per-hop dispatch gate: a hop splitting into
+        more device programs fails --check even when transfer bytes
+        and compiles are flat (the leading indicator the transfer
+        gate misses)."""
+        from m3_tpu.tools.hops import check_against_baseline
+
+        base = tmp_path / "PIPELINE.json"
+        base.write_text(json.dumps(
+            self._artifact(1000, dispatches={"window_drain": 198})))
+        errs = check_against_baseline(
+            self._artifact(1000, dispatches={"window_drain": 240}),
+            str(base), dispatch_tolerance=0.10)
+        assert errs and "dispatches regressed" in errs[0]
+        # within tolerance passes; a zero-dispatch hop gaining ANY fails
+        assert check_against_baseline(
+            self._artifact(1000, dispatches={"window_drain": 210}),
+            str(base), dispatch_tolerance=0.10) == []
+        base.write_text(json.dumps(
+            self._artifact(1000, dispatches={"wire_parse": 0})))
+        errs = check_against_baseline(
+            self._artifact(1000, dispatches={"wire_parse": 1}), str(base))
+        assert errs and "dispatches regressed" in errs[0]
+
+    def test_missing_hop_fails(self, tmp_path):
+        from m3_tpu.tools.hops import check_against_baseline
+
+        base = tmp_path / "PIPELINE.json"
+        base.write_text(json.dumps(
+            self._artifact(1000, dispatches={"encode": 1})))
+        errs = check_against_baseline(self._artifact(1000), str(base))
+        assert errs and "missing from this run" in errs[0]
+
+    def test_dispatch_gate_reads_r09_nesting_too(self, tmp_path):
+        """Back-compat: pre-r13 artifacts carry the count only inside
+        the steady ledger — the gate must read both nestings."""
+        from m3_tpu.tools.hops import check_against_baseline
+
+        base = tmp_path / "PIPELINE.json"
+        base.write_text(json.dumps({
+            "pipeline": {"transfer_bytes_steady": 1000,
+                         "compiles_steady": 0},
+            "hops": {"window_drain": {"steady": {"dispatches": 100}}},
+        }))
+        errs = check_against_baseline(
+            self._artifact(1000, dispatches={"window_drain": 150}),
+            str(base))
+        assert errs and "100 -> 150" in errs[0]
+
+    @pytest.mark.parametrize("name", ["PIPELINE_r09.json",
+                                      "PIPELINE_r13.json"])
+    def test_committed_artifact_is_wellformed(self, name):
         from pathlib import Path
 
         art = json.loads(
-            (Path(__file__).resolve().parent.parent
-             / "PIPELINE_r09.json").read_text())
+            (Path(__file__).resolve().parent.parent / name).read_text())
         hops = art["hops"]
         assert set(hops) == {"wire_parse", "arena_ingest", "window_drain",
                              "encode", "fileset_write"}
@@ -393,3 +445,20 @@ class TestHopsCheckGate:
         assert art["findings"], "artifact must call out a host-hop finding"
         fracs = sum(h["host_time_fraction"] for h in hops.values())
         assert fracs == pytest.approx(1.0, abs=0.02)
+
+    def test_committed_r13_carries_dispatch_fields(self):
+        """The regenerated baseline has the first-class dispatch counts
+        the new gate reads, and they agree with r09's steady ledger —
+        the pipeline gained no dispatches across rounds 10-13."""
+        from pathlib import Path
+
+        root = Path(__file__).resolve().parent.parent
+        r13 = json.loads((root / "PIPELINE_r13.json").read_text())
+        r09 = json.loads((root / "PIPELINE_r09.json").read_text())
+        assert "dispatches_steady" in r13["pipeline"]
+        for h, v in r13["hops"].items():
+            assert "dispatches" in v
+            assert v["dispatches"] == \
+                r09["hops"][h]["steady"].get("dispatches", 0)
+        assert r13["pipeline"]["dispatches_steady"] == sum(
+            v["dispatches"] for v in r13["hops"].values())
