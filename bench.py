@@ -1646,6 +1646,7 @@ def main() -> None:
     promql_block: dict = {}
     pallas_block: dict = {}
     costs_block: dict = {}
+    selfmon_block: dict = {}
 
     def compose_and_log(tag: str) -> None:
         """Fold current state into `result` and mirror to stderr (the
@@ -1696,6 +1697,8 @@ def main() -> None:
             result["pallas_ingest"] = pallas_block
         if costs_block:
             result["costs"] = costs_block
+        if selfmon_block:
+            result["selfmon"] = selfmon_block
         result["probe_timeline"] = PROBE_TIMELINE
         # Structured probe outcome (round-6 satellite): a dead relay
         # used to be one clause in the free-text `note`, which is how
@@ -1833,6 +1836,22 @@ def main() -> None:
         res = _run_child("cpu_scale", min(_left() - 60, 240))
         merge_child(res, "cpu")
         compose_and_log("cpu-scale")
+
+    # ---- stage 3c: selfmon ingest overhead (round 14) ----
+    # Pure host-path storage bench (no accelerator): identical
+    # db.write_batch load bare vs with the self-monitoring scrape
+    # ticking — the acceptance bound is <5% throughput cost, recorded
+    # here (selfmon.ok) without gating the bench verdict (the ratio is
+    # box-noise-sensitive on shared 1-core boxes; the tier it gates is
+    # the artifact record, not validation).
+    if not selfmon_block and _left() > 90:
+        try:
+            from m3_tpu.instrument.selfmon import measure_overhead
+
+            selfmon_block.update(measure_overhead())
+            compose_and_log("selfmon")
+        except Exception as e:  # noqa: BLE001 — recorded, not fatal
+            errors.append(f"selfmon: {type(e).__name__}: {e}")
 
     # ---- stage 4: TPU re-probe loop with the remaining budget ----
     # (the probe is a plain TCP connect and TPU children strip any

@@ -290,6 +290,62 @@ class DeviceConfig:
 
 
 @dataclasses.dataclass
+class SelfmonConfig:
+    """Self-monitoring (instrument/selfmon.py): the node scrapes its
+    own registry — and, in fleet mode, its peers' ``/metrics`` — into
+    the reserved ``namespace`` through the real write path on the
+    mediator tick cadence, and evaluates multi-window multi-burn-rate
+    SLO rules (query/slo.py) over the stored history.
+
+    ``every`` = mediator ticks per scrape cycle; ``budget`` = hard
+    per-source series cap per cycle (deterministic sorted survivors,
+    excess counted, never written); ``peers`` lists fleet-scrape
+    targets as ``host:port`` or ``name=host:port``; ``rules`` are SLO
+    rule dicts (``{name, objective, ratio, windows}``) layered on top
+    of the built-ins when ``default_rules`` is true.  The namespace is
+    auto-provisioned as a ``db.namespaces`` entry when absent —
+    declare it explicitly to tune retention/blocks."""
+
+    enabled: bool = False
+    every: int = 1
+    namespace: str = "_m3_selfmon"
+    budget: int = 2000
+    instance: str = ""          # instance tag (default: db.instance_id)
+    peers: list = dataclasses.field(default_factory=list)
+    scrape_timeout: str = "2s"
+    slo_deadline: str = "2s"
+    default_rules: bool = True
+    rules: list = dataclasses.field(default_factory=list)
+
+    def validate(self, errs: list) -> None:
+        if self.every < 1:
+            errs.append("selfmon.every: must be >= 1")
+        if self.budget < 0:
+            errs.append("selfmon.budget: must be >= 0 (0 = unbudgeted)")
+        if not self.namespace:
+            errs.append("selfmon.namespace: must be non-empty")
+        for f in ("scrape_timeout", "slo_deadline"):
+            try:
+                parse_duration(getattr(self, f))
+            except ConfigError as e:
+                errs.append(f"selfmon.{f}: {e}")
+        from m3_tpu.instrument.selfmon import parse_peer
+
+        for p in self.peers:
+            try:
+                parse_peer(p)
+            except ValueError as e:
+                errs.append(f"selfmon.peers: {e}")
+        from m3_tpu.query.slo import rule_from_dict
+
+        for i, r in enumerate(self.rules):
+            try:
+                rule_from_dict(r)
+            except (ValueError, TypeError) as e:
+                errs.append(f"selfmon.rules[{i}]: {e}")
+
+
+@dataclasses.dataclass
 class CoordinatorConfig:
     listen_host: str = "127.0.0.1"
     listen_port: int = 0  # 0 = ephemeral
@@ -354,6 +410,7 @@ class NodeConfig:
     mediator: MediatorConfig = dataclasses.field(default_factory=MediatorConfig)
     query: QueryConfig = dataclasses.field(default_factory=QueryConfig)
     device: DeviceConfig = dataclasses.field(default_factory=DeviceConfig)
+    selfmon: SelfmonConfig = dataclasses.field(default_factory=SelfmonConfig)
     metrics_prefix: str = "m3tpu"
 
     def validate(self) -> None:
@@ -364,6 +421,12 @@ class NodeConfig:
         self.mediator.validate(errs)
         self.query.validate(errs)
         self.device.validate(errs)
+        self.selfmon.validate(errs)
+        if (self.selfmon.enabled and self.coordinator is not None
+                and self.selfmon.namespace == self.coordinator.namespace):
+            errs.append(
+                "selfmon.namespace: must not be the coordinator's serving "
+                "namespace (self-monitoring series would mix into user data)")
         if errs:
             raise ConfigError("; ".join(errs))
 
@@ -375,6 +438,7 @@ _NESTED = {
     "mediator": MediatorConfig,
     "query": QueryConfig,
     "device": DeviceConfig,
+    "selfmon": SelfmonConfig,
 }
 # Optional nested sections: an explicit `field: null` disables the
 # subsystem (yields None) instead of instantiating defaults.

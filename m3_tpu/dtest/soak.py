@@ -108,6 +108,20 @@ class SoakConfig:
     t_device: float = 30.0
     device_spec: str = "device.dispatch=error"
     replace: bool = True
+    # Self-monitoring (round 14): every node scrapes itself — and, in
+    # fleet mode, its peers — into the _m3_selfmon namespace through
+    # the real write path on the mediator tick, so the soak's SLO
+    # record is retro-queryable PromQL history instead of harness-side
+    # scrape diffs.  selfmon_windows are the burn-rate rule windows,
+    # soak-scaled (a 14.4x-over-1h page rule would never fire inside a
+    # minutes-long run).
+    selfmon: bool = True
+    selfmon_budget: int = 4000
+    selfmon_long: str = "120s"
+    selfmon_short: str = "30s"
+    # extra SLO rule dicts appended to every node's selfmon config
+    # (the acceptance dtest injects a wire-error burn rule here)
+    selfmon_extra_rules: list = dataclasses.field(default_factory=list)
 
     @classmethod
     def smoke_config(cls, **kw) -> "SoakConfig":
@@ -487,6 +501,33 @@ class SoakCluster:
         with self._log_lock:
             self.log.append(f"{time.strftime('%H:%M:%S')} {msg}")
 
+    def _selfmon_config(self, k: int) -> dict:
+        """Node k's selfmon section (JSON is valid YAML): fleet mode —
+        every node scrapes every OTHER node's /metrics under its
+        instance tag — with the soak-scaled burn windows (the 1h/6h
+        SRE defaults would never fire inside a minutes-long run)."""
+        from m3_tpu.query.slo import latency_ratio
+
+        win = [{"long": self.cfg.selfmon_long,
+                "short": self.cfg.selfmon_short, "factor": 2.0}]
+        rules = [
+            {"name": "ingest-latency", "objective": 0.999,
+             "ratio": latency_ratio("m3tpu_db_write_batch_seconds", "0.25"),
+             "windows": win},
+            {"name": "query-latency", "objective": 0.99,
+             "ratio": latency_ratio("m3tpu_query_seconds", "1.0"),
+             "windows": win},
+        ] + list(self.cfg.selfmon_extra_rules)
+        return {
+            "enabled": True, "every": 1,
+            "budget": self.cfg.selfmon_budget,
+            "instance": f"i{k}",
+            "peers": [f"i{i}=127.0.0.1:{p}"
+                      for i, p in enumerate(self.fixed_http_ports)
+                      if i != k],
+            "default_rules": False, "rules": rules,
+        }
+
     def start(self) -> None:
         from m3_tpu.client.session import ConsistencyLevel, ReplicatedSession
         from m3_tpu.cluster.kv_remote import (
@@ -497,12 +538,23 @@ class SoakCluster:
 
         (self.workdir / "kv").mkdir(parents=True, exist_ok=True)
         self.kv_srv = serve_kv_background(root=str(self.workdir / "kv"))
-        self.rpc_ports = _free_ports(self.total)
+        # HTTP ports are pre-allocated (not ephemeral) since round 14:
+        # the selfmon fleet mode needs every node's /metrics endpoint
+        # in every OTHER node's static config.  ONE _free_ports call
+        # for both sets — two calls could hand the second set a port
+        # the kernel just released from the first (bind-failure flake).
+        ports = _free_ports(2 * self.total)
+        self.rpc_ports = ports[:self.total]
+        self.fixed_http_ports = ports[self.total:]
         for k in range(self.total):
             root = self.workdir / f"n{k}" / "data"
             cfgp = self.workdir / f"n{k}" / "node.yaml"
             peers = [f"127.0.0.1:{p}" for i, p in enumerate(self.rpc_ports)
                      if i != k]
+            selfmon_yaml = ""
+            if self.cfg.selfmon:
+                selfmon_yaml = "selfmon: " + json.dumps(
+                    self._selfmon_config(k)) + "\n"
             cfgp.parent.mkdir(parents=True, exist_ok=True)
             cfgp.write_text(f"""
 db:
@@ -518,7 +570,7 @@ db:
       slot_capacity: {self.cfg.slot_capacity}
       block_size: {self.cfg.block_size}
       buffer_past: {self.cfg.buffer_past}
-coordinator: {{listen_port: 0, admin_listen_port: 0}}
+coordinator: {{listen_port: {self.fixed_http_ports[k]}, admin_listen_port: 0}}
 mediator:
   enabled: true
   tick_interval: {"1s" if self.cfg.smoke else "2s"}
@@ -527,7 +579,7 @@ mediator:
   scrub_volumes: 0
   migrate_blocks: 4
   migrate_grace_ticks: 2
-""")
+{selfmon_yaml}""")
             root.mkdir(parents=True, exist_ok=True)
             self.nodes.append(NodeProcess(
                 str(cfgp), str(root), env={"M3_DRAIN_TIMEOUT_S": "60"}))
@@ -600,6 +652,22 @@ mediator:
     def alive_nodes(self) -> List[int]:
         return [k for k in range(self.total)
                 if k < len(self.nodes) and self.nodes[k].alive()]
+
+    def promql(self, k: int, query: str, namespace: str | None = None,
+               time_s: int | None = None, timeout_s: float = 60.0) -> list:
+        """Instant PromQL query against node k's HTTP API; with
+        ``namespace`` the query runs over that namespace's storage
+        (how ``_m3_selfmon`` history is read from outside)."""
+        url = (f"http://127.0.0.1:{self.http_port(k)}/api/v1/query?"
+               f"query={urllib.request.quote(query)}"
+               f"&time={time_s if time_s is not None else int(time.time())}")
+        if namespace:
+            url += f"&namespace={namespace}"
+        with urllib.request.urlopen(url, timeout=timeout_s) as r:
+            out = json.load(r)
+        if out.get("status") != "success":
+            raise RuntimeError(out)
+        return out["data"]["result"]
 
     def scrape_all(self) -> dict:
         """{node index: parsed /metrics | None} — the PhaseTracker's
@@ -922,6 +990,66 @@ def _verify(cluster: SoakCluster, ledger: Ledger, cfg: SoakConfig) -> dict:
     }
 
 
+def selfmon_report(cluster: SoakCluster, window_s: int) -> dict:
+    """The round-14 SLO record: instead of harness-side scrape diffs,
+    the run's fleet SLOs are PromQL queries over the ``_m3_selfmon``
+    HISTORY a live node stored through its own write path — the same
+    queries an operator would issue mid-incident, issued here against
+    ONE node whose fleet scrape covered its peers.  Returns the
+    queries, their answers, the per-(rule, instance) max burn verdicts
+    over the run, and the queried node's /health ``slo`` section."""
+    alive = cluster.alive_nodes()
+    if not alive:
+        return {"error": "no live node to query"}
+    k = alive[0]
+    w = f"{max(60, window_s)}s"
+    out: dict = {"queried_node": k, "window": w, "queries": {}}
+
+    def one_value(query: str):
+        rows = cluster.promql(k, query, namespace="_m3_selfmon")
+        if not rows:
+            return None
+        v = float(rows[0]["value"][1])
+        return None if v != v else round(v, 6)
+
+    for key, q in (
+        ("fleet_ingest_p99_s",
+         f"histogram_quantile(0.99, sum(rate("
+         f"m3tpu_db_write_batch_seconds_bucket[{w}])) by (le))"),
+        ("fleet_ingest_p50_s",
+         f"histogram_quantile(0.5, sum(rate("
+         f"m3tpu_db_write_batch_seconds_bucket[{w}])) by (le))"),
+        ("fleet_query_p99_s",
+         f"histogram_quantile(0.99, sum(rate("
+         f"m3tpu_query_seconds_bucket[{w}])) by (le))"),
+        ("fleet_write_batches_per_s",
+         f"sum(rate(m3tpu_db_write_batch_seconds_count[{w}]))"),
+    ):
+        try:
+            out["queries"][key] = one_value(q)
+        except Exception as e:  # noqa: BLE001 — recorded, not fatal
+            out["queries"][key] = f"error: {type(e).__name__}: {e}"
+    verdicts = []
+    rows = cluster.promql(k, f"max_over_time(m3tpu_slo_burn[{w}])",
+                          namespace="_m3_selfmon")
+    for r in rows:
+        verdicts.append({
+            "rule": r["metric"].get("rule"),
+            "instance": r["metric"].get("instance"),
+            "max_burn": round(float(r["value"][1]), 4),
+        })
+    out["verdicts"] = sorted(
+        verdicts, key=lambda v: (v["rule"] or "", v["instance"] or ""))
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{cluster.http_port(k)}/health",
+                timeout=30) as r:
+            out["health_slo"] = json.load(r).get("slo")
+    except OSError:
+        out["health_slo"] = None
+    return out
+
+
 # ---------------------------------------------------------------------------
 # the run + the regression gate
 # ---------------------------------------------------------------------------
@@ -996,6 +1124,23 @@ def run_soak(cfg: SoakConfig, workdir: str | None = None,
             f"{verdict['mismatched']} mismatched, "
             f"{verdict['unacked_extras']} unacked extras)")
 
+        # Round 14: the run's SLO record comes from PromQL over the
+        # fleet's self-stored _m3_selfmon history, not harness scrape
+        # diffs — at least one burn verdict must be retro-queryable or
+        # the self-monitoring contract is broken (verdict gated).
+        selfmon_rec = None
+        if cfg.selfmon:
+            try:
+                selfmon_rec = selfmon_report(
+                    cluster, int(time.monotonic() - t_run0) + 60)
+            except Exception as e:  # noqa: BLE001 — the artifact must
+                # record the failure; the verdict flag below trips
+                selfmon_rec = {"error": f"{type(e).__name__}: {e}"}
+            verdict["slo_recorded"] = bool(selfmon_rec.get("verdicts"))
+            log(f"soak: selfmon verdicts={len(selfmon_rec.get('verdicts', []))} "
+                f"fleet ingest p99="
+                f"{selfmon_rec.get('queries', {}).get('fleet_ingest_p99_s')}s")
+
         retry_after = xretry.counters()
         artifact = {
             "kind": "SOAK",
@@ -1018,6 +1163,8 @@ def run_soak(cfg: SoakConfig, workdir: str | None = None,
             "cluster_log": cluster.log,
             "verdict": verdict,
         }
+        if selfmon_rec is not None:
+            artifact["selfmon"] = selfmon_rec
         return artifact
     finally:
         if cluster is not None:
@@ -1060,6 +1207,10 @@ def check_artifact(new: dict, baseline: dict,
         errs.append(
             f"acked-sample loss: {v.get('missing')} missing, "
             f"{v.get('mismatched')} mismatched of {v.get('acked_samples')}")
+    if new.get("verdict", {}).get("slo_recorded") is False:
+        # selfmon was on but the run left no queryable burn verdict in
+        # _m3_selfmon — the self-monitoring contract itself regressed
+        errs.append("selfmon: no SLO verdict queryable from _m3_selfmon")
     base_phases = {p["name"]: p for p in baseline.get("phases", ())}
     for p in new.get("phases", ()):  # noqa: B007
         if p["name"] == "setup":

@@ -53,6 +53,12 @@ class Sample:
     name: str
     labels: Tuple[Tuple[str, str], ...]  # sorted (name, unescaped value)
     value: float
+    # Optional Prometheus sample timestamp (milliseconds since epoch):
+    # the text format allows ``name{labels} value [timestamp_ms]``, and
+    # the selfmon fleet scrape needs scrape-time stamping to survive a
+    # slow/queued peer fetch — None when the line carried no timestamp
+    # (our own /metrics never emits one).
+    timestamp_ms: int | None = None
 
     def label(self, name: str, default: str | None = None) -> str | None:
         for k, v in self.labels:
@@ -136,13 +142,30 @@ def parse_text(text: str) -> List[Sample]:
             rest = rest[end + 1:]
         if not rest.startswith(" "):
             raise ExpositionError(lineno, "expected space before value")
-        value = _parse_value(rest[1:], lineno)
+        # "<value>" or "<value> <timestamp_ms>" (Prometheus text format:
+        # the optional trailing integer is milliseconds since epoch).
+        # More than two fields is junk, and a malformed timestamp is a
+        # typed rejection — a lenient scraper would mis-ingest it as
+        # part of the value.
+        fields = rest[1:].split()
+        if not fields or len(fields) > 2:
+            raise ExpositionError(
+                lineno, f"expected 'value [timestamp_ms]', got {rest[1:]!r}")
+        value = _parse_value(fields[0], lineno)
+        timestamp_ms: int | None = None
+        if len(fields) == 2:
+            try:
+                timestamp_ms = int(fields[1])
+            except ValueError:
+                raise ExpositionError(
+                    lineno, f"bad sample timestamp {fields[1]!r} "
+                            "(want integer milliseconds)") from None
         key = (name, labels)
         if key in seen:
             raise ExpositionError(
                 lineno, f"duplicate series {name}{dict(labels)}")
         seen.add(key)
-        samples.append(Sample(name, labels, value))
+        samples.append(Sample(name, labels, value, timestamp_ms))
     _check_histograms(samples)
     return samples
 

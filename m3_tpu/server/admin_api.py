@@ -92,7 +92,7 @@ def _parse_dur_nanos(s) -> int:
 
 class AdminContext:
     def __init__(self, kv: KVStore, db=None, aggregator=None, scrubber=None,
-                 migrator=None, tracer=None):
+                 migrator=None, tracer=None, selfmon=None):
         self.kv = kv
         self.namespaces = NamespaceRegistry(kv)
         self.placements = PlacementService(kv)
@@ -101,6 +101,7 @@ class AdminContext:
         self.aggregator = aggregator
         self.scrubber = scrubber
         self.migrator = migrator  # storage.migration.ShardMigrator | None
+        self.selfmon = selfmon  # instrument.selfmon.SelfMonitor | None
         # span-ring debug surface: defaults to the database's tracer so
         # the admin port serves the same ring as the main API's
         # /api/v1/debug/traces (dtest trace collection hits either)
@@ -138,6 +139,22 @@ class _AdminHandler(BaseHTTPRequestHandler):
     def do_GET(self):
         try:
             path = self.path.split("?")[0].rstrip("/")
+            if path == "/health":
+                # Admin-port liveness with the SAME ``slo`` section the
+                # main port serves (the traces/faults parity pattern):
+                # an operator cut off from the serving port — admission
+                # shedding, a wedged handler pool — still reads the
+                # burn-rate verdicts from the admin side.
+                out = {"ok": True}
+                sm = self.ctx.selfmon
+                if sm is not None:
+                    try:
+                        slo = sm.health_slo()
+                        if slo is not None:
+                            out["slo"] = slo
+                    except Exception:  # noqa: BLE001 — health never 500s
+                        pass
+                return self._json(200, out)
             if path == "/api/v1/debug/traces":
                 # the same ring + filters the main API serves, through
                 # the ONE shared response builder (tracing.

@@ -41,6 +41,7 @@ class Assembly:
     remote_stores: list = dataclasses.field(default_factory=list)
     downsampler: object | None = None   # coordinator.downsample.Downsampler
     checkpointer: object | None = None  # aggregator.checkpoint driver
+    selfmon: object | None = None       # instrument.selfmon.SelfMonitor
 
     @property
     def port(self) -> int | None:
@@ -211,6 +212,13 @@ def run_node(source, start_mediator: bool | None = None,
     from m3_tpu.x import register_metrics
 
     register_metrics(registry)
+    # Process-level self-observation (RSS/CPU/threads/FDs/uptime): the
+    # runtime facts debug.py only ever put in the on-demand debug zip
+    # now ride every scrape — the selfmon loop and operator dashboards
+    # see a node eating memory, not just the post-mortem.
+    from m3_tpu.instrument.procstats import install_process_collector
+
+    install_process_collector(registry, scope)
     tracer = None
     if cfg.coordinator is not None and cfg.coordinator.tracing:
         from m3_tpu.instrument.tracing import Tracer
@@ -228,13 +236,26 @@ def run_node(source, start_mediator: bool | None = None,
         ),
         instrument=scope,
     )
+    namespaces = {
+        name: namespace_options(ns) for name, ns in cfg.db.namespaces.items()
+    }
+    if cfg.selfmon.enabled and cfg.selfmon.namespace not in namespaces:
+        # Auto-provision the reserved self-monitoring namespace as an
+        # ordinary db.namespaces entry (declare it in config to tune
+        # retention/blocks).  num_shards follows the serving namespace
+        # so a placement installed by the topology watcher scopes it
+        # identically — selfmon writes cross the same ownership gate
+        # as user ingest.
+        base = cfg.db.namespaces.get(
+            cfg.coordinator.namespace if cfg.coordinator is not None
+            else "default")
+        namespaces[cfg.selfmon.namespace] = NamespaceOptions(
+            num_shards=base.num_shards if base is not None else 4)
     db = Database(
         DatabaseOptions(
             root=cfg.db.root, commitlog_enabled=cfg.db.commitlog_enabled
         ),
-        namespaces={
-            name: namespace_options(ns) for name, ns in cfg.db.namespaces.items()
-        },
+        namespaces=namespaces,
         instrument=scope,
         tracer=tracer,
         limits=limits,
@@ -385,6 +406,31 @@ def run_node(source, start_mediator: bool | None = None,
                 # is moved aside and the node boots fresh.
                 asm.checkpointer.restore()
 
+        # Self-monitoring BEFORE the mediator: the scrape task rides
+        # the tick loop, and its SLO evaluator binds the selfmon
+        # namespace engine at construction.
+        if cfg.selfmon.enabled:
+            from m3_tpu.instrument.selfmon import SelfMonitor
+            from m3_tpu.query.slo import default_rules, rule_from_dict
+
+            rules = (default_rules(cfg.metrics_prefix)
+                     if cfg.selfmon.default_rules else [])
+            rules += [rule_from_dict(r) for r in cfg.selfmon.rules]
+            asm.selfmon = SelfMonitor(
+                db, registry,
+                namespace=cfg.selfmon.namespace,
+                instance=(cfg.selfmon.instance or cfg.db.instance_id
+                          or "self"),
+                budget=cfg.selfmon.budget,
+                peers=cfg.selfmon.peers,
+                scrape_timeout_s=parse_duration(
+                    cfg.selfmon.scrape_timeout) / 1e9,
+                slo_rules=rules,
+                slo_deadline_s=parse_duration(
+                    cfg.selfmon.slo_deadline) / 1e9,
+                instrument=scope,
+            )
+
         if cfg.mediator.enabled if start_mediator is None else start_mediator:
             asm.mediator = Mediator(
                 db,
@@ -400,6 +446,8 @@ def run_node(source, start_mediator: bool | None = None,
                 checkpointer=asm.checkpointer,
                 checkpoint_every=(cfg.coordinator.checkpoint_every
                                   if cfg.coordinator is not None else 0),
+                selfmon=asm.selfmon,
+                selfmon_every=cfg.selfmon.every,
                 instrument=scope,
             )
             asm.mediator.open()
@@ -423,6 +471,7 @@ def run_node(source, start_mediator: bool | None = None,
                 remotes=asm.remote_stores,
                 remotes_required=cfg.query.remotes_required,
                 checkpointer=asm.checkpointer,
+                selfmon=asm.selfmon,
             )
 
             # Admission/slow-query observability: query_active,
@@ -488,7 +537,8 @@ def run_node(source, start_mediator: bool | None = None,
 
             # asm.kv was built up front (the topology watcher shares it)
             admin_ctx = AdminContext(asm.kv, db, scrubber=asm.scrubber,
-                                     migrator=asm.migrator)
+                                     migrator=asm.migrator,
+                                     selfmon=asm.selfmon)
             # live-tune query limits + cache budget through runtime
             # options (runtime_options_manager.go's role)
             def _limit_applier(lim):

@@ -29,6 +29,10 @@ Read-path overload contract (`/api/v1/query`, `/api/v1/query_range`,
 * Queries spending more than ``query.slow_query_fraction`` of their
   deadline land in the slow-query log (`/health` ``query.slow`` +
   ``slow_query_total`` on /metrics) with per-phase timings.
+* ``namespace=`` on ``/api/v1/query``/``query_range`` evaluates over
+  another configured namespace's LOCAL storage — how the
+  ``_m3_selfmon`` self-monitoring history is queried from outside
+  (unknown names 400).
 """
 
 from __future__ import annotations
@@ -291,6 +295,18 @@ class _Handler(BaseHTTPRequestHandler):
                 section["checkpoint"] = self.ctx.checkpointer.status()
             if section:
                 out["device"] = section
+        except Exception:  # noqa: BLE001 — health must never 500
+            pass
+        # SLO burn-rate verdicts over the self-monitored history
+        # (query/slo.py: cached last evaluation, no queries run here)
+        # plus a compact selfmon scrape summary.  Present only when
+        # rules are configured — a node that only stores, never
+        # judges, keeps a noise-free health document.
+        try:
+            if self.ctx.selfmon is not None:
+                slo = self.ctx.selfmon.health_slo()
+                if slo is not None:
+                    out["slo"] = slo
         except Exception:  # noqa: BLE001 — health must never 500
             pass
         return self._json(200, out)
@@ -583,12 +599,16 @@ class _Handler(BaseHTTPRequestHandler):
             step = 10**9
         dl = self._deadline(q)
         ctx = self.ctx
+        # optional namespace override (e.g. namespace=_m3_selfmon: the
+        # self-monitoring history is served by the SAME PromQL surface
+        # as user data); unknown names 400 via the ValueError path
+        engine = ctx.engine_for(q.get("namespace", [None])[0])
         try:
             # admission first (a shed query must not bind engine
             # resources), then the deadline rides the context into the
             # engine → fanout → wire
             with ctx.admission.admit(deadline=dl), xdeadline.bind(dl):
-                block = ctx.engine.execute_range(query, start, end, step)
+                block = engine.execute_range(query, start, end, step)
         except Exception as e:  # noqa: BLE001 — observed, then re-raised
             ctx.observe_query("promql", query, dl, error=e)
             raise
@@ -661,7 +681,7 @@ class ApiContext:
                  query_timeout_s: float = 30.0,
                  slow_query_fraction: float = 0.75,
                  remotes=None, remotes_required: bool = False,
-                 metrics_scope=None, checkpointer=None):
+                 metrics_scope=None, checkpointer=None, selfmon=None):
         self.db = db
         self.namespace = namespace
         self.downsampler = downsampler
@@ -669,6 +689,12 @@ class ApiContext:
         self.tracer = tracer
         self.migrator = migrator  # storage.migration.ShardMigrator | None
         self.checkpointer = checkpointer  # aggregator checkpoint driver
+        self.selfmon = selfmon  # instrument.selfmon.SelfMonitor | None
+        # Per-namespace engine interning for the ``namespace=`` query
+        # param (bounded: namespaces are config objects, not request
+        # input — an unknown name 400s before anything is built).
+        self._ns_engines: dict = {}
+        self._ns_engines_mu = threading.Lock()
         # read-path overload controls (see module docstring); the
         # default AdmissionController(0) gates nothing
         self.admission = admission or AdmissionController()
@@ -713,6 +739,23 @@ class ApiContext:
         from m3_tpu.query.graphite import GraphiteEngine, GraphiteStorage
 
         self.graphite = GraphiteEngine(GraphiteStorage(db, namespace))
+
+    def engine_for(self, namespace: str | None) -> Engine:
+        """The engine serving one namespace: the default request path
+        keeps the federated default-namespace engine; ``namespace=``
+        (e.g. ``_m3_selfmon`` — how a stored fleet-health series is
+        queried from outside) gets a LOCAL-storage engine over that
+        namespace, interned per name."""
+        if namespace is None or namespace == self.namespace:
+            return self.engine
+        if namespace not in self.db.namespaces:
+            raise ValueError(f"unknown namespace {namespace!r}")
+        with self._ns_engines_mu:
+            eng = self._ns_engines.get(namespace)
+            if eng is None:
+                eng = self._ns_engines[namespace] = Engine(
+                    DatabaseStorage(self.db, namespace), tracer=self.tracer)
+            return eng
 
     def observe_query(self, kind: str, query: str, dl: Deadline,
                       error: Exception | None = None) -> None:

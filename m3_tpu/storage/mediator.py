@@ -47,6 +47,8 @@ class Mediator:
         downsampler=None,
         checkpointer=None,
         checkpoint_every: int = 0,
+        selfmon=None,
+        selfmon_every: int = 1,
         instrument=None,
     ):
         self.db = db
@@ -75,6 +77,12 @@ class Mediator:
         # state; 0 disables the periodic save.
         self.checkpointer = checkpointer
         self.checkpoint_every = checkpoint_every
+        # Optional instrument.selfmon.SelfMonitor: the self-scrape
+        # (registry + fleet peers → the _m3_selfmon namespace through
+        # the real write path) and the SLO burn-rate evaluation ride
+        # the maintenance loop on their own cadence.
+        self.selfmon = selfmon
+        self.selfmon_every = max(1, selfmon_every)
         self._ticks = 0
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -123,6 +131,20 @@ class Mediator:
                     _LOG.exception("mediator: downsampler flush failed")
                     if self._scope is not None:
                         self._scope.counter("downsample_flush_errors").inc()
+            if (self.selfmon is not None
+                    and self._ticks % self.selfmon_every == 0):
+                # Self-scrape AFTER the flush stages so the cycle's
+                # samples record this tick's flush counters; the writes
+                # land in open buffers and seal on a later tick like
+                # any other ingest.
+                try:
+                    stats["selfmon"] = self.selfmon.tick(now)
+                except Exception:  # noqa: BLE001 — a failing scrape
+                    # must not disable flush/snapshot/cleanup; counted
+                    # so a silently-dead selfmon is visible on /metrics
+                    _LOG.exception("mediator: selfmon tick failed")
+                    if self._scope is not None:
+                        self._scope.counter("selfmon_tick_errors").inc()
             if (self.checkpointer is not None and self.checkpoint_every > 0
                     and self._ticks % self.checkpoint_every == 0):
                 try:

@@ -402,7 +402,10 @@ def soak(args) -> int:
         return 1 if errs else 0
     if not args.out:
         sys.stdout.write(text + "\n")
-    return 0 if artifact["verdict"]["zero_acked_loss"] else 1
+    v = artifact["verdict"]
+    # round 14: with selfmon on, the run must also leave at least one
+    # retro-queryable SLO verdict in _m3_selfmon (the dogfooding gate)
+    return 0 if v["zero_acked_loss"] and v.get("slo_recorded", True) else 1
 
 
 def lint(args) -> int:

@@ -135,8 +135,16 @@ class Context:
     timed_prefixes: tuple = ("m3_tpu/tools/",)
     # request-serving trees where instrument interning must be hoisted
     # out of loops/handlers and tag values must be literals
-    # (metric-hygiene rule); maintenance paths may intern lazily
-    metric_prefixes: tuple = ("m3_tpu/server/", "m3_tpu/query/")
+    # (metric-hygiene rule); maintenance paths may intern lazily.
+    # round 14: the self-monitoring loop joined the scope — selfmon
+    # converts SCRAPED samples into storage writes every tick, and a
+    # label passthrough into `.tagged({...})` there would intern one
+    # registry series per scraped label value (the exact unbounded-
+    # cardinality leak the rule exists to stop); coordinator/ joined
+    # because the downsampler sits on the same per-batch ingest path
+    metric_prefixes: tuple = ("m3_tpu/server/", "m3_tpu/query/",
+                              "m3_tpu/instrument/selfmon.py",
+                              "m3_tpu/coordinator/")
     # known large host arrays (constant-bloat flags references to these
     # under the tracer even across modules, where size can't be folded)
     large_constants: tuple = ("_VALUE_CTRL_TBL",)

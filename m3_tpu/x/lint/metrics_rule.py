@@ -22,7 +22,11 @@ back:
   sets.
 
 Scope: ``Context.metric_prefixes`` (the request-serving trees —
-``server/``, ``query/``) — maintenance-path modules may intern lazily.
+``server/``, ``query/`` — plus, since round 14,
+``instrument/selfmon.py`` and ``coordinator/``: the self-monitoring
+loop converts SCRAPED samples every tick, where a per-sample intern or
+a scraped-label tag value is exactly the leak above) — maintenance-path
+modules may intern lazily.
 """
 
 from __future__ import annotations

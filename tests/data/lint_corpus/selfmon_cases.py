@@ -1,0 +1,40 @@
+"""Seeded metric-hygiene violations in the SELFMON shape (never
+imported): round 14 put instrument/selfmon.py and coordinator/ in the
+rule's scope because the self-monitoring loop handles SCRAPED samples —
+label values from a peer's exposition are request input, and passing
+one into ``.tagged({...})`` interns an unbounded registry series per
+distinct scraped value.  The corpus run passes a Context whose metric
+prefixes match this directory."""
+
+scope = None  # placeholder; names resolve statically in the analyzer
+
+
+def convert_cycle(samples):
+    for s in samples:
+        # per-sample interning inside the scrape loop: one name build +
+        # registry-lock intern per scraped series per cycle
+        scope.counter("selfmon_rows").inc()     # VIOLATION (L16)
+        record(s)
+
+
+def tag_passthrough(samples):
+    for s in samples:
+        # a scraped label value straight into a tag set: every distinct
+        # peer-supplied value interns a series that lives forever
+        scope.tagged({"origin": s.label("instance")})  # VIOLATION (L24)
+
+
+def record(s):
+    pass
+
+
+class CleanSelfmon:
+    def __init__(self):
+        # hoisted: interned once at construction, reused per cycle
+        self._rows = scope.counter("selfmon_rows")
+        self._src = scope.tagged({"source": "local"})  # ok: literal
+
+    def convert_cycle(self, samples):
+        for s in samples:
+            self._rows.inc()                    # ok: pre-interned handle
+            record(s)

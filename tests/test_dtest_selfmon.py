@@ -1,0 +1,163 @@
+"""Round-14 acceptance dtest: the fleet scrapes itself into its own
+storage, and a chaos wire-fault window trips a PromQL burn-rate rule.
+
+3 real node processes (rf=3, shared remote KV, placement via the admin
+API) under sustained Majority ingest with self-monitoring ON in fleet
+mode (every node stores its own registry AND its peers' /metrics in
+``_m3_selfmon`` through the real write path).  A wire-fault window
+(``rpc.server`` drop faults armed live over HTTP) on node 1 must:
+
+* trip the configured multi-window burn-rate rule ON the faulted
+  node's ``/health`` ``slo`` section (the rule reads node 1's OWN
+  self-stored fault/ingest series),
+* be visible via a PromQL query over ``_m3_selfmon`` issued to a
+  DIFFERENT node (node 0 fleet-scraped node 1's ``slo_burn`` gauge —
+  the whole cluster's health is one query away from any node),
+* CLEAR after disarm (the rate windows wash out),
+
+with zero acked-sample loss throughout (the soak ledger's regenerate-
+and-reread verify at Majority).
+"""
+
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from m3_tpu.dtest.soak import (
+    NS, Ledger, SoakCluster, SoakConfig, WorkloadGen, _verify,
+)
+
+# objective 0.99 → budget 0.01: fires once >1% of rpc write FRAMES are
+# dropped over BOTH windows (factor 1.0), clears ~long-window after
+# disarm.  fault_drop_triggers is the x/fault mirror every node already
+# exposes; db_write_batch_seconds_count counts completed write frames,
+# so attempts ≈ completed + dropped — both sides frame-rate, same unit
+# (db_writes would be SAMPLES: 1000x off per batch).
+WIRE_RULE = {
+    "name": "wire-errors",
+    "objective": 0.99,
+    "ratio": ("sum(rate(fault_drop_triggers[{window}])) / "
+              "clamp_min(sum(rate(m3tpu_db_write_batch_seconds_count"
+              "[{window}])) + sum(rate(fault_drop_triggers[{window}])), "
+              "0.1)"),
+    "windows": [{"long": "30s", "short": "10s", "factor": 1.0}],
+}
+
+
+def _health(cluster, k):
+    import json
+
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{cluster.http_port(k)}/health",
+            timeout=30) as r:
+        return json.load(r)
+
+
+def _rule_firing(cluster, k, rule):
+    doc = (_health(cluster, k).get("slo") or {}).get("rules", {}).get(rule)
+    return doc is not None and doc.get("firing") is True
+
+
+@pytest.mark.slow
+class TestSelfMonitoringFleetScenario:
+    def test_wire_fault_trips_burn_rule_fleet_visible(self, tmp_path):
+        cfg = SoakConfig(
+            nodes=3, series=4000, batch=1000, num_shards=4,
+            slot_capacity=1 << 16, churn=0.0, smoke=True,  # 1s ticks
+            replace=False, selfmon_budget=4000,
+            selfmon_extra_rules=[WIRE_RULE],
+        )
+        cluster = SoakCluster(cfg, tmp_path / "cluster")
+        try:
+            cluster.start()
+            gen = WorkloadGen(cfg.series, cfg.churn, cfg.seed)
+            ledger = Ledger(gen)
+            stop = threading.Event()
+
+            def ingest():
+                sweep = 0
+                while not stop.is_set():
+                    for lo in range(0, cfg.series, cfg.batch):
+                        if stop.is_set():
+                            break
+                        hi = min(lo + cfg.batch, cfg.series)
+                        ids = gen.ids(sweep, lo, hi)
+                        vals = gen.values(sweep, lo, hi)
+                        ts = time.time_ns()
+                        tsa = np.full(hi - lo, ts, np.int64)
+                        try:
+                            rejected = cluster.session.write_batch(
+                                NS, ids, tsa, vals, now_nanos=ts)
+                        except Exception:  # noqa: BLE001 — unacked
+                            stop.wait(0.2)
+                            continue
+                        if not rejected:
+                            ledger.ack_bulk(sweep, lo, hi, ts)
+                    sweep += 1
+
+            t = threading.Thread(target=ingest, daemon=True)
+            t.start()
+
+            # baseline: ingest + selfmon cycles, rule present, quiet
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                slo = _health(cluster, 1).get("slo")
+                if slo and "wire-errors" in slo.get("rules", {}):
+                    break
+                time.sleep(1.0)
+            else:
+                pytest.fail("wire-errors rule never appeared on node 1")
+            assert not _rule_firing(cluster, 1, "wire-errors")
+
+            # -- fault window: drop 40% of node 1's rpc traffic -------
+            cluster.arm_faults(1, "rpc.server=drop:p=0.4:seed=7")
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if _rule_firing(cluster, 1, "wire-errors"):
+                    break
+                time.sleep(1.0)
+            else:
+                pytest.fail(
+                    "burn rule never fired on the faulted node; health="
+                    f"{_health(cluster, 1).get('slo')}")
+
+            # fleet visibility: node 0 answers for node 1's burn from
+            # its OWN storage (it fleet-scraped i1's slo_burn gauge)
+            deadline = time.monotonic() + 60
+            burn = None
+            while time.monotonic() < deadline:
+                rows = cluster.promql(
+                    0, 'max_over_time(m3tpu_slo_burn'
+                       '{rule="wire-errors",instance="i1"}[5m])',
+                    namespace="_m3_selfmon")
+                if rows:
+                    burn = float(rows[0]["value"][1])
+                    if burn >= 1.0:
+                        break
+                time.sleep(1.0)
+            assert burn is not None and burn >= 1.0, (
+                f"faulted node's burn not visible from node 0: {burn}")
+
+            # -- disarm: the rule must CLEAR as the windows wash out --
+            cluster.clear_faults(1)
+            deadline = time.monotonic() + 150
+            while time.monotonic() < deadline:
+                if not _rule_firing(cluster, 1, "wire-errors"):
+                    break
+                time.sleep(2.0)
+            else:
+                pytest.fail("burn rule never cleared after disarm")
+
+            # -- zero acked-sample loss throughout --------------------
+            stop.set()
+            t.join(60)
+            assert ledger.acked_samples > 0
+            for k in cluster.alive_nodes():
+                cluster.nodes[k].wait_healthy(120)
+            verdict = _verify(cluster, ledger, cfg)
+            assert verdict["zero_acked_loss"], verdict
+        finally:
+            cluster.close()

@@ -94,6 +94,10 @@ EXPECTED = {
     ("metric_cases.py", "metric-hygiene", 16),   # intern in do_GET
     ("metric_cases.py", "metric-hygiene", 20),   # f-string tag value
     ("metric_cases.py", "metric-hygiene", 21),   # variable tag value
+    # round 14: selfmon-shape seeds — intern per scraped sample, and a
+    # scraped label value passed through into a tag set
+    ("selfmon_cases.py", "metric-hygiene", 16),  # intern in scrape loop
+    ("selfmon_cases.py", "metric-hygiene", 24),  # scraped-label tag value
     # round 12: device-boundary guard coverage seeds
     ("devguard_cases.py", "device-guard", 24),   # raw jit dispatch
     ("devguard_cases.py", "device-guard", 27),   # jax.jit(f) assignment
@@ -367,6 +371,36 @@ class TestDevguardScope:
                     "m3_tpu/encoding/m3tsz_jax2.py"):
             got = self._lint_at(tmp_path, rel, self.RAW)
             assert not any(f.rule == "device-guard" for f in got), rel
+
+
+class TestMetricScope:
+    """Round 14: the DEFAULT context aims metric-hygiene at the
+    self-monitoring loop (instrument/selfmon.py) and coordinator/ in
+    addition to server//query/ — scraped-sample label passthrough is
+    the new unbounded-cardinality vector — while the rest of
+    instrument/ (the registry's own home) stays exempt."""
+
+    LEAK = ("scope = None\n"
+            "def cycle(samples):\n"
+            "    for s in samples:\n"
+            "        scope.tagged({'origin': s.label('instance')})\n")
+
+    def _lint_at(self, tmp_path, rel, src):
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+        return lint_file(p, tmp_path, Context())
+
+    def test_fires_in_selfmon_and_coordinator(self, tmp_path):
+        for rel in ("m3_tpu/instrument/selfmon.py",
+                    "m3_tpu/coordinator/downsample2.py"):
+            got = self._lint_at(tmp_path, rel, self.LEAK)
+            assert any(f.rule == "metric-hygiene" for f in got), rel
+
+    def test_rest_of_instrument_exempt(self, tmp_path):
+        got = self._lint_at(tmp_path, "m3_tpu/instrument/tracing2.py",
+                            self.LEAK)
+        assert not any(f.rule == "metric-hygiene" for f in got)
 
 
 class TestExplain:

@@ -319,6 +319,17 @@ class TestCheckGate:
         errs = check_artifact({"kind": "BENCH"}, _artifact())
         assert errs and "kind" in errs[0]
 
+    def test_selfmon_slo_not_recorded_fails(self):
+        # round 14: selfmon on but no queryable burn verdict landed in
+        # _m3_selfmon — the self-monitoring contract itself regressed
+        new = _artifact()
+        new["verdict"]["slo_recorded"] = False
+        errs = check_artifact(new, _artifact())
+        assert any("selfmon" in e for e in errs)
+        ok = _artifact()
+        ok["verdict"]["slo_recorded"] = True
+        assert check_artifact(ok, _artifact()) == []
+
     def test_schema_mismatch_fails(self):
         # a schema bump may rename the compared fields — every .get()
         # would miss and the gate would pass vacuously; it must fail loud
@@ -553,3 +564,16 @@ class TestSoakSmoke:
         # historical + query corpora)
         total = sum(p["ingest"]["acked_samples"] for p in art["phases"])
         assert 0 < total <= v["acked_samples"]
+        # round 14: the run's SLO record is retro-queryable PromQL over
+        # the fleet's self-stored _m3_selfmon history — at least one
+        # burn verdict, per-instance, plus a fleet ingest p99 answered
+        # from ONE node's storage (fleet scrape covered its peer)
+        assert v["slo_recorded"] is True
+        sm = art["selfmon"]
+        assert sm["verdicts"], sm
+        rules = {vd["rule"] for vd in sm["verdicts"]}
+        assert {"ingest-latency", "query-latency"} <= rules
+        insts = {vd["instance"] for vd in sm["verdicts"]}
+        assert {"i0", "i1"} <= insts  # fleet mode: both nodes' burn
+        assert sm["queries"]["fleet_ingest_p99_s"] is not None
+        assert sm["health_slo"] and "rules" in sm["health_slo"]
