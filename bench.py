@@ -496,6 +496,30 @@ def _run_costs_stage(platform: str) -> dict:
     }
 
 
+def _run_irlint_stage(platform: str) -> dict:
+    """IR-rule census of the FULL costwatch registry on this child's
+    backend (cli tpu_backlog's `irlint` stage): the first relay window
+    records the Mosaic/TPU lowering's findings — scatter and width
+    censuses differ legitimately from the committed CPU contracts
+    (pallas stages lower to tpu_custom_call instead of interpret-mode
+    HLO), so this is a head-to-head REPORT, not a gate; the CPU
+    ratchet lives in tier-1 (`cli irlint --check`).  Near-free after
+    the costs stage: both walk the shared costwatch stage cache, so
+    every program is already compiled in this process."""
+    from m3_tpu.x.irlint import build_artifact
+
+    artifact = build_artifact(log=_log)
+    return {
+        "platform": platform,
+        "config": artifact["config"],
+        "counts": artifact["counts"],
+        "findings": artifact["findings"],
+        "suppressions": artifact["suppressions"],
+        "residency": artifact["residency"],
+        "validation": "ok",
+    }
+
+
 # The pre-rewrite wide-carry encode scan's round-7 number — deleted in
 # round 9 (the two-phase lane-emission rewrite replaced it wholesale),
 # so the bench's old-vs-new head-to-head reports against this RECORDED
@@ -1521,6 +1545,9 @@ def child_main(platform: str) -> None:
         # even over the relay) for head-to-head vs the committed CPU
         # baseline COSTS_r13.json.
         guarded("costs", 60, _run_costs_stage, "tpu")
+        # Mosaic-side IR census of the same registry (reuses the stage
+        # cache the costs stage just filled — zero extra compiles).
+        guarded("irlint", 60, _run_irlint_stage, "tpu")
         if jax.device_count() > 1:
             guarded("agg_scaling", 120, _run_agg_scaling, "tpu")
         return

@@ -346,6 +346,85 @@ def costs(args) -> int:
     return 0
 
 
+def irlint(args) -> int:
+    """Typed StableHLO/HLO-level rules over the device-program registry
+    (x/irlint.py): lower every costwatch stage through the shared stage
+    cache (ShapeDtypeStructs only — zero execution, relay-independent)
+    and census the module texts against per-stage contracts
+    (transfer-free / scatter-budget / width-discipline /
+    ir-const-bloat), plus the residency-composition probe of the
+    ROADMAP item-1 chain (arena_ingest → window_drain → encode phase 1
+    → placement) whose host crossings are the committed burn-down list.
+
+    ``--check [BASELINE]`` ratchets against ``IRLINT_r17.json`` (new
+    finding fails, stale baseline entry fails — improvements
+    re-baseline); ``--out`` writes the artifact; ``--explain RULE``
+    prints a rule's rationale + examples."""
+    import os
+
+    if args.explain:
+        from m3_tpu.x.irlint import EXPLAIN
+
+        info = EXPLAIN.get(args.explain)
+        if info is None:
+            print(f"unknown irlint rule {args.explain!r}; rules: "
+                  f"{', '.join(sorted(EXPLAIN))}", file=sys.stderr)
+            return 2
+        print(f"[{args.explain}]\n\n{info['why']}\n\nviolates:\n  "
+              f"{info['bad']}\n\nclean:\n  {info['good']}")
+        return 0
+
+    # same bootstrap as `cli costs`: the sharded stages pin a 2-device
+    # mesh, so give the host platform its virtual devices BEFORE the
+    # backend initializes (inert on a real TPU backend / after init)
+    from m3_tpu.parallel.mesh import enable_cpu_core_devices
+
+    enable_cpu_core_devices(max(2, os.cpu_count() or 1))
+    from m3_tpu.x.irlint import (
+        build_artifact, check_against_baseline, default_baseline_path,
+    )
+
+    baseline = None
+    if args.check is not None:
+        # resolve + validate the baseline BEFORE the compile run: a
+        # typo'd path must fail in milliseconds (the costs precedent)
+        baseline = args.check or str(default_baseline_path())
+        if not Path(baseline).exists():
+            print(f"irlint --check: no baseline at {baseline}",
+                  file=sys.stderr)
+            return 2
+
+    def log(msg):
+        print(msg, file=sys.stderr)
+
+    artifact = build_artifact(stage_names=args.stage or None, log=log)
+    text = json.dumps(artifact, indent=1)
+    if args.out:
+        Path(args.out).write_text(text + "\n")
+        log(f"irlint: artifact written to {args.out}")
+    if baseline is not None:
+        errs = check_against_baseline(artifact, baseline)
+        if args.json:
+            _out({"ok": not errs, "artifact": "IRLINT",
+                  "baseline": baseline, "counts": artifact["counts"],
+                  "violations": errs})
+        else:
+            for e in errs:
+                print(f"{e['kind'].upper():<14} {e['message']}",
+                      file=sys.stderr)
+            _out({"irlint_check": {"ok": not errs, "baseline": baseline,
+                                   "counts": artifact["counts"],
+                                   "violations": len(errs)}})
+        return 1 if errs else 0
+    if args.json:
+        _out({"ok": True, "artifact": "IRLINT",
+              "counts": artifact["counts"],
+              "findings": artifact["findings"]})
+    elif not args.out:
+        sys.stdout.write(text + "\n")
+    return 0
+
+
 def soak(args) -> int:
     """Million-series soak (dtest/soak.py): real multi-node cluster,
     sustained bulk ingest + PromQL/Graphite query traffic, a seeded
@@ -595,6 +674,32 @@ def main(argv=None) -> int:
                     help="restrict to named stages (repeatable; "
                          "default: full registry)")
     co.set_defaults(fn=costs)
+
+    ir = sub.add_parser(
+        "irlint",
+        help="typed StableHLO/HLO rules over the device-program "
+             "registry (transfer-free / scatter-budget / "
+             "width-discipline / ir-const-bloat) + the "
+             "residency-composition probe of the item-1 chain; "
+             "emit/check the IRLINT artifact (compile-only, zero "
+             "execution)")
+    ir.add_argument("--out", help="write the artifact JSON here")
+    ir.add_argument("--check", nargs="?", const="", default=None,
+                    metavar="BASELINE",
+                    help="gate against a committed IRLINT artifact "
+                         "(default: repo IRLINT_r17.json); exit 1 on "
+                         "any new finding or stale baseline entry "
+                         "(improvements re-baseline)")
+    ir.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout (ok flag + "
+                         "per-rule counts + violations) for CI")
+    ir.add_argument("--stage", action="append", metavar="NAME",
+                    help="restrict IR rules to named registry stages "
+                         "(repeatable; residency probes always run)")
+    ir.add_argument("--explain", metavar="RULE",
+                    help="print one rule's rationale + violating/clean "
+                         "examples and exit")
+    ir.set_defaults(fn=irlint)
 
     sk = sub.add_parser(
         "soak",

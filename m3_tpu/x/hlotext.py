@@ -1,0 +1,148 @@
+"""One parsing home for XLA program text (compiled HLO + StableHLO).
+
+Two gates read the same module texts every tier-1 run: ``cli costs``
+fingerprints the compiled-HLO opcode mix, and ``cli irlint`` runs typed
+IR rules over both the StableHLO (formulation level — what WE wrote,
+platform-independent) and the compiled HLO (post-optimization — what
+XLA kept, e.g. constants it folded).  A drifted second copy of the
+instruction grammar would let the two gates disagree about the same
+text, so every regex lives here and both delegate.
+
+Nothing in this module imports jax or touches devices: inputs are the
+strings ``lowered.as_text()`` (StableHLO/MLIR) and
+``compiled.as_text()`` (HLO) hand over.
+
+Grammar notes (pinned by tests, revisit on an XLA upgrade):
+
+* a compiled-HLO instruction line is
+  ``[ROOT ]%name = <shape|(tuple)> opcode(...)``; nested computations
+  use the same line shape, so one regex censuses the whole module;
+* an HLO shape token is ``f64[2,3]`` / ``s32[]`` — element type then
+  bracketed dims (layout ``{...}`` suffix ignored);
+* a StableHLO tensor type is ``tensor<8192xi64>`` / ``tensor<f64>``;
+* StableHLO custom calls appear both as the pretty form
+  ``stablehlo.custom_call @Target(...)`` and the generic form with a
+  ``call_target_name = "Target"`` attribute.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List
+
+__all__ = [
+    "HLO_INSTR_RE", "HLO_SHAPE_RE", "STABLEHLO_TENSOR_RE",
+    "custom_call_targets", "folded_constants", "op_histogram",
+    "shape_elements", "stablehlo_custom_call_targets",
+    "stablehlo_op_count", "stablehlo_type_census",
+]
+
+
+# Compiled-HLO instruction line: `  [ROOT ]%name = shape opcode(...)`.
+# Group 1 is the result shape (possibly a `(tuple)`), group 2 the opcode.
+HLO_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%[^\s=]+\s*=\s*(\([^)]*\)|\S+)\s+([a-z][a-z0-9-]*)\(",
+    re.MULTILINE)
+
+# One element-typed shape token inside an HLO type: `f64[1024,8]`, `s32[]`.
+HLO_SHAPE_RE = re.compile(r"\b([a-z]+[0-9]+)\[([0-9,]*)\]")
+
+# One StableHLO tensor type: `tensor<1024x8xf64>`, `tensor<i64>`.
+STABLEHLO_TENSOR_RE = re.compile(r"tensor<(?:[0-9?]+x)*([a-z][a-z0-9]*)>")
+
+_HLO_CUSTOM_CALL_RE = re.compile(r'custom_call_target="([^"]+)"')
+_SHLO_CUSTOM_CALL_PRETTY_RE = re.compile(
+    r"stablehlo\.custom_call\s+@([\w$.]+)")
+_SHLO_CUSTOM_CALL_GENERIC_RE = re.compile(
+    r'call_target_name\s*=\s*"([^"]+)"')
+
+
+def op_histogram(hlo_text: str,
+                 include_tuple_shaped: bool = False) -> Dict[str, int]:
+    """Opcode-class histogram of a compiled HLO module (entry + nested
+    computations).  Deterministic for a given (program, platform, XLA
+    version) — the op-mix fingerprint that catches "same flops, worse
+    formulation" regressions (e.g. a dense op turning into scatter).
+
+    The default SKIPS tuple-shaped instructions (``(s64[8], f64[8])
+    sort(...)``): the frozen COSTS baselines pin that census, so the
+    default can only change together with a re-baseline.  irlint's
+    transfer census passes ``include_tuple_shaped=True`` — the ops it
+    hunts (infeed, recv) are exactly the tuple-shaped ones."""
+    hist: Dict[str, int] = {}
+    for m in HLO_INSTR_RE.finditer(hlo_text):
+        if not include_tuple_shaped and m.group(1).startswith("("):
+            continue
+        op = m.group(2)
+        hist[op] = hist.get(op, 0) + 1
+    return dict(sorted(hist.items()))
+
+
+def shape_elements(dims: str) -> int:
+    """Element count of an HLO dims string (``"1024,8"`` → 8192;
+    ``""`` — a scalar — → 1)."""
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n
+
+
+def custom_call_targets(hlo_text: str) -> Dict[str, int]:
+    """Per-target custom-call counts of a compiled HLO module."""
+    out: Dict[str, int] = {}
+    for m in _HLO_CUSTOM_CALL_RE.finditer(hlo_text):
+        out[m.group(1)] = out.get(m.group(1), 0) + 1
+    return dict(sorted(out.items()))
+
+
+def stablehlo_custom_call_targets(stablehlo_text: str) -> Dict[str, int]:
+    """Per-target custom-call counts of a StableHLO module (both the
+    pretty ``@Target`` form and the generic-form attribute)."""
+    out: Dict[str, int] = {}
+    for rx in (_SHLO_CUSTOM_CALL_PRETTY_RE, _SHLO_CUSTOM_CALL_GENERIC_RE):
+        for m in rx.finditer(stablehlo_text):
+            out[m.group(1)] = out.get(m.group(1), 0) + 1
+    return dict(sorted(out.items()))
+
+
+def stablehlo_op_count(stablehlo_text: str, op: str) -> int:
+    """Occurrences of one StableHLO op (``"scatter"`` counts
+    ``stablehlo.scatter`` only — ``select_and_scatter`` is a different
+    token and does not match)."""
+    return len(re.findall(
+        r"\bstablehlo\." + re.escape(op) + r"\b", stablehlo_text))
+
+
+def stablehlo_type_census(stablehlo_text: str) -> Dict[str, int]:
+    """Tensor-type token census of a StableHLO module: how many times
+    each element type appears in a ``tensor<...>`` type.  Counts
+    operand AND result positions — deliberately redundant, so a silent
+    i32→i64 / f32→f64 promotion moves the census even when the op count
+    is unchanged."""
+    out: Dict[str, int] = {}
+    for m in STABLEHLO_TENSOR_RE.finditer(stablehlo_text):
+        t = m.group(1)
+        out[t] = out.get(t, 0) + 1
+    return dict(sorted(out.items()))
+
+
+def folded_constants(hlo_text: str, min_elements: int) -> List[dict]:
+    """Constant instructions of at least ``min_elements`` elements in a
+    compiled HLO module — literals XLA kept AFTER folding, the class an
+    AST-level constant-bloat rule cannot see once a builder function
+    folds them (PR 7's 1MB decode control table)."""
+    out: List[dict] = []
+    for m in HLO_INSTR_RE.finditer(hlo_text):
+        if m.group(2) != "constant":
+            continue
+        sm = HLO_SHAPE_RE.search(m.group(1))
+        if sm is None:
+            continue
+        n = shape_elements(sm.group(2))
+        if n >= min_elements:
+            out.append({"dtype": sm.group(1),
+                        "shape": sm.group(2) or "scalar",
+                        "elements": n})
+    out.sort(key=lambda c: (-c["elements"], c["dtype"], c["shape"]))
+    return out

@@ -1,6 +1,6 @@
 """m3lint: codebase-aware static analysis for the m3-tpu tree.
 
-Fourteen rule families, each encoding a contract this repo already
+Sixteen rule families, each encoding a contract this repo already
 pays for at runtime (race tier, fault tier, bit-exactness goldens,
 bench steady-state) as a static gate:
 
@@ -36,6 +36,15 @@ bench steady-state) as a static gate:
   constant-folded into jitted HLO.  Static twin of the runtime
   sanitizer ``m3_tpu/x/tracewatch.py``; see TESTING.md "Compile
   stability & transfer hygiene".
+* ``device-guard``      — raw hot-path device dispatches (module-jitted
+  calls, ``device_put``, ``block_until_ready``) outside the
+  ``x.devguard`` seam in the serving trees (round 12's fault-tier
+  reachability invariant).
+* ``registry-complete`` — devguard entry points × membudget components
+  × costwatch registry stages must describe the same device-program
+  set (``registry_rule.FAMILIES``); a program present in one registry
+  but missing from another — or a family with no cost leg and no
+  reviewed waiver — is a coverage hole (round 17).
 * ``metric-hygiene``    — instrument interning inside loops/per-request
   handlers in the request-serving trees (``server/``, ``query/``) —
   registry interning makes it correct but per-call lock+intern is

@@ -56,6 +56,10 @@ RULES = (
     # round 12: device-boundary guard coverage (devguard_rule.py) —
     # hot-path jit dispatches must run behind x.devguard
     "device-guard",
+    # round 17: device-program registry completeness (registry_rule.py)
+    # — devguard entry points × membudget components × costwatch
+    # stages must describe the same program set
+    "registry-complete",
 )
 
 _SUPPRESS_RE = re.compile(r"#\s*m3lint:\s*disable=([\w,-]+)")
@@ -158,6 +162,13 @@ class Context:
     # files that ARE the guard plumbing (nothing today; the seam lives
     # in x/devguard.py, outside the scoped prefixes)
     device_helper_files: tuple = ()
+    # round 17: trees whose run_guarded/membudget literals must be
+    # declared in registry_rule.FAMILIES (registry-complete rule); the
+    # costwatch registry file additionally cross-checks the inverse
+    # direction (every family has a cost leg or a reviewed waiver)
+    registry_prefixes: tuple = ("m3_tpu/storage/", "m3_tpu/aggregator/",
+                                "m3_tpu/encoding/", "m3_tpu/server/")
+    registry_cost_file: str = "m3_tpu/x/costwatch.py"
 
     def is_wire_module(self, path: str) -> bool:
         return (path in self.wire_files
@@ -230,7 +241,8 @@ def apply_suppressions(unit: FileUnit, findings: Iterable[Finding]) -> List[Find
 def default_rules() -> List[Rule]:
     from m3_tpu.x.lint import (
         corruption, deadline_aware, devguard_rule, faultcov, jaxlint,
-        locks, metrics_rule, placement, purity, resources, wirecheck,
+        locks, metrics_rule, placement, purity, registry_rule, resources,
+        wirecheck,
     )
 
     return [
@@ -249,6 +261,7 @@ def default_rules() -> List[Rule]:
         jaxlint.check_constant_bloat,
         metrics_rule.check,
         devguard_rule.check,
+        registry_rule.check,
     ]
 
 
@@ -257,12 +270,13 @@ def explain(rule: str) -> dict | None:
     modules' EXPLAIN tables (``cli lint --explain`` renders it)."""
     from m3_tpu.x.lint import (
         corruption, deadline_aware, devguard_rule, faultcov, jaxlint,
-        locks, metrics_rule, placement, purity, resources, wirecheck,
+        locks, metrics_rule, placement, purity, registry_rule, resources,
+        wirecheck,
     )
 
     for mod in (jaxlint, locks, purity, wirecheck, faultcov, resources,
                 corruption, placement, deadline_aware, metrics_rule,
-                devguard_rule):
+                devguard_rule, registry_rule):
         entry = getattr(mod, "EXPLAIN", {}).get(rule)
         if entry is not None:
             return entry

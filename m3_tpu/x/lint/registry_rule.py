@@ -1,0 +1,229 @@
+"""Rule family 16 — device-program registry completeness
+(``registry-complete``).
+
+Round 17's cross-check: the repo now has THREE registries that must
+describe the same set of device programs — the ``x.devguard`` entry
+points (``run_guarded``/``transfer_point`` stage names: fault
+classification + breakers), the ``x.membudget`` footprint components
+(HBM admission), and the ``x.costwatch`` stage registry (the costs +
+irlint compile gates).  A device program present in one but missing
+from another is a coverage hole nothing else detects: a guarded stage
+with no costwatch row is invisible to both IR gates, a budgeted
+component with no guard can OOM untyped, a costwatch family with no
+budget registration is unadmitted HBM.
+
+The agreement is declared ONCE, in the :data:`FAMILIES` table below,
+and this rule enforces it per file:
+
+* a ``run_guarded("X", ...)`` / ``transfer_point("X")`` string literal
+  whose stage is not declared by any family is a finding (an
+  unregistered device entry point);
+* a ``membudget.reserve("X", ...)`` / ``membudget.transient("X", ...)``
+  literal whose component is not declared by any family is a finding;
+* in the costwatch registry file, a ``Stage("p/...", ...)`` whose
+  prefix no family covers is a finding — and the inverse: a family
+  whose declared ``cost_prefixes`` match no Stage, or that has neither
+  a cost leg nor a reviewed ``cost_waiver``, is a finding;
+* in each family's declared home file, every declared guard /
+  membudget component must actually appear as a literal (the table
+  drifting from the code is itself the bug).
+
+Real gap found while seeding this rule: the buffer family
+(``storage.buffer_append``/``storage.buffer_drain`` +
+``storage.buffer``) has NO costwatch stage.  Recorded as a reviewed
+``cost_waiver`` rather than new stages: the COSTS_r13 stage set is
+frozen this round (ISSUE 17 satellite: zero hot-path behavior), and
+the buffer's device programs take engine-dependent shapes that pin
+only when the item-1 rebuild lands its pinned-shape buffer stages.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from m3_tpu.x.lint.core import Context, FileUnit, Finding, dotted
+
+# The one declaration of "these three registries agree".  Each family
+# names its devguard stages, membudget components, costwatch stage-name
+# prefixes, and the home files where the guard/membudget literals live.
+# ``cost_waiver`` documents a REVIEWED absence of a cost leg — without
+# it, a family with no costwatch coverage is a finding.
+FAMILIES: Dict[str, dict] = {
+    "codec.decode": {
+        "guards": ("decode",),
+        "guard_files": ("m3_tpu/encoding/m3tsz_jax.py",),
+        "membudget": ("decode.lanes", "decode.ctrl_table"),
+        "membudget_files": ("m3_tpu/encoding/m3tsz_jax.py",),
+        "cost_prefixes": ("decode/",),
+    },
+    "codec.encode": {
+        "guards": ("encode",),
+        "guard_files": ("m3_tpu/encoding/m3tsz_jax.py",),
+        "membudget": ("encode.lanes",),
+        "membudget_files": ("m3_tpu/encoding/m3tsz_jax.py",),
+        "cost_prefixes": ("encode/",),
+    },
+    "arena": {
+        "guards": ("arena.ingest", "arena.consume"),
+        "guard_files": ("m3_tpu/aggregator/arena.py",),
+        "membudget": ("aggregator.counter", "aggregator.gauge",
+                      "aggregator.timer"),
+        "membudget_files": ("m3_tpu/aggregator/arena.py",),
+        "cost_prefixes": ("arena/", "timer/"),
+    },
+    "buffer": {
+        "guards": ("storage.buffer_append", "storage.buffer_drain"),
+        "guard_files": ("m3_tpu/storage/buffer.py",),
+        "membudget": ("storage.buffer",),
+        "membudget_files": ("m3_tpu/storage/buffer.py",),
+        "cost_prefixes": (),
+        "cost_waiver": (
+            "COSTS_r13 stage set is frozen (round-17 zero-hot-path "
+            "contract) and the buffer programs' shapes are "
+            "engine-dependent; pinned-shape buffer stages land with "
+            "the ROADMAP item-1 device-resident rebuild"),
+    },
+}
+
+_GUARD_CALLS = {"devguard.run_guarded", "run_guarded",
+                "devguard.transfer_point", "transfer_point"}
+_BUDGET_CALLS = {"membudget.reserve", "membudget.transient"}
+
+
+def _declared(field: str) -> set:
+    out: set = set()
+    for fam in FAMILIES.values():
+        out.update(fam.get(field, ()))
+    return out
+
+
+def _str_arg0(node: ast.Call):
+    if node.args and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, str):
+        return node.args[0].value
+    return None
+
+
+def check(unit: FileUnit, ctx: Context) -> List[Finding]:
+    cost_file = getattr(ctx, "registry_cost_file",
+                        "m3_tpu/x/costwatch.py")
+    prefixes = getattr(ctx, "registry_prefixes",
+                       ("m3_tpu/storage/", "m3_tpu/aggregator/",
+                        "m3_tpu/encoding/", "m3_tpu/server/"))
+    in_scope = any(unit.path.startswith(p) for p in prefixes)
+    is_cost_file = unit.path == cost_file
+    is_home = any(
+        unit.path in fam.get("guard_files", ())
+        or unit.path in fam.get("membudget_files", ())
+        for fam in FAMILIES.values())
+    if not (in_scope or is_cost_file or is_home):
+        return []
+
+    findings: List[Finding] = []
+    guards = _declared("guards")
+    budgets = _declared("membudget")
+    cost_prefixes = _declared("cost_prefixes")
+    seen_guards: set = set()
+    seen_budgets: set = set()
+    stage_names: List[tuple] = []
+
+    for node in ast.walk(unit.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dotted(node.func)
+        lit = _str_arg0(node)
+        if lit is None:
+            continue
+        if callee in _GUARD_CALLS:
+            seen_guards.add(lit)
+            if in_scope and lit not in guards:
+                findings.append(Finding(
+                    "registry-complete", unit.path, node.lineno,
+                    f"device entry point '{lit}' is not declared by any "
+                    "registry family — a guarded stage outside "
+                    "registry_rule.FAMILIES has no membudget/costwatch "
+                    "cross-check (declare it, with its budget and cost "
+                    "legs, or it is a coverage hole)"))
+        elif callee in _BUDGET_CALLS:
+            seen_budgets.add(lit)
+            if in_scope and lit not in budgets:
+                findings.append(Finding(
+                    "registry-complete", unit.path, node.lineno,
+                    f"membudget component '{lit}' is not declared by any "
+                    "registry family — a budgeted footprint outside "
+                    "registry_rule.FAMILIES has no devguard/costwatch "
+                    "cross-check"))
+        elif callee == "Stage" and is_cost_file and "/" in lit:
+            stage_names.append((lit, node.lineno))
+            prefix = lit.split("/", 1)[0] + "/"
+            if prefix not in cost_prefixes:
+                findings.append(Finding(
+                    "registry-complete", unit.path, node.lineno,
+                    f"costwatch stage '{lit}' has prefix '{prefix}' no "
+                    "registry family covers — a fingerprinted program "
+                    "with no devguard/membudget family is a coverage "
+                    "hole"))
+
+    # table -> code direction: every declared name must exist in its
+    # declared home file (the table drifting from the code is the bug)
+    for fam_name, fam in sorted(FAMILIES.items()):
+        if unit.path in fam.get("guard_files", ()):
+            for g in fam["guards"]:
+                if g not in seen_guards:
+                    findings.append(Finding(
+                        "registry-complete", unit.path, 1,
+                        f"family '{fam_name}' declares device entry "
+                        f"point '{g}' in this file but no run_guarded/"
+                        "transfer_point literal registers it"))
+        if unit.path in fam.get("membudget_files", ()):
+            for b in fam["membudget"]:
+                if b not in seen_budgets:
+                    findings.append(Finding(
+                        "registry-complete", unit.path, 1,
+                        f"family '{fam_name}' declares membudget "
+                        f"component '{b}' in this file but no "
+                        "membudget.reserve/transient literal registers "
+                        "it"))
+        if is_cost_file:
+            covered = [s for s, _ in stage_names
+                       if any(s.startswith(p)
+                              for p in fam.get("cost_prefixes", ()))]
+            for p in fam.get("cost_prefixes", ()):
+                if not any(s.startswith(p) for s, _ in stage_names):
+                    findings.append(Finding(
+                        "registry-complete", unit.path, 1,
+                        f"family '{fam_name}' declares costwatch prefix "
+                        f"'{p}' but the registry has no such stage"))
+            if not fam.get("cost_prefixes") and not covered \
+                    and not fam.get("cost_waiver"):
+                findings.append(Finding(
+                    "registry-complete", unit.path, 1,
+                    f"family '{fam_name}' has no costwatch leg and no "
+                    "reviewed cost_waiver — its device programs are "
+                    "invisible to the costs and irlint gates"))
+    return findings
+
+
+EXPLAIN = {
+    "registry-complete": {
+        "why": (
+            "Three registries must describe the same device programs: "
+            "x.devguard entry points (fault classification + "
+            "breakers), x.membudget components (HBM admission), and "
+            "the x.costwatch stage registry (the costs/irlint compile "
+            "gates).  A program present in one but missing from "
+            "another is a hole nothing else detects — a guarded stage "
+            "with no costwatch row dodges both IR gates; a budgeted "
+            "component with no guard OOMs untyped.  The agreement is "
+            "declared once (registry_rule.FAMILIES) and cross-checked "
+            "per file in both directions; a family with no cost leg "
+            "must carry a reviewed cost_waiver."),
+        "bad": ("devguard.run_guarded(\"rollup.flush\", device, host)  "
+                "# stage not in any FAMILIES entry\n"),
+        "good": ("declare the family: guards + membudget components + "
+                 "costwatch prefixes (or a reviewed cost_waiver) in "
+                 "registry_rule.FAMILIES, then register all three "
+                 "legs\n"),
+    },
+}

@@ -1,0 +1,26 @@
+"""Seeded registry-complete violations: a device entry point and a
+membudget component not declared by any FAMILIES entry, with clean
+registered counterparts that must stay silent."""
+
+from m3_tpu.x import devguard, membudget
+
+
+def rogue_entry(state):
+    # VIOLATION: stage not declared by any registry family
+    return devguard.run_guarded("rollup.flush", lambda: state,
+                                lambda: state)
+
+
+def rogue_budget(nbytes):
+    # VIOLATION: component not declared by any registry family
+    return membudget.transient("rollup.lanes", nbytes)
+
+
+def registered_entry(state):
+    # clean: 'encode' is declared by the codec.encode family
+    return devguard.run_guarded("encode", lambda: state, lambda: state)
+
+
+def registered_budget(nbytes):
+    # clean: 'encode.lanes' is declared by the codec.encode family
+    return membudget.transient("encode.lanes", nbytes)

@@ -34,7 +34,14 @@ PERMISSIVE = Context(dtype_prefixes=("",), wire_prefixes=("",),
                      # device-guard is pinned to its own corpus file:
                      # jax_cases.py's clean `jax.block_until_ready`
                      # timing idiom is a legitimate raw sync there
-                     device_prefixes=("devguard_cases",))
+                     device_prefixes=("devguard_cases",),
+                     # registry-complete likewise: devguard_cases.py's
+                     # run_guarded('s', ...) is a legitimate ad-hoc
+                     # stage name in ITS corpus; no corpus file plays
+                     # the costwatch registry (inverse checks anchor
+                     # only in declared home files)
+                     registry_prefixes=("registry_cases",),
+                     registry_cost_file="")
 
 EXPECTED = {
     ("lock_cases.py", "lock-discipline", 22),
@@ -103,6 +110,9 @@ EXPECTED = {
     ("devguard_cases.py", "device-guard", 27),   # jax.jit(f) assignment
     ("devguard_cases.py", "device-guard", 28),   # raw block_until_ready
     ("devguard_cases.py", "device-guard", 32),   # raw device_put
+    # round 17: device-program registry completeness seeds
+    ("registry_cases.py", "registry-complete", 10),  # rogue entry point
+    ("registry_cases.py", "registry-complete", 16),  # rogue membudget
 }
 
 
@@ -133,7 +143,8 @@ class TestCorpus:
                      "resource-hygiene", "corruption-typed",
                      "placement-cas", "deadline-aware", "retrace-risk",
                      "transfer-hygiene", "dtype-stability",
-                     "constant-bloat", "metric-hygiene", "device-guard"):
+                     "constant-bloat", "metric-hygiene", "device-guard",
+                     "registry-complete"):
             assert len(by_rule.get(rule, [])) >= 2, rule
 
 
