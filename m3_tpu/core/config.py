@@ -346,6 +346,68 @@ class SelfmonConfig:
 
 
 @dataclasses.dataclass
+class ControllerConfig:
+    """SLO-burn-driven self-healing (x/controller.py): a mediator-tick
+    control plane that reads the node's own selfmon burn verdicts and
+    acts through the typed actuator registry — shed query slots on
+    query burn, evacuate the device path + pre-checkpoint on device
+    burn, pulse a placement rebalance on SUSTAINED node burn — then
+    relaxes every action back to baseline half-open on recovery.
+
+    Requires ``selfmon.enabled`` (the verdicts are the sensor).  Rule
+    bindings are by NAME against the evaluator's configured rule set
+    (``slo.rules()``): a named rule that is not configured is simply
+    not bound.  All hysteresis knobs are in mediator-controller ticks
+    (``every`` mediator ticks per controller pass)."""
+
+    enabled: bool = False
+    every: int = 1                    # mediator ticks per controller pass
+    fire_ticks: int = 2               # consecutive firing verdicts to act
+    clear_ticks: int = 3              # consecutive clear verdicts to relax
+    clear_burn: float = 1.0           # burn multiple at/under which "clear"
+    hold_ticks: int = 2               # post-shed ticks before relax starts
+    min_action_interval: str = "5s"   # per-actuator rate limit
+    history_deadline: str = "1s"      # PromQL budget for sustained reads
+    # rule-name bindings ("" = do not bind)
+    ingest_rule: str = "ingest-latency"
+    query_rule: str = "query-latency"
+    device_rule: str = ""
+    node_rule: str = ""               # sustained burn -> rebalance pulse
+    sustain_window: str = "120s"      # min_over_time window for node_rule
+    sustain_burn: float = 1.0         # min sustained burn multiple to act
+    # actuator envelopes
+    query_floor: int = 2              # query-slot shed target
+    query_step: int = 2               # slots per shed/relax step
+    mem_floor_frac: float = 0.5       # membudget shed floor (x budget)
+    mem_steps: int = 4                # steps from budget to floor
+
+    def validate(self, errs: list) -> None:
+        for f in ("every", "fire_ticks", "clear_ticks"):
+            if getattr(self, f) < 1:
+                errs.append(f"controller.{f}: must be >= 1")
+        if self.hold_ticks < 0:
+            errs.append("controller.hold_ticks: must be >= 0")
+        if self.clear_burn <= 0:
+            errs.append("controller.clear_burn: must be > 0")
+        for f in ("min_action_interval", "history_deadline",
+                  "sustain_window"):
+            try:
+                parse_duration(getattr(self, f))
+            except ConfigError as e:
+                errs.append(f"controller.{f}: {e}")
+        if self.sustain_burn < 0:
+            errs.append("controller.sustain_burn: must be >= 0")
+        if self.query_floor < 0:
+            errs.append("controller.query_floor: must be >= 0")
+        if self.query_step < 1:
+            errs.append("controller.query_step: must be >= 1")
+        if not (0.0 < self.mem_floor_frac <= 1.0):
+            errs.append("controller.mem_floor_frac: must be in (0, 1]")
+        if self.mem_steps < 1:
+            errs.append("controller.mem_steps: must be >= 1")
+
+
+@dataclasses.dataclass
 class CoordinatorConfig:
     listen_host: str = "127.0.0.1"
     listen_port: int = 0  # 0 = ephemeral
@@ -411,6 +473,8 @@ class NodeConfig:
     query: QueryConfig = dataclasses.field(default_factory=QueryConfig)
     device: DeviceConfig = dataclasses.field(default_factory=DeviceConfig)
     selfmon: SelfmonConfig = dataclasses.field(default_factory=SelfmonConfig)
+    controller: ControllerConfig = dataclasses.field(
+        default_factory=ControllerConfig)
     metrics_prefix: str = "m3tpu"
 
     def validate(self) -> None:
@@ -422,6 +486,11 @@ class NodeConfig:
         self.query.validate(errs)
         self.device.validate(errs)
         self.selfmon.validate(errs)
+        self.controller.validate(errs)
+        if self.controller.enabled and not self.selfmon.enabled:
+            errs.append(
+                "controller.enabled: requires selfmon.enabled (the burn "
+                "verdicts are the controller's only sensor)")
         if (self.selfmon.enabled and self.coordinator is not None
                 and self.selfmon.namespace == self.coordinator.namespace):
             errs.append(
@@ -439,6 +508,7 @@ _NESTED = {
     "query": QueryConfig,
     "device": DeviceConfig,
     "selfmon": SelfmonConfig,
+    "controller": ControllerConfig,
 }
 # Optional nested sections: an explicit `field: null` disables the
 # subsystem (yields None) instead of instantiating defaults.

@@ -122,6 +122,29 @@ class SoakConfig:
     # extra SLO rule dicts appended to every node's selfmon config
     # (the acceptance dtest injects a wire-error burn rule here)
     selfmon_extra_rules: list = dataclasses.field(default_factory=list)
+    # Self-healing (round 18): the x/controller control plane rides
+    # every node's mediator tick whenever selfmon is on.  Its trigger
+    # is a DEDICATED error-ratio rule ("ingest-errors": the share of
+    # rpc write frames dropped at the wire), appended next to the
+    # recorded latency SLOs — an error ratio is exactly 0.0 on a
+    # healthy run, so the smoke pin (controller enabled, ZERO actions)
+    # can never flake on a slow box's latency blips, while the
+    # recorded 0.25s-lane SLO stays the honest latency record.  The
+    # 0.90 objective (budget 0.1, factor 1.0) fires at >10% dropped
+    # frames: the smoke wire window (drop p=0.05) stays below it, the
+    # selfheal sustained window (drop p=0.4) blows through it.
+    controller: bool = True
+    controller_fire_ticks: int = 3
+    controller_clear_ticks: int = 3
+    controller_hold_ticks: int = 2
+    controller_min_interval: str = "3s"
+    # selfheal phase: a ``sustained`` chaos event (arm + hold +
+    # auto-disarm as ONE timeline entry) hard enough to trip the
+    # controller; off by default so the pinned phase-label lists of
+    # the full and smoke shapes stay exactly as committed.
+    selfheal: bool = False
+    t_selfheal: float = 45.0
+    selfheal_spec: str = "rpc.server=drop:p=0.4"
 
     @classmethod
     def smoke_config(cls, **kw) -> "SoakConfig":
@@ -192,6 +215,15 @@ def build_timeline(cfg: SoakConfig) -> List[ChaosEvent]:
         ev.append(ChaosEvent(t, "phase", arg="replace"))
         ev.append(ChaosEvent(t + 1, "replace", node=victim))
         t += 2  # replace blocks until cutover; recovered marks after it
+    if cfg.selfheal and cfg.t_selfheal > 0:
+        # One ``sustained`` entry: arm the heavy drop spec on node 1,
+        # hold long enough for the controller to shed, auto-disarm 2s
+        # before the phase ends so the recovered window starts clean.
+        ev.append(ChaosEvent(t, "phase", arg="selfheal"))
+        ev.append(ChaosEvent(t + 1, "sustained", node=1 % cfg.nodes,
+                             arg=cfg.selfheal_spec,
+                             hold_s=max(1.0, cfg.t_selfheal - 3)))
+        t += cfg.t_selfheal
     ev.append(ChaosEvent(t, "phase", arg="recovered"))
     return ev
 
@@ -518,6 +550,36 @@ class SoakCluster:
              "ratio": latency_ratio("m3tpu_query_seconds", "1.0"),
              "windows": win},
         ] + list(self.cfg.selfmon_extra_rules)
+        if self.cfg.controller:
+            # The controller's dedicated trigger (see SoakConfig): the
+            # dropped-frame share of rpc write traffic, scoped to THIS
+            # node's instance — self-healing is a node-local decision
+            # on the node's OWN burn, and the fleet-wide sum would
+            # dilute one node's drops under every peer's (selfmon-
+            # inflated) completion rate.  Zero on a healthy run by
+            # construction; fires past 10% drops (the drop share can
+            # never exceed the armed drop probability, so the smoke
+            # window's p=0.05 is quiet by margin, not by luck).
+            # fault_drop_triggers is the x/fault mirror every node
+            # exposes; both sides are frame-rate, same unit.
+            inst = f'{{instance="i{k}"}}'
+            # FIRST in the rule list: the whole pass runs under one
+            # deadline budget and rules past it degrade to "error"
+            # (burn unknown) — the control plane's sensor must never
+            # be the one starved behind the heavy latency-histogram
+            # rules on a loaded box (unknown means HOLD forever).
+            rules.insert(0, {
+                "name": "ingest-errors", "objective": 0.90,
+                "ratio": (f"sum(rate(fault_drop_triggers{inst}"
+                          "[{window}])) / "
+                          "clamp_min(sum(rate("
+                          f"m3tpu_db_write_batch_seconds_count{inst}"
+                          "[{window}])) + "
+                          f"sum(rate(fault_drop_triggers{inst}"
+                          "[{window}])), 0.1)"),
+                "windows": [{"long": "30s", "short": "10s",
+                             "factor": 1.0}],
+            })
         return {
             "enabled": True, "every": 1,
             "budget": self.cfg.selfmon_budget,
@@ -525,7 +587,27 @@ class SoakCluster:
             "peers": [f"i{i}=127.0.0.1:{p}"
                       for i, p in enumerate(self.fixed_http_ports)
                       if i != k],
+            # 3 rules x 2 windows x 2 ratio queries over a fleet-
+            # scraped namespace on a shared box: the 2s default budget
+            # systematically starves the tail of the rule list
+            "slo_deadline": "6s",
             "default_rules": False, "rules": rules,
+        }
+
+    def _controller_config(self) -> dict:
+        """Every node's round-18 control plane: the ingest binding
+        rides the dedicated error-ratio trigger; the latency SLOs stay
+        record-only (bound to nothing) so a slow box's latency blips
+        can never move an actuator mid-run."""
+        cfg = self.cfg
+        return {
+            "enabled": True, "every": 1,
+            "ingest_rule": "ingest-errors", "query_rule": "",
+            "device_rule": "", "node_rule": "",
+            "fire_ticks": cfg.controller_fire_ticks,
+            "clear_ticks": cfg.controller_clear_ticks,
+            "hold_ticks": cfg.controller_hold_ticks,
+            "min_action_interval": cfg.controller_min_interval,
         }
 
     def start(self) -> None:
@@ -555,6 +637,9 @@ class SoakCluster:
             if self.cfg.selfmon:
                 selfmon_yaml = "selfmon: " + json.dumps(
                     self._selfmon_config(k)) + "\n"
+                if self.cfg.controller:  # requires selfmon (validated)
+                    selfmon_yaml += "controller: " + json.dumps(
+                        self._controller_config()) + "\n"
             cfgp.parent.mkdir(parents=True, exist_ok=True)
             cfgp.write_text(f"""
 db:
@@ -1050,6 +1135,55 @@ def selfmon_report(cluster: SoakCluster, window_s: int) -> dict:
     return out
 
 
+def controller_report(cluster: SoakCluster, window_s: int) -> dict:
+    """The round-18 self-healing record: every controller decision was
+    emitted as a ``controller_action`` gauge sample and self-scraped
+    into ``_m3_selfmon``, so the run's full act→hold→relax sequence is
+    retro-queryable PromQL history FROM A PEER — the same question an
+    operator asks post-incident ("what did the control plane do, and
+    did it relax back?").  Also snapshots every live node's ``/health``
+    ``controller`` section (actions_total, per-actuator at_baseline)."""
+    alive = cluster.alive_nodes()
+    if not alive:
+        return {"error": "no live node to query"}
+    k = alive[0]
+    w = f"{max(60, window_s)}s"
+    out: dict = {"queried_node": k, "window": w, "history": [],
+                 "actions_total": 0, "nodes": {}}
+    rows = cluster.promql(k, f"max_over_time(m3tpu_controller_action[{w}])",
+                          namespace="_m3_selfmon")
+    for r in rows:
+        out["history"].append({
+            "instance": r["metric"].get("instance"),
+            "rule": r["metric"].get("rule"),
+            "actuator": r["metric"].get("actuator"),
+            "action": r["metric"].get("action"),
+            "last_level": round(float(r["value"][1]), 6),
+        })
+    out["history"].sort(key=lambda h: (h["instance"] or "",
+                                       h["actuator"] or "",
+                                       h["action"] or ""))
+    for n in alive:
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{cluster.http_port(n)}/health",
+                    timeout=30) as r:
+                ctl = json.load(r).get("controller")
+        except OSError:
+            ctl = None
+        if ctl:
+            out["nodes"][f"i{n}"] = {
+                "actions_total": ctl.get("actions_total", 0),
+                "held_unknown": ctl.get("held_unknown", 0),
+                "rate_limited": ctl.get("rate_limited", 0),
+                "at_baseline": {
+                    name: a.get("at_baseline")
+                    for name, a in ctl.get("actuators", {}).items()},
+            }
+            out["actions_total"] += int(ctl.get("actions_total", 0))
+    return out
+
+
 # ---------------------------------------------------------------------------
 # the run + the regression gate
 # ---------------------------------------------------------------------------
@@ -1141,6 +1275,28 @@ def run_soak(cfg: SoakConfig, workdir: str | None = None,
                 f"fleet ingest p99="
                 f"{selfmon_rec.get('queries', {}).get('fleet_ingest_p99_s')}s")
 
+        # Round 18: the controller's decision record.  A selfheal run
+        # must show actions AND every actuator back at baseline; any
+        # other run must show ZERO actions (the enabled-but-quiet
+        # invariant the smoke tier pins).
+        controller_rec = None
+        if cfg.selfmon and cfg.controller:
+            try:
+                controller_rec = controller_report(
+                    cluster, int(time.monotonic() - t_run0) + 60)
+            except Exception as e:  # noqa: BLE001 — recorded, not fatal
+                controller_rec = {"error": f"{type(e).__name__}: {e}"}
+            acted = int(controller_rec.get("actions_total", 0) or 0)
+            baseline_ok = all(
+                all(n.get("at_baseline", {}).values())
+                for n in controller_rec.get("nodes", {}).values())
+            verdict["controller_quiet"] = (acted == 0)
+            verdict["controller_relaxed"] = baseline_ok
+            if cfg.selfheal:
+                verdict["controller_acted"] = acted > 0
+            log(f"soak: controller actions={acted} "
+                f"relaxed_to_baseline={baseline_ok}")
+
         retry_after = xretry.counters()
         artifact = {
             "kind": "SOAK",
@@ -1165,6 +1321,8 @@ def run_soak(cfg: SoakConfig, workdir: str | None = None,
         }
         if selfmon_rec is not None:
             artifact["selfmon"] = selfmon_rec
+        if controller_rec is not None:
+            artifact["controller"] = controller_rec
         return artifact
     finally:
         if cluster is not None:

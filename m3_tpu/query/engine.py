@@ -246,6 +246,13 @@ class Engine:
             else:
                 raw, eval_steps = self._fetch(sel_arg, steps,
                                               sel_arg.range_nanos)
+            if len(raw.series) == 0 and f != "absent_over_time":
+                # No matched series: an empty instant vector
+                # (Prometheus semantics).  Must short-circuit BEFORE
+                # the jitted stencils — a 0-row window gather cannot
+                # even shape its reshape.
+                return Block(steps, np.empty((0, len(steps)),
+                                             np.float64), [])
             from m3_tpu.query import precision
 
             narrow = precision.compute_dtype() == np.float32

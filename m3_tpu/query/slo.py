@@ -178,7 +178,7 @@ class SLOEvaluator:
     def __init__(self, engine, rules: Iterable[SLORule],
                  deadline_s: float = 2.0, scope=None):
         self.engine = engine
-        self.rules: Tuple[SLORule, ...] = tuple(rules)
+        self._rules: Tuple[SLORule, ...] = tuple(rules)
         self.deadline_s = float(deadline_s)
         # _eval_lock serializes evaluation passes (engine queries, up
         # to deadline_s); _lock guards ONLY the cached verdicts, so
@@ -195,7 +195,7 @@ class SLOEvaluator:
         # amplification-guard constancy test pins exactly that).
         self._gauges = {}
         if scope is not None:
-            for r in self.rules:
+            for r in self._rules:
                 g = scope.tagged({"rule": r.name}).gauge("slo_burn")  # m3lint: disable=metric-hygiene — interned once per configured rule at construction; rule names are config-bounded, never request-derived
                 g.update(0.0)
                 self._gauges[r.name] = g
@@ -226,7 +226,7 @@ class SLOEvaluator:
             rules_out: dict = {}
             spent = False
             with xdeadline.bind(dl):
-                for rule in self.rules:
+                for rule in self._rules:
                     doc: dict = {"objective": rule.objective,
                                  "budget": round(rule.budget, 9)}
                     if spent:
@@ -285,11 +285,32 @@ class SLOEvaluator:
                 self._last = last
             return last
 
+    def rules(self) -> dict:
+        """Static rule metadata keyed by name — consumers (the
+        x/controller's bindings, operators reading ``/health``) bind to
+        rules by NAME through this accessor instead of re-parsing the
+        selfmon config.  Pure configuration: no queries, no verdicts."""
+        return {
+            r.name: {
+                "objective": r.objective,
+                "budget": round(r.budget, 9),
+                "windows": [
+                    {"long": w.long, "short": w.short, "factor": w.factor}
+                    for w in r.windows
+                ],
+            }
+            for r in self._rules
+        }
+
     def status(self) -> dict:
         """The cached last evaluation (the /health ``slo`` document) —
-        no queries run on the health path."""
+        no queries run on the health path.  ``rule_set`` carries the
+        static rule metadata, so the configured objectives/windows are
+        readable even before (or without) a completed evaluation."""
         with self._lock:
-            return dict(self._last)
+            out = dict(self._last)
+        out["rule_set"] = self.rules()
+        return out
 
     @property
     def firing(self) -> List[str]:

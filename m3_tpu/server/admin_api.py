@@ -92,7 +92,7 @@ def _parse_dur_nanos(s) -> int:
 
 class AdminContext:
     def __init__(self, kv: KVStore, db=None, aggregator=None, scrubber=None,
-                 migrator=None, tracer=None, selfmon=None):
+                 migrator=None, tracer=None, selfmon=None, controller=None):
         self.kv = kv
         self.namespaces = NamespaceRegistry(kv)
         self.placements = PlacementService(kv)
@@ -102,6 +102,7 @@ class AdminContext:
         self.scrubber = scrubber
         self.migrator = migrator  # storage.migration.ShardMigrator | None
         self.selfmon = selfmon  # instrument.selfmon.SelfMonitor | None
+        self.controller = controller  # x.controller.Controller | None
         # span-ring debug surface: defaults to the database's tracer so
         # the admin port serves the same ring as the main API's
         # /api/v1/debug/traces (dtest trace collection hits either)
@@ -152,6 +153,14 @@ class _AdminHandler(BaseHTTPRequestHandler):
                         slo = sm.health_slo()
                         if slo is not None:
                             out["slo"] = slo
+                    except Exception:  # noqa: BLE001 — health never 500s
+                        pass
+                # ... and the same ``controller`` section: the
+                # self-healing state must be readable even when the
+                # controller itself shed the serving port's slots.
+                if self.ctx.controller is not None:
+                    try:
+                        out["controller"] = self.ctx.controller.status()
                     except Exception:  # noqa: BLE001 — health never 500s
                         pass
                 return self._json(200, out)

@@ -42,6 +42,7 @@ class Assembly:
     downsampler: object | None = None   # coordinator.downsample.Downsampler
     checkpointer: object | None = None  # aggregator.checkpoint driver
     selfmon: object | None = None       # instrument.selfmon.SelfMonitor
+    controller: object | None = None    # x.controller.Controller
 
     @property
     def port(self) -> int | None:
@@ -431,6 +432,81 @@ def run_node(source, start_mediator: bool | None = None,
                 instrument=scope,
             )
 
+        # Admission is shared by the HTTP front door and the
+        # controller's query_slots actuator — build it before either
+        # consumer exists.
+        admission = None
+        if cfg.coordinator is not None:
+            from m3_tpu.x.admission import AdmissionController
+
+            admission = AdmissionController(
+                max_concurrent=cfg.query.max_concurrent,
+                max_queue=cfg.query.max_queue,
+                queue_timeout_s=parse_duration(cfg.query.queue_timeout) / 1e9,
+            )
+
+        # The self-healing control plane BEFORE the mediator (its pass
+        # rides the tick loop right after the selfmon stage, acting on
+        # the verdicts evaluated the same tick).  Bindings resolve by
+        # rule NAME against the evaluator's configured rule set
+        # (slo.rules()) — an unconfigured name simply does not bind.
+        if (cfg.controller.enabled and asm.selfmon is not None
+                and getattr(asm.selfmon, "slo", None) is not None):
+            from m3_tpu.x import controller as xctl
+            from m3_tpu.x import membudget as _mb
+
+            ccfg = cfg.controller
+            slo = asm.selfmon.slo
+            known = set(slo.rules())
+            reg = xctl.ActuatorRegistry()
+            bindings: list = []
+
+            def _bind(rule: str, acts: list, name: str = "", **kw) -> None:
+                if rule and rule in known and acts:
+                    bindings.append(xctl.Binding(
+                        rule=rule, actuators=tuple(acts),
+                        name=name or rule,
+                        fire_ticks=ccfg.fire_ticks,
+                        clear_ticks=ccfg.clear_ticks,
+                        clear_burn=ccfg.clear_burn,
+                        hold_ticks=ccfg.hold_ticks, **kw))
+
+            slot_acts = []
+            if admission is not None:
+                reg.register(xctl.admission_actuator(
+                    admission, floor=ccfg.query_floor,
+                    step=ccfg.query_step))
+                slot_acts = ["query_slots"]
+            _bind(ccfg.query_rule, slot_acts, name="query-burn")
+            _bind(ccfg.ingest_rule, slot_acts, name="ingest-burn")
+            dev_acts = [reg.register(
+                xctl.devguard_fallback_actuator()).name]
+            if asm.checkpointer is not None:
+                dev_acts.append(reg.register(
+                    xctl.checkpoint_actuator(asm.checkpointer)).name)
+            budget_b = _mb.budget()
+            if budget_b > 0:
+                floor_b = int(budget_b * ccfg.mem_floor_frac)
+                step_b = max(1, (budget_b - floor_b) // ccfg.mem_steps)
+                dev_acts.append(reg.register(xctl.membudget_actuator(
+                    floor_bytes=floor_b, step_bytes=step_b)).name)
+            _bind(ccfg.device_rule, dev_acts, name="device-burn")
+            if asm.migrator is not None:
+                reg.register(xctl.rebalance_actuator(asm.migrator))
+                _bind(ccfg.node_rule, ["rebalance"], name="node-burn",
+                      sustain_window=ccfg.sustain_window,
+                      sustain_burn=ccfg.sustain_burn)
+            asm.controller = xctl.Controller(
+                reg, bindings, burn_source=slo.status,
+                instrument=scope,
+                min_interval_s=parse_duration(
+                    ccfg.min_action_interval) / 1e9,
+                history=xctl.BurnHistory(
+                    slo.engine,
+                    metric=f"{cfg.metrics_prefix}_slo_burn",
+                    deadline_s=parse_duration(
+                        ccfg.history_deadline) / 1e9))
+
         if cfg.mediator.enabled if start_mediator is None else start_mediator:
             asm.mediator = Mediator(
                 db,
@@ -448,18 +524,13 @@ def run_node(source, start_mediator: bool | None = None,
                                   if cfg.coordinator is not None else 0),
                 selfmon=asm.selfmon,
                 selfmon_every=cfg.selfmon.every,
+                controller=asm.controller,
+                controller_every=cfg.controller.every,
                 instrument=scope,
             )
             asm.mediator.open()
 
         if serve_http and cfg.coordinator is not None:
-            from m3_tpu.x.admission import AdmissionController
-
-            admission = AdmissionController(
-                max_concurrent=cfg.query.max_concurrent,
-                max_queue=cfg.query.max_queue,
-                queue_timeout_s=parse_duration(cfg.query.queue_timeout) / 1e9,
-            )
             ctx = ApiContext(
                 db, namespace=cfg.coordinator.namespace, registry=registry,
                 metrics_scope=scope,
@@ -472,6 +543,7 @@ def run_node(source, start_mediator: bool | None = None,
                 remotes_required=cfg.query.remotes_required,
                 checkpointer=asm.checkpointer,
                 selfmon=asm.selfmon,
+                controller=asm.controller,
             )
 
             # Admission/slow-query observability: query_active,
@@ -538,7 +610,8 @@ def run_node(source, start_mediator: bool | None = None,
             # asm.kv was built up front (the topology watcher shares it)
             admin_ctx = AdminContext(asm.kv, db, scrubber=asm.scrubber,
                                      migrator=asm.migrator,
-                                     selfmon=asm.selfmon)
+                                     selfmon=asm.selfmon,
+                                     controller=asm.controller)
             # live-tune query limits + cache budget through runtime
             # options (runtime_options_manager.go's role)
             def _limit_applier(lim):

@@ -309,6 +309,14 @@ class _Handler(BaseHTTPRequestHandler):
                     out["slo"] = slo
         except Exception:  # noqa: BLE001 — health must never 500
             pass
+        # Self-healing controller: configuration + per-binding state +
+        # actuator positions + the recent action tail (x/controller;
+        # cheap cached state, no queries run here).
+        try:
+            if self.ctx.controller is not None:
+                out["controller"] = self.ctx.controller.status()
+        except Exception:  # noqa: BLE001 — health must never 500
+            pass
         return self._json(200, out)
 
     def _debug_dump(self, q):
@@ -681,7 +689,8 @@ class ApiContext:
                  query_timeout_s: float = 30.0,
                  slow_query_fraction: float = 0.75,
                  remotes=None, remotes_required: bool = False,
-                 metrics_scope=None, checkpointer=None, selfmon=None):
+                 metrics_scope=None, checkpointer=None, selfmon=None,
+                 controller=None):
         self.db = db
         self.namespace = namespace
         self.downsampler = downsampler
@@ -690,6 +699,7 @@ class ApiContext:
         self.migrator = migrator  # storage.migration.ShardMigrator | None
         self.checkpointer = checkpointer  # aggregator checkpoint driver
         self.selfmon = selfmon  # instrument.selfmon.SelfMonitor | None
+        self.controller = controller  # x.controller.Controller | None
         # Per-namespace engine interning for the ``namespace=`` query
         # param (bounded: namespaces are config objects, not request
         # input — an unknown name 400s before anything is built).

@@ -49,6 +49,8 @@ class Mediator:
         checkpoint_every: int = 0,
         selfmon=None,
         selfmon_every: int = 1,
+        controller=None,
+        controller_every: int = 1,
         instrument=None,
     ):
         self.db = db
@@ -83,6 +85,12 @@ class Mediator:
         # the maintenance loop on their own cadence.
         self.selfmon = selfmon
         self.selfmon_every = max(1, selfmon_every)
+        # Optional x.controller.Controller: the self-healing pass reads
+        # the verdicts the selfmon stage just refreshed and acts through
+        # its typed actuator registry — sensor before controller, every
+        # pass, by construction.
+        self.controller = controller
+        self.controller_every = max(1, controller_every)
         self._ticks = 0
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -145,6 +153,18 @@ class Mediator:
                     _LOG.exception("mediator: selfmon tick failed")
                     if self._scope is not None:
                         self._scope.counter("selfmon_tick_errors").inc()
+            if (self.controller is not None
+                    and self._ticks % self.controller_every == 0):
+                # Self-healing AFTER selfmon so each pass acts on the
+                # verdicts evaluated THIS tick, never last tick's.
+                try:
+                    stats["controller"] = self.controller.tick(now)
+                except Exception:  # noqa: BLE001 — a failing control
+                    # pass must not disable maintenance; counted so a
+                    # silently-dead controller is visible on /metrics
+                    _LOG.exception("mediator: controller tick failed")
+                    if self._scope is not None:
+                        self._scope.counter("controller_tick_errors").inc()
             if (self.checkpointer is not None and self.checkpoint_every > 0
                     and self._ticks % self.checkpoint_every == 0):
                 try:

@@ -459,6 +459,8 @@ def soak(args) -> int:
         v = getattr(args, name)
         if v is not None:
             overrides[name] = v
+    if args.selfheal:
+        overrides["selfheal"] = True
     if baseline is not None:
         cfg = config_from_artifact(baseline, **overrides)
     elif args.smoke:
@@ -710,6 +712,12 @@ def main(argv=None) -> int:
     sk.add_argument("--smoke", action="store_true",
                     help="tier-1 shape: 2 nodes, ~20K series, one "
                          "wire-fault window, <2 min")
+    sk.add_argument("--selfheal", action="store_true",
+                    help="add the round-18 selfheal phase: a sustained "
+                         "heavy-drop window the SLO-burn controller "
+                         "must shed, survive, and relax back from "
+                         "(artifact records the controller_action "
+                         "history)")
     sk.add_argument("--check", nargs="?", const="", default=None,
                     metavar="BASELINE",
                     help="re-run BASELINE's config (default: repo "

@@ -77,6 +77,24 @@ class AdmissionController:
                 "max_queue": self.max_queue,
             }
 
+    # -- live resize (the self-healing actuator seam) ----------------------
+
+    def resize(self, max_concurrent: int | None = None,
+               max_queue: int | None = None) -> dict:
+        """Live-retune capacity (the x/controller actuator seam).
+
+        Takes effect for the NEXT admit: active holders keep their
+        slots (shrinking never evicts — the count drains naturally),
+        and growing wakes every queued waiter so freed headroom is
+        claimed immediately.  Returns the post-resize metrics doc."""
+        with self._cv:
+            if max_concurrent is not None:
+                self.max_concurrent = int(max_concurrent)
+            if max_queue is not None:
+                self.max_queue = int(max_queue)
+            self._cv.notify_all()
+        return self.metrics()
+
     # -- gate --------------------------------------------------------------
 
     @contextlib.contextmanager

@@ -156,6 +156,18 @@ class CircuitBreaker:
                 self._opened_at = self._clock()
                 self._probing = False
 
+    def force_open(self) -> None:
+        """Administratively trip the breaker NOW (the x/controller
+        evacuation verb).  Recovery is the normal path: after
+        ``reset_timeout_s`` the breaker half-opens and a successful
+        probe closes it — forced entry, earned exit."""
+        with self._mu:
+            if self._effective_state() != OPEN:
+                _bump(self.name, "opened")
+            self._state = OPEN
+            self._opened_at = self._clock()
+            self._probing = False
+
     def call(self, fn: Callable[[], object]):
         """``allow()`` → ``fn()`` → record.  Exceptions classified by
         ``is_failure`` count toward the trip threshold; application

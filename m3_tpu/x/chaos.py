@@ -31,6 +31,16 @@ Two pieces:
                  phase label.  Error-mode triggers surface as typed
                  DeviceOOM/CompileFailure/DeviceLost and trip the
                  per-stage fallback breakers — no real TPU needed.
+  ``sustained``  a burn-window-length fault as ONE entry: arm the
+                 ``arg`` spec at ``at_s``, hold ``hold_s`` seconds,
+                 auto-disarm.  Expanded by the scheduler into the
+                 arm + ``clear_faults`` pair (wire or device arm is
+                 inferred from the point namespaces; mixing the two
+                 in one spec is rejected eagerly), so ops adapters
+                 need no new verbs and the log still shows the exact
+                 fault window.  This is the self-healing soak's
+                 primitive: long enough to drive an SLO burn window,
+                 gone again so recovery is provable.
   ``clear_faults``  disarm every faultpoint on a node (same endpoint)
   ``corrupt``    byte-flip a flushed fileset volume on a node's disk
                  (``ops.corrupt(node, seed)`` — quarantine/scrub must
@@ -62,10 +72,11 @@ from typing import Callable, List
 
 from m3_tpu.x import fault
 
-__all__ = ["ChaosEvent", "ChaosScheduler", "parse_timeline"]
+__all__ = ["ChaosEvent", "ChaosScheduler", "expand_sustained",
+           "parse_timeline"]
 
 ACTIONS = ("phase", "kill", "restart", "wire_fault", "device_fault",
-           "clear_faults", "corrupt", "replace")
+           "sustained", "clear_faults", "corrupt", "replace")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -74,6 +85,7 @@ class ChaosEvent:
     action: str          # one of ACTIONS
     node: int | None = None  # target node index (phase: None)
     arg: str = ""        # wire_fault: spec string; phase: phase label
+    hold_s: float = 0.0  # sustained only: seconds armed before disarm
 
     def __post_init__(self):
         if self.action not in ACTIONS:
@@ -83,6 +95,9 @@ class ChaosEvent:
             raise ValueError("phase events need a label in 'arg'")
         if self.action != "phase" and self.node is None:
             raise ValueError(f"{self.action} event needs a 'node'")
+        if self.action != "sustained" and self.hold_s:
+            raise ValueError(
+                f"{self.action} events take no 'hold_s' (sustained only)")
         if self.action == "wire_fault":
             fault.parse_faults(self.arg)  # validate at BUILD time
         if self.action == "device_fault":
@@ -94,6 +109,25 @@ class ChaosEvent:
                     "use wire_fault for wire-boundary points")
             if not specs:
                 raise ValueError("device_fault events need a spec in 'arg'")
+        if self.action == "sustained":
+            if self.hold_s <= 0:
+                raise ValueError("sustained events need 'hold_s' > 0")
+            self._arm_action()  # eager: spec parses, namespaces uniform
+
+    def _arm_action(self) -> str:
+        """The concrete arm verb a ``sustained`` event expands to,
+        inferred from the spec's point namespaces (eager-validated:
+        device and wire points cannot share one sustained window —
+        their phase labels and mitigation paths differ)."""
+        specs = fault.parse_faults(self.arg)
+        if not specs:
+            raise ValueError("sustained events need a spec in 'arg'")
+        device = [p.startswith("device.") for p, _, _ in specs]
+        if any(device) and not all(device):
+            raise ValueError(
+                "sustained event mixes device and wire points: "
+                "use two events")
+        return "device_fault" if all(device) else "wire_fault"
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -109,13 +143,32 @@ def parse_timeline(spec: dict) -> tuple[int, List[ChaosEvent]]:
         raise ValueError(f"chaos timeline: unknown keys {sorted(unknown)}")
     events = []
     for i, e in enumerate(spec.get("events", ())):
-        bad = set(e) - {"at_s", "action", "node", "arg"}
+        bad = set(e) - {"at_s", "action", "node", "arg", "hold_s"}
         if bad:
             raise ValueError(f"chaos event #{i}: unknown keys {sorted(bad)}")
         events.append(ChaosEvent(
             at_s=float(e["at_s"]), action=e["action"],
-            node=e.get("node"), arg=e.get("arg", "")))
+            node=e.get("node"), arg=e.get("arg", ""),
+            hold_s=float(e.get("hold_s", 0.0))))
     return int(spec.get("seed", 0)), sorted(events, key=lambda e: e.at_s)
+
+
+def expand_sustained(events: List[ChaosEvent]) -> List[ChaosEvent]:
+    """Replace every ``sustained`` event with its concrete
+    arm + ``clear_faults`` pair (arm verb from the spec's namespaces,
+    disarm at ``at_s + hold_s``), re-sorted.  Ops adapters therefore
+    never see ``sustained`` — the scheduler applies this expansion, and
+    the log records the exact armed window as two entries."""
+    out: List[ChaosEvent] = []
+    for ev in events:
+        if ev.action != "sustained":
+            out.append(ev)
+            continue
+        out.append(ChaosEvent(at_s=ev.at_s, action=ev._arm_action(),
+                              node=ev.node, arg=ev.arg))
+        out.append(ChaosEvent(at_s=ev.at_s + ev.hold_s,
+                              action="clear_faults", node=ev.node))
+    return sorted(out, key=lambda e: e.at_s)
 
 
 def _seeded_spec(spec: str, seed: int) -> str:
@@ -148,7 +201,8 @@ class ChaosScheduler:
     def __init__(self, timeline: List[ChaosEvent], ops, seed: int = 0,
                  clock: Callable[[], float] = time.monotonic,
                  sleep: Callable[[float], None] | None = None):
-        self.timeline = sorted(timeline, key=lambda e: e.at_s)
+        self.timeline = expand_sustained(
+            sorted(timeline, key=lambda e: e.at_s))
         self.ops = ops
         self.seed = int(seed)
         self._clock = clock

@@ -72,7 +72,7 @@ __all__ = [
     "DeviceError", "DeviceOOM", "CompileFailure", "DeviceLost",
     "DeviceStateError", "classify", "run_guarded", "transfer_point",
     "configure", "counters", "reset_counters", "reset_stages", "status",
-    "stage_breaker",
+    "stage_breaker", "force_fallback", "fallback_forced",
 ]
 
 
@@ -178,6 +178,7 @@ _RESET_S = float(os.environ.get("M3_DEVICE_BREAKER_RESET_S", "") or 10.0)
 _lock = threading.Lock()
 _counters: Dict[str, int] = {}
 _compiled: Dict[str, bool] = {}  # stage -> first device call done
+_forced = False  # controller-imposed evacuation: all stages on fallback
 
 
 def configure(failures: int | None = None,
@@ -190,6 +191,32 @@ def configure(failures: int | None = None,
         _FAILURES = int(failures)
     if reset_s is not None:
         _RESET_S = float(reset_s)
+
+
+def force_fallback(on: bool) -> None:
+    """Controller-imposed device evacuation (the x/controller
+    ``device_fallback`` actuator — the ONLY legal caller outside
+    tests; the actuator-typed lint rule enforces that).
+
+    Engaging sets the module flag AND force-opens every EXISTING stage
+    breaker, so in-flight guard decisions and /metrics breaker state
+    agree with the evacuation.  Disengaging clears only the flag: the
+    breakers recover through their own half-open probes — forced
+    entry, earned exit (x/breaker's half-open discipline)."""
+    global _forced
+    with _lock:
+        _forced = bool(on)
+    if on:
+        from m3_tpu.x.breaker import all_breakers
+
+        for name, br in all_breakers().items():
+            if name.startswith("stage:"):
+                br.force_open()
+
+
+def fallback_forced() -> bool:
+    with _lock:
+        return _forced
 
 
 def _bump(key: str, n: int = 1) -> None:
@@ -214,9 +241,11 @@ def reset_stages() -> None:
     """Test hygiene: forget per-stage compile markers and counters.
     (Stage breakers live in the x.breaker registry — reset that too
     for full isolation.)"""
+    global _forced
     with _lock:
         _counters.clear()
         _compiled.clear()
+        _forced = False
 
 
 def stage_breaker(stage: str):
@@ -273,10 +302,15 @@ def run_guarded(stage: str, primary: Callable[[], object],
     br = stage_breaker(stage)
     on_device = True
     if fallback is not None:
-        try:
-            br.allow()
-        except BreakerOpenError:
+        if fallback_forced():
+            # Controller-imposed evacuation: skip the primary without
+            # consuming a half-open probe slot.
             on_device = False
+        else:
+            try:
+                br.allow()
+            except BreakerOpenError:
+                on_device = False
     if on_device:
         try:
             _fire_faultpoints(stage)
@@ -341,4 +375,7 @@ def status() -> dict:
     for name, br in all_breakers().items():
         if name.startswith("stage:"):
             stages.setdefault(name[len("stage:"):], {})["breaker"] = br.state
-    return {"stages": stages}
+    out = {"stages": stages}
+    if fallback_forced():
+        out["forced_fallback"] = True
+    return out

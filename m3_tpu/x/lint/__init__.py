@@ -1,6 +1,6 @@
 """m3lint: codebase-aware static analysis for the m3-tpu tree.
 
-Sixteen rule families, each encoding a contract this repo already
+Seventeen rule families, each encoding a contract this repo already
 pays for at runtime (race tier, fault tier, bit-exactness goldens,
 bench steady-state) as a static gate:
 
@@ -45,6 +45,12 @@ bench steady-state) as a static gate:
   set (``registry_rule.FAMILIES``); a program present in one registry
   but missing from another — or a family with no cost leg and no
   reviewed waiver — is a coverage hole (round 17).
+* ``actuator-typed``    — control-plane knobs (admission capacity,
+  membudget budget, breaker thresholds/state, forced device fallback)
+  mutated outside ``x/controller.py``'s typed actuator registry — the
+  placement-cas pattern for control state: mutations must be
+  bounds-clamped, rate-limited, and emitted as ``controller_action``
+  samples (round 18).
 * ``metric-hygiene``    — instrument interning inside loops/per-request
   handlers in the request-serving trees (``server/``, ``query/``) —
   registry interning makes it correct but per-call lock+intern is

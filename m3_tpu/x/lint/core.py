@@ -60,6 +60,11 @@ RULES = (
     # — devguard entry points × membudget components × costwatch
     # stages must describe the same program set
     "registry-complete",
+    # round 18: self-healing actuator discipline (actuator_rule.py) —
+    # control-plane knobs (admission capacity, membudget budget,
+    # breaker thresholds/state, forced fallback) mutate only through
+    # x/controller.py's typed actuator registry
+    "actuator-typed",
 )
 
 _SUPPRESS_RE = re.compile(r"#\s*m3lint:\s*disable=([\w,-]+)")
@@ -169,6 +174,14 @@ class Context:
     registry_prefixes: tuple = ("m3_tpu/storage/", "m3_tpu/aggregator/",
                                 "m3_tpu/encoding/", "m3_tpu/server/")
     registry_cost_file: str = "m3_tpu/x/costwatch.py"
+    # round 18: the blessed homes of control-plane mutation verbs
+    # (actuator-typed rule): the controller's actuator registry itself,
+    # devguard (force_fallback drives force_open — plumbing under the
+    # seam), and assembly (boot-time configuration from validated
+    # config is initialization, not runtime mutation)
+    controller_files: tuple = ("m3_tpu/x/controller.py",
+                               "m3_tpu/x/devguard.py",
+                               "m3_tpu/server/assembly.py")
 
     def is_wire_module(self, path: str) -> bool:
         return (path in self.wire_files
@@ -240,9 +253,9 @@ def apply_suppressions(unit: FileUnit, findings: Iterable[Finding]) -> List[Find
 
 def default_rules() -> List[Rule]:
     from m3_tpu.x.lint import (
-        corruption, deadline_aware, devguard_rule, faultcov, jaxlint,
-        locks, metrics_rule, placement, purity, registry_rule, resources,
-        wirecheck,
+        actuator_rule, corruption, deadline_aware, devguard_rule,
+        faultcov, jaxlint, locks, metrics_rule, placement, purity,
+        registry_rule, resources, wirecheck,
     )
 
     return [
@@ -262,6 +275,7 @@ def default_rules() -> List[Rule]:
         metrics_rule.check,
         devguard_rule.check,
         registry_rule.check,
+        actuator_rule.check,
     ]
 
 
@@ -269,14 +283,14 @@ def explain(rule: str) -> dict | None:
     """{why, bad, good} for a rule name, harvested from the rule
     modules' EXPLAIN tables (``cli lint --explain`` renders it)."""
     from m3_tpu.x.lint import (
-        corruption, deadline_aware, devguard_rule, faultcov, jaxlint,
-        locks, metrics_rule, placement, purity, registry_rule, resources,
-        wirecheck,
+        actuator_rule, corruption, deadline_aware, devguard_rule,
+        faultcov, jaxlint, locks, metrics_rule, placement, purity,
+        registry_rule, resources, wirecheck,
     )
 
     for mod in (jaxlint, locks, purity, wirecheck, faultcov, resources,
                 corruption, placement, deadline_aware, metrics_rule,
-                devguard_rule, registry_rule):
+                devguard_rule, registry_rule, actuator_rule):
         entry = getattr(mod, "EXPLAIN", {}).get(rule)
         if entry is not None:
             return entry

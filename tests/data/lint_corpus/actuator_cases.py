@@ -1,0 +1,42 @@
+"""Seeded actuator-typed violations: direct control-plane mutations
+outside x/controller.py's actuator registry, with clean read-only
+counterparts that must stay silent."""
+
+from m3_tpu.x import devguard, membudget
+
+
+def panic_shed(admission):
+    # VIOLATION: direct admission resize outside the actuator registry
+    admission.resize(max_concurrent=1)
+
+
+def panic_tighten():
+    # VIOLATION: direct membudget mutation outside the actuator registry
+    membudget.set_budget(1024)
+
+
+def panic_evacuate():
+    # VIOLATION: direct forced fallback outside the actuator registry
+    devguard.force_fallback(True)
+
+
+def panic_trip(br):
+    # VIOLATION: direct breaker force-open outside the actuator registry
+    br.force_open()
+
+
+def panic_retune():
+    # VIOLATION: breaker thresholds mutated outside the actuator registry
+    devguard.configure(failures=1)
+
+
+def read_only(admission):
+    # clean: reads never mutate — always legal anywhere
+    return (admission.metrics(), membudget.budget(),
+            devguard.fallback_forced())
+
+
+def ledger_resize(reservation, nbytes):
+    # clean: a membudget Reservation's resize is the ledger-internal
+    # verb (buffer growth), not an admission-capacity mutation
+    reservation.resize(nbytes)

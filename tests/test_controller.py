@@ -1,0 +1,551 @@
+"""x/controller tier: the self-healing control plane's unit matrix.
+
+Everything here runs on synthetic burn documents and a fake clock — no
+cluster processes, no sleeps on the state machine.  The matrix covers
+the guardrails one by one (they ARE the feature): fire/clear
+hysteresis, post-shed hold, per-actuator rate limit, NaN/unknown HOLD,
+bounds clamping, half-open relax-back with a mid-relax re-fire — plus
+each actuator factory against its real seam and the tier-1 healthy-run
+invariant (controller enabled on a live assembly, ten mediator ticks,
+ZERO actions and zero ``controller_action`` series).
+"""
+
+import json
+import time
+import urllib.request
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from m3_tpu.x.controller import (
+    Actuator, ActuatorRegistry, Binding, BurnHistory, Controller,
+    admission_actuator, checkpoint_actuator, devguard_fallback_actuator,
+    ingest_backoff_actuator, membudget_actuator, rebalance_actuator,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class FakeScope:
+    """Records tagged-gauge interning + updates (the emission seam)."""
+
+    def __init__(self):
+        self.gauges = {}
+
+    def tagged(self, tags):
+        scope, key = self, tuple(sorted(tags.items()))
+
+        class _T:
+            def gauge(self, name):
+                g = SimpleNamespace(values=[], update=None)
+                g.update = g.values.append
+                scope.gauges[(name, key)] = g
+                return g
+
+        return _T()
+
+
+def level(name="a", baseline=10.0, limit=2.0, step=4.0, log=None):
+    log = log if log is not None else []
+    act = Actuator(name, "test", baseline, limit, step,
+                   apply=log.append)
+    act.log = log
+    return act
+
+
+def doc(burn, firing, rule="r"):
+    return {"rules": {rule: {"burn": burn, "firing": firing}}}
+
+
+def make(act_list, clock=None, scope=None, min_interval=0.0, history=None,
+         **bind_kw):
+    reg = ActuatorRegistry(act_list)
+    kw = dict(rule="r", actuators=tuple(a.name for a in act_list),
+              fire_ticks=1, clear_ticks=1, hold_ticks=0)
+    kw.update(bind_kw)
+    state = {"doc": doc(None, None)}
+    ctl = Controller(reg, [Binding(**kw)], burn_source=lambda: state["doc"],
+                     clock=clock or FakeClock(), instrument=scope,
+                     min_interval_s=min_interval, history=history)
+    return ctl, state
+
+
+class TestActuator:
+    def test_step_and_bounds_clamp(self):
+        act = level()
+        assert (act.lo, act.hi) == (2.0, 10.0)
+        assert act.shed() == 6.0 and act.shed() == 2.0
+        assert act.shed() is None           # clamped at the envelope
+        assert act.log == [6.0, 2.0]
+        assert act.relax() == 6.0 and act.relax() == 10.0
+        assert act.relax() is None          # at baseline, nothing moves
+        assert act.at_baseline
+        assert act.clamp(99.0) == 10.0 and act.clamp(-99.0) == 2.0
+
+    def test_overshoot_lands_on_the_bound(self):
+        act = level(baseline=10.0, limit=3.0, step=4.0)
+        assert act.shed() == 6.0
+        assert act.shed() == 3.0            # not 2.0: clamped to lo
+
+    def test_grow_direction(self):
+        # a backoff-style actuator sheds UP and relaxes DOWN
+        act = level(baseline=50.0, limit=400.0, step=200.0)
+        assert act.shed() == 250.0 and act.shed() == 400.0
+        assert act.relax() == 200.0 and act.relax() == 50.0
+
+    def test_pulse_fires_every_shed_and_never_relaxes(self):
+        log = []
+        act = Actuator("p", "test", 0.0, 1.0, 1.0, apply=log.append,
+                       pulse=True)
+        assert act.shed() == 1.0 and act.shed() == 1.0
+        assert log == [1.0, 1.0]
+        assert act.relax() is None and act.at_baseline
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            Actuator("", "t", 0, 1, 1, apply=lambda v: None)
+        with pytest.raises(ValueError):
+            Actuator("a", "t", 0, 1, 0, apply=lambda v: None)
+
+    def test_registry_rejects_duplicates(self):
+        reg = ActuatorRegistry([level("a")])
+        with pytest.raises(ValueError):
+            reg.register(level("a"))
+        assert "a" in reg and reg.names() == ["a"]
+
+
+class TestBindingValidation:
+    def test_bad_shapes_rejected_eagerly(self):
+        ok = dict(rule="r", actuators=("a",))
+        Binding(**ok)
+        for bad in (dict(ok, rule=""), dict(ok, actuators=()),
+                    dict(ok, fire_ticks=0), dict(ok, clear_ticks=0),
+                    dict(ok, hold_ticks=-1), dict(ok, clear_burn=0.0)):
+            with pytest.raises(ValueError):
+                Binding(**bad)
+
+    def test_controller_rejects_unknown_actuator_and_dup_names(self):
+        reg = ActuatorRegistry([level("a")])
+        with pytest.raises(ValueError):
+            Controller(reg, [Binding(rule="r", actuators=("nope",))],
+                       burn_source=dict)
+        with pytest.raises(ValueError):
+            Controller(reg, [Binding(rule="r", actuators=("a",)),
+                             Binding(rule="r", actuators=("a",))],
+                       burn_source=dict)
+
+
+class TestStateMachine:
+    def test_fire_ticks_hysteresis(self):
+        act = level()
+        ctl, st = make([act], fire_ticks=2)
+        st["doc"] = doc(3.0, True)
+        ctl.tick(0)                       # streak 1 < 2: no action
+        assert act.value == 10.0 and ctl.actions_total == 0
+        ctl.tick(0)                       # streak 2: shed
+        assert act.value == 6.0 and ctl.actions_total == 1
+
+    def test_flap_resets_the_firing_streak(self):
+        act = level()
+        ctl, st = make([act], fire_ticks=2, clear_burn=5.0)
+        st["doc"] = doc(3.0, True)
+        ctl.tick(0)
+        st["doc"] = doc(0.1, False)
+        ctl.tick(0)                       # flap: streak back to 0
+        st["doc"] = doc(3.0, True)
+        ctl.tick(0)
+        assert act.value == 10.0 and ctl.actions_total == 0
+
+    def test_clear_burn_hysteresis_blocks_relax(self):
+        act = level()
+        ctl, st = make([act], clear_ticks=2, clear_burn=0.5)
+        st["doc"] = doc(3.0, True)
+        ctl.tick(0)
+        assert act.value == 6.0
+        # not firing, but burn still ABOVE the clear threshold: the
+        # clear streak never builds, nothing relaxes
+        st["doc"] = doc(0.8, False)
+        for _ in range(5):
+            ctl.tick(0)
+        assert act.value == 6.0
+        # burn at/below clear_burn: streak builds, relax steps back
+        st["doc"] = doc(0.4, False)
+        ctl.tick(0)
+        assert act.value == 6.0           # streak 1 < clear_ticks
+        ctl.tick(0)
+        assert act.value == 10.0
+
+    def test_hold_ticks_delay_relax(self):
+        act = level()
+        ctl, st = make([act], hold_ticks=2)
+        st["doc"] = doc(3.0, True)
+        ctl.tick(0)
+        assert act.value == 6.0
+        st["doc"] = doc(0.0, False)
+        ctl.tick(0)                       # hold 2 -> 1
+        ctl.tick(0)                       # hold 1 -> 0
+        assert act.value == 6.0
+        ctl.tick(0)                       # hold spent: relax
+        assert act.value == 10.0
+
+    def test_rate_limit_per_actuator(self):
+        clock = FakeClock()
+        act = level(step=1.0)
+        ctl, st = make([act], clock=clock, min_interval=10.0)
+        st["doc"] = doc(3.0, True)
+        ctl.tick(0)
+        assert act.value == 9.0
+        clock.advance(1.0)
+        ctl.tick(0)                       # within the interval: held
+        assert act.value == 9.0 and ctl.rate_limited == 1
+        clock.advance(10.0)
+        ctl.tick(0)
+        assert act.value == 8.0
+
+    def test_nan_and_unknown_always_hold(self):
+        act = level()
+        ctl, st = make([act], clear_ticks=1)
+        st["doc"] = doc(3.0, True)
+        ctl.tick(0)
+        assert act.value == 6.0
+        # every unknown shape freezes the binding mid-mitigation:
+        # errored rule, NaN burn, missing rule doc, empty document
+        for frozen in (doc(None, None), doc(float("nan"), False),
+                       {"rules": {}}, {}):
+            st["doc"] = frozen
+            ctl.tick(0)
+        assert act.value == 6.0           # no shed, no relax
+        assert ctl.held_unknown == 4
+        st["doc"] = doc(0.0, False)       # knowledge returns: relax
+        ctl.tick(0)
+        assert act.value == 10.0
+
+    def test_relax_back_half_open_with_refire(self):
+        act = level()                      # 10 -> 6 -> 2
+        ctl, st = make([act], fire_ticks=1, clear_ticks=1, hold_ticks=0)
+        st["doc"] = doc(3.0, True)
+        ctl.tick(0)
+        ctl.tick(0)
+        assert act.value == 2.0
+        st["doc"] = doc(0.0, False)
+        ctl.tick(0)
+        assert act.value == 6.0            # one probe step per tick
+        st["doc"] = doc(3.0, True)         # the probe failed: re-shed
+        ctl.tick(0)
+        assert act.value == 2.0
+        st["doc"] = doc(0.0, False)
+        ctl.tick(0)
+        ctl.tick(0)
+        assert act.value == 10.0 and act.at_baseline
+        status = ctl.status()
+        assert status["actuators"]["a"]["at_baseline"] is True
+        acts = [a["action"] for a in status["recent"]]
+        assert acts == ["shed", "shed", "relax", "shed", "relax", "relax"]
+
+    def test_lazy_emission_zero_series_until_first_action(self):
+        scope = FakeScope()
+        act = level()
+        ctl, st = make([act], scope=scope, fire_ticks=2)
+        st["doc"] = doc(0.0, False)
+        for _ in range(10):
+            ctl.tick(0)
+        assert scope.gauges == {}          # the quiet invariant
+        st["doc"] = doc(3.0, True)
+        ctl.tick(0)
+        ctl.tick(0)
+        (name, tags), g = next(iter(scope.gauges.items()))
+        assert name == "controller_action"
+        assert dict(tags) == {"rule": "r", "actuator": "a",
+                              "action": "shed"}
+        assert g.values == [6.0]
+
+    def test_sustain_gate_unknown_history_holds(self):
+        hist = SimpleNamespace(min_burn=lambda rule, w, t: None)
+        pulse = Actuator("p", "t", 0.0, 1.0, 1.0, pulse=True,
+                         apply=lambda v: None)
+        ctl, st = make([pulse], history=hist, sustain_window="120s",
+                       sustain_burn=1.0)
+        st["doc"] = doc(3.0, True)
+        ctl.tick(0)
+        assert ctl.actions_total == 0 and ctl.held_unknown == 1
+        # sustained but BELOW the demand: still no pulse
+        hist.min_burn = lambda rule, w, t: 0.5
+        ctl.tick(0)
+        assert ctl.actions_total == 0
+        hist.min_burn = lambda rule, w, t: 2.0
+        ctl.tick(0)
+        assert ctl.actions_total == 1
+
+
+class TestBurnHistory:
+    def _engine(self, vals):
+        return SimpleNamespace(
+            execute_instant=lambda q, t: SimpleNamespace(
+                values=np.asarray(vals, dtype=np.float64)))
+
+    def test_worst_instance_min_burn(self):
+        h = BurnHistory(self._engine([[1.5], [2.25]]))
+        assert h.min_burn("r", "120s", 0) == 2.25
+
+    def test_empty_nan_and_error_mean_unknown(self):
+        assert BurnHistory(self._engine(np.empty((0, 0)))).min_burn(
+            "r", "1m", 0) is None
+        assert BurnHistory(self._engine([[float("nan")]])).min_burn(
+            "r", "1m", 0) is None
+
+        def boom(q, t):
+            raise RuntimeError("engine down")
+
+        h = BurnHistory(SimpleNamespace(execute_instant=boom))
+        assert h.min_burn("r", "1m", 0) is None
+
+    def test_query_shape(self):
+        seen = {}
+
+        def record(q, t):
+            seen["q"] = q
+            return SimpleNamespace(values=np.asarray([[1.0]]))
+
+        BurnHistory(SimpleNamespace(execute_instant=record),
+                    metric="m3tpu_slo_burn").min_burn("ing", "120s", 5)
+        assert seen["q"] == 'min_over_time(m3tpu_slo_burn{rule="ing"}[120s])'
+
+
+class TestActuatorFactories:
+    def test_admission_actuator_resizes_live(self):
+        from m3_tpu.x.admission import AdmissionController, QueryShedError
+
+        adm = AdmissionController(max_concurrent=0)  # gating off
+        act = admission_actuator(adm, floor=1, step=1)
+        act.shed()
+        assert adm.max_concurrent == 1
+        with adm.admit():                  # one slot: second admit sheds
+            with pytest.raises(QueryShedError):
+                adm.admit().__enter__()
+        act.relax()
+        assert adm.max_concurrent == 0     # baseline: gating off again
+        with adm.admit(), adm.admit():
+            pass
+
+    def test_admission_resize_wakes_queued_waiters(self):
+        import threading
+
+        from m3_tpu.x.admission import AdmissionController
+
+        adm = AdmissionController(max_concurrent=1, max_queue=1,
+                                  queue_timeout_s=30.0)
+        entered = threading.Event()
+
+        def worker():
+            with adm.admit():
+                entered.set()
+
+        with adm.admit():
+            t = threading.Thread(target=worker, daemon=True)
+            t.start()
+            while adm.waiting == 0:
+                time.sleep(0.005)
+            adm.resize(max_concurrent=2)   # grow: waiter wakes NOW
+            assert entered.wait(5.0)
+        t.join(5.0)
+
+    def test_ingest_backoff_actuator(self):
+        srv = SimpleNamespace(backoff_hint_ms=50)
+        act = ingest_backoff_actuator(srv, ceiling_ms=400, step_ms=200)
+        assert act.shed() == 250.0 and srv.backoff_hint_ms == 250
+        assert act.shed() == 400.0 and srv.backoff_hint_ms == 400
+        assert act.shed() is None          # clamped at the ceiling
+        act.relax()
+        act.relax()
+        assert srv.backoff_hint_ms == 50 and act.at_baseline
+
+    def test_membudget_actuator(self):
+        from m3_tpu.x import membudget
+
+        before = membudget.budget()
+        membudget.set_budget(1000)
+        try:
+            act = membudget_actuator(floor_bytes=500, step_bytes=250)
+            act.shed()
+            assert membudget.budget() == 750
+            act.shed()
+            assert membudget.budget() == 500
+            assert act.shed() is None
+            act.relax()
+            act.relax()
+            assert membudget.budget() == 1000 and act.at_baseline
+        finally:
+            membudget.set_budget(before)
+
+    def test_devguard_fallback_actuator_and_half_open_recovery(self):
+        from m3_tpu.x import breaker, devguard
+
+        breaker.reset_registry()
+        devguard.reset_stages()
+        try:
+            devguard.configure(failures=5, reset_s=0.05)
+            calls = []
+            run = lambda: devguard.run_guarded(  # noqa: E731
+                "ctl.test", lambda: calls.append("primary"),
+                lambda: calls.append("fallback"))
+            run()
+            assert calls == ["primary"]
+            act = devguard_fallback_actuator()
+            act.shed()
+            assert devguard.fallback_forced()
+            assert devguard.status()["forced_fallback"] is True
+            # the stage breaker was force-opened too: state agrees
+            assert devguard.stage_breaker("ctl.test").state == "open"
+            run()
+            assert calls[-1] == "fallback"
+            act.relax()
+            assert not devguard.fallback_forced()
+            assert "forced_fallback" not in devguard.status()
+            # earned exit: the breaker recovers via its own half-open
+            # probe after the reset timeout, not by fiat
+            run()
+            assert calls[-1] == "fallback"
+            time.sleep(0.08)
+            run()
+            assert calls[-1] == "primary"
+        finally:
+            devguard.configure(failures=5, reset_s=10.0)
+            devguard.reset_stages()
+            breaker.reset_registry()
+
+    def test_pulse_factories(self):
+        saves, ticks = [], []
+        checkpoint_actuator(
+            SimpleNamespace(save=lambda: saves.append(1))).shed()
+        rebalance_actuator(
+            SimpleNamespace(tick=lambda: ticks.append(1))).shed()
+        assert saves == [1] and ticks == [1]
+
+
+@pytest.fixture()
+def controller_assembly(tmp_path):
+    from m3_tpu.query.slo import latency_ratio
+    from m3_tpu.server.assembly import run_node
+
+    # Same rule NAMES as the defaults (the controller binds by name)
+    # but on the generous 16s bucket lane: a fresh node's first write
+    # batches pay one-time XLA compile + series allocation and can
+    # legitimately exceed the production 0.25s ingest bucket, which
+    # would make the controller CORRECTLY shed.  This pin is about
+    # quiet discipline given healthy verdicts, so the verdicts must be
+    # healthy by construction.
+    rules = [{"name": "ingest-latency", "objective": 0.999,
+              "ratio": latency_ratio("m3tpu_db_write_batch_seconds",
+                                     "16.0")},
+             {"name": "query-latency", "objective": 0.99,
+              "ratio": latency_ratio("m3tpu_query_seconds", "16.0")}]
+    cfg = f"""
+db:
+  root: {tmp_path / "node"}
+  namespaces:
+    default: {{num_shards: 2}}
+coordinator: {{listen_port: 0, admin_listen_port: 0}}
+mediator: {{enabled: false}}
+selfmon:
+  enabled: true
+  budget: 1500
+  default_rules: false
+  rules: {json.dumps(rules)}
+controller:
+  enabled: true
+"""
+    asm = run_node(cfg)
+    try:
+        yield asm
+    finally:
+        asm.close()
+
+
+def _get_json(url, timeout=60):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.load(r)
+
+
+class TestHealthyRunInvariant:
+    """THE tier-1 pin: controller enabled, no faults — ten mediator
+    ticks produce ZERO actions and zero controller_action series, and
+    every actuator rests at its configured baseline."""
+
+    def test_ten_quiet_mediator_ticks(self, controller_assembly):
+        from m3_tpu.storage.mediator import Mediator
+
+        asm = controller_assembly
+        assert asm.controller is not None
+        med = Mediator(asm.db, selfmon=asm.selfmon, selfmon_every=1,
+                       controller=asm.controller, controller_every=1,
+                       snapshot_every=10**9, cleanup_every=10**9,
+                       tick_interval_s=3600)
+        for _ in range(10):
+            stats = med.run_once()
+            assert stats["controller"]["sheds"] == 0
+            assert stats["controller"]["relaxes"] == 0
+        status = asm.controller.status()
+        assert status["ticks"] >= 10
+        assert status["actions_total"] == 0 and status["recent"] == []
+        assert all(a["at_baseline"]
+                   for a in status["actuators"].values())
+        # the quiet invariant on the wire: no controller_action series
+        # was ever interned, so none can ever be scraped into selfmon
+        metrics = urllib.request.urlopen(
+            f"http://127.0.0.1:{asm.port}/metrics",
+            timeout=30).read().decode()
+        assert "controller_action" not in metrics
+
+    def test_health_sections_main_and_admin_parity(
+            self, controller_assembly):
+        asm = controller_assembly
+        asm.selfmon.tick(time.time_ns())
+        main = _get_json(f"http://127.0.0.1:{asm.port}/health")
+        admin = _get_json(f"http://127.0.0.1:{asm.admin_port}/health")
+        for out in (main, admin):
+            assert out["controller"]["enabled"] is True
+            assert set(out["controller"]["bindings"]) == {"query-burn",
+                                                          "ingest-burn"}
+            # satellite: static SLO rule metadata rides /health
+            assert set(out["slo"]["rule_set"]) == {"ingest-latency",
+                                                   "query-latency"}
+            for meta in out["slo"]["rule_set"].values():
+                assert {"objective", "budget", "windows"} <= set(meta)
+        assert main["controller"]["bindings"] == admin["controller"]["bindings"]
+        assert main["slo"]["rule_set"] == admin["slo"]["rule_set"]
+
+    def test_slo_rules_accessor(self, controller_assembly):
+        slo = controller_assembly.selfmon.slo
+        meta = slo.rules()
+        assert meta["ingest-latency"]["objective"] == 0.999
+        assert meta["query-latency"]["objective"] == 0.99
+        for m in meta.values():
+            for w in m["windows"]:
+                assert {"long", "short", "factor"} <= set(w)
+
+
+class TestConfigValidation:
+    def test_controller_requires_selfmon(self):
+        from m3_tpu.core.config import ConfigError, load_config
+
+        with pytest.raises(ConfigError, match="requires selfmon"):
+            load_config("controller: {enabled: true}\n"
+                        "selfmon: {enabled: false}\n").validate()
+
+    def test_bad_knobs_aggregate(self):
+        from m3_tpu.core.config import ConfigError, load_config
+
+        with pytest.raises(ConfigError, match="controller.fire_ticks"):
+            load_config("selfmon: {enabled: true}\n"
+                        "controller: {enabled: true, fire_ticks: 0}\n"
+                        ).validate()

@@ -1,0 +1,192 @@
+"""Round-18 acceptance dtest: the SLO-burn controller closes the loop
+on a live cluster — sustained fault → shed → recovery → relax back.
+
+3 real node processes (rf=3, shared remote KV, placement via the admin
+API) under sustained Majority ingest, self-monitoring AND the
+x/controller control plane riding every mediator tick.  One
+``sustained`` chaos event (the round-18 verb: arm + hold + auto-disarm
+as a single timeline entry) drops 40% of node 1's rpc write frames,
+which must drive the full loop:
+
+* the dedicated ``ingest-errors`` burn rule FIRES on node 1 (its own
+  self-stored drop/ingest series, through the ordinary PromQL engine),
+* the controller sheds through the typed actuator registry — the
+  ``query_slots`` actuator leaves baseline, the decision lands in the
+  ``/health`` ``controller`` section,
+* the fault auto-disarms, the windows wash out, the verdict RECOVERS
+  below the clear threshold, and the controller relaxes every
+  actuator back to baseline with half-open discipline,
+* ZERO acked-sample loss throughout (the soak ledger's regenerate-
+  and-reread verify at Majority),
+* the whole act→relax sequence is retro-queryable as PromQL over the
+  ``_m3_selfmon`` ``controller_action`` history FROM A PEER (node 0
+  fleet-scraped node 1's emission gauges).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from m3_tpu.dtest.soak import (
+    NS, Ledger, SoakCluster, SoakConfig, WorkloadGen, _verify,
+)
+from m3_tpu.x.chaos import ChaosEvent, ChaosScheduler
+
+
+def _health(cluster, k):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{cluster.http_port(k)}/health",
+            timeout=30) as r:
+        return json.load(r)
+
+
+def _controller(cluster, k):
+    return _health(cluster, k).get("controller") or {}
+
+
+def _rule_firing(cluster, k, rule):
+    doc = (_health(cluster, k).get("slo") or {}).get("rules", {}).get(rule)
+    return doc is not None and doc.get("firing") is True
+
+
+@pytest.mark.slow
+class TestSelfHealingScenario:
+    def test_sustained_fault_shed_recover_relax(self, tmp_path):
+        cfg = SoakConfig(
+            nodes=3, series=4000, batch=1000, num_shards=4,
+            slot_capacity=1 << 16, churn=0.0, smoke=True,  # 1s ticks
+            replace=False, selfmon_budget=4000,
+            controller_fire_ticks=2, controller_clear_ticks=3,
+            controller_hold_ticks=1, controller_min_interval="2s",
+        )
+        cluster = SoakCluster(cfg, tmp_path / "cluster")
+        scheduler = None
+        try:
+            cluster.start()
+            gen = WorkloadGen(cfg.series, cfg.churn, cfg.seed)
+            ledger = Ledger(gen)
+            stop = threading.Event()
+
+            def ingest():
+                sweep = 0
+                while not stop.is_set():
+                    for lo in range(0, cfg.series, cfg.batch):
+                        if stop.is_set():
+                            break
+                        hi = min(lo + cfg.batch, cfg.series)
+                        ids = gen.ids(sweep, lo, hi)
+                        vals = gen.values(sweep, lo, hi)
+                        ts = time.time_ns()
+                        tsa = np.full(hi - lo, ts, np.int64)
+                        try:
+                            rejected = cluster.session.write_batch(
+                                NS, ids, tsa, vals, now_nanos=ts)
+                        except Exception:  # noqa: BLE001 — unacked
+                            stop.wait(0.2)
+                            continue
+                        if not rejected:
+                            ledger.ack_bulk(sweep, lo, hi, ts)
+                    sweep += 1
+
+            t = threading.Thread(target=ingest, daemon=True)
+            t.start()
+
+            # -- baseline: controller live, bound, and QUIET ----------
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                ctl = _controller(cluster, 1)
+                if ctl.get("enabled") and "ingest-burn" in ctl.get(
+                        "bindings", {}):
+                    break
+                time.sleep(1.0)
+            else:
+                pytest.fail("controller never appeared on node 1's "
+                            f"/health: {_controller(cluster, 1)}")
+            assert _controller(cluster, 1)["actions_total"] == 0
+            assert not _rule_firing(cluster, 1, "ingest-errors")
+
+            # -- ONE sustained event: arm 40% drops on node 1, hold,
+            #    auto-disarm — the scheduler sees only the expansion
+            scheduler = ChaosScheduler(
+                [ChaosEvent(1.0, "sustained", node=1,
+                            arg="rpc.server=drop:p=0.4", hold_s=35.0)],
+                cluster, seed=7)
+            scheduler.start()
+
+            # -- the loop must CLOSE: burn fires, controller sheds ----
+            deadline = time.monotonic() + 120
+            shed_seen = None
+            while time.monotonic() < deadline:
+                ctl = _controller(cluster, 1)
+                recent = ctl.get("recent", [])
+                if any(a["action"] == "shed" for a in recent):
+                    shed_seen = recent
+                    break
+                time.sleep(1.0)
+            else:
+                pytest.fail(
+                    "controller never shed on the faulted node; "
+                    f"health={_controller(cluster, 1)}")
+            assert any(a["actuator"] == "query_slots"
+                       and a["rule"] == "ingest-errors"
+                       for a in shed_seen)
+            # the mutation is typed and bounds-clamped: the actuator
+            # moved off baseline but never past its shed limit
+            act = _controller(cluster, 1)["actuators"]["query_slots"]
+            assert act["at_baseline"] is False
+            lo = min(act["baseline"], act["shed_limit"])
+            hi = max(act["baseline"], act["shed_limit"])
+            assert lo <= act["value"] <= hi
+
+            # -- recovery: disarm (automatic), burn clears, controller
+            #    relaxes EVERYTHING back to baseline ------------------
+            deadline = time.monotonic() + 240
+            while time.monotonic() < deadline:
+                ctl = _controller(cluster, 1)
+                acts = ctl.get("actuators", {})
+                if acts and all(a["at_baseline"] for a in acts.values()):
+                    break
+                time.sleep(2.0)
+            else:
+                pytest.fail("actuators never relaxed back to baseline; "
+                            f"health={_controller(cluster, 1)}")
+            assert not _rule_firing(cluster, 1, "ingest-errors")
+            recent = _controller(cluster, 1)["recent"]
+            actions = [a["action"] for a in recent]
+            assert "shed" in actions and "relax" in actions
+            assert actions.index("shed") < len(actions) - 1 - \
+                actions[::-1].index("relax")  # shed happened, relax after
+
+            # -- zero acked-sample loss throughout --------------------
+            stop.set()
+            t.join(60)
+            assert ledger.acked_samples > 0
+            for k in cluster.alive_nodes():
+                cluster.nodes[k].wait_healthy(120)
+            verdict = _verify(cluster, ledger, cfg)
+            assert verdict["zero_acked_loss"], verdict
+
+            # -- the whole sequence is one PromQL query away from a
+            #    PEER: node 0 answers for node 1's controller history
+            deadline = time.monotonic() + 90
+            got = set()
+            while time.monotonic() < deadline:
+                rows = cluster.promql(
+                    0, 'max_over_time(m3tpu_controller_action'
+                       '{instance="i1",actuator="query_slots"}[15m])',
+                    namespace="_m3_selfmon")
+                got = {r["metric"].get("action") for r in rows}
+                if {"shed", "relax"} <= got:
+                    break
+                time.sleep(2.0)
+            assert {"shed", "relax"} <= got, (
+                f"peer-readable controller_action history incomplete: "
+                f"{got}")
+        finally:
+            if scheduler is not None:
+                scheduler.stop()
+            cluster.close()

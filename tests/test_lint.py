@@ -113,6 +113,12 @@ EXPECTED = {
     # round 17: device-program registry completeness seeds
     ("registry_cases.py", "registry-complete", 10),  # rogue entry point
     ("registry_cases.py", "registry-complete", 16),  # rogue membudget
+    # round 18: self-healing actuator discipline seeds
+    ("actuator_cases.py", "actuator-typed", 10),  # admission.resize
+    ("actuator_cases.py", "actuator-typed", 15),  # membudget.set_budget
+    ("actuator_cases.py", "actuator-typed", 20),  # devguard.force_fallback
+    ("actuator_cases.py", "actuator-typed", 25),  # breaker force_open
+    ("actuator_cases.py", "actuator-typed", 30),  # devguard.configure
 }
 
 
@@ -144,7 +150,7 @@ class TestCorpus:
                      "placement-cas", "deadline-aware", "retrace-risk",
                      "transfer-hygiene", "dtype-stability",
                      "constant-bloat", "metric-hygiene", "device-guard",
-                     "registry-complete"):
+                     "registry-complete", "actuator-typed"):
             assert len(by_rule.get(rule, [])) >= 2, rule
 
 
@@ -412,6 +418,35 @@ class TestMetricScope:
         got = self._lint_at(tmp_path, "m3_tpu/instrument/tracing2.py",
                             self.LEAK)
         assert not any(f.rule == "metric-hygiene" for f in got)
+
+
+class TestActuatorScope:
+    """Round 18: the DEFAULT context exempts exactly the blessed homes
+    of control-plane mutation — the controller's actuator registry,
+    devguard (force_fallback drives force_open), and assembly's
+    boot-time configuration — and fires everywhere else."""
+
+    RAW = ("from m3_tpu.x import membudget\n"
+           "def f():\n"
+           "    membudget.set_budget(0)\n")
+
+    def _lint_at(self, tmp_path, rel):
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(self.RAW)
+        return lint_file(p, tmp_path, Context())
+
+    def test_fires_outside_the_blessed_homes(self, tmp_path):
+        for rel in ("m3_tpu/storage/mediator.py",
+                    "m3_tpu/server/http_api.py"):
+            got = self._lint_at(tmp_path, rel)
+            assert any(f.rule == "actuator-typed" for f in got), rel
+
+    def test_blessed_homes_exempt(self, tmp_path):
+        for rel in ("m3_tpu/x/controller.py", "m3_tpu/x/devguard.py",
+                    "m3_tpu/server/assembly.py"):
+            got = self._lint_at(tmp_path, rel)
+            assert not any(f.rule == "actuator-typed" for f in got), rel
 
 
 class TestExplain:

@@ -329,6 +329,20 @@ class TestSubqueries:
             QSTART, QEND, STEP)
         assert np.all(np.isnan(there.values))
 
+    def test_range_function_over_absent_metric_is_empty(self, engine):
+        """Every temporal family over a selector matching NO series
+        must return an empty vector (Prometheus semantics), never
+        error — the short-circuit sits before the jitted stencils,
+        whose 0-row window gather cannot even shape itself."""
+        for q in ("max_over_time(no_such_metric[5m])",
+                  "rate(no_such_metric[5m])",
+                  "quantile_over_time(0.9, no_such_metric[5m])",
+                  "sum_over_time(no_such_metric[5m])",
+                  "deriv(no_such_metric[5m])",
+                  "changes(no_such_metric[5m])"):
+            b = engine.execute_range(q, QSTART, QEND, STEP)
+            assert b.num_series == 0, q
+
     def test_subquery_over_scalar_expr(self, engine):
         b = engine.execute_range('min_over_time(time()[10m:1m])',
                                  QSTART, QEND, STEP)

@@ -215,6 +215,87 @@ class TestChaosScheduler:
         nodev = build_timeline(SoakConfig.smoke_config(t_device=0.0))
         assert "device_fault" not in [e.action for e in nodev]
 
+    def test_selfheal_phase_is_opt_in_and_sustained(self):
+        heal = build_timeline(SoakConfig.smoke_config(selfheal=True))
+        labels = [e.arg for e in heal if e.action == "phase"]
+        assert labels == ["healthy", "wire_faults", "device_faults",
+                          "selfheal", "recovered"]
+        sus = [e for e in heal if e.action == "sustained"]
+        assert len(sus) == 1 and sus[0].hold_s > 0
+        # the window closes before the recovered phase mark
+        rec_at = next(e.at_s for e in heal
+                      if e.action == "phase" and e.arg == "recovered")
+        assert sus[0].at_s + sus[0].hold_s < rec_at
+
+
+class TestSustainedEvents:
+    """Round-18 ``sustained`` chaos verb: one entry = arm + hold +
+    auto-disarm, expanded at scheduler construction so ops adapters
+    only ever see the existing arm/clear verbs."""
+
+    WIRE = "rpc.server=drop:p=0.5"
+    DEV = "device.dispatch=error"
+
+    def test_eager_validation(self):
+        with pytest.raises(ValueError):  # hold_s required and positive
+            chaos.ChaosEvent(0.0, "sustained", node=0, arg=self.WIRE)
+        with pytest.raises(ValueError):
+            chaos.ChaosEvent(0.0, "sustained", node=0, arg=self.WIRE,
+                             hold_s=-1.0)
+        with pytest.raises(ValueError):  # empty spec
+            chaos.ChaosEvent(0.0, "sustained", node=0, arg="",
+                             hold_s=5.0)
+        with pytest.raises(ValueError):  # device + wire in one window
+            chaos.ChaosEvent(0.0, "sustained", node=0,
+                             arg=f"{self.WIRE};{self.DEV}", hold_s=5.0)
+        with pytest.raises(ValueError):  # hold_s is sustained-only
+            chaos.ChaosEvent(0.0, "kill", node=0, hold_s=5.0)
+        with pytest.raises(ValueError):  # malformed spec caught eagerly
+            chaos.ChaosEvent(0.0, "sustained", node=0, arg="not-a-spec",
+                             hold_s=5.0)
+
+    def test_parse_timeline_accepts_hold_s(self):
+        _, ev = chaos.parse_timeline({"events": [
+            {"at_s": 2, "action": "sustained", "node": 1,
+             "arg": self.WIRE, "hold_s": 7.5}]})
+        assert ev[0].action == "sustained" and ev[0].hold_s == 7.5
+
+    def test_expansion_verb_inference_and_window(self):
+        wire = chaos.ChaosEvent(3.0, "sustained", node=1, arg=self.WIRE,
+                                hold_s=4.0)
+        dev = chaos.ChaosEvent(1.0, "sustained", node=0, arg=self.DEV,
+                               hold_s=10.0)
+        out = chaos.expand_sustained([wire, dev])
+        assert [(e.at_s, e.action, e.node) for e in out] == [
+            (1.0, "device_fault", 0),
+            (3.0, "wire_fault", 1),
+            (7.0, "clear_faults", 1),      # 3.0 + hold 4.0
+            (11.0, "clear_faults", 0),     # 1.0 + hold 10.0
+        ]
+        assert out[1].arg == self.WIRE     # arm carries the spec
+        assert not any(e.action == "sustained" for e in out)
+
+    def test_expansion_leaves_other_events_alone(self):
+        kill = chaos.ChaosEvent(5.0, "kill", node=2)
+        out = chaos.expand_sustained([kill])
+        assert out == [kill]
+
+    def test_scheduler_fires_arm_then_auto_disarm(self):
+        ops, clk = _FakeOps(), _FakeClock()
+        sched = chaos.ChaosScheduler(
+            [chaos.ChaosEvent(2.0, "sustained", node=1, arg=self.WIRE,
+                              hold_s=6.0),
+             chaos.ChaosEvent(4.0, "phase", arg="mid-window")],
+            ops, seed=17, clock=clk, sleep=clk.sleep)
+        log = sched.run()
+        assert [c[0] for c in ops.calls] == ["arm_faults", "phase",
+                                             "clear_faults"]
+        assert [e["action"] for e in log] == ["wire_fault", "phase",
+                                              "clear_faults"]
+        assert [e["fired_at_s"] for e in log] == [2.0, 4.0, 8.0]
+        # the run-seed stamping still applies to the expanded arm
+        assert "seed=17" in ops.calls[0][2]
+
 
 # ---------------------------------------------------------------------------
 # faultpoint runtime re-arm registry
@@ -577,3 +658,17 @@ class TestSoakSmoke:
         assert {"i0", "i1"} <= insts  # fleet mode: both nodes' burn
         assert sm["queries"]["fleet_ingest_p99_s"] is not None
         assert sm["health_slo"] and "rules" in sm["health_slo"]
+        # round 18: the controller rode every mediator tick ENABLED —
+        # its trigger rule evaluated, its binding armed — and took
+        # ZERO actions (its trigger is an error-ratio rule, exactly 0
+        # on a run whose only drops are the 5% wire window, below the
+        # 10% threshold).  Quiet means no controller_action series
+        # ever interned, so the selfmon history has none either.
+        assert "ingest-errors" in rules  # trigger rule evaluated live
+        ctl = art["controller"]
+        assert ctl["actions_total"] == 0 and ctl["history"] == []
+        assert v["controller_quiet"] is True
+        assert v["controller_relaxed"] is True
+        assert ctl["nodes"], ctl  # every node served the section
+        for node in ctl["nodes"].values():
+            assert all(node["at_baseline"].values())
