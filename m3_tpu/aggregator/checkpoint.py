@@ -49,6 +49,7 @@ from typing import Dict, List, Tuple
 
 import numpy as np
 
+from m3_tpu.persist.capacity import capacity_guard, inject
 from m3_tpu.persist.corruption import ChecksumMismatch, FormatCorruption
 from m3_tpu.persist.digest import digest
 
@@ -199,16 +200,22 @@ def save_lists(lists: dict, path, extra_meta: dict | None = None) -> int:
     fd, tmp = tempfile.mkstemp(dir=str(path.parent),
                                prefix=path.name + ".tmp")
     try:
-        with os.fdopen(fd, "wb") as f:
-            f.write(MAGIC)
-            f.write(struct.pack("<BQ", SCHEMA, len(hbytes)))
-            f.write(struct.pack("<I", digest(hbytes)))
-            f.write(hbytes)
-            for raw in blobs:
-                f.write(raw)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        # capacity_guard also unlinks tmp on ENOSPC; the outer
+        # BaseException handler keeps covering every OTHER failure
+        # (serialization bugs, KeyboardInterrupt mid-save).
+        with capacity_guard(path=path, component="checkpoint", op="write",
+                            cleanup=(tmp,)):
+            inject("checkpoint.write")
+            with os.fdopen(fd, "wb") as f:
+                f.write(MAGIC)
+                f.write(struct.pack("<BQ", SCHEMA, len(hbytes)))
+                f.write(struct.pack("<I", digest(hbytes)))
+                f.write(hbytes)
+                for raw in blobs:
+                    f.write(raw)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
     except BaseException:
         try:
             os.unlink(tmp)
@@ -336,7 +343,9 @@ class AggregatorCheckpointer:
             # quarantine-in-place: keep the bytes for forensics, never
             # crash-loop the node on them
             try:
-                os.replace(self.path, str(self.path) + ".corrupt")
+                with capacity_guard(path=self.path, component="checkpoint",
+                                    op="sideline"):
+                    os.replace(self.path, str(self.path) + ".corrupt")
             except OSError:
                 pass
             return False

@@ -290,6 +290,42 @@ class DeviceConfig:
 
 
 @dataclasses.dataclass
+class DiskConfig:
+    """Disk-capacity resilience knobs (x/diskbudget + persist/capacity).
+
+    ``capacity`` treats ``db.root`` as a quota of that many bytes (byte
+    count or K/M/G/T-suffixed string, binary units) — 0 means headroom
+    comes from ``os.statvfs`` (production: the root owns its
+    filesystem).  ``reserve`` is the flush-headroom band: free bytes
+    at/below it are CRITICAL regardless of ratio, so cold flush, WAL
+    appends and the final-drain snapshot always have room to complete.
+    ``low_ratio``/``critical_ratio`` are the free-ratio watermarks: LOW
+    runs cleanup eagerly on the mediator tick, CRITICAL additionally
+    sheds NEW ingest typed (DiskCapacityError → backoff) while reads
+    and flushes keep serving.  ``enabled: false`` leaves the ledger
+    disarmed (no walks, no gauges, no shedding)."""
+
+    enabled: bool = False
+    capacity: str = "0"
+    reserve: str = "64M"
+    low_ratio: float = 0.25
+    critical_ratio: float = 0.10
+
+    def validate(self, errs: list) -> None:
+        from m3_tpu.x.membudget import parse_bytes
+
+        for f in ("capacity", "reserve"):
+            try:
+                parse_bytes(getattr(self, f))
+            except ValueError as e:
+                errs.append(f"disk.{f}: {e}")
+        if not (0.0 <= self.critical_ratio <= self.low_ratio <= 1.0):
+            errs.append(
+                "disk: want 0 <= critical_ratio <= low_ratio <= 1, got "
+                f"critical={self.critical_ratio} low={self.low_ratio}")
+
+
+@dataclasses.dataclass
 class SelfmonConfig:
     """Self-monitoring (instrument/selfmon.py): the node scrapes its
     own registry — and, in fleet mode, its peers' ``/metrics`` — into
@@ -373,6 +409,7 @@ class ControllerConfig:
     query_rule: str = "query-latency"
     device_rule: str = ""
     node_rule: str = ""               # sustained burn -> rebalance pulse
+    disk_rule: str = ""               # disk burn -> emergency cleanup pulse
     sustain_window: str = "120s"      # min_over_time window for node_rule
     sustain_burn: float = 1.0         # min sustained burn multiple to act
     # actuator envelopes
@@ -472,6 +509,7 @@ class NodeConfig:
     mediator: MediatorConfig = dataclasses.field(default_factory=MediatorConfig)
     query: QueryConfig = dataclasses.field(default_factory=QueryConfig)
     device: DeviceConfig = dataclasses.field(default_factory=DeviceConfig)
+    disk: DiskConfig = dataclasses.field(default_factory=DiskConfig)
     selfmon: SelfmonConfig = dataclasses.field(default_factory=SelfmonConfig)
     controller: ControllerConfig = dataclasses.field(
         default_factory=ControllerConfig)
@@ -485,6 +523,7 @@ class NodeConfig:
         self.mediator.validate(errs)
         self.query.validate(errs)
         self.device.validate(errs)
+        self.disk.validate(errs)
         self.selfmon.validate(errs)
         self.controller.validate(errs)
         if self.controller.enabled and not self.selfmon.enabled:
@@ -507,6 +546,7 @@ _NESTED = {
     "mediator": MediatorConfig,
     "query": QueryConfig,
     "device": DeviceConfig,
+    "disk": DiskConfig,
     "selfmon": SelfmonConfig,
     "controller": ControllerConfig,
 }

@@ -145,6 +145,23 @@ class SoakConfig:
     selfheal: bool = False
     t_selfheal: float = 45.0
     selfheal_spec: str = "rpc.server=drop:p=0.4"
+    # Disk-pressure resilience (round 20): with disk_capacity set, each
+    # node runs its x/diskbudget ledger in capacity-quota mode (the
+    # nodes share one real filesystem, so statvfs would watermark them
+    # all at once) and the timeline gains a disk_pressure window:
+    # ballast-fill node 1's root to disk_spec (a target FREE ratio),
+    # hold t_disk seconds, auto-release.  The reserve is deliberately
+    # small — soak quotas are hundreds of MB, and the 64M production
+    # default would put CRITICAL at absurdly high free ratios.
+    # disk_rule optionally binds the controller's emergency_cleanup
+    # pulse to the disk-pressure SLO rule ("" = record-only).
+    disk_capacity: str = ""   # per-node byte quota; "" = ledger off
+    disk_reserve: str = "4M"
+    disk_low: float = 0.25
+    disk_crit: float = 0.10
+    t_disk: float = 0.0       # disk-pressure window seconds; 0 = off
+    disk_spec: str = "0.18"   # ballast target free ratio (LOW, not crit)
+    disk_rule: str = ""       # controller disk binding rule name
 
     @classmethod
     def smoke_config(cls, **kw) -> "SoakConfig":
@@ -161,6 +178,10 @@ class SoakConfig:
             t_device=8.0,
             wire_spec="rpc.server=delay:ms=10:p=0.4;rpc.server=drop:p=0.05",
             replace=False,
+            # short disk-pressure window: ballast to free=0.18 — LOW
+            # (eager cleanup, ledger visible) but above CRITICAL, so
+            # nothing sheds and the quiet-controller pin still holds
+            disk_capacity="192M", t_disk=6.0,
         )
         base.update(kw)
         return cls(**base)
@@ -200,6 +221,16 @@ def build_timeline(cfg: SoakConfig) -> List[ChaosEvent]:
                              arg=cfg.device_spec))
         t += cfg.t_device
         ev.append(ChaosEvent(t - 1, "clear_faults", node=0))
+    if cfg.t_disk > 0 and cfg.disk_capacity:
+        # Disk-pressure window: one windowed event ballast-fills node
+        # 1's root to the target free ratio and auto-releases 2s before
+        # the phase ends, so 'recovered' (or the next window) starts
+        # with the ledger relaxing back.
+        ev.append(ChaosEvent(t, "phase", arg="disk_pressure"))
+        ev.append(ChaosEvent(t + 1, "disk_pressure", node=1 % cfg.nodes,
+                             arg=cfg.disk_spec,
+                             hold_s=max(1.0, cfg.t_disk - 3)))
+        t += cfg.t_disk
     victim = cfg.nodes - 1
     if cfg.t_kill > 0:
         ev.append(ChaosEvent(t, "phase", arg="sigkill"))
@@ -580,6 +611,40 @@ class SoakCluster:
                 "windows": [{"long": "30s", "short": "10s",
                              "factor": 1.0}],
             })
+            if self.cfg.selfheal:
+                # Satellite of round 20 (ROADMAP item-7 follow-on):
+                # the selfheal profile binds the device lane too.  The
+                # ratio is the fallback share of guarded device calls
+                # — exactly 0.0 on a healthy run (same no-flake
+                # property as ingest-errors), driven hard by the
+                # device_fault sustained window.
+                rules.insert(1, {
+                    "name": "device-errors", "objective": 0.90,
+                    "ratio": ("sum(rate(device_fallback_total"
+                              f"{inst}" "[{window}])) / "
+                              "clamp_min(sum(rate(device_guard_calls"
+                              f"{inst}" "[{window}])), 0.1)"),
+                    "windows": [{"long": "30s", "short": "10s",
+                                 "factor": 1.0}],
+                })
+        if self.cfg.disk_capacity:
+            # Round 20: disk headroom as an SLO.  disk_free_ratio is a
+            # LEVEL (a gauge), not an event rate, so the burn ratio is
+            # "how far below the LOW watermark did this window get",
+            # normalized over the LOW→CRITICAL span: 0.0 at/above LOW,
+            # 1.0 at/below CRITICAL.  max_over_time makes a brief dip
+            # count for the whole window — exactly what paging on disk
+            # pressure should do.  Node-local, like ingest-errors.
+            inst = f'{{instance="i{k}"}}'
+            span = max(0.01, self.cfg.disk_low - self.cfg.disk_crit)
+            rules.insert(0, {
+                "name": "disk-pressure", "objective": 0.75,
+                "ratio": (f"clamp_max(clamp_min({self.cfg.disk_low} - "
+                          f"max_over_time(disk_free_ratio{inst}"
+                          "[{window}])" f", 0) / {span}, 1)"),
+                "windows": [{"long": "30s", "short": "10s",
+                             "factor": 1.0}],
+            })
         return {
             "enabled": True, "every": 1,
             "budget": self.cfg.selfmon_budget,
@@ -603,7 +668,16 @@ class SoakCluster:
         return {
             "enabled": True, "every": 1,
             "ingest_rule": "ingest-errors", "query_rule": "",
-            "device_rule": "", "node_rule": "",
+            # selfheal profile binds the device and node lanes too
+            # (round 20 satellite): device burn → the devguard/
+            # checkpoint/membudget actuators; node burn → rebalance,
+            # with disk pressure as its realistic driver.  Default
+            # profile leaves both record-only, so the smoke quiet-
+            # controller pin can never flake.
+            "device_rule": "device-errors" if cfg.selfheal else "",
+            "node_rule": ("disk-pressure"
+                          if cfg.selfheal and cfg.disk_capacity else ""),
+            "disk_rule": cfg.disk_rule,
             "fire_ticks": cfg.controller_fire_ticks,
             "clear_ticks": cfg.controller_clear_ticks,
             "hold_ticks": cfg.controller_hold_ticks,
@@ -640,6 +714,16 @@ class SoakCluster:
                 if self.cfg.controller:  # requires selfmon (validated)
                     selfmon_yaml += "controller: " + json.dumps(
                         self._controller_config()) + "\n"
+            if self.cfg.disk_capacity:
+                # capacity-quota mode: all nodes share one real
+                # filesystem, so statvfs would watermark them together
+                selfmon_yaml += "disk: " + json.dumps({
+                    "enabled": True,
+                    "capacity": self.cfg.disk_capacity,
+                    "reserve": self.cfg.disk_reserve,
+                    "low_ratio": self.cfg.disk_low,
+                    "critical_ratio": self.cfg.disk_crit,
+                }) + "\n"
             cfgp.parent.mkdir(parents=True, exist_ok=True)
             cfgp.write_text(f"""
 db:
@@ -812,6 +896,36 @@ mediator:
         out = self._admin(k, "POST", "/api/v1/database/scrub",
                           {"repair": True})
         self.note(f"scrub on node {k}: {out.get('scrub')}")
+
+    def disk_fill(self, k: int, target: float) -> None:
+        """Ballast-fill node k's storage root so its capacity-quota
+        ledger sees ``target`` free ratio.  The ballast is a SPARSE
+        file (truncate, no real bytes): the ledger walks ``st_size``,
+        so the node experiences genuine watermark pressure while the
+        shared host filesystem spends nothing — which is also why the
+        soak runs quota mode instead of statvfs."""
+        from m3_tpu.x.membudget import parse_bytes
+
+        root = self.workdir / f"n{k}" / "data"
+        ballast = root / "ballast.fill"
+        capacity = parse_bytes(self.cfg.disk_capacity)
+        used = 0
+        for p in root.rglob("*"):
+            try:
+                if p != ballast and p.is_file():
+                    used += p.lstat().st_size
+            except OSError:
+                continue
+        size = max(0, int(capacity * (1.0 - target)) - used)
+        with open(ballast, "wb") as f:
+            f.truncate(size)
+        self.note(f"disk ballast on node {k}: {size} bytes "
+                  f"(target free ratio {target})")
+
+    def disk_release(self, k: int) -> None:
+        ballast = self.workdir / f"n{k}" / "data" / "ballast.fill"
+        ballast.unlink(missing_ok=True)
+        self.note(f"disk ballast released on node {k}")
 
     def replace(self, k: int, timeout_s: float = 600.0) -> None:
         from m3_tpu.cluster.placement import PlacementService

@@ -31,6 +31,7 @@ from typing import Iterator
 
 import numpy as np
 
+from m3_tpu.persist.capacity import capacity_guard, inject
 from m3_tpu.persist.corruption import ChecksumMismatch, FormatCorruption
 from m3_tpu.persist.digest import digest
 from m3_tpu.x import fault
@@ -60,12 +61,25 @@ class CommitLogWriter:
     commit_log.go NotifyOpts/rotation)."""
 
     def __init__(self, root, fsync: str = FsyncPolicy.INTERVAL,
-                 fsync_interval_s: float = 1.0):
+                 fsync_interval_s: float = 1.0, rotate_bytes: int = 0,
+                 fsync_histogram=None):
         self.dir = Path(root) / "commitlogs"
         self.dir.mkdir(parents=True, exist_ok=True)
         self.fsync = fsync
         self.fsync_interval_s = fsync_interval_s
+        # Size-based rotation bound (0 = only rotate on demand, the
+        # pre-existing behavior).  Without it a node whose snapshot
+        # cadence is long appends to ONE segment forever, and cleanup
+        # can never reclaim WAL space — the growth bound that makes
+        # commitlog bytes reclaimable under disk pressure.
+        self.rotate_bytes = rotate_bytes
+        # Optional instrument.Histogram: fsync wall time.  A stalling
+        # disk shows up here long before ENOSPC does, and the histogram
+        # is windowed so an SLO rule over it reflects *current* device
+        # behavior.
+        self._fsync_hist = fsync_histogram
         self._last_fsync = 0.0
+        self._active_bytes = 0
         self._f = None
         self._seq = self._next_seq()
         self.rotate()
@@ -95,7 +109,9 @@ class CommitLogWriter:
             self._flush_fsync()
             self._f.close()
             self._seq += 1
-        self._f = open(self.path, "ab")
+        with capacity_guard(path=self.path, component="commitlog", op="open"):
+            self._f = open(self.path, "ab")
+        self._active_bytes = self.path.stat().st_size
         return old
 
     def write_batch(self, ids: list[bytes], timestamps: np.ndarray,
@@ -116,8 +132,17 @@ class CommitLogWriter:
         pd = digest(payload)
         hdr_body = struct.pack("<II", len(payload), pd)
         chunk = hdr_body + struct.pack("<I", digest(hdr_body)) + payload
-        self._f.write(chunk)
-        if self.fsync == FsyncPolicy.EVERY_WRITE:
+        with capacity_guard(path=self.path, component="commitlog", op="write"):
+            inject("commitlog.write")
+            self._f.write(chunk)
+        self._active_bytes += len(chunk)
+        if self.rotate_bytes and self._active_bytes >= self.rotate_bytes:
+            # Rotate AFTER the append so the chunk that crossed the
+            # bound is fsynced by rotate()'s flush — the new segment
+            # starts empty and the old one is immediately eligible for
+            # reclaim once its entries are flushed to filesets.
+            self.rotate()
+        elif self.fsync == FsyncPolicy.EVERY_WRITE:
             self._flush_fsync()
         elif self.fsync == FsyncPolicy.INTERVAL:
             now = time.monotonic()
@@ -132,8 +157,12 @@ class CommitLogWriter:
         reader's checksum contract must absorb."""
         if fault.fire("commitlog.flush") == "drop":
             return
-        self._f.flush()
-        os.fsync(self._f.fileno())
+        t0 = time.monotonic()
+        with capacity_guard(path=self.path, component="commitlog", op="fsync"):
+            self._f.flush()
+            os.fsync(self._f.fileno())
+        if self._fsync_hist is not None:
+            self._fsync_hist.record(time.monotonic() - t0)
 
     def close(self) -> None:
         if self._f:

@@ -27,6 +27,7 @@ from pathlib import Path
 from typing import Iterator
 
 from m3_tpu.persist.bloom import BloomFilter
+from m3_tpu.persist.capacity import capacity_guard, inject
 from m3_tpu.persist.corruption import ChecksumMismatch, FormatCorruption
 from m3_tpu.persist.digest import digest, digest_file, pack_digest, unpack_digest
 from m3_tpu.x import fault
@@ -92,11 +93,17 @@ class IndexEntry:
 
 def _write_atomic(path: Path, data: bytes) -> None:
     tmp = path.with_suffix(".tmp")
-    with open(tmp, "wb") as f:
-        f.write(data)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    # ENOSPC/EDQUOT here become typed DiskCapacityError and the temp
+    # file is unlinked on the way out — a full disk never publishes a
+    # half-written artifact and never litters beside the real one.
+    with capacity_guard(path=path, component="fileset", op="write",
+                        cleanup=(tmp,)):
+        inject("fileset.write")
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
 
 
 class DataFileSetWriter:

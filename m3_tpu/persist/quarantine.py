@@ -31,6 +31,7 @@ import os
 import time
 from pathlib import Path
 
+from m3_tpu.persist.capacity import capacity_guard
 from m3_tpu.persist.corruption import CorruptionError
 from m3_tpu.persist.fs import FILE_TYPES, fileset_path
 
@@ -77,20 +78,25 @@ def quarantine_fileset(src_root, namespace: str, shard: int, block_start: int,
     moved: list[str] = []
     # Checkpoint FIRST: once it is gone the volume is invisible, so a
     # crash mid-move can never leave a half-readable fileset behind.
-    for t in ("checkpoint", "digest") + FILE_TYPES:
-        src = fileset_path(src_root, namespace, shard, block_start, volume, t)
-        if src.exists():
-            qdir.mkdir(parents=True, exist_ok=True)
-            os.replace(src, qdir / src.name)
-            moved.append(src.name)
-    if not moved:
-        return None
-    reason = _reason(err, {
-        "kind": "fileset", "label": label, "namespace": namespace,
-        "shard": shard, "block_start": block_start, "volume": volume,
-        "files": moved,
-    })
-    (qdir / REASON_FILE).write_text(json.dumps(reason, indent=1))
+    # Renames are same-filesystem (no new data blocks) but the reason
+    # file is a fresh write, and directory entries cost metadata blocks
+    # — on a truly full disk even these classify as capacity errors.
+    with capacity_guard(path=qdir, component="quarantine", op="move"):
+        for t in ("checkpoint", "digest") + FILE_TYPES:
+            src = fileset_path(src_root, namespace, shard, block_start,
+                               volume, t)
+            if src.exists():
+                qdir.mkdir(parents=True, exist_ok=True)
+                os.replace(src, qdir / src.name)
+                moved.append(src.name)
+        if not moved:
+            return None
+        reason = _reason(err, {
+            "kind": "fileset", "label": label, "namespace": namespace,
+            "shard": shard, "block_start": block_start, "volume": volume,
+            "files": moved,
+        })
+        (qdir / REASON_FILE).write_text(json.dumps(reason, indent=1))
     return qdir
 
 
@@ -105,15 +111,16 @@ def quarantine_snapshot(root, seq: int, err=None) -> Path | None:
     data = Path(root) / "snapshots" / str(seq)
     qdir = _unique_dir(quarantine_root(root) / "snapshots" / str(seq))
     moved: list[str] = []
-    for src in (meta, data):
-        if src.exists():
-            qdir.mkdir(parents=True, exist_ok=True)
-            os.replace(src, qdir / src.name)
-            moved.append(src.name)
-    if not moved:
-        return None
-    reason = _reason(err, {"kind": "snapshot", "seq": seq, "files": moved})
-    (qdir / REASON_FILE).write_text(json.dumps(reason, indent=1))
+    with capacity_guard(path=qdir, component="quarantine", op="move"):
+        for src in (meta, data):
+            if src.exists():
+                qdir.mkdir(parents=True, exist_ok=True)
+                os.replace(src, qdir / src.name)
+                moved.append(src.name)
+        if not moved:
+            return None
+        reason = _reason(err, {"kind": "snapshot", "seq": seq, "files": moved})
+        (qdir / REASON_FILE).write_text(json.dumps(reason, indent=1))
     return qdir
 
 

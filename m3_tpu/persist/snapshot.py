@@ -26,11 +26,13 @@ began — recovery = load snapshot + replay logs with seq >= commitlog_seq
 
 from __future__ import annotations
 
+import os
 import shutil
 import struct
 from dataclasses import dataclass
 from pathlib import Path
 
+from m3_tpu.persist.capacity import capacity_guard
 from m3_tpu.persist.corruption import ChecksumMismatch, FormatCorruption
 from m3_tpu.persist.digest import digest
 
@@ -90,9 +92,18 @@ def commit_snapshot(root, seq: int, commitlog_seq: int) -> None:
     """Write the metadata file — the snapshot's atomic commit point."""
     d = snapshots_root(root)
     d.mkdir(parents=True, exist_ok=True)
-    tmp = meta_path(root, seq).with_suffix(".tmp")
-    tmp.write_bytes(SnapshotMetadata(seq, commitlog_seq).to_bytes())
-    tmp.replace(meta_path(root, seq))
+    final = meta_path(root, seq)
+    tmp = final.with_suffix(".tmp")
+    # fsync before the rename (the meta gates the whole snapshot's
+    # visibility — a published-but-unsynced meta would be a torn commit
+    # point after power loss), and classify ENOSPC on the way.
+    with capacity_guard(path=final, component="snapshot", op="write",
+                        cleanup=(tmp,)):
+        with open(tmp, "wb") as f:
+            f.write(SnapshotMetadata(seq, commitlog_seq).to_bytes())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
 
 
 def list_snapshots(root) -> list[SnapshotMetadata]:

@@ -13,6 +13,7 @@ order.
 from __future__ import annotations
 
 import dataclasses
+import time as _time
 
 from m3_tpu import instrument
 from m3_tpu.core.config import NodeConfig, load_config, parse_duration
@@ -205,6 +206,21 @@ def run_node(source, start_mediator: bool | None = None,
     _devguard.configure(
         failures=cfg.device.breaker_failures,
         reset_s=parse_duration(cfg.device.breaker_reset) / 1e9)
+    # Disk ledger next (membudget's twin): armed before the Database
+    # exists so the very first mediator tick refreshes real watermarks.
+    # reset() when disabled — the ledger is process-global and a prior
+    # in-process node's configuration must not leak into this one.
+    from m3_tpu.x import diskbudget as _diskbudget
+
+    if cfg.disk.enabled:
+        _diskbudget.configure(
+            cfg.db.root,
+            capacity=cfg.disk.capacity,
+            reserve=cfg.disk.reserve,
+            low_ratio=cfg.disk.low_ratio,
+            critical_ratio=cfg.disk.critical_ratio)
+    else:
+        _diskbudget.reset()
     registry = instrument.new_registry()
     scope = registry.scope(cfg.metrics_prefix)
     # Mirror the process-global fault/retry counters onto this node's
@@ -330,6 +346,11 @@ def run_node(source, start_mediator: bool | None = None,
             asm.rpc_server = serve_rpc_background(
                 db, host=cfg.db.rpc_listen_host, port=cfg.db.rpc_listen_port
             )
+            if cfg.disk.enabled:
+                # CRITICAL watermark → refuse write batches un-acked
+                # (typed RPC_ERR the session's consistency level
+                # absorbs); reads/repair/ticks are never gated.
+                asm.rpc_server.ingest_gate = _diskbudget.check_ingest
 
         # Query federation (query/remote): serve THIS node's storage to
         # peer coordinators over QUERY_FETCH, and/or federate peer
@@ -496,6 +517,14 @@ def run_node(source, start_mediator: bool | None = None,
                 _bind(ccfg.node_rule, ["rebalance"], name="node-burn",
                       sustain_window=ccfg.sustain_window,
                       sustain_burn=ccfg.sustain_burn)
+            if cfg.disk.enabled:
+                # Disk-burn → a cleanup PULSE: the watermark gate sheds
+                # ingest on its own; the controller's job is to force a
+                # reclaim pass the cadence wouldn't run yet.
+                reg.register(xctl.emergency_cleanup_actuator(
+                    lambda: db.cleanup(_time.time_ns())))
+                _bind(ccfg.disk_rule, ["emergency_cleanup"],
+                      name="disk-burn")
             asm.controller = xctl.Controller(
                 reg, bindings, burn_source=slo.status,
                 instrument=scope,
@@ -506,6 +535,22 @@ def run_node(source, start_mediator: bool | None = None,
                     metric=f"{cfg.metrics_prefix}_slo_burn",
                     deadline_s=parse_duration(
                         ccfg.history_deadline) / 1e9))
+
+        # Disk-pressure stage for the mediator: refresh the ledger every
+        # pass; at/above LOW run cleanup EAGERLY (superseded volumes,
+        # stale snapshots, aged quarantine, flushed commitlog segments)
+        # instead of waiting out the cleanup cadence.  Shedding itself
+        # happens at the ingest gates off the cached level — this stage
+        # is what keeps that cache fresh.
+        _disk_stage = None
+        if cfg.disk.enabled:
+            def _disk_stage(now: int, _db=db) -> dict:
+                dsnap = _diskbudget.refresh()
+                out = {"level": dsnap["level"],
+                       "free_ratio": round(dsnap["free_ratio"], 4)}
+                if dsnap["level_value"] >= 1:
+                    out["cleanup"] = _db.cleanup(now)
+                return out
 
         if cfg.mediator.enabled if start_mediator is None else start_mediator:
             asm.mediator = Mediator(
@@ -526,6 +571,7 @@ def run_node(source, start_mediator: bool | None = None,
                 selfmon_every=cfg.selfmon.every,
                 controller=asm.controller,
                 controller_every=cfg.controller.every,
+                diskpressure=_disk_stage,
                 instrument=scope,
             )
             asm.mediator.open()

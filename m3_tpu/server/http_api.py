@@ -44,6 +44,7 @@ import re
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
 from urllib.parse import parse_qs, urlparse
 
 import numpy as np
@@ -208,8 +209,22 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception:  # noqa: BLE001 — health must never 500
             inv = None
         if inv:
+            # Byte accounting per entry: under disk pressure the reaper
+            # (and the operator) needs to know what releasing an entry
+            # buys, not just that it exists.
+            qbytes = 0
+            for e in inv:
+                try:
+                    d = e.get("dir")
+                    if d:
+                        qbytes += sum(f.stat().st_size
+                                      for f in Path(d).rglob("*")
+                                      if f.is_file())
+                except OSError:
+                    pass
             out["quarantine"] = {
                 "entries": len(inv),
+                "bytes": qbytes,
                 # brief per-entry detail; the full reason files live in
                 # <root>/quarantine/
                 "items": [
@@ -295,6 +310,31 @@ class _Handler(BaseHTTPRequestHandler):
                 section["checkpoint"] = self.ctx.checkpointer.status()
             if section:
                 out["device"] = section
+        except Exception:  # noqa: BLE001 — health must never 500
+            pass
+        # Disk-capacity visibility (x/diskbudget + persist/capacity):
+        # the ledger's watermark verdict, per-family byte accounting
+        # and shed/typed-error counters.  The membudget discipline —
+        # health reports DEGRADATION, not activity: the section appears
+        # only once the node is at/past LOW, has shed ingest, or has
+        # classified a capacity error; a clean node stays noise-free.
+        try:
+            from m3_tpu.persist import capacity as xcap
+            from m3_tpu.x import diskbudget
+
+            dsnap = diskbudget.snapshot()
+            caps = xcap.counters()
+            if dsnap["enabled"] and (dsnap["level_value"] > 0
+                                     or dsnap["shed_total"] or caps):
+                disk = dict(dsnap)
+                disk["free_ratio"] = round(disk["free_ratio"], 4)
+                if caps:
+                    disk["capacity_errors"] = caps
+                out["disk"] = disk
+            elif caps:
+                # Typed errors with the ledger disarmed (statvfs-only
+                # deployments without watermarks) still surface.
+                out["disk"] = {"enabled": False, "capacity_errors": caps}
         except Exception:  # noqa: BLE001 — health must never 500
             pass
         # SLO burn-rate verdicts over the self-monitored history

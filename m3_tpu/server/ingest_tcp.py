@@ -263,6 +263,10 @@ class IngestServer(socketserver.ThreadingTCPServer):
         self.max_queue_frames = max_queue_frames
         self.per_conn_inflight = per_conn_inflight
         self.backoff_hint_ms = backoff_hint_ms
+        # Optional nullary admission gate (raises typed DiskCapacityError
+        # to refuse a frame un-acked); assembly binds it to the disk
+        # ledger's check_ingest when disk.enabled.
+        self.ingest_gate = None
         self._queue: "queue.Queue" = queue.Queue()
         self._q_lock = threading.Lock()
         self._inflight = 0
@@ -285,6 +289,16 @@ class IngestServer(socketserver.ThreadingTCPServer):
     # -- ingest queue ------------------------------------------------------
 
     def _try_enqueue(self, conn, sock, ftype, batch, n, tctx=None) -> bool:
+        # Disk-pressure shed rides the SAME refuse-before-ack path as
+        # queue overflow: at CRITICAL the frame is never enqueued, the
+        # client gets the explicit BACKOFF hint, and since the ack is
+        # the durability boundary nothing un-acked is lost.
+        gate = self.ingest_gate
+        if gate is not None:
+            try:
+                gate()
+            except OSError:  # DiskCapacityError — typed capacity refuse
+                return False
         with self._q_lock:
             # A server mid-shutdown sheds (explicit BACKOFF) rather
             # than enqueueing onto a queue whose worker is stopping —

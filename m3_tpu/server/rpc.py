@@ -319,6 +319,17 @@ class _RpcHandler(socketserver.BaseRequestHandler):
     def _dispatch(self, db, method: int, raw: bytes) -> bytes:
         if method == M_HEALTH:
             return b"ok"
+        if method in (M_WRITE_BATCH, M_WRITE_TAGGED):
+            # Disk-pressure admission (assembly wires the gate from
+            # x.diskbudget.check_ingest): at CRITICAL the batch is
+            # refused BEFORE decode with the typed DiskCapacityError —
+            # the RPC_ERR frame below makes it a per-replica failure
+            # the session's consistency level absorbs, so nothing is
+            # acked here and nothing is lost.  Reads, repair streams
+            # and ticks are never gated.
+            gate = getattr(self.server, "ingest_gate", None)
+            if gate is not None:
+                gate()
         if method == M_WRITE_BATCH:
             ns, pos = _dec_str(raw, 0)
             (now,) = struct.unpack_from("<q", raw, pos)
@@ -430,6 +441,10 @@ class DbNodeRpcServer(socketserver.ThreadingTCPServer):
         # the same ring the debug endpoint serves
         self.tracer = (tracer if tracer is not None
                        else getattr(db, "tracer", None) or NOOP_TRACER)
+        # Optional nullary admission gate for the write methods (raises
+        # typed to refuse a batch un-acked); assembly binds it to the
+        # disk ledger's check_ingest when disk.enabled.
+        self.ingest_gate = None
         super().__init__((host, port), _RpcHandler)
 
     @property

@@ -335,9 +335,30 @@ class ShardBuffer:
         )
         self._n_host[row] = 0
 
+    def discard(self, block_start: int) -> None:
+        """Drop one open window WITHOUT the drain sort.  The flush path
+        reads via :meth:`peek`, writes the volume, and discards only
+        once the write is durably on disk — an ENOSPC mid-write leaves
+        the window buffered and readable for the next tick's retry."""
+        row = self.open_blocks.pop(block_start, None)
+        if row is not None:
+            self._reset_row(row)
+
     def drain_cold(self, block_start: int):
         """Pull the overflow list for one block (sorted, deduped)."""
         parts = self.cold.pop(block_start, None)
+        return self._merge_cold(parts)
+
+    def peek_cold(self, block_start: int):
+        """Non-destructive :meth:`drain_cold` — pair with
+        :meth:`discard_cold` after the merged volume lands on disk."""
+        return self._merge_cold(self.cold.get(block_start))
+
+    def discard_cold(self, block_start: int) -> None:
+        self.cold.pop(block_start, None)
+
+    @staticmethod
+    def _merge_cold(parts):
         if not parts:
             return (np.empty(0, np.int32), np.empty(0, np.int64), np.empty(0))
         slots = np.concatenate([p[0] for p in parts]).astype(np.int32)

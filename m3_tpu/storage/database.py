@@ -41,6 +41,7 @@ from m3_tpu.persist.commitlog import (
     CommitLogEntry, CommitLogWriter, commitlog_seq, list_commitlogs,
     read_commitlog,
 )
+from m3_tpu.persist import capacity as cap
 from m3_tpu.persist.corruption import CorruptionError
 from m3_tpu.persist.fs import (
     DataFileSetReader, DataFileSetWriter, list_fileset_volumes, list_filesets,
@@ -76,6 +77,11 @@ class NamespaceOptions:
 class DatabaseOptions:
     root: str = "m3tpu_data"
     commitlog_enabled: bool = True
+    # Active-segment size bound: the WAL rotates once a segment crosses
+    # this many bytes, so cleanup can reclaim fully-flushed segments on
+    # nodes whose snapshot cadence (the only other rotation driver) is
+    # long.  0 = rotate only on snapshot (the pre-round-20 behavior).
+    commitlog_rotate_bytes: int = 64 << 20
     # 0 = unlimited; live-tunable via the write_new_series_limit_per_sec
     # runtime option (reference dbnode/kvconfig/keys.go).
     write_new_series_limit_per_sec: float = 0.0
@@ -223,13 +229,19 @@ class Shard:
 
     def warm_flush(self, block_start: int) -> int:
         """Seal + persist one block (reference buffer.go:634 WarmFlush →
-        persist_manager flush).  Returns series flushed."""
-        slots, ts, vals = self.buffer.drain(block_start)
+        persist_manager flush).  Returns series flushed.
+
+        The window clears only AFTER the volume is durably on disk
+        (peek → write → discard): a DiskCapacityError mid-write leaves
+        every sample buffered and readable, and the next tick retries
+        the flush against whatever space the cleanup freed."""
+        slots, ts, vals = self.buffer.peek(block_start)
         series = self._encode_runs(slots, ts, vals, block_start)
         DataFileSetWriter(
             self.root, self.namespace, self.shard_id, block_start,
             self.opts.block_size_nanos, volume=0,
         ).write_all(series)
+        self.buffer.discard(block_start)
         self.flushed_blocks.add(block_start)
         return len(series)
 
@@ -248,8 +260,9 @@ class Shard:
         for block_start in sorted(self.buffer.cold.keys()):
             if block_start in skip_open:
                 continue
-            slots, ts, vals = self.buffer.drain_cold(block_start)
+            slots, ts, vals = self.buffer.peek_cold(block_start)
             if len(slots) == 0:
+                self.buffer.discard_cold(block_start)
                 continue
             vol = -1
             for bs, v in list_filesets(self.root, self.namespace, self.shard_id):
@@ -286,6 +299,9 @@ class Shard:
                 self.root, self.namespace, self.shard_id, block_start,
                 self.opts.block_size_nanos, volume=vol + 1,
             ).write_all(series)
+            # staged overflow clears only once volume+1 is on disk —
+            # same no-loss-on-ENOSPC ordering as warm_flush
+            self.buffer.discard_cold(block_start)
             self.flushed_blocks.add(block_start)
             if self.block_cache is not None:
                 # volume+1 supersedes the cached volume's blocks
@@ -695,7 +711,15 @@ class Database:
                 corruption_cb=self._note_corruption,
             )
         self.commitlog = (
-            CommitLogWriter(self.opts.root) if self.opts.commitlog_enabled else None
+            CommitLogWriter(
+                self.opts.root,
+                rotate_bytes=self.opts.commitlog_rotate_bytes,
+                # fsync wall time on the db scope: a stalling disk is
+                # SLO-visible long before it is full
+                fsync_histogram=(
+                    self._scope.histogram("commitlog_fsync_seconds")
+                    if self._scope is not None else None),
+            ) if self.opts.commitlog_enabled else None
         )
         # (num_shards, owned) the topology watcher last installed:
         # inherited by namespaces created later (see ensure_namespace).
@@ -1106,14 +1130,44 @@ class Database:
                 stats["quarantine_reaped"] = stats.get("quarantine_reaped", 0) + 1
         stats["snapshots"] = snap.prune_snapshots(self.opts.root, keep=1)
         latest = snap.latest_snapshot(self.opts.root)
-        if latest is not None:
-            for log in list_commitlogs(self.opts.root):
-                if self.commitlog is not None and log == self.commitlog.path:
-                    continue
-                if commitlog_seq(log) < latest.commitlog_seq:
-                    log.unlink(missing_ok=True)
-                    stats["commitlogs"] += 1
+        for log in list_commitlogs(self.opts.root):
+            if self.commitlog is not None and log == self.commitlog.path:
+                continue
+            if latest is not None and commitlog_seq(log) < latest.commitlog_seq:
+                log.unlink(missing_ok=True)
+                stats["commitlogs"] += 1
+            elif self._commitlog_fully_flushed(log):
+                # Size-rotated segments (rotate_bytes) are not covered
+                # by any snapshot, so without this check they live to
+                # retention — a segment every entry of which is durable
+                # in a checkpointed fileset protects nothing.
+                log.unlink(missing_ok=True)
+                stats["commitlogs"] += 1
         return stats
+
+    def _commitlog_fully_flushed(self, log) -> bool:
+        """True iff EVERY entry in the (inactive) segment is durable in
+        a checkpointed fileset: its block is flushed and nothing for
+        that block is still pending in the warm/cold buffers.  Entries
+        for unknown namespaces or unflushed blocks keep the segment
+        (conservative — replay may still need it)."""
+        try:
+            for e in read_commitlog(log):
+                ns = self.namespaces.get(e.namespace.decode())
+                if ns is None:
+                    return False
+                shard = ns.shards[
+                    shard_for_id(e.series_id, ns.opts.num_shards)]
+                bs = (e.timestamp // ns.opts.block_size_nanos
+                      * ns.opts.block_size_nanos)
+                if bs not in shard.flushed_blocks:
+                    return False
+                if (bs in shard.buffer.open_blocks
+                        or bs in shard.buffer.cold):
+                    return False
+        except OSError:
+            return False
+        return True
 
     def _replay_entries(self, name: str, entries: list,
                         flushed_pts: Dict[tuple, dict] | None = None) -> int:
@@ -1211,6 +1265,13 @@ class Database:
                 return self._bootstrap_locked()
 
     def _bootstrap_locked(self) -> dict:
+        # Torn-write sweep FIRST: a crash (or classified ENOSPC whose
+        # unlink itself failed) between temp-write and rename leaves a
+        # dead ``*.tmp*`` beside the real artifact — invisible to every
+        # reader but holding disk the ledger would count forever.
+        swept = cap.sweep_temp_files(self.opts.root)
+        if swept:
+            _LOG.info("bootstrap: swept %d torn temp file(s)", len(swept))
         restored = 0
         flushed_pts: Dict[tuple, dict] = {}  # shared fileset-decode cache
         latest = snap.latest_snapshot(self.opts.root)
@@ -1264,7 +1325,8 @@ class Database:
             for name, entries in per_ns.items():
                 replayed += self._replay_entries(name, entries, flushed_pts)
         self.bootstrapped = True
-        return {"commitlog_replayed": replayed, "snapshot_restored": restored}
+        return {"commitlog_replayed": replayed, "snapshot_restored": restored,
+                "temp_files_swept": len(swept)}
 
     def close(self) -> None:
         with self._mu:

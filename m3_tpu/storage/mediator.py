@@ -51,6 +51,7 @@ class Mediator:
         selfmon_every: int = 1,
         controller=None,
         controller_every: int = 1,
+        diskpressure=None,
         instrument=None,
     ):
         self.db = db
@@ -91,6 +92,11 @@ class Mediator:
         # pass, by construction.
         self.controller = controller
         self.controller_every = max(1, controller_every)
+        # Optional disk-pressure stage (assembly closure over
+        # x.diskbudget + Database.cleanup): refreshes the disk ledger
+        # every pass and runs cleanup EAGERLY at/above the LOW
+        # watermark — pressure-driven reclaim instead of cadence.
+        self.diskpressure = diskpressure
         self._ticks = 0
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -124,6 +130,18 @@ class Mediator:
                 stats["snapshot"] = self.db.snapshot()
             if self._ticks % self.cleanup_every == 0:
                 stats["cleanup"] = self.db.cleanup(now)
+            if self.diskpressure is not None:
+                # After flush/snapshot/cleanup (their writes are the
+                # bytes being measured), before selfmon (so this pass's
+                # scrape stores the watermark the ledger just computed).
+                try:
+                    stats["disk"] = self.diskpressure(now)
+                except Exception:  # noqa: BLE001 — a failing ledger
+                    # walk must not disable maintenance; counted so a
+                    # silently-dead disk stage is visible on /metrics
+                    _LOG.exception("mediator: disk-pressure stage failed")
+                    if self._scope is not None:
+                        self._scope.counter("disk_pressure_errors").inc()
             if (self.migrator is not None
                     and self._ticks % self.migrate_every == 0):
                 # Shard lifecycle before the scrub stage: a freshly

@@ -461,6 +461,13 @@ def soak(args) -> int:
             overrides[name] = v
     if args.selfheal:
         overrides["selfheal"] = True
+        # Round 20: the selfheal profile binds the device and node
+        # lanes (satellite of the disk-pressure round) and gives node
+        # burn its realistic driver — a capacity-quota disk ledger, a
+        # disk-pressure window, and the emergency_cleanup binding.
+        overrides.setdefault("disk_capacity", "256M")
+        overrides.setdefault("t_disk", 20.0)
+        overrides.setdefault("disk_rule", "disk-pressure")
     if baseline is not None:
         cfg = config_from_artifact(baseline, **overrides)
     elif args.smoke:
@@ -715,7 +722,10 @@ def main(argv=None) -> int:
     sk.add_argument("--selfheal", action="store_true",
                     help="add the round-18 selfheal phase: a sustained "
                          "heavy-drop window the SLO-burn controller "
-                         "must shed, survive, and relax back from "
+                         "must shed, survive, and relax back from; "
+                         "also binds the device/node/disk lanes "
+                         "(device-errors, disk-pressure) with a disk-"
+                         "pressure window as the node-burn driver "
                          "(artifact records the controller_action "
                          "history)")
     sk.add_argument("--check", nargs="?", const="", default=None,

@@ -45,6 +45,12 @@
   series buffers and big transient stage buffers reserve bytes BEFORE
   XLA allocates; over ``M3_DEVICE_MEM_BUDGET`` rejects typed
   (``DeviceBudgetExceeded``) instead of dying inside the runtime.
+* ``m3_tpu.x.diskbudget`` — membudget's disk twin: a per-root byte
+  ledger (filesets / commitlog / snapshots / quarantine / checkpoints
+  + statvfs or quota headroom) with OK/LOW/CRITICAL watermarks and a
+  reserved flush-headroom band; LOW triggers eager cleanup, CRITICAL
+  sheds NEW ingest typed (``DiskCapacityError``) while flush/WAL ride
+  the reserve.
 * ``m3_tpu.x.costwatch`` — machine-independent cost fingerprints: a
   registry of every hot-path device program at pinned canonical
   shapes, fingerprinted compile-only from XLA's cost/memory analysis
@@ -129,6 +135,26 @@ def register_metrics(registry, prefix: str = "") -> object:
         scope.gauge("device_mem_peak_bytes").update(mb["peak_bytes"])
         scope.gauge("device_mem_rejected_total").update(
             mb["rejected_total"])
+        # disk ledger + typed-capacity counters (lazy: diskbudget pulls
+        # persist.capacity in, and most registry users never touch disk)
+        from m3_tpu.persist import capacity
+        from m3_tpu.x import diskbudget
+        db = diskbudget.snapshot()
+        if db["enabled"]:
+            scope.gauge("disk_total_bytes").update(db["total_bytes"])
+            scope.gauge("disk_used_bytes").update(db["used_bytes"])
+            scope.gauge("disk_free_bytes").update(db["free_bytes"])
+            scope.gauge("disk_free_ratio").update(db["free_ratio"])
+            scope.gauge("disk_reserve_bytes").update(db["reserve_bytes"])
+            scope.gauge("disk_level").update(db["level_value"])
+            scope.gauge("disk_ingest_shed_total").update(db["shed_total"])
+            for comp, nbytes in db["components"].items():
+                scope.tagged({"component": comp}).gauge(
+                    "disk_component_bytes").update(nbytes)
+        for name, value in capacity.counters().items():
+            comp, _, _key = name.rpartition(".")
+            scope.tagged({"component": comp}).gauge(
+                "disk_capacity_errors_total").update(value)
 
     registry.register_collector(collect)
     return collect

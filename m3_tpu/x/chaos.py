@@ -48,6 +48,14 @@ Two pieces:
   ``replace``    rolling node replace: retire ``node``, bring in the
                  spare (``ops.replace(node)`` drives the admin
                  placement/replace verb + the migration path)
+  ``disk_pressure``  ballast-fill a node's storage root until its free
+                 ratio drops to ``arg`` (a float in (0, 1)); the node's
+                 disk ledger must cross its watermarks, shed typed, and
+                 keep serving (``ops.disk_fill(node, target)``).  With
+                 ``hold_s`` the scheduler auto-appends the matching
+                 ``disk_release`` — the sustained-window idiom.
+  ``disk_release``  delete the ballast again
+                 (``ops.disk_release(node)``) so relax-back is provable
   =============  ==========================================================
 
 * :class:`ChaosScheduler` — executes the timeline against an *ops*
@@ -76,7 +84,8 @@ __all__ = ["ChaosEvent", "ChaosScheduler", "expand_sustained",
            "parse_timeline"]
 
 ACTIONS = ("phase", "kill", "restart", "wire_fault", "device_fault",
-           "sustained", "clear_faults", "corrupt", "replace")
+           "sustained", "clear_faults", "corrupt", "replace",
+           "disk_pressure", "disk_release")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -95,7 +104,7 @@ class ChaosEvent:
             raise ValueError("phase events need a label in 'arg'")
         if self.action != "phase" and self.node is None:
             raise ValueError(f"{self.action} event needs a 'node'")
-        if self.action != "sustained" and self.hold_s:
+        if self.action not in ("sustained", "disk_pressure") and self.hold_s:
             raise ValueError(
                 f"{self.action} events take no 'hold_s' (sustained only)")
         if self.action == "wire_fault":
@@ -113,6 +122,19 @@ class ChaosEvent:
             if self.hold_s <= 0:
                 raise ValueError("sustained events need 'hold_s' > 0")
             self._arm_action()  # eager: spec parses, namespaces uniform
+        if self.action == "disk_pressure":
+            # arg = target free RATIO after the fill; eager-validated so
+            # a fat-fingered percentage (e.g. "15") fails at parse time.
+            try:
+                target = float(self.arg)
+            except ValueError:
+                raise ValueError(
+                    "disk_pressure 'arg' must be a target free ratio, "
+                    f"got {self.arg!r}") from None
+            if not 0.0 < target < 1.0:
+                raise ValueError(
+                    "disk_pressure target free ratio must be in (0, 1), "
+                    f"got {target}")
 
     def _arm_action(self) -> str:
         """The concrete arm verb a ``sustained`` event expands to,
@@ -161,13 +183,20 @@ def expand_sustained(events: List[ChaosEvent]) -> List[ChaosEvent]:
     the log records the exact armed window as two entries."""
     out: List[ChaosEvent] = []
     for ev in events:
-        if ev.action != "sustained":
+        if ev.action == "sustained":
+            out.append(ChaosEvent(at_s=ev.at_s, action=ev._arm_action(),
+                                  node=ev.node, arg=ev.arg))
+            out.append(ChaosEvent(at_s=ev.at_s + ev.hold_s,
+                                  action="clear_faults", node=ev.node))
+        elif ev.action == "disk_pressure" and ev.hold_s:
+            # Same windowing idiom for disk pressure: fill now, release
+            # at at_s + hold_s, so relax-back is part of the timeline.
+            out.append(ChaosEvent(at_s=ev.at_s, action="disk_pressure",
+                                  node=ev.node, arg=ev.arg))
+            out.append(ChaosEvent(at_s=ev.at_s + ev.hold_s,
+                                  action="disk_release", node=ev.node))
+        else:
             out.append(ev)
-            continue
-        out.append(ChaosEvent(at_s=ev.at_s, action=ev._arm_action(),
-                              node=ev.node, arg=ev.arg))
-        out.append(ChaosEvent(at_s=ev.at_s + ev.hold_s,
-                              action="clear_faults", node=ev.node))
     return sorted(out, key=lambda e: e.at_s)
 
 
@@ -190,7 +219,9 @@ class ChaosScheduler:
 
     ``ops`` must provide ``kill(node)``, ``restart(node)``,
     ``arm_faults(node, spec)``, ``clear_faults(node)``,
-    ``corrupt(node, seed)``, ``replace(node)``, and ``phase(label)``.
+    ``corrupt(node, seed)``, ``replace(node)``,
+    ``disk_fill(node, target)``, ``disk_release(node)``, and
+    ``phase(label)``.
     An event whose op RAISES is recorded in :attr:`log` with its error
     and the run continues — one failed injection must not silently
     cancel the rest of the chaos (the artifact shows exactly what
@@ -265,6 +296,10 @@ class ChaosScheduler:
                 self.ops.corrupt(ev.node, self.seed + index)
             elif ev.action == "replace":
                 self.ops.replace(ev.node)
+            elif ev.action == "disk_pressure":
+                self.ops.disk_fill(ev.node, float(ev.arg))
+            elif ev.action == "disk_release":
+                self.ops.disk_release(ev.node)
         except Exception as e:  # noqa: BLE001 — recorded, run continues
             rec["ok"] = False
             rec["error"] = f"{type(e).__name__}: {e}"

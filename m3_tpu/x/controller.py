@@ -70,7 +70,7 @@ __all__ = [
     "Actuator", "ActuatorRegistry", "Binding", "BurnHistory", "Controller",
     "admission_actuator", "ingest_backoff_actuator", "membudget_actuator",
     "devguard_fallback_actuator", "checkpoint_actuator",
-    "rebalance_actuator",
+    "rebalance_actuator", "emergency_cleanup_actuator",
 ]
 
 
@@ -556,3 +556,15 @@ def rebalance_actuator(migrator, name: str = "rebalance") -> Actuator:
     return Actuator(
         name, "placement", baseline=0.0, shed_limit=1.0, step=1.0,
         pulse=True, apply=lambda v: migrator.tick())
+
+
+def emergency_cleanup_actuator(fn: Callable[[], object],
+                               name: str = "emergency_cleanup") -> Actuator:
+    """Space-reclaim pulse for disk burn: run the cleanup machinery NOW
+    (superseded volumes, stale snapshots, retention-aged quarantine,
+    fully-flushed commitlog segments) instead of waiting for its
+    mediator cadence — the controller's answer to a filling disk, fired
+    alongside ingest backoff so reclaim and shed act together."""
+    return Actuator(
+        name, "disk", baseline=0.0, shed_limit=1.0, step=1.0,
+        pulse=True, apply=lambda v: fn())

@@ -1,6 +1,6 @@
 """m3lint: codebase-aware static analysis for the m3-tpu tree.
 
-Seventeen rule families, each encoding a contract this repo already
+Eighteen rule families, each encoding a contract this repo already
 pays for at runtime (race tier, fault tier, bit-exactness goldens,
 bench steady-state) as a static gate:
 
@@ -51,6 +51,12 @@ bench steady-state) as a static gate:
   placement-cas pattern for control state: mutations must be
   bounds-clamped, rate-limited, and emitted as ``controller_action``
   samples (round 18).
+* ``enospc-typed``      — durable write ops (``os.fsync``/``os.replace``/
+  write-mode ``open``/``.write_bytes``) in ``persist/`` and the
+  aggregator checkpoint outside a ``capacity_guard`` block, or
+  capacity-shaped ``raise OSError(ENOSPC/EDQUOT...)`` instead of the
+  typed ``DiskCapacityError`` — a full disk must classify, clean its
+  temp files, and count, never crash the flush that hit it (round 20).
 * ``metric-hygiene``    — instrument interning inside loops/per-request
   handlers in the request-serving trees (``server/``, ``query/``) —
   registry interning makes it correct but per-call lock+intern is

@@ -65,6 +65,12 @@ RULES = (
     # breaker thresholds/state, forced fallback) mutate only through
     # x/controller.py's typed actuator registry
     "actuator-typed",
+    # round 20: typed disk-capacity errors (capacity_rule.py) —
+    # durable write ops in persist/ (+ the aggregator checkpoint) run
+    # inside capacity_guard so ENOSPC/EDQUOT classify into
+    # DiskCapacityError with temp cleanup and counters, never escape
+    # as raw OSError
+    "enospc-typed",
 )
 
 _SUPPRESS_RE = re.compile(r"#\s*m3lint:\s*disable=([\w,-]+)")
@@ -182,6 +188,12 @@ class Context:
     controller_files: tuple = ("m3_tpu/x/controller.py",
                                "m3_tpu/x/devguard.py",
                                "m3_tpu/server/assembly.py")
+    # round 20: trees whose durable write ops (fsync/replace/write-mode
+    # opens) must run inside capacity_guard (enospc-typed rule); the
+    # guard module itself is the blessed classification seam and exempt
+    capacity_prefixes: tuple = ("m3_tpu/persist/",
+                                "m3_tpu/aggregator/checkpoint.py")
+    capacity_helper_files: tuple = ("m3_tpu/persist/capacity.py",)
 
     def is_wire_module(self, path: str) -> bool:
         return (path in self.wire_files
@@ -201,6 +213,11 @@ class Context:
 
     def wants_timed(self, path: str) -> bool:
         return any(path.startswith(p) for p in self.timed_prefixes)
+
+    def is_capacity_module(self, path: str) -> bool:
+        if path in self.capacity_helper_files:
+            return False
+        return any(path.startswith(p) for p in self.capacity_prefixes)
 
 
 @dataclass
@@ -253,9 +270,9 @@ def apply_suppressions(unit: FileUnit, findings: Iterable[Finding]) -> List[Find
 
 def default_rules() -> List[Rule]:
     from m3_tpu.x.lint import (
-        actuator_rule, corruption, deadline_aware, devguard_rule,
-        faultcov, jaxlint, locks, metrics_rule, placement, purity,
-        registry_rule, resources, wirecheck,
+        actuator_rule, capacity_rule, corruption, deadline_aware,
+        devguard_rule, faultcov, jaxlint, locks, metrics_rule,
+        placement, purity, registry_rule, resources, wirecheck,
     )
 
     return [
@@ -276,6 +293,7 @@ def default_rules() -> List[Rule]:
         devguard_rule.check,
         registry_rule.check,
         actuator_rule.check,
+        capacity_rule.check,
     ]
 
 
@@ -283,14 +301,15 @@ def explain(rule: str) -> dict | None:
     """{why, bad, good} for a rule name, harvested from the rule
     modules' EXPLAIN tables (``cli lint --explain`` renders it)."""
     from m3_tpu.x.lint import (
-        actuator_rule, corruption, deadline_aware, devguard_rule,
-        faultcov, jaxlint, locks, metrics_rule, placement, purity,
-        registry_rule, resources, wirecheck,
+        actuator_rule, capacity_rule, corruption, deadline_aware,
+        devguard_rule, faultcov, jaxlint, locks, metrics_rule,
+        placement, purity, registry_rule, resources, wirecheck,
     )
 
     for mod in (jaxlint, locks, purity, wirecheck, faultcov, resources,
                 corruption, placement, deadline_aware, metrics_rule,
-                devguard_rule, registry_rule, actuator_rule):
+                devguard_rule, registry_rule, actuator_rule,
+                capacity_rule):
         entry = getattr(mod, "EXPLAIN", {}).get(rule)
         if entry is not None:
             return entry

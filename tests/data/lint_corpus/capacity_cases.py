@@ -1,0 +1,51 @@
+"""Seeded violations + clean twins for the enospc-typed rule.
+
+Four BAD sites (unguarded fsync / replace / write_bytes, untyped
+capacity OSError) and two clean counterparts (fully-guarded atomic
+write, typed DiskCapacityError raise).
+"""
+# m3lint: disable-file=fault-coverage
+# (the raw os.fsync seeds below are capacity-rule bait, not wire ops)
+
+import errno
+import os
+
+
+def bad_unguarded_fsync(path, data):
+    with open(path, "wb") as f:          # BAD: write-mode open, no guard
+        f.write(data)
+        os.fsync(f.fileno())             # BAD: fsync outside capacity_guard
+
+
+def bad_unguarded_replace(tmp, path):
+    os.replace(tmp, path)                # BAD: durable rename, no guard
+
+
+def bad_unguarded_write_bytes(path, data):
+    path.write_bytes(data)               # BAD: Path writer, no guard
+
+
+def bad_untyped_capacity_error(path):
+    raise OSError(errno.ENOSPC,          # BAD: capacity-shaped, untyped
+                  "no space left writing " + str(path))
+
+
+def good_guarded_atomic_write(capacity_guard, path, tmp, data):
+    with capacity_guard(path=path, component="fileset", op="write",
+                        cleanup=(tmp,)):
+        with open(tmp, "wb") as f:       # guarded: legal
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())         # guarded: legal
+        os.replace(tmp, path)            # guarded: legal
+
+
+def good_typed_capacity_error(DiskCapacityError, path):
+    raise DiskCapacityError(
+        OSError(errno.ENOSPC, "seed"),
+        "no space left writing " + str(path))
+
+
+def good_read_mode_open(path):
+    with open(path, "rb") as f:          # read mode: out of signal
+        return f.read()

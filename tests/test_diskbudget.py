@@ -1,0 +1,347 @@
+"""Disk-pressure tier: typed capacity errors, the disk budget ledger,
+and the torn temp-file matrix.
+
+Three halves of the round-20 contract:
+
+* ``capacity_guard`` classifies ENOSPC/EDQUOT into the typed
+  :class:`DiskCapacityError` (an ``OSError`` subclass, so every
+  existing handler keeps working), unlinks atomic-write temps on the
+  error path, and counts per component; every other ``OSError`` passes
+  through untyped.
+* ``x/diskbudget`` turns a root walk + watermarks into the OK/LOW/
+  CRITICAL verdict the mediator acts on, with the reserve band keeping
+  flush headroom CRITICAL regardless of ratio, and ``check_ingest``
+  shedding new writes typed and counted.
+* The injected-fault matrix: ENOSPC at the fileset / commitlog /
+  checkpoint faultpoints surfaces typed, litters no ``*.tmp*``, and
+  the site keeps serving once space returns; bootstrap sweeps any
+  survivors a hard kill left behind.
+"""
+
+import errno
+import os
+
+import numpy as np
+import pytest
+
+from m3_tpu.persist import capacity as cap
+from m3_tpu.persist.capacity import (
+    DiskCapacityError, capacity_guard, sweep_temp_files,
+)
+from m3_tpu.x import diskbudget, fault
+
+BLOCK = 2 * 3600 * 10**9
+START = (1_700_000_000 * 10**9) // BLOCK * BLOCK
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    cap.reset()
+    diskbudget.reset()
+    fault.disarm()
+    yield
+    cap.reset()
+    diskbudget.reset()
+    fault.disarm()
+
+
+class TestCapacityGuard:
+    def test_enospc_classified_typed(self, tmp_path):
+        with pytest.raises(DiskCapacityError) as ei:
+            with capacity_guard(path=tmp_path / "f", component="fileset",
+                                op="write"):
+                raise OSError(errno.ENOSPC, "no space left on device")
+        e = ei.value
+        assert isinstance(e, OSError)           # handlers keep working
+        assert e.errno == errno.ENOSPC
+        assert e.component == "fileset" and e.op == "write"
+        assert isinstance(e.__cause__, OSError)
+        assert cap.counters() == {"fileset.enospc": 1}
+        d = e.describe()
+        assert d["error_type"] == "DiskCapacityError"
+        assert d["component"] == "fileset"
+
+    def test_edquot_classified_typed(self):
+        with pytest.raises(DiskCapacityError) as ei:
+            with capacity_guard(component="snapshot", op="fsync"):
+                raise OSError(errno.EDQUOT, "quota exceeded")
+        assert ei.value.errno == errno.EDQUOT
+        assert cap.counters() == {"snapshot.enospc": 1}
+
+    def test_other_oserror_passes_through_untyped(self):
+        with pytest.raises(OSError) as ei:
+            with capacity_guard(component="fileset"):
+                raise OSError(errno.EACCES, "permission denied")
+        assert not isinstance(ei.value, DiskCapacityError)
+        assert cap.counters() == {}
+
+    def test_nested_guard_classifies_once(self):
+        with pytest.raises(DiskCapacityError):
+            with capacity_guard(component="outer"):
+                with capacity_guard(component="commitlog", op="write"):
+                    raise OSError(errno.ENOSPC, "no space")
+        # the inner guard owns the classification; the outer one must
+        # not re-wrap or re-count the already-typed error
+        assert cap.counters() == {"commitlog.enospc": 1}
+
+    def test_cleanup_unlinks_temp_on_error_path(self, tmp_path):
+        tmp = tmp_path / "vol.db.tmp"
+        keep = tmp_path / "vol.db"
+        tmp.write_bytes(b"half-written")
+        keep.write_bytes(b"published")
+        with pytest.raises(DiskCapacityError):
+            with capacity_guard(path=keep, component="fileset",
+                                cleanup=(tmp,)):
+                raise OSError(errno.ENOSPC, "no space")
+        assert not tmp.exists()                 # error path never litters
+        assert keep.read_bytes() == b"published"
+
+    def test_inject_bridges_faultpoint_to_enospc(self):
+        with fault.armed("capacity.test", "error"):
+            with pytest.raises(DiskCapacityError):
+                with capacity_guard(component="fileset", op="write"):
+                    cap.inject("capacity.test")
+        assert cap.counters() == {"fileset.enospc": 1}
+        # disarmed: a pure no-op
+        with capacity_guard(component="fileset"):
+            cap.inject("capacity.test")
+
+
+class TestSweepTempFiles:
+    def test_removes_both_temp_shapes_and_nothing_else(self, tmp_path):
+        (tmp_path / "data" / "ns" / "0").mkdir(parents=True)
+        (tmp_path / "checkpoint").mkdir()
+        torn = [
+            tmp_path / "data" / "ns" / "0" / "volume-0.db.tmp",
+            tmp_path / "checkpoint" / "agg.ckpt.tmpXk42Qz",
+        ]
+        for p in torn:
+            p.write_bytes(b"torn")
+        real = tmp_path / "data" / "ns" / "0" / "volume-0.db"
+        real.write_bytes(b"published")
+        outside = tmp_path / "node.json.tmp"    # not a swept dir
+        outside.write_bytes(b"x")
+        removed = sweep_temp_files(tmp_path)
+        assert sorted(removed) == sorted(str(p) for p in torn)
+        assert real.exists() and outside.exists()
+        assert sweep_temp_files(tmp_path) == []
+
+
+class TestDiskBudget:
+    def _fill(self, root, rel, size):
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        with open(p, "wb") as f:
+            f.write(b"\0" * size)
+
+    def test_configure_validates_watermark_order(self, tmp_path):
+        with pytest.raises(ValueError):
+            diskbudget.configure(tmp_path, capacity=1000,
+                                 low_ratio=0.1, critical_ratio=0.25)
+
+    def test_quota_mode_watermark_ladder(self, tmp_path):
+        diskbudget.configure(tmp_path, capacity=10_000, reserve=0,
+                             low_ratio=0.25, critical_ratio=0.10)
+        self._fill(tmp_path, "data/vol.db", 5_000)
+        snap = diskbudget.refresh()
+        assert snap["level"] == "ok" and snap["free_bytes"] == 5_000
+        assert snap["components"] == {"filesets": 5_000}
+
+        self._fill(tmp_path, "commitlogs/commitlog-0.db", 3_000)
+        snap = diskbudget.refresh()                 # free 2000 / 10000
+        assert snap["level"] == "low"
+        assert diskbudget.level() == "low" and not diskbudget.shedding()
+        assert snap["components"]["commitlog"] == 3_000
+
+        self._fill(tmp_path, "ballast.fill", 1_500)  # free 500 -> 0.05
+        snap = diskbudget.refresh()
+        assert snap["level"] == "critical" and diskbudget.shedding()
+        assert snap["components"]["other"] == 1_500  # stray bytes counted
+
+    def test_reserve_band_forces_critical(self, tmp_path):
+        diskbudget.configure(tmp_path, capacity=10_000, reserve=2_000,
+                             low_ratio=0.25, critical_ratio=0.10)
+        self._fill(tmp_path, "data/vol.db", 8_500)   # ratio 0.15 > crit
+        snap = diskbudget.refresh()
+        assert snap["free_ratio"] > snap["critical_ratio"]
+        assert snap["level"] == "critical"           # free <= reserve
+
+    def test_check_ingest_sheds_typed_and_counts(self, tmp_path):
+        diskbudget.configure(tmp_path, capacity=1_000, reserve=0,
+                             low_ratio=0.25, critical_ratio=0.10)
+        self._fill(tmp_path, "data/vol.db", 990)
+        diskbudget.refresh()
+        with pytest.raises(DiskCapacityError) as ei:
+            diskbudget.check_ingest()
+        assert ei.value.component == "ingest" and ei.value.op == "admit"
+        with pytest.raises(DiskCapacityError):
+            diskbudget.check_ingest()
+        assert diskbudget.counters() == {"diskbudget.shed_total": 2}
+        assert diskbudget.snapshot()["shed_total"] == 2
+        # space comes back -> admission reopens, counter is cumulative
+        (tmp_path / "data" / "vol.db").unlink()
+        diskbudget.refresh()
+        diskbudget.check_ingest()
+        assert diskbudget.counters() == {"diskbudget.shed_total": 2}
+
+    def test_snapshot_stub_before_first_refresh(self, tmp_path):
+        diskbudget.configure(tmp_path, capacity=1_000)
+        snap = diskbudget.snapshot()            # no walk yet: benign OK
+        assert snap["enabled"] and snap["level"] == "ok"
+        assert not diskbudget.shedding()
+
+    def test_statvfs_mode_reads_real_headroom(self, tmp_path):
+        diskbudget.configure(tmp_path, capacity=0, reserve=0)
+        snap = diskbudget.refresh()
+        assert snap["total_bytes"] > 0
+        assert 0.0 <= snap["free_ratio"] <= 1.0
+        assert snap["level"] in diskbudget.LEVELS
+
+    def test_reset_disarms(self, tmp_path):
+        diskbudget.configure(tmp_path, capacity=1_000)
+        assert diskbudget.enabled()
+        diskbudget.reset()
+        assert not diskbudget.enabled()
+        assert diskbudget.snapshot()["enabled"] is False
+
+
+class TestTornWriteMatrix:
+    """Satellite matrix: ENOSPC injected at each persistence faultpoint
+    surfaces typed, litters nothing, and the site serves once space
+    returns."""
+
+    def test_fileset_write_enospc(self, tmp_path):
+        from m3_tpu.persist.fs import DataFileSetReader, DataFileSetWriter
+
+        series = [(b"sid", b"segment-bytes")]
+        with fault.armed("fileset.write", "error"):
+            with pytest.raises(DiskCapacityError) as ei:
+                DataFileSetWriter(tmp_path, "ns", 0, START,
+                                  BLOCK).write_all(series)
+        assert ei.value.component == "fileset"
+        assert cap.counters().get("fileset.enospc", 0) >= 1
+        assert not list(tmp_path.rglob("*.tmp*"))    # no litter
+        # disarmed: the same write succeeds and reads back
+        DataFileSetWriter(tmp_path, "ns", 0, START, BLOCK).write_all(series)
+        r = DataFileSetReader(tmp_path, "ns", 0, START, 0)
+        assert r.read(b"sid") == b"segment-bytes"
+
+    def test_commitlog_write_enospc(self, tmp_path):
+        from m3_tpu.persist.commitlog import (
+            CommitLogWriter, FsyncPolicy, read_commitlog,
+        )
+
+        w = CommitLogWriter(tmp_path, fsync=FsyncPolicy.EVERY_WRITE)
+        ts = np.asarray([START], np.int64)
+        vals = np.asarray([1.5], np.float64)
+        with fault.armed("commitlog.write", "error"):
+            with pytest.raises(DiskCapacityError) as ei:
+                w.write_batch([b"a"], ts, vals)
+        assert ei.value.component == "commitlog"
+        # the writer survives the shed append: the next write lands
+        w.write_batch([b"b"], ts, vals)
+        w.close()
+        got = [e.series_id for e in read_commitlog(w.path)]
+        assert got == [b"b"]
+        assert cap.counters().get("commitlog.enospc", 0) >= 1
+
+    def test_checkpoint_write_enospc(self, tmp_path):
+        from m3_tpu.aggregator.checkpoint import load_lists, save_lists
+
+        path = tmp_path / "checkpoint" / "agg.ckpt"
+        with fault.armed("checkpoint.write", "error"):
+            with pytest.raises(DiskCapacityError) as ei:
+                save_lists({}, path)
+        assert ei.value.component == "checkpoint"
+        assert not list(tmp_path.rglob("*.tmp*"))    # mkstemp cleaned
+        assert not path.exists()                     # nothing half-published
+        save_lists({}, path)
+        header, _arrays = load_lists(path)
+        assert header["lists"] == []
+        assert cap.counters().get("checkpoint.enospc", 0) >= 1
+
+    def test_failed_flush_retains_buffer_and_retries(self, tmp_path):
+        """Flush ordering is peek -> write -> discard: an ENOSPC
+        mid-flush must leave every sealed sample buffered and readable,
+        and the next tick's retry lands it durably (drain-first would
+        drop the window on the floor until a WAL replay)."""
+        from m3_tpu.storage.database import (
+            Database, DatabaseOptions, NamespaceOptions,
+        )
+
+        def make_db():
+            return Database(
+                DatabaseOptions(root=str(tmp_path)),
+                {"default": NamespaceOptions(
+                    block_size_nanos=BLOCK,
+                    retention_nanos=48 * 3600 * 10**9,
+                    buffer_past_nanos=10 * 60 * 10**9,
+                    buffer_future_nanos=2 * 60 * 10**9,
+                    num_shards=2,
+                    slot_capacity=1 << 10,
+                    sample_capacity=1 << 12,
+                )},
+            )
+
+        db = make_db()
+        try:
+            db.bootstrap()
+            ts = np.asarray([START + 10**9], np.int64)
+            db.write_batch("default", [b"sid"], ts,
+                           np.asarray([1.0], np.float64))
+            with fault.armed("fileset.write", "error"):
+                with pytest.raises(DiskCapacityError):
+                    db.tick(START + BLOCK + 40 * 60 * 10**9)
+            assert not list(tmp_path.rglob("*.tmp*"))
+            # still served from the buffer after the failed flush
+            assert db.read("default", b"sid", START,
+                           START + BLOCK) == [(START + 10**9, 1.0)]
+            # space back -> the retry flushes the retained window
+            db.tick(START + BLOCK + 80 * 60 * 10**9)
+            assert db.read("default", b"sid", START,
+                           START + BLOCK) == [(START + 10**9, 1.0)]
+        finally:
+            db.close()
+        db2 = make_db()
+        try:
+            db2.bootstrap()
+            assert db2.read("default", b"sid", START,
+                            START + BLOCK) == [(START + 10**9, 1.0)]
+        finally:
+            db2.close()
+
+    def test_bootstrap_sweeps_litter_and_node_serves(self, tmp_path):
+        from m3_tpu.storage.database import (
+            Database, DatabaseOptions, NamespaceOptions,
+        )
+
+        torn = [
+            tmp_path / "data" / "default" / "0" / "volume-0.db.tmp",
+            tmp_path / "checkpoint" / "agg.ckpt.tmpQ7x1Zx",
+        ]
+        for p in torn:
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_bytes(b"torn by a hard kill mid-write")
+        db = Database(
+            DatabaseOptions(root=str(tmp_path)),
+            {"default": NamespaceOptions(
+                block_size_nanos=BLOCK,
+                retention_nanos=48 * 3600 * 10**9,
+                buffer_past_nanos=10 * 60 * 10**9,
+                buffer_future_nanos=2 * 60 * 10**9,
+                num_shards=2,
+                slot_capacity=1 << 10,
+                sample_capacity=1 << 12,
+            )},
+        )
+        try:
+            stats = db.bootstrap()
+            assert stats["temp_files_swept"] == len(torn)
+            assert not list(tmp_path.rglob("*.tmp*"))
+            ts = np.asarray([START + 10**9], np.int64)
+            db.write_batch("default", [b"sid"], ts,
+                           np.asarray([2.0], np.float64))
+            assert db.read("default", b"sid", START,
+                           START + BLOCK) == [(START + 10**9, 2.0)]
+        finally:
+            db.close()

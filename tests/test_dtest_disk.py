@@ -1,0 +1,227 @@
+"""Round-20 acceptance dtest: disk pressure on a live cluster — fill to
+CRITICAL, shed typed, reclaim via the controller, relax back.
+
+3 real node processes (rf=3, shared remote KV) under sustained Majority
+ingest, each running its x/diskbudget ledger in capacity-quota mode
+(one real filesystem under every node, so statvfs would watermark them
+all at once).  Ballast-filling node 1's root to a free ratio below the
+critical watermark must drive the full loop:
+
+* node 1 goes CRITICAL and sheds NEW ingest with the typed
+  DiskCapacityError — ``disk_level`` and ``disk_ingest_shed_total``
+  move on /metrics, the /health ``disk`` section appears (degraded-
+  only), and the Majority session keeps acking through the other two
+  replicas (never acked = never lost),
+* reads keep serving from the pressured node (the reserve exists so
+  the paths that make and serve data always have room),
+* the ``disk-pressure`` SLO rule — level-based ``max_over_time`` over
+  node 1's self-stored ``disk_free_ratio`` history, so only SUSTAINED
+  pressure fires it — trips the controller, which pulses the
+  ``emergency_cleanup`` actuator through the typed registry,
+* the ballast releases, the window washes out, the rule clears,
+* ZERO acked-sample loss throughout (the soak ledger's regenerate-
+  and-reread verify at Majority),
+* the whole episode — watermark dip AND controller pulse — is
+  retro-queryable as PromQL over ``_m3_selfmon`` FROM A PEER (node 0
+  fleet-scraped node 1's gauges).
+"""
+
+import json
+import re
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from m3_tpu.dtest.soak import (
+    NS, Ledger, SoakCluster, SoakConfig, WorkloadGen, _verify,
+)
+
+
+def _health(cluster, k):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{cluster.http_port(k)}/health",
+            timeout=30) as r:
+        return json.load(r)
+
+
+def _controller(cluster, k):
+    return _health(cluster, k).get("controller") or {}
+
+
+def _rule_firing(cluster, k, rule):
+    doc = (_health(cluster, k).get("slo") or {}).get("rules", {}).get(rule)
+    return doc is not None and doc.get("firing") is True
+
+
+def _metric(cluster, k, name):
+    """First un-labeled sample of ``name`` on node k's /metrics."""
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{cluster.http_port(k)}/metrics",
+            timeout=30) as r:
+        text = r.read().decode()
+    m = re.search(rf"^{re.escape(name)} ([0-9.eE+-]+)$", text, re.M)
+    return float(m.group(1)) if m else None
+
+
+@pytest.mark.slow
+class TestDiskPressureScenario:
+    def test_fill_shed_cleanup_release(self, tmp_path):
+        cfg = SoakConfig(
+            nodes=3, series=4000, batch=1000, num_shards=4,
+            slot_capacity=1 << 16, churn=0.0, smoke=True,  # 1s ticks
+            replace=False, selfmon_budget=4000,
+            controller_fire_ticks=2, controller_clear_ticks=3,
+            controller_hold_ticks=1, controller_min_interval="2s",
+            disk_capacity="192M", disk_reserve="4M",
+            disk_rule="disk-pressure",
+        )
+        cluster = SoakCluster(cfg, tmp_path / "cluster")
+        try:
+            cluster.start()
+            gen = WorkloadGen(cfg.series, cfg.churn, cfg.seed)
+            ledger = Ledger(gen)
+            stop = threading.Event()
+
+            def ingest():
+                sweep = 0
+                while not stop.is_set():
+                    for lo in range(0, cfg.series, cfg.batch):
+                        if stop.is_set():
+                            break
+                        hi = min(lo + cfg.batch, cfg.series)
+                        ids = gen.ids(sweep, lo, hi)
+                        vals = gen.values(sweep, lo, hi)
+                        ts = time.time_ns()
+                        tsa = np.full(hi - lo, ts, np.int64)
+                        try:
+                            rejected = cluster.session.write_batch(
+                                NS, ids, tsa, vals, now_nanos=ts)
+                        except Exception:  # noqa: BLE001 — unacked
+                            stop.wait(0.2)
+                            continue
+                        if not rejected:
+                            ledger.ack_bulk(sweep, lo, hi, ts)
+                    sweep += 1
+
+            t = threading.Thread(target=ingest, daemon=True)
+            t.start()
+
+            # -- baseline: ledger live, controller bound, all quiet ---
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                ctl = _controller(cluster, 1)
+                if (ctl.get("enabled")
+                        and "disk-burn" in ctl.get("bindings", {})
+                        and _metric(cluster, 1, "disk_level") is not None):
+                    break
+                time.sleep(1.0)
+            else:
+                pytest.fail("disk ledger/controller never appeared on "
+                            f"node 1: {_controller(cluster, 1)}")
+            assert _metric(cluster, 1, "disk_level") == 0.0
+            assert _metric(cluster, 1, "disk_ingest_shed_total") == 0.0
+            assert "disk" not in _health(cluster, 1)  # degraded-only
+
+            # -- fill node 1 to CRITICAL (free ~0.05 < crit 0.10) -----
+            cluster.disk_fill(1, 0.05)
+
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline:
+                if (_metric(cluster, 1, "disk_level") == 2.0
+                        and (_metric(cluster, 1,
+                                     "disk_ingest_shed_total") or 0) > 0):
+                    break
+                time.sleep(1.0)
+            else:
+                pytest.fail(
+                    "node 1 never went CRITICAL + shedding: level="
+                    f"{_metric(cluster, 1, 'disk_level')} shed="
+                    f"{_metric(cluster, 1, 'disk_ingest_shed_total')}")
+            # the degradation is visible and diagnosable on /health
+            disk = _health(cluster, 1).get("disk") or {}
+            assert disk.get("level") == "critical", disk
+            assert disk.get("shed_total", 0) > 0
+            # reads keep serving FROM the pressured node (the reserve
+            # band exists exactly so the read/flush paths never starve)
+            rows = cluster.promql(
+                1, 'disk_free_ratio{instance="i1"}',
+                namespace="_m3_selfmon")
+            assert rows, "node 1 stopped serving queries under pressure"
+
+            # -- the loop closes: sustained low watermark history fires
+            #    disk-pressure, the controller pulses emergency_cleanup
+            deadline = time.monotonic() + 180
+            pulse = None
+            while time.monotonic() < deadline:
+                ctl = _controller(cluster, 1)
+                recent = ctl.get("recent", [])
+                hits = [a for a in recent
+                        if a["actuator"] == "emergency_cleanup"
+                        and a["action"] == "shed"]
+                if hits:
+                    pulse = hits
+                    break
+                time.sleep(2.0)
+            else:
+                pytest.fail("controller never pulsed emergency_cleanup; "
+                            f"health={_controller(cluster, 1)}")
+            assert any(a["rule"] == "disk-pressure" for a in pulse)
+            # a pulse actuator rests at baseline by construction
+            act = _controller(cluster, 1)["actuators"]["emergency_cleanup"]
+            assert act["at_baseline"] is True and act["sheds"] >= 1
+
+            # -- release: ballast gone, window washes out, rule clears,
+            #    admission reopens ------------------------------------
+            cluster.disk_release(1)
+            deadline = time.monotonic() + 240
+            while time.monotonic() < deadline:
+                if (_metric(cluster, 1, "disk_level") == 0.0
+                        and not _rule_firing(cluster, 1, "disk-pressure")):
+                    break
+                time.sleep(2.0)
+            else:
+                pytest.fail(
+                    "node 1 never relaxed back to OK: level="
+                    f"{_metric(cluster, 1, 'disk_level')} "
+                    f"firing={_rule_firing(cluster, 1, 'disk-pressure')}")
+            shed_at_release = _metric(cluster, 1, "disk_ingest_shed_total")
+            time.sleep(3.0)   # a few post-release ingest rounds
+            assert _metric(
+                cluster, 1, "disk_ingest_shed_total") == shed_at_release
+
+            # -- zero acked-sample loss throughout --------------------
+            stop.set()
+            t.join(60)
+            assert ledger.acked_samples > 0
+            for k in cluster.alive_nodes():
+                cluster.nodes[k].wait_healthy(120)
+            verdict = _verify(cluster, ledger, cfg)
+            assert verdict["zero_acked_loss"], verdict
+
+            # -- the episode is one PromQL query away from a PEER -----
+            deadline = time.monotonic() + 90
+            dip = pulse_actions = None
+            while time.monotonic() < deadline:
+                rows = cluster.promql(
+                    0, 'min_over_time(disk_free_ratio'
+                       '{instance="i1"}[15m])',
+                    namespace="_m3_selfmon")
+                dip = float(rows[0]["value"][1]) if rows else None
+                rows = cluster.promql(
+                    0, 'max_over_time(m3tpu_controller_action'
+                       '{instance="i1",actuator="emergency_cleanup"}[15m])',
+                    namespace="_m3_selfmon")
+                pulse_actions = {r["metric"].get("action") for r in rows}
+                if dip is not None and dip <= cfg.disk_crit \
+                        and "shed" in pulse_actions:
+                    break
+                time.sleep(2.0)
+            assert dip is not None and dip <= cfg.disk_crit, (
+                f"peer-readable watermark history missing the dip: {dip}")
+            assert "shed" in pulse_actions, (
+                f"peer-readable cleanup pulse missing: {pulse_actions}")
+        finally:
+            cluster.close()

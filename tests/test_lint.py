@@ -41,7 +41,12 @@ PERMISSIVE = Context(dtype_prefixes=("",), wire_prefixes=("",),
                      # the costwatch registry (inverse checks anchor
                      # only in declared home files)
                      registry_prefixes=("registry_cases",),
-                     registry_cost_file="")
+                     registry_cost_file="",
+                     # enospc-typed is pinned to its own corpus file:
+                     # other corpus files legitimately fsync/replace
+                     # as bait for fault-coverage/resource-hygiene
+                     capacity_prefixes=("capacity_cases",),
+                     capacity_helper_files=())
 
 EXPECTED = {
     ("lock_cases.py", "lock-discipline", 22),
@@ -119,6 +124,12 @@ EXPECTED = {
     ("actuator_cases.py", "actuator-typed", 20),  # devguard.force_fallback
     ("actuator_cases.py", "actuator-typed", 25),  # breaker force_open
     ("actuator_cases.py", "actuator-typed", 30),  # devguard.configure
+    # round 20: typed disk-capacity error seeds
+    ("capacity_cases.py", "enospc-typed", 15),   # write-mode open, no guard
+    ("capacity_cases.py", "enospc-typed", 17),   # raw os.fsync
+    ("capacity_cases.py", "enospc-typed", 21),   # raw os.replace
+    ("capacity_cases.py", "enospc-typed", 25),   # raw .write_bytes
+    ("capacity_cases.py", "enospc-typed", 29),   # untyped ENOSPC OSError
 }
 
 
@@ -150,7 +161,8 @@ class TestCorpus:
                      "placement-cas", "deadline-aware", "retrace-risk",
                      "transfer-hygiene", "dtype-stability",
                      "constant-bloat", "metric-hygiene", "device-guard",
-                     "registry-complete", "actuator-typed"):
+                     "registry-complete", "actuator-typed",
+                     "enospc-typed"):
             assert len(by_rule.get(rule, [])) >= 2, rule
 
 
@@ -447,6 +459,35 @@ class TestActuatorScope:
                     "m3_tpu/server/assembly.py"):
             got = self._lint_at(tmp_path, rel)
             assert not any(f.rule == "actuator-typed" for f in got), rel
+
+
+class TestCapacityScope:
+    """Round 20: the DEFAULT context aims enospc-typed at persist/ and
+    the aggregator checkpoint — every durable write op there must run
+    inside capacity_guard — while persist/capacity.py (the guard's own
+    home, which performs the raw classification) stays exempt."""
+
+    RAW = ("import os\n"
+           "def sideline(tmp, path):\n"
+           "    os.replace(tmp, path)\n")
+
+    def _lint_at(self, tmp_path, rel):
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(self.RAW)
+        return lint_file(p, tmp_path, Context())
+
+    def test_fires_in_persist_and_checkpoint(self, tmp_path):
+        for rel in ("m3_tpu/persist/fs2.py",
+                    "m3_tpu/aggregator/checkpoint.py"):
+            got = self._lint_at(tmp_path, rel)
+            assert any(f.rule == "enospc-typed" for f in got), rel
+
+    def test_guard_home_and_out_of_scope_exempt(self, tmp_path):
+        for rel in ("m3_tpu/persist/capacity.py",
+                    "m3_tpu/storage/database.py"):
+            got = self._lint_at(tmp_path, rel)
+            assert not any(f.rule == "enospc-typed" for f in got), rel
 
 
 class TestExplain:

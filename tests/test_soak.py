@@ -127,6 +127,12 @@ class _FakeOps:
     def replace(self, node):
         self._rec("replace", node)
 
+    def disk_fill(self, node, target):
+        self._rec("disk_fill", node, target)
+
+    def disk_release(self, node):
+        self._rec("disk_release", node)
+
 
 class _FakeClock:
     def __init__(self):
@@ -209,17 +215,22 @@ class TestChaosScheduler:
         sactions = [e.action for e in smoke]
         assert "wire_fault" in sactions and "kill" not in sactions
         assert "device_fault" in sactions
+        assert "disk_pressure" in sactions  # round 20: smoke disk window
         assert [e.arg for e in smoke if e.action == "phase"] == \
-            ["healthy", "wire_faults", "device_faults", "recovered"]
+            ["healthy", "wire_faults", "device_faults", "disk_pressure",
+             "recovered"]
         # t_device=0 removes the window entirely
         nodev = build_timeline(SoakConfig.smoke_config(t_device=0.0))
         assert "device_fault" not in [e.action for e in nodev]
+        # the disk window needs BOTH a duration and a capacity quota
+        nodisk = build_timeline(SoakConfig.smoke_config(disk_capacity=""))
+        assert "disk_pressure" not in [e.action for e in nodisk]
 
     def test_selfheal_phase_is_opt_in_and_sustained(self):
         heal = build_timeline(SoakConfig.smoke_config(selfheal=True))
         labels = [e.arg for e in heal if e.action == "phase"]
         assert labels == ["healthy", "wire_faults", "device_faults",
-                          "selfheal", "recovered"]
+                          "disk_pressure", "selfheal", "recovered"]
         sus = [e for e in heal if e.action == "sustained"]
         assert len(sus) == 1 and sus[0].hold_s > 0
         # the window closes before the recovered phase mark
@@ -295,6 +306,47 @@ class TestSustainedEvents:
         assert [e["fired_at_s"] for e in log] == [2.0, 4.0, 8.0]
         # the run-seed stamping still applies to the expanded arm
         assert "seed=17" in ops.calls[0][2]
+
+
+class TestDiskPressureEvents:
+    """Round-20 ``disk_pressure`` chaos verb: ballast-fill a node's
+    root to a target FREE ratio; with ``hold_s`` the scheduler appends
+    the matching ``disk_release`` (the sustained-window idiom)."""
+
+    def test_eager_validation(self):
+        with pytest.raises(ValueError):  # not a float
+            chaos.ChaosEvent(0.0, "disk_pressure", node=0, arg="full")
+        with pytest.raises(ValueError):  # a percentage, not a ratio
+            chaos.ChaosEvent(0.0, "disk_pressure", node=0, arg="15")
+        with pytest.raises(ValueError):  # needs a target node
+            chaos.ChaosEvent(0.0, "disk_pressure", arg="0.2")
+        with pytest.raises(ValueError):  # hold_s still kill-rejected
+            chaos.ChaosEvent(0.0, "kill", node=0, hold_s=5.0)
+        ev = chaos.ChaosEvent(0.0, "disk_pressure", node=0, arg="0.2",
+                              hold_s=4.0)
+        assert ev.hold_s == 4.0  # windowed form allowed
+
+    def test_windowed_fill_expands_to_release(self):
+        ev = chaos.ChaosEvent(2.0, "disk_pressure", node=1, arg="0.15",
+                              hold_s=6.0)
+        out = chaos.expand_sustained([ev])
+        assert [(e.at_s, e.action, e.node) for e in out] == [
+            (2.0, "disk_pressure", 1), (8.0, "disk_release", 1)]
+        assert out[0].arg == "0.15"
+        # un-windowed fill passes through untouched (release scripted
+        # explicitly, or deliberately never)
+        bare = chaos.ChaosEvent(1.0, "disk_pressure", node=0, arg="0.3")
+        assert chaos.expand_sustained([bare]) == [bare]
+
+    def test_scheduler_dispatches_fill_then_release(self):
+        ops, clk = _FakeOps(), _FakeClock()
+        sched = chaos.ChaosScheduler(
+            [chaos.ChaosEvent(1.0, "disk_pressure", node=1, arg="0.2",
+                              hold_s=3.0)],
+            ops, clock=clk, sleep=clk.sleep)
+        log = sched.run()
+        assert ops.calls == [("disk_fill", 1, 0.2), ("disk_release", 1)]
+        assert [e["fired_at_s"] for e in log] == [1.0, 4.0]
 
 
 # ---------------------------------------------------------------------------
